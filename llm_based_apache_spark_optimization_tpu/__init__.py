@@ -1,0 +1,28 @@
+"""TPU-native NL->Spark-SQL studio.
+
+A from-scratch, TPU-first framework with the capabilities of the reference
+`Rajwardhan0511/LLM-Based-Apache-Spark-Optimization` (see SURVEY.md): a
+natural-language data studio where a CSV + English question become Spark SQL
+via a text-to-SQL LLM, the SQL is executed, results exported and recorded in a
+query-history store, and failures are diagnosed by a second LLM — with the LLM
+inference engine **in-tree** as a JAX/XLA stack (reference delegates it to an
+out-of-process Ollama/llama.cpp sidecar, reference `Flask/app.py:102-107`).
+
+Subpackages (bottom-up):
+  models/      Llama-family transformer definitions (pure-functional JAX)
+  ops/         numerical building blocks: rmsnorm, rope, attention, sampling,
+               Pallas TPU kernels
+  engine/      generation runtime: KV cache, prefill/decode, samplers
+  parallel/    device mesh, TP/DP/SP sharding, ring attention, collectives
+  checkpoint/  weight loading (HF safetensors -> sharded jax.Arrays)
+  tokenizer/   in-tree BPE tokenizers (+ optional HF tokenizer.json loader)
+  serve/       model registry + generation service + continuous batching
+  sql/         Spark-parity SQL execution backends (CSV -> temp_view -> SQL)
+  history/     query_results history store (sqlite default, MySQL optional)
+  app/         web layer: WSGI micro-framework, Flask-parity UI,
+               FastAPI-parity JSON API
+  evalh/       evaluation harness (exact match / edit distance / latency)
+  utils/       config, logging, timing/tracing
+"""
+
+__version__ = "0.1.0"

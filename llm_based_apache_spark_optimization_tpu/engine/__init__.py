@@ -1,0 +1,4 @@
+"""Generation runtime: KV cache, prefill/decode loop, engine wrapper."""
+
+from .generate import InferenceEngine, make_generate_fn  # noqa: F401
+from .kvcache import bucket_len, cache_bytes, init_cache  # noqa: F401

@@ -1,0 +1,146 @@
+"""Autoregressive generation: batched prefill + `lax.while_loop` decode.
+
+This module is the heart of the in-tree engine that replaces the reference's
+`ollama.generate(...)` calls (reference `Flask/app.py:102-107,160-166`,
+`FastAPI/app.py:85-90,105-111`). One jit-compiled function per
+(batch, prompt-bucket, max_new, sampling) signature does:
+
+    prefill (all prompt tokens at once, MXU-bound)
+      -> sample first token from each sequence's last real logit
+      -> while_loop decode (one token/step, HBM-bandwidth-bound)
+         with per-sequence stop-token handling and early exit when
+         every sequence is done.
+
+TPU/XLA notes:
+- The whole generate call is ONE XLA program: no host round-trip per token.
+  The while_loop carries the KV cache; XLA keeps it in HBM and updates it
+  in place.
+- Early exit is real: the loop condition is `step < max_new & ~all(done)`,
+  so a batch of short SQL answers doesn't pay for the longest possible
+  completion.
+- Prompt lengths are bucketed (engine/kvcache.bucket_len) so the number of
+  distinct compilations stays small; compiled fns are cached per signature.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.configs import LlamaConfig
+from ..models.llama import Params, forward
+from ..ops.sampling import SamplingParams, sample
+from .kvcache import bucket_len, init_cache
+
+
+def _is_stop(tok: jnp.ndarray, stop_ids: Tuple[int, ...]) -> jnp.ndarray:
+    hit = jnp.zeros(tok.shape, jnp.bool_)
+    for s in stop_ids:
+        hit = hit | (tok == s)
+    return hit
+
+
+@functools.lru_cache(maxsize=64)
+def make_generate_fn(
+    cfg: LlamaConfig,
+    max_new: int,
+    sampling: SamplingParams,
+    stop_ids: Tuple[int, ...],
+):
+    """Build + jit a generate function for a fixed decode budget and sampler.
+
+    Returned fn: (params, tokens [B,T] i32, lengths [B] i32, key) ->
+    (out_tokens [B, max_new] i32, gen_lens [B] i32). Cached so repeated calls
+    with the same signature reuse the compiled executable.
+    """
+    pad_id = cfg.pad_id
+
+    def gen(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray, key: jax.Array):
+        b, t = tokens.shape
+        cache = init_cache(cfg, b, t + max_new, dtype=params["embed"].dtype)
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
+        # Unembed only each sequence's last real position: sampling never looks
+        # at the other T-1 logits, and skipping them drops the [B, T, V]
+        # prefill unembed to [B, 1, V].
+        logits, cache = forward(
+            cfg, params, tokens, positions, cache, logit_indices=lengths - 1
+        )
+        first = sample(logits[:, 0], sampling, jax.random.fold_in(key, 0))
+        done = _is_stop(first, stop_ids)
+        out = jnp.full((b, max_new), pad_id, jnp.int32)
+        out = out.at[:, 0].set(first)
+
+        def cond(carry):
+            out, cur, pos, done, cache, step = carry
+            return (step < max_new) & ~jnp.all(done)
+
+        def body(carry):
+            out, cur, pos, done, cache, step = carry
+            logits, cache = forward(cfg, params, cur[:, None], pos[:, None], cache)
+            nxt = sample(logits[:, 0], sampling, jax.random.fold_in(key, step))
+            nxt = jnp.where(done, pad_id, nxt)
+            done = done | _is_stop(nxt, stop_ids)
+            out = lax.dynamic_update_slice(out, nxt[:, None], (0, step))
+            return (out, nxt, pos + 1, done, cache, step + 1)
+
+        carry = (out, first, lengths.astype(jnp.int32), done, cache, jnp.int32(1))
+        out, _, _, done, _, _ = lax.while_loop(cond, body, carry)
+
+        stops = _is_stop(out, stop_ids)
+        gen_lens = jnp.where(
+            jnp.any(stops, axis=1),
+            jnp.argmax(stops, axis=1).astype(jnp.int32) + 1,
+            jnp.int32(max_new),
+        )
+        return out, gen_lens
+
+    return jax.jit(gen)
+
+
+class InferenceEngine:
+    """Convenience host-side wrapper: ragged python prompts -> ragged outputs.
+
+    Pads/buckets prompts, dispatches to the cached jitted generate fn, and
+    slices per-sequence completions. This is the object the serve/ registry
+    holds per model name.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: Params,
+        stop_ids: Optional[Sequence[int]] = None,
+        prompt_bucket: int = 128,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.stop_ids = tuple(stop_ids) if stop_ids is not None else (cfg.eos_id,)
+        self.prompt_bucket = prompt_bucket
+
+    def generate(
+        self,
+        prompts: List[List[int]],
+        max_new_tokens: int = 256,
+        sampling: SamplingParams = SamplingParams(),
+        seed: int = 0,
+    ) -> List[List[int]]:
+        assert prompts and all(len(p) >= 1 for p in prompts), "empty prompt"
+        b = len(prompts)
+        t = bucket_len(max(len(p) for p in prompts), self.prompt_bucket)
+        if t + max_new_tokens > self.cfg.max_seq_len:
+            raise ValueError(
+                f"bucketed prompt ({t}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds model context max_seq_len={self.cfg.max_seq_len}"
+            )
+        tokens = jnp.asarray(
+            [p + [self.cfg.pad_id] * (t - len(p)) for p in prompts], jnp.int32
+        )
+        lengths = jnp.asarray([len(p) for p in prompts], jnp.int32)
+        fn = make_generate_fn(self.cfg, int(max_new_tokens), sampling, self.stop_ids)
+        out, gen_lens = fn(self.params, tokens, lengths, jax.random.key(seed))
+        out, gen_lens = jax.device_get(out), jax.device_get(gen_lens)
+        return [list(map(int, out[i, : gen_lens[i]])) for i in range(b)]

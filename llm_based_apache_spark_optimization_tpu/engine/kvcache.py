@@ -1,0 +1,50 @@
+"""Preallocated KV cache in device memory (HBM on TPU).
+
+Replaces llama.cpp's KV-cache management (the reference's context handling all
+lives inside Ollama — SURVEY.md §5 "Long-context"). Layout:
+
+    {"k": [L, B, S_max, K, H], "v": [L, B, S_max, K, H]}
+
+- Leading L axis matches the scan-over-layers parameter stacking in
+  models/llama.py, so one `lax.scan` carries cache slices alongside weights.
+- The whole generate call (prefill + decode loop) is one jitted XLA program:
+  the cache is allocated inside it and carried through the `lax.while_loop`,
+  so XLA keeps it in HBM and updates it in place across decode steps — no
+  per-step realloc or host round-trip. (There is deliberately no cross-call
+  buffer reuse yet; a persistent donated cache arrives with the continuous
+  batching scheduler in serve/.)
+- Invariant (relied on by ops/attention.py): every cache slot with index
+  <= a live query position holds that sequence's real token K/V. Prefill
+  writes slots [0, T); right-padding garbage beyond a sequence's length is
+  overwritten by decode exactly when it would first become visible.
+
+Sizing: bf16 cache for duckdb-nsql-7B at B=32, S=4096 is
+2*32*32*4096*128*2B*32L ≈ 4.3 GiB — fits v5e-8 sharded over TP=4/8 on the KV
+heads axis (parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from ..models.configs import LlamaConfig
+
+
+def init_cache(
+    cfg: LlamaConfig, batch: int, max_seq: int, dtype=jnp.bfloat16
+) -> Dict[str, jnp.ndarray]:
+    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_bytes(cfg: LlamaConfig, batch: int, max_seq: int, itemsize: int = 2) -> int:
+    return (
+        2 * cfg.num_layers * batch * max_seq * cfg.num_kv_heads * cfg.head_dim * itemsize
+    )
+
+
+def bucket_len(n: int, bucket: int = 128) -> int:
+    """Round a sequence length up to a bucket so jit recompiles are bounded."""
+    return ((n + bucket - 1) // bucket) * bucket

@@ -1,0 +1,14 @@
+"""Llama-family model definitions (pure-functional JAX)."""
+
+from .configs import (  # noqa: F401
+    BENCH_1B,
+    DUCKDB_NSQL_7B,
+    LLAMA32_1B,
+    LLAMA32_3B,
+    MISTRAL_7B,
+    REGISTRY,
+    TINY,
+    LlamaConfig,
+    RopeScaling,
+)
+from .llama import forward, init_params  # noqa: F401

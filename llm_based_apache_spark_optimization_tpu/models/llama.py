@@ -1,0 +1,162 @@
+"""Llama-family transformer as a pure-functional JAX model.
+
+This is the in-tree replacement for the GGUF models llama.cpp executes for the
+reference app (reference `Flask/app.py:102-107`, `FastAPI/app.py:85-90`): one
+parameterized architecture covering duckdb-nsql-7B (Llama-2 shape), Llama-3.2
+1B/3B (GQA, tied embeddings, llama3 rope scaling) and Mistral-7B
+(sliding window) — see `models/configs.py`.
+
+TPU-first design decisions:
+
+- **Params are a plain pytree** (nested dict of `jax.Array`), not a module
+  object: shardings attach via `jax.tree.map` + `NamedSharding`, the same tree
+  flows through `jit`/`shard_map`/checkpointing with zero framework friction.
+- **Per-layer weights are stacked on a leading [L, ...] axis** and the block
+  stack runs under `jax.lax.scan`. XLA traces ONE block instead of L copies:
+  compile time and program size stay flat as models deepen (32-layer 7B
+  compiles as fast as the 2-layer test model, modulo constant folding).
+- **One forward for prefill and decode**: the call is "run T tokens whose
+  cache-write starts at per-sequence positions"; T=prompt_len is prefill, T=1
+  is decode. Static shapes per (B, T) bucket, no dynamic control flow in jit.
+- Matmuls run in the params dtype (bf16 on TPU -> MXU native); softmax, norms
+  and rope run in f32; logits return in f32 for stable sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.attention import attention_mask, gqa_attention
+from ..ops.norm import rms_norm
+from ..ops.rope import apply_rope, rope_cos_sin
+from .configs import LlamaConfig
+
+Params = Dict[str, jnp.ndarray]
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init params with the exact tree structure the weight loader fills.
+
+    Init scale follows the standard 1/sqrt(fan_in) so random-weight smoke
+    models produce finite logits at any depth.
+    """
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    nh, kh, hd, L = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.num_layers
+    keys = jax.random.split(key, 9)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * fan_in**-0.5).astype(dtype)
+
+    params: Params = {
+        "embed": w(keys[0], (cfg.vocab_size, d), d),
+        "blocks": {
+            "wq": w(keys[1], (L, d, nh * hd), d),
+            "wk": w(keys[2], (L, d, kh * hd), d),
+            "wv": w(keys[3], (L, d, kh * hd), d),
+            "wo": w(keys[4], (L, nh * hd, d), nh * hd),
+            "wg": w(keys[5], (L, d, f), d),
+            "wu": w(keys[6], (L, d, f), d),
+            "wd": w(keys[7], (L, f, d), f),
+            "ln_attn": jnp.ones((L, d), dtype),
+            "ln_mlp": jnp.ones((L, d), dtype),
+        },
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(keys[8], (cfg.vocab_size, d), d)
+    return params
+
+
+def _update_cache(cache: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
+    """Write `new` [B, T, K, H] into `cache` [B, S, K, H] at per-batch offsets.
+
+    vmap of dynamic_update_slice lowers to an efficient batched scatter; each
+    sequence writes a contiguous [T, K, H] block starting at its own position.
+    """
+    return jax.vmap(
+        lambda c, n, s: lax.dynamic_update_slice(c, n, (s, 0, 0))
+    )(cache, new, start.astype(jnp.int32))
+
+
+def forward(
+    cfg: LlamaConfig,
+    params: Params,
+    tokens: jnp.ndarray,      # [B, T] int32
+    positions: jnp.ndarray,   # [B, T] int32 — absolute position of each token
+    cache: Optional[Dict[str, jnp.ndarray]] = None,  # {"k","v"}: [L, B, S, K, H]
+    logit_indices: Optional[jnp.ndarray] = None,  # [B] int32 — unembed only these T-indices
+) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
+    """Run T tokens through the stack; returns (logits f32, cache').
+
+    With `cache=None` a transient [B, T] cache is used (pure prefill-only
+    forward, e.g. for scoring); with a cache dict, K/V are written at
+    `positions[:, 0] + t` and attention reads the full cache buffer.
+
+    `logit_indices=None` returns full [B, T, V] logits. Passing per-sequence
+    indices [B] gathers the hidden state *before* the unembed matmul and
+    returns [B, 1, V] — during prefill only the last real token's logits are
+    ever sampled, and skipping the [B, T, V] unembed saves a T-times-larger
+    matmul and its f32 output buffer (V=128k makes this the dominant prefill
+    cost at long T).
+    """
+    b, t = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    start = positions[:, 0]
+
+    if cache is None:
+        kv_size = t
+    else:
+        kv_size = cache["k"].shape[2]
+    mask = attention_mask(positions, kv_size, cfg.sliding_window)
+
+    nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def block(x, layer_in):
+        p, k_cache, v_cache = layer_in
+        h = rms_norm(x, p["ln_attn"], cfg.norm_eps)
+        q = (h @ p["wq"]).reshape(b, t, nh, hd)
+        k = (h @ p["wk"]).reshape(b, t, kh, hd)
+        v = (h @ p["wv"]).reshape(b, t, kh, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if k_cache is None:
+            k_full, v_full = k, v
+            k_out = v_out = None
+        else:
+            k_full = _update_cache(k_cache, k, start)
+            v_full = _update_cache(v_cache, v, start)
+            k_out, v_out = k_full, v_full
+        attn = gqa_attention(q, k_full, v_full, mask)
+        x = x + attn.reshape(b, t, nh * hd) @ p["wo"]
+        h2 = rms_norm(x, p["ln_mlp"], cfg.norm_eps)
+        gate = jax.nn.silu((h2 @ p["wg"]).astype(jnp.float32)).astype(x.dtype)
+        x = x + (gate * (h2 @ p["wu"])) @ p["wd"]
+        return x, (k_out, v_out)
+
+    if cache is None:
+        # scan with no cache arrays: feed Nones via a python loop over stacked
+        # params is wasteful; instead run scan with dummy empty caches.
+        def block_nocache(x, p):
+            y, _ = block(x, (p, None, None))
+            return y, None
+        x, _ = lax.scan(block_nocache, x, params["blocks"])
+        new_cache = None
+    else:
+        x, (k_new, v_new) = lax.scan(
+            block, x, (params["blocks"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": k_new, "v": v_new}
+
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    if logit_indices is not None:
+        x = jnp.take_along_axis(
+            x, logit_indices.astype(jnp.int32)[:, None, None], axis=1
+        )  # [B, 1, D]
+    unembed = params["embed"] if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,vd->btv", x, unembed, preferred_element_type=jnp.float32)
+    return logits, new_cache

@@ -1,0 +1,69 @@
+"""Grouped-query attention over a preallocated KV cache (XLA reference path).
+
+This replaces the role of llama.cpp's attention kernels in the reference app
+(reference `Flask/app.py:102-107` delegates all inference to Ollama). The TPU
+story:
+
+- One code path serves both prefill (T = prompt length) and decode (T = 1):
+  both are a causal read of the same [B, S_max, K, H] cache buffers, masked by
+  integer query positions. Static shapes in, so one jit-compilation per
+  (B, T) bucket and everything tiles onto the MXU.
+- GQA is expressed by reshaping Q to [B, T, K, G, H] and contracting per KV
+  head — no materialized K/V repetition (repeating would multiply HBM traffic
+  by the group size, and HBM bandwidth is the decode bottleneck).
+- Scores and softmax accumulate in float32; inputs/outputs stay bf16.
+- A Pallas flash/ragged kernel (ops/pallas/) is swapped in behind
+  `EngineConfig.use_pallas_attention` for the cases XLA's fusion leaves
+  bandwidth on the table; this einsum path is the always-correct fallback and
+  the golden reference in tests.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .common import NEG_INF
+
+
+def attention_mask(
+    q_positions: jnp.ndarray,
+    kv_size: int,
+    sliding_window: Optional[int] = None,
+) -> jnp.ndarray:
+    """Boolean [B, T, S] mask: key slot s visible to query at position p iff s <= p.
+
+    Cache slots beyond a sequence's current length hold garbage (padded prefill
+    writes); they sit at slots > p so causality alone hides them — no separate
+    length mask is needed (see engine/kvcache.py invariant).
+    """
+    kv_idx = jnp.arange(kv_size, dtype=jnp.int32)[None, None, :]
+    qp = q_positions.astype(jnp.int32)[:, :, None]
+    mask = kv_idx <= qp
+    if sliding_window is not None:
+        mask = mask & (qp - kv_idx < sliding_window)
+    return mask
+
+
+def gqa_attention(
+    q: jnp.ndarray,  # [B, T, N, H]
+    k: jnp.ndarray,  # [B, S, K, H]
+    v: jnp.ndarray,  # [B, S, K, H]
+    mask: jnp.ndarray,  # [B, T, S] bool
+) -> jnp.ndarray:
+    """Returns [B, T, N, H]. N = K * G."""
+    b, t, n, h = q.shape
+    s = k.shape[1]
+    kh = k.shape[2]
+    g = n // kh
+    scale = h ** -0.5
+    q5 = q.reshape(b, t, kh, g, h)
+    # [B, K, G, T, S] score tensor, f32 accumulation on the MXU.
+    scores = jnp.einsum("btkgh,bskh->bkgts", q5, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v)
+    return out.reshape(b, t, n, h)

@@ -1,0 +1,20 @@
+"""RMSNorm — the normalization used across the Llama family.
+
+Computed in float32 regardless of activation dtype (bf16 accumulation of the
+mean-square loses enough precision to visibly shift logits on long prompts),
+then cast back. XLA fuses the whole thing into neighboring ops; no Pallas
+needed here.
+"""
+
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

@@ -1,0 +1,71 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency rescaling.
+
+Design notes (TPU-first):
+- cos/sin tables are computed on the fly from integer positions rather than
+  precomputed-and-gathered: a gather of [S, H/2] from HBM is
+  bandwidth-bound, while computing `pos * inv_freq` is a handful of VPU ops
+  that XLA fuses into the surrounding attention projections for free.
+- We use the "split-half" rotation layout (rotate pairs (x[..., :h/2],
+  x[..., h/2:])), matching the HF Llama checkpoint convention so converted
+  safetensors weights work unmodified (see checkpoint/loader.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..models.configs import RopeScaling
+
+
+def _inv_freq(head_dim: int, theta: float, scaling: Optional[RopeScaling]) -> jnp.ndarray:
+    """Inverse frequencies [head_dim/2] in float32, with llama3 rescaling."""
+    exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta ** exponents)
+    if scaling is None:
+        return inv_freq
+    # Llama-3 rescaling: wavelengths longer than original_ctx/low_freq_factor
+    # are slowed by `factor`; shorter than original_ctx/high_freq_factor kept;
+    # smooth ramp in between.
+    old_ctx = scaling.original_max_position_embeddings
+    low_wl = old_ctx / scaling.low_freq_factor
+    high_wl = old_ctx / scaling.high_freq_factor
+    wavelen = 2.0 * jnp.pi / inv_freq
+    smooth = (old_ctx / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor
+    )
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    scaled = (1.0 - smooth) * inv_freq / scaling.factor + smooth * inv_freq
+    return jnp.where(
+        wavelen > low_wl,
+        inv_freq / scaling.factor,
+        jnp.where(wavelen < high_wl, inv_freq, scaled),
+    )
+
+
+def rope_cos_sin(
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float,
+    scaling: Optional[RopeScaling] = None,
+):
+    """cos/sin tables for integer `positions` [...]; returns ([..., h/2], [..., h/2])."""
+    inv_freq = _inv_freq(head_dim, theta, scaling)
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., h/2]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate `x` [..., n_heads, head_dim] by per-position cos/sin [..., head_dim/2].
+
+    cos/sin broadcast over the heads axis: x is [B, S, N, H], cos is [B, S, H/2].
+    """
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    c = cos[..., None, :]  # [B, S, 1, H/2]
+    s = sin[..., None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

@@ -1,0 +1,1 @@
+"""Device mesh, sharding specs, tensor/sequence parallelism, collectives."""

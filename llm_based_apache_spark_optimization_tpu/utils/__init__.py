@@ -1,0 +1,1 @@
+"""Config, logging, timing/tracing utilities."""

@@ -1,0 +1,44 @@
+"""Test harness configuration.
+
+All tests run on CPU JAX with 8 virtual devices — the standard way to test
+pjit/mesh/collective code without real TPU chips (SURVEY.md §4). Must run
+before jax initializes, hence the env mutation at import time.
+"""
+
+import os
+
+# Force CPU: the ambient environment pins jax to the 'axon' TPU tunnel (its
+# sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") in every
+# process, which overrides the JAX_PLATFORMS env var); tests must be hermetic
+# and run on the virtual 8-device CPU mesh, so we override at the config layer
+# too, before any backend is initialized.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_model():
+    """(cfg, params) for the tiny test config, f32 for CPU exactness."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+
+    params = init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+    return TINY, params
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,88 @@
+"""Engine tests: generate loop, stop tokens, sampling, batching raggedness."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+from llm_based_apache_spark_optimization_tpu.engine.generate import make_generate_fn
+from llm_based_apache_spark_optimization_tpu.models import forward
+from llm_based_apache_spark_optimization_tpu.ops import SamplingParams
+from llm_based_apache_spark_optimization_tpu.ops.sampling import sample
+
+
+def test_greedy_generate_matches_manual_loop(tiny_model):
+    """The jitted while_loop decode must equal a hand-rolled argmax loop."""
+    cfg, params = tiny_model
+    prompt = [1, 17, 42, 99]
+    eng = InferenceEngine(cfg, params, stop_ids=(cfg.eos_id,), prompt_bucket=8)
+    got = eng.generate([prompt], max_new_tokens=6)[0]
+
+    # Manual: full forward re-run per step (no cache), greedy.
+    seq = list(prompt)
+    want = []
+    for _ in range(6):
+        tokens = jnp.asarray([seq], jnp.int32)
+        pos = jnp.arange(len(seq), dtype=jnp.int32)[None]
+        logits, _ = forward(cfg, params, tokens, pos, None)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        want.append(nxt)
+        if nxt == cfg.eos_id:
+            break
+        seq.append(nxt)
+    assert got == want
+
+
+def test_ragged_batch_equals_individual_runs(tiny_model):
+    """Batching with different prompt lengths must not change any sequence."""
+    cfg, params = tiny_model
+    prompts = [[1, 5], [1, 9, 13, 21, 7], [1, 200, 30]]
+    eng = InferenceEngine(cfg, params, prompt_bucket=8)
+    batched = eng.generate(prompts, max_new_tokens=5)
+    for p, b in zip(prompts, batched):
+        single = eng.generate([p], max_new_tokens=5)[0]
+        assert single == b
+
+
+def test_stop_token_truncates_and_pads(tiny_model):
+    cfg, params = tiny_model
+    # Pick a stop id we know greedy decode will emit: run once, then use the
+    # 3rd generated token as the stop id.
+    eng = InferenceEngine(cfg, params, prompt_bucket=8)
+    free = eng.generate([[1, 2, 3]], max_new_tokens=6)[0]
+    stop = free[2]
+    first_idx = free.index(stop)  # greedy may emit the same id earlier
+    eng2 = InferenceEngine(cfg, params, stop_ids=(stop,), prompt_bucket=8)
+    got = eng2.generate([[1, 2, 3]], max_new_tokens=6)[0]
+    assert got == free[: first_idx + 1]
+    assert got[-1] == stop
+
+
+def test_topp_sampling_valid_and_reproducible(tiny_model):
+    cfg, params = tiny_model
+    sp = SamplingParams(temperature=0.8, top_p=0.9)
+    eng = InferenceEngine(cfg, params, prompt_bucket=8)
+    a = eng.generate([[1, 4, 7]], max_new_tokens=8, sampling=sp, seed=42)
+    b = eng.generate([[1, 4, 7]], max_new_tokens=8, sampling=sp, seed=42)
+    c = eng.generate([[1, 4, 7]], max_new_tokens=8, sampling=sp, seed=43)
+    assert a == b
+    assert all(0 <= t < cfg.vocab_size for t in a[0])
+    # Different seed should (overwhelmingly) differ somewhere in 8 tokens.
+    assert a != c or len(a[0]) == 0
+
+
+def test_top_p_masks_tail():
+    logits = jnp.asarray([[3.0, 2.9, -5.0, -6.0]], jnp.float32)
+    sp = SamplingParams(temperature=1.0, top_p=0.9)
+    counts = set()
+    for s in range(20):
+        tok = sample(logits, sp, jax.random.key(s))
+        counts.add(int(tok[0]))
+    assert counts <= {0, 1}  # tail tokens masked out
+
+
+def test_generate_fn_cache_reuse(tiny_model):
+    cfg, params = tiny_model
+    f1 = make_generate_fn(cfg, 8, SamplingParams(), (2,))
+    f2 = make_generate_fn(cfg, 8, SamplingParams(), (2,))
+    assert f1 is f2

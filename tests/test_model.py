@@ -1,0 +1,154 @@
+"""Model-layer unit tests: shapes, rope, norm, attention semantics, cache parity.
+
+These are the pure-unit tier of the test pyramid SURVEY.md §4 mandates (the
+reference has no tests; its only check is a live eval harness).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.engine import init_cache
+from llm_based_apache_spark_optimization_tpu.models import TINY, forward, init_params
+from llm_based_apache_spark_optimization_tpu.models.configs import RopeScaling
+from llm_based_apache_spark_optimization_tpu.ops import (
+    apply_rope,
+    attention_mask,
+    gqa_attention,
+    rms_norm,
+    rope_cos_sin,
+)
+
+
+def test_rms_norm_matches_numpy():
+    x = np.random.default_rng(0).normal(size=(2, 5, 16)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(16,)).astype(np.float32)
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)
+    want = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_rope_rotation_preserves_norm_and_is_position_dependent():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 4, 2, 8)), jnp.float32)
+    pos = jnp.arange(4, dtype=jnp.int32)[None, :]
+    cos, sin = rope_cos_sin(pos, 8, 10000.0)
+    y = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        rtol=1e-5,
+    )
+    # Position 0 => identity rotation.
+    np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(x[:, 0]), rtol=1e-5)
+    # Later positions differ.
+    assert not np.allclose(np.asarray(y[:, 1]), np.asarray(x[:, 1]))
+
+
+def test_rope_llama3_scaling_changes_low_freqs_only():
+    # Large position so the low-frequency angle difference is visible in sin.
+    pos = jnp.asarray([[5000]], jnp.int32)
+    _, sin_a = rope_cos_sin(pos, 64, 500000.0, None)
+    _, sin_b = rope_cos_sin(
+        pos, 64, 500000.0, RopeScaling(factor=8.0, original_max_position_embeddings=8192)
+    )
+    a, b = np.asarray(sin_a)[0, 0], np.asarray(sin_b)[0, 0]
+    # Highest-frequency band (first entry) unchanged; lowest band slowed 8x.
+    np.testing.assert_allclose(a[0], b[0], rtol=1e-5)
+    assert abs(a[-1] - b[-1]) > 1e-4
+
+
+def test_attention_mask_causal_and_sliding_window():
+    pos = jnp.asarray([[3]], jnp.int32)  # single decode query at position 3
+    m = attention_mask(pos, 8)
+    np.testing.assert_array_equal(
+        np.asarray(m)[0, 0], [True] * 4 + [False] * 4
+    )
+    m2 = attention_mask(pos, 8, sliding_window=2)
+    np.testing.assert_array_equal(
+        np.asarray(m2)[0, 0], [False, False, True, True, False, False, False, False]
+    )
+
+
+def test_gqa_matches_mha_when_kv_repeated():
+    rng = np.random.default_rng(0)
+    b, t, n, k, h = 2, 4, 4, 2, 8
+    q = jnp.asarray(rng.normal(size=(b, t, n, h)), jnp.float32)
+    kv_k = jnp.asarray(rng.normal(size=(b, t, k, h)), jnp.float32)
+    kv_v = jnp.asarray(rng.normal(size=(b, t, k, h)), jnp.float32)
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    mask = attention_mask(pos, t)
+    out_gqa = gqa_attention(q, kv_k, kv_v, mask)
+    # Repeat KV heads to full MHA and compare.
+    rep_k = jnp.repeat(kv_k, n // k, axis=2)
+    rep_v = jnp.repeat(kv_v, n // k, axis=2)
+    out_mha = gqa_attention(q, rep_k, rep_v, mask)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha), rtol=1e-5, atol=1e-5)
+
+
+def test_forward_shapes_and_finite(tiny_model):
+    cfg, params = tiny_model
+    tokens = jnp.asarray([[1, 5, 9, 2], [1, 7, 2, 0]], jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(4, dtype=jnp.int32)[None], (2, 4))
+    logits, cache = forward(cfg, params, tokens, pos, None)
+    assert logits.shape == (2, 4, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert cache is None
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_cached_incremental_forward_matches_full_forward(tiny_model):
+    """Prefill+decode through the cache == one full no-cache forward."""
+    cfg, params = tiny_model
+    rng = np.random.default_rng(3)
+    seq = rng.integers(3, cfg.vocab_size, size=12).tolist()
+    full_tokens = jnp.asarray([seq], jnp.int32)
+    full_pos = jnp.arange(12, dtype=jnp.int32)[None]
+    full_logits, _ = forward(cfg, params, full_tokens, full_pos, None)
+
+    # Prefill 8 tokens, then decode 4 one at a time.
+    cache = init_cache(cfg, 1, 16, dtype=jnp.float32)
+    pre_logits, cache = forward(
+        cfg, params, jnp.asarray([seq[:8]], jnp.int32),
+        jnp.arange(8, dtype=jnp.int32)[None], cache,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pre_logits[0]), np.asarray(full_logits[0, :8]), rtol=2e-4, atol=2e-4
+    )
+    for i in range(8, 12):
+        step_logits, cache = forward(
+            cfg, params, jnp.asarray([[seq[i]]], jnp.int32),
+            jnp.asarray([[i]], jnp.int32), cache,
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits[0, 0]), np.asarray(full_logits[0, i]),
+            rtol=2e-4, atol=2e-4,
+        )
+
+
+def test_causality_future_tokens_do_not_affect_past_logits(tiny_model):
+    cfg, params = tiny_model
+    pos = jnp.arange(6, dtype=jnp.int32)[None]
+    a = jnp.asarray([[1, 5, 9, 11, 13, 2]], jnp.int32)
+    b = jnp.asarray([[1, 5, 9, 200, 201, 202]], jnp.int32)
+    la, _ = forward(cfg, params, a, pos, None)
+    lb, _ = forward(cfg, params, b, pos, None)
+    np.testing.assert_allclose(
+        np.asarray(la[0, :3]), np.asarray(lb[0, :3]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_untied_head_used_when_config_untied():
+    from llm_based_apache_spark_optimization_tpu.models.configs import LlamaConfig
+    import dataclasses
+
+    cfg = LlamaConfig(
+        name="tiny-untied", vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_layers=1, num_heads=2, num_kv_heads=2, head_dim=8, max_seq_len=32,
+    )
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    assert "lm_head" in params
+    tokens = jnp.asarray([[1, 2, 3]], jnp.int32)
+    pos = jnp.arange(3, dtype=jnp.int32)[None]
+    logits, _ = forward(cfg, params, tokens, pos, None)
+    assert logits.shape == (1, 3, 64)
