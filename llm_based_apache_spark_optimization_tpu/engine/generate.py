@@ -34,6 +34,7 @@ from jax import lax
 from ..models.configs import LlamaConfig
 from ..models.llama import Params, forward
 from ..ops.sampling import SamplingParams, sample
+from ..parallel.sharding import constrain_cache, shard_batch, shard_params
 from .kvcache import bucket_len, init_cache
 
 
@@ -50,18 +51,25 @@ def make_generate_fn(
     max_new: int,
     sampling: SamplingParams,
     stop_ids: Tuple[int, ...],
+    mesh=None,
 ):
     """Build + jit a generate function for a fixed decode budget and sampler.
 
     Returned fn: (params, tokens [B,T] i32, lengths [B] i32, key) ->
     (out_tokens [B, max_new] i32, gen_lens [B] i32). Cached so repeated calls
     with the same signature reuse the compiled executable.
+
+    With a `jax.sharding.Mesh`, the KV cache allocated inside the program is
+    pinned to the TP×DP layout (parallel/sharding.cache_spec); params/tokens
+    carry their own NamedShardings in, and GSPMD lays the collectives.
     """
     pad_id = cfg.pad_id
 
     def gen(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray, key: jax.Array):
         b, t = tokens.shape
         cache = init_cache(cfg, b, t + max_new, dtype=params["embed"].dtype)
+        if mesh is not None:
+            cache = constrain_cache(cache, mesh)
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
         # Unembed only each sequence's last real position: sampling never looks
         # at the other T-1 logits, and skipping them drops the [B, T, V]
@@ -115,8 +123,12 @@ class InferenceEngine:
         params: Params,
         stop_ids: Optional[Sequence[int]] = None,
         prompt_bucket: int = 128,
+        mesh=None,
     ):
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            params = shard_params(params, cfg, mesh)
         self.params = params
         self.stop_ids = tuple(stop_ids) if stop_ids is not None else (cfg.eos_id,)
         self.prompt_bucket = prompt_bucket
@@ -136,11 +148,21 @@ class InferenceEngine:
                 f"bucketed prompt ({t}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds model context max_seq_len={self.cfg.max_seq_len}"
             )
+        padded = list(prompts)
+        if self.mesh is not None:
+            # The batch axis shards over dp; pad with dummy rows to a multiple
+            # of dp (sliced off after decode) so any request count works.
+            dp = self.mesh.shape["dp"]
+            padded += [[self.cfg.bos_id]] * (-b % dp)
         tokens = jnp.asarray(
-            [p + [self.cfg.pad_id] * (t - len(p)) for p in prompts], jnp.int32
+            [p + [self.cfg.pad_id] * (t - len(p)) for p in padded], jnp.int32
         )
-        lengths = jnp.asarray([len(p) for p in prompts], jnp.int32)
-        fn = make_generate_fn(self.cfg, int(max_new_tokens), sampling, self.stop_ids)
+        lengths = jnp.asarray([len(p) for p in padded], jnp.int32)
+        if self.mesh is not None:
+            tokens, lengths = shard_batch((tokens, lengths), self.mesh)
+        fn = make_generate_fn(
+            self.cfg, int(max_new_tokens), sampling, self.stop_ids, self.mesh
+        )
         out, gen_lens = fn(self.params, tokens, lengths, jax.random.key(seed))
         out, gen_lens = jax.device_get(out), jax.device_get(gen_lens)
         return [list(map(int, out[i, : gen_lens[i]])) for i in range(b)]

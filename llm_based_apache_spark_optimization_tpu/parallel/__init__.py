@@ -1,1 +1,12 @@
-"""Device mesh, sharding specs, tensor/sequence parallelism, collectives."""
+"""Device mesh, TP/DP sharding specs, collective-by-construction parallelism."""
+
+from .mesh import make_mesh  # noqa: F401
+from .sharding import (  # noqa: F401
+    batch_spec,
+    cache_spec,
+    constrain_cache,
+    param_specs,
+    shard_batch,
+    shard_params,
+    validate_tp,
+)

@@ -1,0 +1,41 @@
+"""Device mesh construction for TP×DP sharded inference.
+
+The reference has no distributed layer at all — its only parallelism is
+whatever llama.cpp does on one host (SURVEY.md §2.4). Here the mesh is the
+foundation: every sharded object (params, KV cache, token batches) is placed
+by `NamedSharding(mesh, PartitionSpec(...))` and XLA GSPMD compiles the
+communication (all-reduce after row-parallel matmuls) onto ICI.
+
+Axes:
+  dp — data/request parallelism: batch dimension of serving requests.
+  tp — tensor parallelism: attention heads / MLP hidden dim (Megatron-style).
+
+A v5e-8 slice is typically meshed as dp=2, tp=4 or dp=1, tp=8 (BASELINE.json
+configs 4/5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    dp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, tp) mesh over `devices` (default: all local devices).
+
+    tp is placed on the fastest-varying axis so tensor-parallel collectives
+    ride neighboring ICI links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if dp * tp != len(devices):
+        raise ValueError(f"dp*tp = {dp * tp} != device count {len(devices)}")
+    arr = np.asarray(devices).reshape(dp, tp)
+    return Mesh(arr, ("dp", "tp"))

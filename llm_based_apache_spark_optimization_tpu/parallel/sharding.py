@@ -1,0 +1,101 @@
+"""Sharding rules: how the Llama param tree, KV cache and batches partition.
+
+Replaces the role of NCCL/MPI in GPU serving stacks — sharding specs are the
+*whole* communication story here: annotate placements, and XLA GSPMD inserts
+the all-reduce after row-parallel matmuls (wo, wd) and any resharding moves,
+compiled onto ICI (SURVEY.md §2.4, §5 "Distributed communication backend").
+
+Megatron-style tensor parallelism over the "tp" axis:
+
+  wq/wk/wv [L, D, heads*H]  column-parallel  -> shard last dim
+  wo       [L, N*H, D]      row-parallel     -> shard first (contracted) dim
+  wg/wu    [L, D, F]        column-parallel  -> shard last dim
+  wd       [L, F, D]        row-parallel     -> shard contracted dim
+  embed / lm_head / norms   replicated (vocab matmul is negligible at decode;
+                            vocab-sharded unembed is a later optimization)
+
+KV cache [L, B, S, K, H] shards batch over "dp" and KV heads over "tp" —
+each chip holds only its own heads' cache, which is what makes the 7B
+batch=32 cache fit (engine/kvcache.py sizing note).
+
+Constraint: num_heads and num_kv_heads must divide by tp (checked in
+`validate_tp`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.configs import LlamaConfig
+
+Pytree = Any
+
+
+def validate_tp(cfg: LlamaConfig, tp: int) -> None:
+    if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={cfg.num_heads} and "
+            f"num_kv_heads={cfg.num_kv_heads} ({cfg.name})"
+        )
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpec tree matching models.llama.init_params exactly."""
+    specs: Dict[str, Any] = {
+        "embed": P(None, None),
+        "blocks": {
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "wg": P(None, None, "tp"),
+            "wu": P(None, None, "tp"),
+            "wd": P(None, "tp", None),
+            "ln_attn": P(None, None),
+            "ln_mlp": P(None, None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, None)
+    return specs
+
+
+def cache_spec() -> P:
+    """[L, B, S, K, H]: batch over dp, KV heads over tp."""
+    return P(None, "dp", None, "tp", None)
+
+
+def batch_spec(ndim: int = 2) -> P:
+    """[B, ...] batches: rows over dp, remaining dims replicated."""
+    return P("dp", *([None] * (ndim - 1)))
+
+
+def shard_params(params: Pytree, cfg: LlamaConfig, mesh: Mesh) -> Pytree:
+    """Place a (host or single-device) param tree onto the mesh."""
+    validate_tp(cfg, mesh.shape["tp"])
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_batch(tokens: Pytree, mesh: Mesh) -> Pytree:
+    """Place [B, ...] host arrays row-sharded over dp, replicated otherwise."""
+    def put(x):
+        return jax.device_put(x, NamedSharding(mesh, batch_spec(x.ndim)))
+    return jax.tree.map(put, tokens)
+
+
+def constrain_cache(cache: Pytree, mesh: Mesh) -> Pytree:
+    """Pin the in-program KV cache layout (called inside jit)."""
+    return jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, cache_spec())
+        ),
+        cache,
+    )
