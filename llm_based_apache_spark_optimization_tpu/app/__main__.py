@@ -1,0 +1,95 @@
+"""Run the studio: `python -m llm_based_apache_spark_optimization_tpu.app`.
+
+Wires the web UI (or headless JSON API with --api) to a generation service:
+  --backend tiny   in-tree TINY model + byte tokenizer, random weights —
+                   real engine path end-to-end without checkpoint assets
+  --backend fake   canned deterministic responses (demo/tests)
+Real checkpoints plug in through checkpoint/ + serve/ once weights exist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..history import SQLiteHistory
+from ..serve import EngineBackend, FakeBackend, GenerationService
+from ..sql import default_backend
+from .api import create_api_app
+from .config import AppConfig
+from .web import create_web_app
+
+
+def make_tiny_service(max_new_tokens: int) -> GenerationService:
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..engine import InferenceEngine
+    from ..models import TINY, init_params
+    from ..tokenizer import ByteTokenizer
+
+    # TINY's CI context (128) is smaller than a schema prompt; a longer
+    # context costs nothing (rope tables are computed on the fly).
+    cfg = dataclasses.replace(TINY, name="tiny-demo", max_seq_len=2048)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    tok = ByteTokenizer()
+    svc = GenerationService()
+    for name in ("duckdb-nsql", "llama3.2"):
+        eng = InferenceEngine(cfg, params, stop_ids=(cfg.eos_id,), prompt_bucket=64)
+        svc.register(name, EngineBackend(eng, tok, max_new_tokens=max_new_tokens))
+    return svc
+
+
+def make_fake_service() -> GenerationService:
+    svc = GenerationService()
+    svc.register(
+        "duckdb-nsql",
+        FakeBackend(lambda p: "SELECT * FROM temp_view LIMIT 10"),
+    )
+    svc.register(
+        "llama3.2",
+        FakeBackend(lambda p: "Check that the referenced columns exist in the schema."),
+    )
+    return svc
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="llm_based_apache_spark_optimization_tpu.app")
+    ap.add_argument("--api", action="store_true", help="headless JSON API instead of the web UI")
+    ap.add_argument("--backend", choices=("tiny", "fake"), default="fake")
+    ap.add_argument("--host", default=None)
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU jax platform (hermetic demo)")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    cfg = AppConfig.from_env()
+    if args.host:
+        cfg = type(cfg)(**{**cfg.__dict__, "host": args.host})
+    if args.port:
+        cfg = type(cfg)(**{**cfg.__dict__, "port": args.port})
+    cfg.ensure_dirs()
+
+    # max_new small for the tiny demo model: it babbles bytes, not SQL.
+    service = (make_tiny_service(32) if args.backend == "tiny"
+               else make_fake_service())
+    history = SQLiteHistory(cfg.history_db)
+    factory = create_api_app if args.api else create_web_app
+    # Pass the backend factory, not an instance: each request gets an
+    # isolated SQL session (own connection + temp_view).
+    app = factory(service, default_backend, history, cfg)
+    kind = "JSON API" if args.api else "web UI"
+    print(f"serving {kind} on http://{cfg.host}:{cfg.port} "
+          f"(backend={args.backend})", file=sys.stderr)
+    app.serve(cfg.host, cfg.port)
+
+
+if __name__ == "__main__":
+    main()

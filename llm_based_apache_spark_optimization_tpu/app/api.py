@@ -1,0 +1,75 @@
+"""Headless JSON API — parity with the reference's FastAPI service.
+
+`POST /process-data/` takes `{"input_text": ..., "file_name": ...}` where the
+file must already exist in the input dir (no upload — reference
+`FastAPI/app.py:62-73`), and returns the §2.2 contract shapes verbatim:
+
+  missing file  → {"error": "CSV file not found at <path>"}
+  SQL failure   → {"error": "SQL execution failed", "sql_query", "error_details"}
+  success       → {"message": "Query executed successfully!", "input_file_name",
+                   "input_data", "sql_query", "output_file"}
+
+(`FastAPI/app.py:72-73,112-116,138-144`.)
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..history.store import HistoryStore
+from ..serve.service import GenerationService
+from ..sql.backend import SQLBackend
+from .config import AppConfig
+from .pipeline import Pipeline
+from .wsgi import App, Request, Response
+
+
+def create_api_app(
+    service: GenerationService,
+    sql_backend: SQLBackend,
+    history: HistoryStore | None,
+    config: AppConfig | None = None,
+) -> App:
+    cfg = config or AppConfig.from_env()
+    cfg.ensure_dirs()
+    pipeline = Pipeline(service, sql_backend, history, cfg)
+    app = App(secret_key=cfg.secret_key)
+
+    @app.route("/process-data/", methods=("POST",))
+    def process_data(req: Request) -> Response:
+        try:
+            data = req.json()
+        except Exception:
+            return Response.json({"error": "invalid JSON body"}, status=400)
+        input_text = data.get("input_text", "")
+        file_name = data.get("file_name", "")
+        # Bare names only: os.path.join would happily follow "../" or an
+        # absolute path out of the input dir.
+        if not file_name or os.path.basename(file_name) != file_name:
+            return Response.json({"error": "invalid file name"}, status=400)
+        file_path = os.path.join(cfg.input_dir, file_name)
+        if not os.path.exists(file_path):
+            return Response.json({"error": "CSV file not found at " + file_path})
+        result = pipeline.run(file_path, input_text)
+        if not result.ok:
+            return Response.json({
+                "error": "SQL execution failed",
+                "sql_query": result.sql_query,
+                "error_details": result.error_solution,
+            })
+        return Response.json({
+            "message": "Query executed successfully!",
+            "input_file_name": result.input_file_name,
+            "input_data": result.input_data,
+            "sql_query": result.sql_query,
+            "output_file": result.output_file,
+        })
+
+    @app.route("/models")
+    def models(req: Request) -> Response:
+        return Response.json({
+            "models": service.models(),
+            "stats": service.stats,
+        })
+
+    return app

@@ -1,0 +1,47 @@
+"""Typed application config with env overrides.
+
+The reference hard-codes every knob — I/O dirs, MySQL DSN with credentials,
+model names, page size, secret key, bind address (SURVEY.md §5 "Config/flag
+system": `Flask/app.py:12,19-20,28-33,214`; `FastAPI/app.py:68,118,148`).
+Here they live in one frozen dataclass, overridable from the environment with
+the `LSOT_` prefix.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+
+
+@dataclasses.dataclass(frozen=True)
+class AppConfig:
+    input_dir: str = "data/input"
+    output_dir: str = "data/output"
+    history_db: str = "data/history.db"     # sqlite path, or ":memory:"
+    sql_model: str = "duckdb-nsql"          # NL→SQL generator
+    error_model: str = "llama3.2"           # error-analysis explainer
+    view_name: str = "temp_view"
+    page_size: int = 8
+    secret_key: str = "change-me"
+    host: str = "127.0.0.1"
+    port: int = 8000
+    max_new_tokens: int = 256
+
+    @classmethod
+    def from_env(cls, **overrides) -> "AppConfig":
+        fields = {f.name: f.type for f in dataclasses.fields(cls)}
+        kwargs = {}
+        for name in fields:
+            env = os.environ.get(f"LSOT_{name.upper()}")
+            if env is not None:
+                default = getattr(cls, name)
+                kwargs[name] = type(default)(env)
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    def ensure_dirs(self) -> None:
+        Path(self.input_dir).mkdir(parents=True, exist_ok=True)
+        Path(self.output_dir).mkdir(parents=True, exist_ok=True)
+        if self.history_db != ":memory:":
+            Path(self.history_db).parent.mkdir(parents=True, exist_ok=True)

@@ -1,0 +1,161 @@
+"""The NL→SQL pipeline: one implementation behind both app frontends.
+
+Reference equivalent: the duplicated handler bodies of `Flask/app.py:75-172`
+and `FastAPI/app.py:62-144`. Stages (status strings are the §2.2 behavioral
+contract, surfaced through the per-request status feed):
+
+  upload/stage CSV → load into SQL backend + extract schema → NL→SQL via the
+  generation service → execute → write single CSV → record history; on SQL
+  failure, route the engine error to the error-analysis model.
+
+Differences from the reference, by design (SURVEY.md §2.2 quirks — fixed,
+shapes kept):
+  - status is per-pipeline-run, not a process-global (the reference's race);
+  - the export timestamp is computed per run, not once at import;
+  - history-store failures degrade gracefully but are logged, never fatal
+    (same user-facing behavior, without unbound-variable crashes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..history.store import HistoryStore
+from ..serve.service import GenerationService
+from ..sql.backend import SQLBackend
+from .config import AppConfig
+
+log = logging.getLogger("lsot.pipeline")
+
+# §2.2 status-stage strings (Flask/app.py:79-146,152-169).
+ST_UPLOAD = "Uploading file..."
+ST_LOAD = "CSV file loading into Spark."
+ST_GEN = "Generating SQL query..."
+ST_GEN_OK = "SQL query generated successfully."
+ST_EXEC = "Executing query in Spark..."
+ST_SAVE_CSV = "Saving results to CSV..."
+ST_SAVE_DB = "Saving results to MySQL..."
+ST_ERR = "Error occurred"
+ST_ERR_RESOLVE = "Trying to resolve error..."
+ST_ERR_DONE = "Error resolved"
+
+
+@dataclasses.dataclass
+class PipelineResult:
+    ok: bool
+    input_file_name: str
+    input_data: str
+    table_schema: str = ""
+    sql_query: str = ""
+    output_file: str = ""
+    error_message: str = ""
+    error_solution: str = ""
+
+
+StatusCb = Callable[[str, str], None]  # (status, message)
+
+
+def _noop_status(status: str, message: str) -> None:
+    pass
+
+
+class Pipeline:
+    def __init__(
+        self,
+        service: GenerationService,
+        sql_backend,
+        history: Optional[HistoryStore],
+        config: AppConfig,
+    ):
+        """`sql_backend` is a zero-arg factory (e.g. the SQLiteBackend class
+        itself) or a single instance. A factory gives each run its own
+        backend — its own connection and its own `temp_view` — so concurrent
+        requests can't read each other's tables (the reference shares one
+        SparkSession-wide view across all users, `Flask/app.py:16,113`)."""
+        self.service = service
+        self._sql_factory = (
+            sql_backend if callable(sql_backend) else (lambda: sql_backend)
+        )
+        self.history = history
+        self.config = config
+
+    def run(
+        self,
+        file_path: str,
+        input_text: str,
+        status: StatusCb = _noop_status,
+    ) -> PipelineResult:
+        """Execute the full pipeline for one staged CSV + NL question."""
+        cfg = self.config
+        file_name = Path(file_path).name
+        result = PipelineResult(ok=False, input_file_name=file_name,
+                                input_data=input_text)
+        sql = self._sql_factory()
+
+        status("processing", ST_LOAD)
+        schema = sql.load_csv(file_path, cfg.view_name)
+        result.table_schema = schema.prompt_lines()
+
+        status("processing", ST_GEN)
+        # §2.2 NL→SQL system prompt, verbatim (FastAPI/app.py:85-89).
+        res = self.service.generate(
+            model=cfg.sql_model,
+            system=(
+                f"Table name is {cfg.view_name}. "
+                f"The structure of the table is:\n{result.table_schema}"
+            ),
+            prompt=input_text,
+            max_new_tokens=cfg.max_new_tokens,
+        )
+        result.sql_query = res.response
+        status("processing", ST_GEN_OK)
+
+        status("processing", ST_EXEC)
+        try:
+            table = sql.execute(result.sql_query)
+        except Exception as e:
+            result.error_message = str(e)
+            result.error_solution = self.explain_error(result.error_message, status)
+            return result
+
+        status("processing", ST_SAVE_CSV)
+        stamp = time.strftime("%Y_%m_%d_%H_%M_%S")
+        out_path = str(Path(cfg.output_dir) / f"{stamp}_{file_name}.csv")
+        result.output_file = sql.write_csv(table, out_path)
+
+        status("processing", ST_SAVE_DB)
+        if self.history is not None:
+            try:
+                self.history.record(
+                    file_name, input_text, result.sql_query, result.output_file
+                )
+            except Exception:
+                # Reference parity: a history outage must not fail the request
+                # (Flask/app.py:44-45) — but we log instead of print-and-lose.
+                log.exception("history store failed; continuing")
+
+        result.ok = True
+        status("done", "done")
+        return result
+
+    def explain_error(self, error_message: str, status: StatusCb = _noop_status) -> str:
+        """Error-analysis path — §2.2 prompts verbatim (FastAPI/app.py:99-111)."""
+        status("error", ST_ERR_RESOLVE)
+        res = self.service.generate(
+            model=self.config.error_model,
+            system=(
+                "You are an AI that helps troubleshoot Apache Spark errors. "
+                "Provide clear, concise solutions."
+            ),
+            prompt=(
+                f"The following Spark error occurred:\n\n{error_message}\n\n"
+                f"Please analyze this error and suggest possible solutions."
+            ),
+            max_new_tokens=self.config.max_new_tokens,
+        )
+        status("error", ST_ERR_DONE)
+        return res.response

@@ -1,0 +1,16 @@
+"""Evaluation harness: exact-match / edit-distance / latency / tok/s."""
+
+from .fixtures import (  # noqa: F401
+    FOUR_QUERY_SUITE,
+    SINGLE_COMPLEX_CASE,
+    TAXI_DDL_SYSTEM,
+    EvalCase,
+)
+from .harness import (  # noqa: F401
+    CaseResult,
+    ModelReport,
+    evaluate_model,
+    evaluate_models,
+    format_summary,
+)
+from .metrics import edit_distance, exact_match  # noqa: F401

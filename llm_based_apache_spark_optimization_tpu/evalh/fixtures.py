@@ -1,0 +1,71 @@
+"""Evaluation fixtures: the NYC-taxi schema and query suites.
+
+These reproduce the reference harness's *data* (its behavioral contract, not
+its code): the taxi CREATE TABLE system prompt and the NL→SQL pairs scored in
+`Model_Evaluation_&_Comparision.py:25-38` (single query) and `:86-103`
+(four-query suite) — the same fixtures behind every number in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+
+@dataclasses.dataclass(frozen=True)
+class EvalCase:
+    nl: str
+    expected_sql: str
+
+
+TAXI_DDL_SYSTEM = (
+    "Here is the database schema that the SQL query will run on: "
+    "CREATE TABLE taxi (VendorID bigint, tpep_pickup_datetime timestamp, "
+    "tpep_dropoff_datetime timestamp, passenger_count double, "
+    "trip_distance double, fare_amount double, extra double, "
+    "tip_amount double, tolls_amount double, improvement_surcharge double, "
+    "total_amount double);"
+)
+
+SINGLE_COMPLEX_CASE = EvalCase(
+    nl=(
+        "Provide me with the total fare amount, including tips and tolls, "
+        "for each vendor, along with the average trip distance, for trips "
+        "that had more than 2 passengers, sorted by total fare amount in "
+        "descending order?"
+    ),
+    expected_sql=(
+        "SELECT VendorID, \n"
+        "       SUM(total_amount) AS total_fare, \n"
+        "       AVG(trip_distance) AS avg_trip_distance\n"
+        "FROM taxi\n"
+        "WHERE passenger_count > 2\n"
+        "GROUP BY VendorID\n"
+        "ORDER BY total_fare DESC;"
+    ),
+)
+
+FOUR_QUERY_SUITE: List[EvalCase] = [
+    EvalCase(
+        nl="Get all taxis with more than 2 passengers.",
+        expected_sql="SELECT * FROM taxi WHERE passenger_count > 2;",
+    ),
+    EvalCase(
+        nl="Show total fare collected by each vendor.",
+        expected_sql=(
+            "SELECT VendorID, SUM(total_amount) AS Total_Fare FROM taxi "
+            "GROUP BY VendorID;"
+        ),
+    ),
+    EvalCase(
+        nl="Find the average trip distance for trips that had more than 2 passengers.",
+        expected_sql="SELECT AVG(trip_distance) FROM taxi WHERE passenger_count > 2;",
+    ),
+    EvalCase(
+        nl="List all vendors ordered by total fare in descending order.",
+        expected_sql=(
+            "SELECT VendorID, SUM(total_amount) AS Total_Fare FROM taxi "
+            "GROUP BY VendorID ORDER BY Total_Fare DESC;"
+        ),
+    ),
+]

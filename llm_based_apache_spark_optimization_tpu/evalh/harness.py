@@ -1,0 +1,105 @@
+"""Evaluation harness: score registered models on NL→SQL suites.
+
+TPU rebuild of the reference's measurement instrument (reference
+`Model_Evaluation_&_Comparision.py:19-66` single-query, `:109-158`
+multi-query): per-case exact match / edit distance / latency, per-model
+aggregates — plus output tok/s, which the reference never measured but
+BASELINE.json's north star is denominated in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence
+
+from ..serve.service import GenerationService
+from .fixtures import EvalCase
+from .metrics import edit_distance, exact_match
+
+
+@dataclasses.dataclass(frozen=True)
+class CaseResult:
+    nl: str
+    generated_sql: str
+    expected_sql: str
+    exact_match: int
+    edit_distance: int
+    latency_s: float
+    output_tokens: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelReport:
+    model: str
+    cases: List[CaseResult]
+
+    @property
+    def exact_match_rate(self) -> float:
+        return 100.0 * sum(c.exact_match for c in self.cases) / len(self.cases)
+
+    @property
+    def avg_edit_distance(self) -> float:
+        return sum(c.edit_distance for c in self.cases) / len(self.cases)
+
+    @property
+    def avg_latency_s(self) -> float:
+        return sum(c.latency_s for c in self.cases) / len(self.cases)
+
+    @property
+    def aggregate_tok_per_s(self) -> float:
+        total_t = sum(c.latency_s for c in self.cases)
+        return sum(c.output_tokens for c in self.cases) / total_t if total_t else 0.0
+
+
+def evaluate_model(
+    service: GenerationService,
+    model: str,
+    cases: Sequence[EvalCase],
+    system: str,
+    max_new_tokens: int = 256,
+) -> ModelReport:
+    results = []
+    for case in cases:
+        res = service.generate(
+            model=model, prompt=case.nl, system=system,
+            max_new_tokens=max_new_tokens,
+        )
+        generated = res.response.strip()
+        expected = case.expected_sql.strip()
+        results.append(CaseResult(
+            nl=case.nl,
+            generated_sql=generated,
+            expected_sql=expected,
+            exact_match=exact_match(generated, expected),
+            edit_distance=edit_distance(generated, expected),
+            latency_s=res.latency_s,
+            output_tokens=res.output_tokens,
+        ))
+    return ModelReport(model=model, cases=results)
+
+
+def evaluate_models(
+    service: GenerationService,
+    models: Sequence[str],
+    cases: Sequence[EvalCase],
+    system: str,
+    max_new_tokens: int = 256,
+) -> Dict[str, ModelReport]:
+    return {
+        m: evaluate_model(service, m, cases, system, max_new_tokens)
+        for m in models
+    }
+
+
+def format_summary(reports: Dict[str, ModelReport]) -> str:
+    lines = ["Final Evaluation Summary:", "=" * 72]
+    for model, rep in reports.items():
+        lines += [
+            f"Model: {model}",
+            f"Exact Match Rate: {rep.exact_match_rate:.2f}%",
+            f"Average Edit Distance: {rep.avg_edit_distance:.2f}",
+            f"Average Latency: {rep.avg_latency_s:.4f} sec",
+            f"Aggregate Throughput: {rep.aggregate_tok_per_s:.1f} tok/s",
+            "=" * 72,
+        ]
+    return "\n".join(lines)

@@ -1,0 +1,42 @@
+"""Scoring metrics for NL→SQL quality: exact match + Levenshtein distance.
+
+Same metrics the reference's harness computes (reference
+`Model_Evaluation_&_Comparision.py:45-51`: stripped string equality and
+`Levenshtein.distance`). Uses the C-accelerated `Levenshtein` package when
+importable, with an in-tree two-row DP fallback so the harness has zero hard
+dependencies.
+"""
+
+from __future__ import annotations
+
+try:
+    from Levenshtein import distance as _lev
+except ImportError:  # pragma: no cover
+    _lev = None
+
+
+def exact_match(generated: str, expected: str) -> int:
+    return int(generated.strip() == expected.strip())
+
+
+def edit_distance(a: str, b: str) -> int:
+    if _lev is not None:
+        return _lev(a, b)
+    return _edit_distance_dp(a, b)
+
+
+def _edit_distance_dp(a: str, b: str) -> int:
+    """Two-row Wagner–Fischer; O(len(a)·len(b)) time, O(len(b)) space."""
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(
+                prev[j] + 1,          # deletion
+                cur[j - 1] + 1,       # insertion
+                prev[j - 1] + (ca != cb),  # substitution
+            ))
+        prev = cur
+    return prev[-1]

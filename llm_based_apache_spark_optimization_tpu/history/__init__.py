@@ -1,0 +1,9 @@
+"""query_results history store (SQLite default, MySQL optional)."""
+
+from .store import (  # noqa: F401
+    PAGE_SIZE,
+    HistoryRecord,
+    HistoryStore,
+    MySQLHistory,
+    SQLiteHistory,
+)

@@ -160,7 +160,7 @@ MISTRAL_7B = LlamaConfig(
 # llama3 rope scaling) at CPU-test size. head_dim=8 keeps CPU matmuls cheap.
 TINY = LlamaConfig(
     name="tiny",
-    vocab_size=256,
+    vocab_size=320,  # >= ByteTokenizer's 259 so tiny end-to-end text tests work
     hidden_size=32,
     intermediate_size=64,
     num_layers=2,

@@ -1,0 +1,5 @@
+"""Generation service: model registry, prompt templates, backends."""
+
+from .backends import Completion, EngineBackend, FakeBackend  # noqa: F401
+from .service import GenerateResult, GenerationService  # noqa: F401
+from .templates import TEMPLATES  # noqa: F401

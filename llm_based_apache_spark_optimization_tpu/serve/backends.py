@@ -1,0 +1,97 @@
+"""Completion backends: the text→text seam under the generation service.
+
+`EngineBackend` is the real path (tokenizer + in-tree TPU engine).
+`FakeBackend` makes the whole app/eval stack hermetically testable without
+weights — the capability the reference never had (its only 'test' needed a
+live Ollama server, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, List, Optional, Sequence
+
+from ..engine.generate import InferenceEngine
+from ..ops.sampling import SamplingParams
+from ..tokenizer.base import Tokenizer
+
+
+@dataclasses.dataclass
+class Completion:
+    text: str
+    output_tokens: int
+
+
+class EngineBackend:
+    """Tokenize → engine.generate → detokenize. Thread-safe: one lock per
+    backend serializes device work (the continuous-batching scheduler
+    replaces this lock for concurrent serving)."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        tokenizer: Tokenizer,
+        max_new_tokens: int = 256,
+        sampling: SamplingParams = SamplingParams(),
+        stop_texts: Sequence[str] = (),
+        add_bos: bool = True,
+    ):
+        """Set `add_bos=False` for chat templates whose rendered prompt
+        already begins with the BOS string (e.g. llama3-chat's
+        <|begin_of_text|>) — otherwise the model sees BOS twice, an
+        off-distribution prompt that silently degrades output quality."""
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling
+        self.stop_texts = tuple(stop_texts)
+        self.add_bos = add_bos
+        self._lock = threading.Lock()
+
+    def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
+                 sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
+        ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+        # Clamp the decode budget to what fits the model context after the
+        # bucketed prompt: a serving backend degrades to a shorter completion
+        # instead of erroring (the engine itself raises on overflow).
+        from ..engine.kvcache import bucket_len
+
+        cfg = self.engine.cfg
+        room = cfg.max_seq_len - bucket_len(len(ids), self.engine.prompt_bucket)
+        if room < 1:
+            raise ValueError(
+                f"prompt ({len(ids)} tokens) leaves no room in the "
+                f"{cfg.max_seq_len}-token context of {cfg.name}"
+            )
+        budget = min(max_new_tokens or self.max_new_tokens, room)
+        with self._lock:
+            out = self.engine.generate(
+                [ids],
+                max_new_tokens=budget,
+                sampling=sampling or self.sampling,
+                seed=seed,
+            )[0]
+        # Strip the stop token itself from the text.
+        if out and out[-1] in self.engine.stop_ids:
+            out = out[:-1]
+        text = self.tokenizer.decode(out)
+        for stop in self.stop_texts:
+            cut = text.find(stop)
+            if cut != -1:
+                text = text[:cut]
+        return Completion(text=text, output_tokens=len(out))
+
+
+class FakeBackend:
+    """Deterministic canned backend: `fn(prompt) -> text`."""
+
+    def __init__(self, fn: Callable[[str], str]):
+        self.fn = fn
+        self.calls: List[str] = []
+
+    def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
+                 sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
+        self.calls.append(prompt)
+        text = self.fn(prompt)
+        return Completion(text=text, output_tokens=len(text.split()))

@@ -1,0 +1,94 @@
+"""Generation service: the in-process replacement for the Ollama sidecar.
+
+The reference calls `ollama.generate(model=..., system=..., prompt=...)` over
+HTTP to a separate Go server and reads `res.response` (reference
+`Flask/app.py:102-107,160-166`; `FastAPI/app.py:85-90,105-111`). Here the
+same call shape is a method on an in-process registry of TPU engines — no
+sidecar, no socket, and per-request metrics built in (SURVEY.md §5
+observability: per-request tok/s and latency counters).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional
+
+from ..ops.sampling import SamplingParams
+from .templates import TEMPLATES, Template
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerateResult:
+    """Mirror of the ollama response surface the reference touches: only
+    `.response` is read there; the rest is in-tree observability."""
+
+    response: str
+    model: str
+    latency_s: float
+    output_tokens: int
+
+    @property
+    def tok_per_s(self) -> float:
+        return self.output_tokens / self.latency_s if self.latency_s > 0 else 0.0
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    name: str
+    backend: object  # EngineBackend | FakeBackend (duck-typed .complete)
+    template: Template
+
+
+class GenerationService:
+    """Named-model registry + generate() — the Ollama capability surface."""
+
+    def __init__(self):
+        self._models: Dict[str, ModelEntry] = {}
+        self._lock = threading.Lock()
+        self.stats: Dict[str, Dict[str, float]] = {}
+
+    def register(self, name: str, backend, template: str = "completion") -> None:
+        if template not in TEMPLATES:
+            raise ValueError(f"unknown template {template!r}; choices {sorted(TEMPLATES)}")
+        with self._lock:
+            self._models[name] = ModelEntry(name, backend, TEMPLATES[template])
+            self.stats.setdefault(
+                name, {"requests": 0, "total_latency_s": 0.0, "total_tokens": 0}
+            )
+
+    def models(self):
+        return sorted(self._models)
+
+    def generate(
+        self,
+        model: str,
+        prompt: str,
+        system: str = "",
+        max_new_tokens: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None,
+        seed: int = 0,
+    ) -> GenerateResult:
+        entry = self._models.get(model)
+        if entry is None:
+            raise KeyError(
+                f"model {model!r} is not registered; available: {self.models()}"
+            )
+        rendered = entry.template(system, prompt)
+        t0 = time.perf_counter()
+        completion = entry.backend.complete(
+            rendered, max_new_tokens=max_new_tokens, sampling=sampling, seed=seed
+        )
+        latency = time.perf_counter() - t0
+        with self._lock:
+            s = self.stats[model]
+            s["requests"] += 1
+            s["total_latency_s"] += latency
+            s["total_tokens"] += completion.output_tokens
+        return GenerateResult(
+            response=completion.text,
+            model=model,
+            latency_s=latency,
+            output_tokens=completion.output_tokens,
+        )

@@ -1,0 +1,12 @@
+"""SQL execution backends: CSV → temp_view → SQL → single-file CSV export."""
+
+from .backend import ResultTable, SQLBackend, TableSchema  # noqa: F401
+from .spark_backend import SparkBackend, spark_available  # noqa: F401
+from .sqlite_backend import SQLiteBackend  # noqa: F401
+
+
+def default_backend() -> SQLBackend:
+    """Spark when installed (the reference's engine), else in-tree SQLite."""
+    if spark_available():
+        return SparkBackend()
+    return SQLiteBackend()

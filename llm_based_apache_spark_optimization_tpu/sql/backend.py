@@ -1,0 +1,55 @@
+"""SQL execution backend protocol: CSV → temp view → SQL → CSV out.
+
+This is the capability surface the reference gets from Apache Spark via py4j
+(reference `Flask/app.py:95-129`, `FastAPI/app.py:68-133`): read a CSV with
+header+schema inference, expose its schema as `"col (dtype)"` lines (the
+text-to-SQL model's system prompt is built from exactly that string —
+`FastAPI/app.py:79,85-89`), register it as the temp view `temp_view`, run a
+SQL string against it, and export the result as ONE headed CSV file
+(Spark's `coalesce(1)` + part-file rename dance, `FastAPI/app.py:118-133`).
+
+Two implementations:
+  - SQLiteBackend (sql/sqlite_backend.py): in-tree default, zero external
+    engines — stdlib sqlite3 with Spark-compatible schema naming.
+  - SparkBackend (sql/spark_backend.py): the real thing when pyspark is
+    importable; the north star keeps Spark as the consumer of TPU-generated
+    SQL (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Protocol, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSchema:
+    """Column names + Spark-style dtype names (bigint/double/string/...)."""
+
+    columns: Tuple[str, ...]
+    dtypes: Tuple[str, ...]
+
+    def prompt_lines(self) -> str:
+        """The exact schema string fed to the NL→SQL system prompt
+        (reference `FastAPI/app.py:79`)."""
+        return "\n".join(f"{c} ({t})" for c, t in zip(self.columns, self.dtypes))
+
+
+@dataclasses.dataclass(frozen=True)
+class ResultTable:
+    columns: Tuple[str, ...]
+    rows: List[Tuple]
+
+
+class SQLBackend(Protocol):
+    def load_csv(self, path: str, view_name: str = "temp_view") -> TableSchema:
+        """Read a headed CSV, infer types, register as `view_name`."""
+        ...
+
+    def execute(self, sql: str) -> ResultTable:
+        """Run SQL against registered views; raises on engine errors."""
+        ...
+
+    def write_csv(self, result: ResultTable, out_path: str) -> str:
+        """Write result as ONE headed CSV file (coalesce(1) semantics)."""
+        ...

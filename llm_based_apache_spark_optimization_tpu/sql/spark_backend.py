@@ -1,0 +1,60 @@
+"""PySpark-backed SQL executor — the reference's engine, behind the same
+protocol.
+
+Used when `pyspark` is importable (it is not in the CI image; the SQLite
+backend is the default there). Mirrors the reference's exact Spark usage:
+`read.csv(header=True, inferSchema=True)` (`Flask/app.py:95`),
+`createOrReplaceTempView` (`:113`), `spark.sql` (`:115`), and the
+`coalesce(1)` single-file CSV export with part-file rename (`:119-129`).
+"""
+
+from __future__ import annotations
+
+import shutil
+import tempfile
+from pathlib import Path
+
+from .backend import ResultTable, TableSchema
+
+
+def spark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+class SparkBackend:
+    def __init__(self, app_name: str = "llm-spark-opt-tpu"):
+        from pyspark.sql import SparkSession
+
+        self._spark = SparkSession.builder.appName(app_name).getOrCreate()
+        self._dfs = {}
+
+    def load_csv(self, path: str, view_name: str = "temp_view") -> TableSchema:
+        if not Path(path).exists():
+            raise FileNotFoundError(path)
+        df = self._spark.read.csv(path, header=True, inferSchema=True)
+        df.createOrReplaceTempView(view_name)
+        self._dfs[view_name] = df
+        cols, dtypes = zip(*df.dtypes) if df.dtypes else ((), ())
+        return TableSchema(columns=tuple(cols), dtypes=tuple(dtypes))
+
+    def execute(self, sql: str) -> ResultTable:
+        df = self._spark.sql(sql)
+        rows = [tuple(r) for r in df.collect()]
+        return ResultTable(columns=tuple(df.columns), rows=rows)
+
+    def write_csv(self, result: ResultTable, out_path: str) -> str:
+        # Re-create a DataFrame for the Spark write path so the export uses
+        # the engine's own CSV writer (coalesce(1) + part-file rename).
+        out = Path(out_path)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        df = self._spark.createDataFrame(result.rows, schema=list(result.columns))
+        tmp = tempfile.mkdtemp(prefix="spark_out_")
+        df.coalesce(1).write.mode("overwrite").option("header", "true").csv(tmp)
+        part = next(p for p in Path(tmp).iterdir() if p.name.startswith("part-"))
+        shutil.move(str(part), str(out))
+        shutil.rmtree(tmp, ignore_errors=True)
+        return str(out)

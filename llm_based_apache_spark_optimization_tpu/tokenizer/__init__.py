@@ -1,0 +1,6 @@
+"""In-tree tokenizers: byte-level, trainable BPE, HF tokenizer.json adapter."""
+
+from .base import Tokenizer  # noqa: F401
+from .bpe import BPETokenizer, train_bpe  # noqa: F401
+from .byte import ByteTokenizer  # noqa: F401
+from .hf import HFTokenizer  # noqa: F401

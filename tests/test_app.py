@@ -1,0 +1,236 @@
+"""App tier: endpoint tests asserting the §2.2 JSON/redirect contract shapes,
+hermetic via FakeBackend (no weights, no sockets)."""
+
+import pytest
+from pathlib import Path
+
+from llm_based_apache_spark_optimization_tpu.app import (
+    AppConfig,
+    create_api_app,
+    create_web_app,
+    secure_filename,
+)
+from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+from llm_based_apache_spark_optimization_tpu.serve import FakeBackend, GenerationService
+from llm_based_apache_spark_optimization_tpu.sql import SQLiteBackend
+
+CSV = "VendorID,passenger_count,total_amount\n1,2,12.5\n2,4,25.0\n1,3,18.0\n"
+GOOD_SQL = "SELECT VendorID, SUM(total_amount) AS Total_Fare FROM temp_view GROUP BY VendorID"
+BAD_SQL = "SELECT FROM nothing WHERE"
+
+
+def make_service(sql=GOOD_SQL):
+    svc = GenerationService()
+    svc.register("duckdb-nsql", FakeBackend(lambda p: sql))
+    svc.register("llama3.2", FakeBackend(
+        lambda p: "The table or column does not exist; check the schema."))
+    return svc
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    return AppConfig(
+        input_dir=str(tmp_path / "input"),
+        output_dir=str(tmp_path / "output"),
+        history_db=":memory:",
+        secret_key="test-secret",
+    )
+
+
+@pytest.fixture()
+def api(cfg, tmp_path):
+    (tmp_path / "input").mkdir(exist_ok=True)
+    (tmp_path / "input" / "taxi.csv").write_text(CSV)
+    app = create_api_app(make_service(), SQLiteBackend(), SQLiteHistory(), cfg)
+    return app.test_client()
+
+
+def test_api_success_shape(api):
+    res = api.post_json("/process-data/", {"input_text": "total fare per vendor",
+                                           "file_name": "taxi.csv"})
+    assert res.status == 200
+    body = res.json()
+    assert body["message"] == "Query executed successfully!"
+    assert set(body) == {"message", "input_file_name", "input_data",
+                         "sql_query", "output_file"}
+    assert body["input_file_name"] == "taxi.csv"
+    assert body["sql_query"] == GOOD_SQL
+    # The export exists and is a single headed CSV.
+    lines = open(body["output_file"]).read().splitlines()
+    assert lines[0] == "VendorID,Total_Fare"
+
+
+def test_api_missing_file_shape(api):
+    res = api.post_json("/process-data/", {"input_text": "q", "file_name": "nope.csv"})
+    body = res.json()
+    assert set(body) == {"error"}
+    assert body["error"].startswith("CSV file not found at ")
+    assert body["error"].endswith("nope.csv")
+
+
+def test_api_sql_failure_shape(cfg, tmp_path):
+    (tmp_path / "input").mkdir(exist_ok=True)
+    (tmp_path / "input" / "taxi.csv").write_text(CSV)
+    app = create_api_app(make_service(sql=BAD_SQL), SQLiteBackend(),
+                         SQLiteHistory(), cfg)
+    res = app.test_client().post_json(
+        "/process-data/", {"input_text": "q", "file_name": "taxi.csv"})
+    body = res.json()
+    assert body["error"] == "SQL execution failed"
+    assert body["sql_query"] == BAD_SQL
+    assert "error_details" in body and body["error_details"]
+
+
+def test_api_records_history(cfg, tmp_path):
+    (tmp_path / "input").mkdir(exist_ok=True)
+    (tmp_path / "input" / "taxi.csv").write_text(CSV)
+    hist = SQLiteHistory()
+    app = create_api_app(make_service(), SQLiteBackend(), hist, cfg)
+    app.test_client().post_json(
+        "/process-data/", {"input_text": "q", "file_name": "taxi.csv"})
+    assert hist.count() == 1
+    records, _ = hist.page(1)
+    assert records[0].sql_query == GOOD_SQL
+
+
+def test_api_invalid_json_400(api):
+    res = api.request("POST", "/process-data/", b"not json", "application/json")
+    assert res.status == 400
+
+
+def test_api_unknown_route_404_known_route_405(api):
+    assert api.get("/nope").status == 404
+    assert api.get("/process-data/").status == 405
+
+
+@pytest.fixture()
+def web(cfg):
+    app = create_web_app(make_service(), SQLiteBackend(), SQLiteHistory(), cfg)
+    return app.test_client()
+
+
+def test_web_index_serves_form_and_css(web):
+    res = web.get("/")
+    assert res.status == 200
+    assert "<form" in res.text
+    assert web.get("/static/styles.css").status == 200
+
+
+def test_web_upload_success_redirect_and_show(web):
+    res = web.post_multipart(
+        "/process-data/",
+        fields={"input_text": "total fare per vendor"},
+        files={"file": ("taxi.csv", CSV.encode())},
+    )
+    assert res.json() == {"redirect": "/show"}
+    show = web.get("/show")
+    assert show.status == 200
+    assert "taxi.csv" in show.text
+    assert "Total_Fare" in show.text  # generated SQL rendered
+
+
+def test_web_status_tracks_session(web):
+    assert web.get("/status").json() == {"status": "idle", "message": ""}
+    web.post_multipart(
+        "/process-data/", fields={"input_text": "q"},
+        files={"file": ("taxi.csv", CSV.encode())},
+    )
+    assert web.get("/status").json() == {"status": "done", "message": "done"}
+
+
+def test_web_error_path_redirects_to_err_sol(cfg):
+    app = create_web_app(make_service(sql=BAD_SQL), SQLiteBackend(),
+                         SQLiteHistory(), cfg)
+    client = app.test_client()
+    res = client.post_multipart(
+        "/process-data/", fields={"input_text": "q"},
+        files={"file": ("taxi.csv", CSV.encode())},
+    )
+    redirect = res.json()["redirect"]
+    assert redirect.startswith("/err_sol?")
+    # Solution travels in query params (reference contract Flask/app.py:171-190).
+    assert "error_message=" in redirect and "err=" in redirect
+    path, _, query = redirect.partition("?")
+    page = client.request("GET", path, query=query)
+    assert page.status == 200
+    assert "Suggested solution" in page.text
+
+
+def test_web_upload_missing_file_400(web):
+    res = web.post_multipart("/process-data/", fields={"input_text": "q"}, files={})
+    assert res.status == 400
+
+
+def test_web_history_pagination(cfg):
+    hist = SQLiteHistory()
+    for i in range(10):
+        hist.record(f"f{i}.csv", f"q{i}", f"SELECT {i};", f"o{i}.csv")
+    app = create_web_app(make_service(), SQLiteBackend(), hist, cfg)
+    client = app.test_client()
+    p1 = client.get("/history", query="page=1")
+    assert "f9.csv" in p1.text and "Next" in p1.text
+    p2 = client.get("/history", query="page=2")
+    assert "f0.csv" in p2.text and "Next" not in p2.text and "Prev" in p2.text
+
+
+def test_secure_filename():
+    assert secure_filename("../../etc/passwd") == "etc_passwd"
+    assert secure_filename("taxi data.csv") == "taxi_data.csv"
+    assert secure_filename("") == "upload.csv"
+
+
+def test_concurrent_sessions_do_not_share_status(cfg):
+    """The reference's status feed is a process-global (race); ours is
+    per-session — two clients must see independent statuses."""
+    app = create_web_app(make_service(), SQLiteBackend(), SQLiteHistory(), cfg)
+    a, b = app.test_client(), app.test_client()
+    a.post_multipart("/process-data/", fields={"input_text": "q"},
+                     files={"file": ("taxi.csv", CSV.encode())})
+    assert a.get("/status").json()["status"] == "done"
+    assert b.get("/status").json() == {"status": "idle", "message": ""}
+
+
+def test_api_path_traversal_rejected(api):
+    for name in ["../secret.csv", "/etc/passwd", "a/../../b.csv", ""]:
+        res = api.post_json("/process-data/", {"input_text": "q", "file_name": name})
+        assert res.status == 400, name
+        assert res.json() == {"error": "invalid file name"}
+
+
+def test_multipart_preserves_trailing_newlines(cfg, tmp_path):
+    """Upload bytes must be staged exactly — including trailing blank lines."""
+    content = CSV + "\n"  # trailing blank line
+    app = create_web_app(make_service(), SQLiteBackend, SQLiteHistory(), cfg)
+    client = app.test_client()
+    client.post_multipart("/process-data/", fields={"input_text": "q"},
+                          files={"file": ("taxi.csv", content.encode())})
+    staged = (Path(cfg.input_dir) / "taxi.csv").read_bytes()
+    assert staged == content.encode()
+
+
+def test_readonly_poll_does_not_clobber_session_result(web):
+    """A /status poll racing the POST must not overwrite the stored result:
+    read-only requests don't re-set the session cookie."""
+    web.post_multipart("/process-data/", fields={"input_text": "q"},
+                       files={"file": ("taxi.csv", CSV.encode())})
+    cookie_after_post = dict(web.cookies)
+    web.get("/status")  # read-only: no session change
+    assert web.cookies == cookie_after_post
+    assert web.get("/show").status == 200
+
+
+def test_pipeline_runs_are_isolated_per_backend_factory(cfg, tmp_path):
+    """With a factory, one run's temp_view cannot leak into another's."""
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return SQLiteBackend()
+
+    app = create_api_app(make_service(), factory, SQLiteHistory(), cfg)
+    client = app.test_client()
+    (Path(cfg.input_dir)).mkdir(parents=True, exist_ok=True)
+    (Path(cfg.input_dir) / "taxi.csv").write_text(CSV)
+    client.post_json("/process-data/", {"input_text": "q", "file_name": "taxi.csv"})
+    client.post_json("/process-data/", {"input_text": "q", "file_name": "taxi.csv"})
+    assert len(calls) == 2

@@ -1,0 +1,55 @@
+"""Eval-harness tier: metrics math + suite scoring against fake models."""
+
+from llm_based_apache_spark_optimization_tpu.evalh import (
+    FOUR_QUERY_SUITE,
+    TAXI_DDL_SYSTEM,
+    edit_distance,
+    evaluate_model,
+    evaluate_models,
+    exact_match,
+    format_summary,
+)
+from llm_based_apache_spark_optimization_tpu.evalh.metrics import _edit_distance_dp
+from llm_based_apache_spark_optimization_tpu.serve import FakeBackend, GenerationService
+
+
+def test_exact_match_strips():
+    assert exact_match(" SELECT 1; \n", "SELECT 1;") == 1
+    assert exact_match("SELECT 2;", "SELECT 1;") == 0
+
+
+def test_edit_distance_basic_and_fallback_agrees():
+    cases = [("kitten", "sitting", 3), ("", "abc", 3), ("abc", "abc", 0),
+             ("SELECT *", "SELECT 1", 1)]
+    for a, b, want in cases:
+        assert edit_distance(a, b) == want
+        assert _edit_distance_dp(a, b) == want
+
+
+def test_evaluate_model_perfect_fake():
+    """A fake that answers every suite query correctly scores 100%."""
+    answers = {c.nl: c.expected_sql for c in FOUR_QUERY_SUITE}
+
+    def fn(prompt):
+        for nl, sql in answers.items():
+            if nl in prompt:
+                return sql
+        return "SELECT NULL;"
+
+    svc = GenerationService()
+    svc.register("perfect", FakeBackend(fn))
+    rep = evaluate_model(svc, "perfect", FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM)
+    assert rep.exact_match_rate == 100.0
+    assert rep.avg_edit_distance == 0.0
+    assert len(rep.cases) == 4
+
+
+def test_evaluate_models_summary_format():
+    svc = GenerationService()
+    svc.register("bad", FakeBackend(lambda p: "SELECT garbage;"))
+    reports = evaluate_models(svc, ["bad"], FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM)
+    out = format_summary(reports)
+    assert "Model: bad" in out
+    assert "Exact Match Rate: 0.00%" in out
+    assert "Average Edit Distance:" in out
+    assert reports["bad"].avg_edit_distance > 0

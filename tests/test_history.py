@@ -1,0 +1,49 @@
+"""History store tier: schema, pagination semantics (8/page, newest first)."""
+
+from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+
+
+def test_record_and_count():
+    h = SQLiteHistory()
+    assert h.count() == 0
+    rid = h.record("f.csv", "count rows", "SELECT COUNT(*) FROM temp_view;", "out.csv")
+    assert rid == 1
+    assert h.count() == 1
+
+
+def test_pagination_newest_first_and_has_next():
+    h = SQLiteHistory()
+    for i in range(10):
+        h.record(f"f{i}.csv", f"q{i}", f"SELECT {i};", f"o{i}.csv")
+    page1, has_next = h.page(1)
+    assert len(page1) == 8
+    assert has_next
+    assert page1[0].input_file_name == "f9.csv"  # newest first
+    page2, has_next2 = h.page(2)
+    assert len(page2) == 2
+    assert not has_next2
+    assert page2[-1].input_file_name == "f0.csv"
+
+
+def test_exact_page_boundary():
+    h = SQLiteHistory()
+    for i in range(8):
+        h.record(f"f{i}.csv", "q", "s;", "o.csv")
+    _, has_next = h.page(1)
+    assert not has_next  # exactly one full page: no next
+
+
+def test_page_clamps_below_one():
+    h = SQLiteHistory()
+    h.record("f.csv", "q", "s;", "o.csv")
+    records, _ = h.page(0)
+    assert len(records) == 1
+
+
+def test_persistent_file_store(tmp_path):
+    db = str(tmp_path / "hist.db")
+    h = SQLiteHistory(db)
+    h.record("f.csv", "q", "s;", "o.csv")
+    h.close()
+    h2 = SQLiteHistory(db)
+    assert h2.count() == 1

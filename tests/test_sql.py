@@ -1,0 +1,99 @@
+"""SQL backend tier: schema inference parity, execution, CSV export."""
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.sql import ResultTable, SQLiteBackend
+
+TAXI_CSV = """VendorID,tpep_pickup_datetime,passenger_count,trip_distance,total_amount
+1,2024-01-01 10:00:00,2,1.5,12.50
+2,2024-01-01 11:00:00,4,3.0,25.00
+1,2024-01-01 12:00:00,3,2.0,18.00
+2,2024-01-02 09:30:00,1,0.5,6.00
+"""
+
+
+@pytest.fixture()
+def taxi_csv(tmp_path):
+    p = tmp_path / "taxi.csv"
+    p.write_text(TAXI_CSV)
+    return str(p)
+
+
+def test_schema_inference_spark_dtype_names(taxi_csv):
+    be = SQLiteBackend()
+    schema = be.load_csv(taxi_csv)
+    assert schema.columns == (
+        "VendorID", "tpep_pickup_datetime", "passenger_count",
+        "trip_distance", "total_amount",
+    )
+    assert schema.dtypes == ("int", "timestamp", "int", "double", "double")
+    # The exact system-prompt schema string shape: "col (dtype)" lines.
+    lines = schema.prompt_lines().splitlines()
+    assert lines[0] == "VendorID (int)"
+    assert lines[1] == "tpep_pickup_datetime (timestamp)"
+
+
+def test_bigint_inference(tmp_path):
+    p = tmp_path / "big.csv"
+    p.write_text("id,val\n5000000000,1\n2,3\n")
+    schema = SQLiteBackend().load_csv(str(p))
+    assert schema.dtypes == ("bigint", "int")
+
+
+def test_execute_aggregation_query(taxi_csv):
+    be = SQLiteBackend()
+    be.load_csv(taxi_csv)
+    res = be.execute(
+        "SELECT VendorID, SUM(total_amount) AS Total_Fare FROM temp_view "
+        "GROUP BY VendorID ORDER BY Total_Fare DESC"
+    )
+    assert res.columns == ("VendorID", "Total_Fare")
+    assert res.rows == [(2, 31.0), (1, 30.5)]
+
+
+def test_execute_where_filter(taxi_csv):
+    be = SQLiteBackend()
+    be.load_csv(taxi_csv)
+    res = be.execute("SELECT * FROM temp_view WHERE passenger_count > 2")
+    assert len(res.rows) == 2
+
+
+def test_execute_bad_sql_raises(taxi_csv):
+    be = SQLiteBackend()
+    be.load_csv(taxi_csv)
+    with pytest.raises(Exception):
+        be.execute("SELECT nonexistent_col FROM temp_view")
+
+
+def test_missing_csv_raises():
+    with pytest.raises(FileNotFoundError):
+        SQLiteBackend().load_csv("/nope/missing.csv")
+
+
+def test_write_csv_single_file_with_header(taxi_csv, tmp_path):
+    be = SQLiteBackend()
+    be.load_csv(taxi_csv)
+    res = be.execute("SELECT VendorID, total_amount FROM temp_view ORDER BY VendorID")
+    out = be.write_csv(res, str(tmp_path / "out" / "result.csv"))
+    text = open(out).read().splitlines()
+    assert text[0] == "VendorID,total_amount"
+    assert len(text) == 5
+
+
+def test_view_reload_replaces(taxi_csv, tmp_path):
+    be = SQLiteBackend()
+    be.load_csv(taxi_csv)
+    p2 = tmp_path / "other.csv"
+    p2.write_text("a,b\n1,x\n")
+    be.load_csv(str(p2))
+    res = be.execute("SELECT * FROM temp_view")
+    assert res.columns == ("a", "b")
+
+
+def test_empty_values_become_null(tmp_path):
+    p = tmp_path / "nulls.csv"
+    p.write_text("a,b\n1,\n,2\n")
+    be = SQLiteBackend()
+    be.load_csv(str(p))
+    res = be.execute("SELECT COUNT(a), COUNT(b) FROM temp_view")
+    assert res.rows == [(1, 1)]

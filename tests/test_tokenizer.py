@@ -1,0 +1,64 @@
+"""Tokenizer tier: byte round-trips, BPE train/encode/decode/persistence."""
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.tokenizer import (
+    BPETokenizer,
+    ByteTokenizer,
+    train_bpe,
+)
+
+
+def test_byte_roundtrip_ascii_and_unicode():
+    tok = ByteTokenizer()
+    for text in ["SELECT * FROM taxi;", "héllo wörld ✓", ""]:
+        ids = tok.encode(text)
+        assert ids[0] == tok.bos_id
+        assert tok.decode(ids) == text
+
+
+def test_byte_ids_in_range():
+    tok = ByteTokenizer()
+    ids = tok.encode("abc")
+    assert all(0 <= i < tok.vocab_size for i in ids)
+    assert tok.vocab_size == 259
+
+
+def test_bpe_train_learns_frequent_pairs_and_roundtrips():
+    corpus = ["SELECT * FROM taxi", "SELECT VendorID FROM taxi",
+              "SELECT SUM(total_amount) FROM taxi"] * 4
+    tok = train_bpe(corpus, num_merges=32)
+    assert len(tok.merges) > 0
+    text = "SELECT AVG(trip_distance) FROM taxi"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    # Compression: trained text must use fewer tokens than raw bytes.
+    assert len(tok.encode("SELECT * FROM taxi", add_bos=False)) < len(
+        "SELECT * FROM taxi".encode()
+    )
+
+
+def test_bpe_merge_priority_is_rank_order():
+    # merges: (a,b) first, then (ab, c): encode "abc" -> single id.
+    a, b, c = 3 + ord("a"), 3 + ord("b"), 3 + ord("c")
+    tok = BPETokenizer([(a, b), (259, c)])
+    ids = tok.encode("abc", add_bos=False)
+    assert ids == [260]
+    assert tok.decode([260]) == "abc"
+
+
+def test_bpe_save_load_roundtrip(tmp_path):
+    corpus = ["the quick brown fox"] * 8
+    tok = train_bpe(corpus, num_merges=16)
+    path = tmp_path / "bpe.json"
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    text = "the quick brown fox jumps"
+    assert tok.encode(text) == tok2.encode(text)
+    assert tok2.decode(tok2.encode(text)) == text
+
+
+def test_bpe_handles_unseen_bytes():
+    tok = train_bpe(["ascii only"] * 4, num_merges=8)
+    text = "日本語 ¿ñ?"
+    assert tok.decode(tok.encode(text)) == text
