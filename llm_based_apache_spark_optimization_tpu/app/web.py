@@ -19,6 +19,7 @@ import html
 import secrets
 import threading
 import time
+import uuid
 from pathlib import Path
 from typing import Dict, Tuple
 from urllib.parse import urlencode
@@ -118,7 +119,11 @@ def create_web_app(
             board.set(sid, "error", "No file uploaded")
             return Response.json({"error": "no file uploaded"}, status=400)
         file_name = secure_filename(upload.filename)
-        file_path = Path(cfg.input_dir) / file_name
+        # Per-request subdirectory: concurrent uploads of the same filename
+        # must not overwrite each other between this write and the pipeline's
+        # read-back, while the basename (used for history/display) stays clean.
+        file_path = Path(cfg.input_dir) / uuid.uuid4().hex[:12] / file_name
+        file_path.parent.mkdir(parents=True, exist_ok=True)
         file_path.write_bytes(upload.content)
 
         try:

@@ -33,6 +33,7 @@ from jax import lax
 
 from ..models.configs import LlamaConfig
 from ..models.llama import Params, forward
+from ..ops.pallas import attention_impl
 from ..ops.sampling import SamplingParams, sample
 from ..parallel.sharding import constrain_cache, shard_batch, shard_params
 from .kvcache import bucket_len, init_cache
@@ -52,6 +53,7 @@ def make_generate_fn(
     sampling: SamplingParams,
     stop_ids: Tuple[int, ...],
     mesh=None,
+    attn_impl: Optional[str] = None,
 ):
     """Build + jit a generate function for a fixed decode budget and sampler.
 
@@ -64,6 +66,11 @@ def make_generate_fn(
     carry their own NamedShardings in, and GSPMD lays the collectives.
     """
     pad_id = cfg.pad_id
+    # The impl is part of the lru_cache key (callers resolve
+    # attention_impl(mesh) per generate call), so flipping
+    # set_attention_impl() between calls picks up a fresh compilation
+    # instead of silently reusing the old path.
+    impl = attn_impl or attention_impl(mesh)
 
     def gen(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray, key: jax.Array):
         b, t = tokens.shape
@@ -75,7 +82,8 @@ def make_generate_fn(
         # at the other T-1 logits, and skipping them drops the [B, T, V]
         # prefill unembed to [B, 1, V].
         logits, cache = forward(
-            cfg, params, tokens, positions, cache, logit_indices=lengths - 1
+            cfg, params, tokens, positions, cache,
+            logit_indices=lengths - 1, attn_impl=impl,
         )
         first = sample(logits[:, 0], sampling, jax.random.fold_in(key, 0))
         done = _is_stop(first, stop_ids)
@@ -88,7 +96,9 @@ def make_generate_fn(
 
         def body(carry):
             out, cur, pos, done, cache, step = carry
-            logits, cache = forward(cfg, params, cur[:, None], pos[:, None], cache)
+            logits, cache = forward(
+                cfg, params, cur[:, None], pos[:, None], cache, attn_impl=impl
+            )
             nxt = sample(logits[:, 0], sampling, jax.random.fold_in(key, step))
             nxt = jnp.where(done, pad_id, nxt)
             done = done | _is_stop(nxt, stop_ids)
@@ -161,7 +171,8 @@ class InferenceEngine:
         if self.mesh is not None:
             tokens, lengths = shard_batch((tokens, lengths), self.mesh)
         fn = make_generate_fn(
-            self.cfg, int(max_new_tokens), sampling, self.stop_ids, self.mesh
+            self.cfg, int(max_new_tokens), sampling, self.stop_ids, self.mesh,
+            attention_impl(self.mesh),
         )
         out, gen_lens = fn(self.params, tokens, lengths, jax.random.key(seed))
         out, gen_lens = jax.device_get(out), jax.device_get(gen_lens)

@@ -18,20 +18,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-
-@dataclasses.dataclass(frozen=True)
-class RopeScaling:
-    """Llama-3 style rope frequency rescaling (used by Llama-3.2).
-
-    Matches the HF `rope_scaling={"rope_type": "llama3", ...}` semantics:
-    low-frequency bands are divided by `factor`, high-frequency bands are kept,
-    and a smooth interpolation bridges the two.
-    """
-
-    factor: float = 8.0
-    low_freq_factor: float = 1.0
-    high_freq_factor: float = 4.0
-    original_max_position_embeddings: int = 8192
+from ..ops.rope import RopeScaling  # noqa: F401  (canonical home: ops/rope.py)
 
 
 @dataclasses.dataclass(frozen=True)

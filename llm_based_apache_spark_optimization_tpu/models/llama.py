@@ -32,6 +32,7 @@ from jax import lax
 
 from ..ops.attention import attention_mask, gqa_attention
 from ..ops.norm import rms_norm
+from ..ops.pallas import attention_impl, flash_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
 from .configs import LlamaConfig
 
@@ -89,6 +90,7 @@ def forward(
     positions: jnp.ndarray,   # [B, T] int32 — absolute position of each token
     cache: Optional[Dict[str, jnp.ndarray]] = None,  # {"k","v"}: [L, B, S, K, H]
     logit_indices: Optional[jnp.ndarray] = None,  # [B] int32 — unembed only these T-indices
+    attn_impl: str = "xla",  # "xla" | "pallas"; resolve via ops.pallas.attention_impl(mesh)
 ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
     """Run T tokens through the stack; returns (logits f32, cache').
 
@@ -112,7 +114,16 @@ def forward(
         kv_size = t
     else:
         kv_size = cache["k"].shape[2]
-    mask = attention_mask(positions, kv_size, cfg.sliding_window)
+    # Default is the always-correct einsum path: a bare forward() cannot see
+    # whether its inputs are TP-sharded, and the pallas kernel requires
+    # unsharded operands (or an explicit shard_map) — callers that know the
+    # placement (engine/generate.py) pass the resolved impl explicitly.
+    impl = attn_impl
+    mask = (
+        attention_mask(positions, kv_size, cfg.sliding_window)
+        if impl == "xla"
+        else None
+    )
 
     nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
@@ -131,7 +142,12 @@ def forward(
             k_full = _update_cache(k_cache, k, start)
             v_full = _update_cache(v_cache, v, start)
             k_out, v_out = k_full, v_full
-        attn = gqa_attention(q, k_full, v_full, mask)
+        if impl == "pallas":
+            attn = flash_gqa_attention(
+                q, k_full, v_full, positions, cfg.sliding_window
+            )
+        else:
+            attn = gqa_attention(q, k_full, v_full, mask)
         x = x + attn.reshape(b, t, nh * hd) @ p["wo"]
         h2 = rms_norm(x, p["ln_mlp"], cfg.norm_eps)
         gate = jax.nn.silu((h2 @ p["wg"]).astype(jnp.float32)).astype(x.dtype)

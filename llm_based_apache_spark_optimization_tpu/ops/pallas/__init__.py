@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops.
+
+The XLA einsum path (`ops.attention.gqa_attention`) is the always-correct
+golden reference; these kernels are the bandwidth-optimal TPU implementations
+swapped in behind `attention_impl()`. On non-TPU backends the kernels run in
+interpreter mode so CPU tests exercise the same code path.
+
+Replaces the role of llama.cpp's hand-written attention kernels in the
+reference stack (reference `Flask/app.py:102-107` delegates inference to
+Ollama/llama.cpp, whose C++/CUDA kernels are the analogous hot loop).
+"""
+
+from .attention import flash_gqa_attention  # noqa: F401
+from .dispatch import attention_impl, set_attention_impl  # noqa: F401

@@ -1,0 +1,173 @@
+"""Flash GQA attention over the preallocated KV cache, as a Pallas TPU kernel.
+
+One kernel serves prefill (T = prompt bucket) and decode (T = 1): both are a
+causal read of the full [B, S, K, H] cache masked by absolute query positions
+(same contract as `ops.attention.gqa_attention`, which is the golden
+reference in tests).
+
+Kernel design (standard online-softmax flash schedule):
+
+- Grid = (B, K, cdiv(S, block_kv)). The KV-block axis is innermost, so for a
+  fixed (batch, kv-head) the S-blocks run sequentially on one core and the
+  running max / denominator / weighted-sum accumulators live in VMEM scratch
+  across grid steps — K and V stream HBM -> VMEM once, and the [GT, S] score
+  matrix is never materialized.
+- GQA without repetition: the G query heads sharing one KV head are folded
+  into the row axis (rows = G*T), so each K/V block is loaded once per KV
+  head, not once per query head. HBM traffic is what decode is bound by;
+  this is the kernel's whole reason to exist.
+- Causality via absolute positions: key slot s is visible to the query at
+  position p iff s <= p (and p - s < window for sliding-window models).
+  Cache slots past a sequence's length hold garbage but sit at s > p, so the
+  causal mask hides them — the same invariant engine/kvcache.py documents.
+- Scores/softmax accumulate in f32 on the MXU; out-of-range rows of a ragged
+  final KV block are masked the same way (their kv index exceeds every p).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..common import NEG_INF
+
+_LANES = 128  # VMEM lane width: scratch row-stats are kept lane-broadcast
+
+
+def _flash_kernel(
+    qpos_ref,  # [1, GT] i32   (positions tiled over the G query groups)
+    q_ref,     # [1, 1, GT, H]
+    k_ref,     # [1, BLK, 1, H]
+    v_ref,     # [1, BLK, 1, H]
+    o_ref,     # [1, 1, GT, H]
+    m_ref,     # [GT, LANES] f32 scratch — running row max (lane-broadcast)
+    l_ref,     # [GT, LANES] f32 scratch — running denominator
+    acc_ref,   # [GT, H] f32 scratch — running weighted V sum
+    *,
+    scale: float,
+    sliding_window: Optional[int],
+    kv_len: int,
+):
+    s_idx = pl.program_id(2)
+    blk = k_ref.shape[1]
+
+    @pl.when(s_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]            # [GT, H]
+    k = k_ref[0, :, 0]         # [BLK, H]
+    v = v_ref[0, :, 0]         # [BLK, H]
+    # A ragged final block reads past S: those rows are padding garbage
+    # (possibly NaN), and 0 * NaN = NaN would leak through the p @ v matmul
+    # even with p zeroed — zero the rows themselves.
+    row_pos = s_idx * blk + jax.lax.broadcasted_iota(
+        jnp.int32, v.shape, dimension=0
+    )
+    v = jnp.where(row_pos < kv_len, v, 0)
+
+    scores = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [GT, BLK]
+
+    qp = qpos_ref[0][:, None]  # [GT, 1]
+    kv_pos = s_idx * blk + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, dimension=1
+    )
+    mask = kv_pos <= qp
+    if sliding_window is not None:
+        mask = mask & (qp - kv_pos < sliding_window)
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    m_prev = m_ref[:, :1]                                   # [GT, 1]
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                          # [GT, 1]
+    p = jnp.exp(scores - m_new)                              # [GT, BLK]
+    # Fully-masked-so-far rows keep m == NEG_INF; exp(NEG_INF - NEG_INF) = 1
+    # would pollute l with BLK, so zero p where the mask killed the score.
+    p = jnp.where(mask, p, 0.0)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+    pv = jax.lax.dot_general(
+        p.astype(v.dtype), v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [GT, H]
+    acc_ref[:] = acc_ref[:] * alpha + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s_idx == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sliding_window", "block_kv", "interpret")
+)
+def flash_gqa_attention(
+    q: jnp.ndarray,            # [B, T, N, H]
+    k: jnp.ndarray,            # [B, S, K, H]
+    v: jnp.ndarray,            # [B, S, K, H]
+    q_positions: jnp.ndarray,  # [B, T] i32 — absolute position of each query
+    sliding_window: Optional[int] = None,
+    *,
+    block_kv: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Drop-in for `gqa_attention(q, k, v, attention_mask(positions, S, w))`.
+
+    Returns [B, T, N, H] in q's dtype.
+    """
+    b, t, n, h = q.shape
+    s, kh = k.shape[1], k.shape[2]
+    g = n // kh
+    gt = g * t
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    blk = min(block_kv, s)
+    grid = (b, kh, pl.cdiv(s, blk))
+
+    # [B, T, N, H] -> [B, K, G*T, H]: fold query groups into rows per KV head.
+    q5 = q.reshape(b, t, kh, g, h).transpose(0, 2, 3, 1, 4).reshape(b, kh, gt, h)
+    # Row r = g*T + t attends from position q_positions[b, r % T].
+    qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))  # [B, GT]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, scale=h**-0.5, sliding_window=sliding_window,
+            kv_len=s,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, gt), lambda bi, ki, si: (bi, 0)),
+            pl.BlockSpec((1, 1, gt, h), lambda bi, ki, si: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, blk, 1, h), lambda bi, ki, si: (bi, si, ki, 0)),
+            pl.BlockSpec((1, blk, 1, h), lambda bi, ki, si: (bi, si, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, gt, h), lambda bi, ki, si: (bi, ki, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, kh, gt, h), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((gt, _LANES), jnp.float32),
+            pltpu.VMEM((gt, _LANES), jnp.float32),
+            pltpu.VMEM((gt, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qpos, q5, k, v)
+
+    # [B, K, G*T, H] -> [B, T, N, H]
+    return out.reshape(b, kh, g, t, h).transpose(0, 3, 1, 2, 4).reshape(b, t, n, h)

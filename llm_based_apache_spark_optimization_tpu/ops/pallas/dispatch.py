@@ -1,0 +1,47 @@
+"""Attention implementation selection: XLA einsum vs Pallas flash kernel.
+
+Modes:
+- "xla"    — always the einsum reference path (`ops.attention.gqa_attention`).
+- "pallas" — always the flash kernel (interpreted off-TPU).
+- "auto"   — (default) flash kernel on single-device TPU programs, einsum
+  otherwise. Under a TP mesh the einsum path stays default because GSPMD
+  partitions it across the "tp"-sharded KV-head axis for free, while a
+  pallas_call would need an explicit shard_map wrapper (planned follow-up).
+
+Selected once per `forward` trace; override globally with
+`set_attention_impl(...)` or per-process with LBASO_ATTENTION_IMPL.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_VALID = ("auto", "xla", "pallas")
+_mode: Optional[str] = None
+
+
+def set_attention_impl(mode: Optional[str]) -> None:
+    """Force 'xla'/'pallas', or restore the default with 'auto'/None.
+
+    'auto' clears the override entirely so the LBASO_ATTENTION_IMPL env var
+    (the operator's setting) is consulted again rather than being shadowed.
+    """
+    global _mode
+    if mode is not None and mode not in _VALID:
+        raise ValueError(f"attention impl {mode!r} not in {_VALID}")
+    _mode = None if mode in (None, "auto") else mode
+
+
+def attention_impl(mesh=None) -> str:
+    """Resolve to 'xla' or 'pallas' for the current trace."""
+    mode = _mode or os.environ.get("LBASO_ATTENTION_IMPL", "auto")
+    if mode not in _VALID:
+        raise ValueError(f"LBASO_ATTENTION_IMPL={mode!r} not in {_VALID}")
+    if mode != "auto":
+        return mode
+    if mesh is not None:
+        return "xla"
+    return "pallas" if jax.devices()[0].platform == "tpu" else "xla"

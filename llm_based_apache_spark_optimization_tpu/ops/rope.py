@@ -12,11 +12,28 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import jax.numpy as jnp
 
-from ..models.configs import RopeScaling
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3 style rope frequency rescaling (used by Llama-3.2).
+
+    Matches the HF `rope_scaling={"rope_type": "llama3", ...}` semantics:
+    low-frequency bands are divided by `factor`, high-frequency bands are kept,
+    and a smooth interpolation bridges the two.
+
+    Defined here (not models/configs.py) so ops/ never imports models/ —
+    keeps the layering acyclic: ops -> nothing, models -> ops, engine -> both.
+    """
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
 
 
 def _inv_freq(head_dim: int, theta: float, scaling: Optional[RopeScaling]) -> jnp.ndarray:

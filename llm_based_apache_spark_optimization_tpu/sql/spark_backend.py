@@ -51,6 +51,12 @@ class SparkBackend:
         # the engine's own CSV writer (coalesce(1) + part-file rename).
         out = Path(out_path)
         out.parent.mkdir(parents=True, exist_ok=True)
+        if not result.rows:
+            # createDataFrame([]) cannot infer types; an empty result is a
+            # successful query — write the header-only CSV directly (same
+            # output shape the SQLite backend produces).
+            out.write_text(",".join(result.columns) + "\n")
+            return str(out)
         df = self._spark.createDataFrame(result.rows, schema=list(result.columns))
         tmp = tempfile.mkdtemp(prefix="spark_out_")
         df.coalesce(1).write.mode("overwrite").option("header", "true").csv(tmp)

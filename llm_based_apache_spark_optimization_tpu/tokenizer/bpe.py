@@ -77,13 +77,24 @@ class BPETokenizer:
         ordered = sorted(self.merges.items(), key=lambda kv: kv[1])
         Path(path).write_text(json.dumps({
             "n_special": self.n_special,
+            "pad_id": self.pad_id,
+            "bos_id": self.bos_id,
+            "eos_id": self.eos_id,
             "merges": [list(pair) for pair, _ in ordered],
         }))
 
     @classmethod
     def load(cls, path: str | Path) -> "BPETokenizer":
         blob = json.loads(Path(path).read_text())
-        return cls([tuple(m) for m in blob["merges"]], n_special=blob["n_special"])
+        return cls(
+            [tuple(m) for m in blob["merges"]],
+            n_special=blob["n_special"],
+            # Older saves predate special-id persistence; fall back to the
+            # constructor defaults they were built with.
+            pad_id=blob.get("pad_id", 0),
+            bos_id=blob.get("bos_id", 1),
+            eos_id=blob.get("eos_id", 2),
+        )
 
 
 def train_bpe(corpus: Iterable[str], num_merges: int, n_special: int = 3) -> BPETokenizer:

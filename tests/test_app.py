@@ -204,8 +204,11 @@ def test_multipart_preserves_trailing_newlines(cfg, tmp_path):
     client = app.test_client()
     client.post_multipart("/process-data/", fields={"input_text": "q"},
                           files={"file": ("taxi.csv", content.encode())})
-    staged = (Path(cfg.input_dir) / "taxi.csv").read_bytes()
-    assert staged == content.encode()
+    # Uploads stage into a per-request unique subdirectory (concurrent
+    # same-name uploads must not overwrite each other).
+    staged_paths = list(Path(cfg.input_dir).glob("*/taxi.csv"))
+    assert len(staged_paths) == 1
+    assert staged_paths[0].read_bytes() == content.encode()
 
 
 def test_readonly_poll_does_not_clobber_session_result(web):

@@ -58,6 +58,15 @@ def test_bpe_save_load_roundtrip(tmp_path):
     assert tok2.decode(tok2.encode(text)) == text
 
 
+def test_bpe_save_load_preserves_special_ids(tmp_path):
+    tok = BPETokenizer([], n_special=8, pad_id=3, bos_id=5, eos_id=6)
+    path = tmp_path / "bpe_special.json"
+    tok.save(path)
+    tok2 = BPETokenizer.load(path)
+    assert (tok2.pad_id, tok2.bos_id, tok2.eos_id) == (3, 5, 6)
+    assert tok2.n_special == 8
+
+
 def test_bpe_handles_unseen_bytes():
     tok = train_bpe(["ascii only"] * 4, num_merges=8)
     text = "日本語 ¿ñ?"
