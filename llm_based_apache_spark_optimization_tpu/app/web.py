@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import html
 import secrets
+import shutil
 import threading
 import time
 import uuid
@@ -127,10 +128,16 @@ def create_web_app(
         file_path.write_bytes(upload.content)
 
         try:
-            result = pipeline.run(
-                str(file_path), input_text,
-                status=lambda s, m: board.set(sid, s, m),
-            )
+            try:
+                result = pipeline.run(
+                    str(file_path), input_text,
+                    status=lambda s, m: board.set(sid, s, m),
+                )
+            finally:
+                # The staged copy is only needed between this handler's write
+                # and the pipeline's read-back; without cleanup every upload
+                # would grow input_dir forever.
+                shutil.rmtree(file_path.parent, ignore_errors=True)
         except Exception as e:
             # Reference parity: the Flask handler routes ANY failure through
             # the LLM error-analysis page (Flask/app.py:151-172) — but unlike

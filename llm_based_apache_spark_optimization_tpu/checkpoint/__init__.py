@@ -1,0 +1,14 @@
+"""Checkpoint layer: HF safetensors -> sharded JAX param trees, plus a native
+resharded cache.
+
+This is the TPU build's equivalent of the reference stack's weight handling —
+there, GGUF blobs are downloaded and memory-mapped by Ollama/llama.cpp
+("locally downloaded Ollama model", reference Project Report ch.3); here the
+framework owns the loading path end-to-end (SURVEY.md §5 "Checkpoint /
+resume"): read HF-format safetensors, map tensor names onto the
+`models.llama.init_params` tree, stack per-layer weights for the scanned
+block, cast to the serving dtype, and place directly onto a TP×DP mesh.
+"""
+
+from .hf import config_from_hf, load_hf_checkpoint, save_hf_checkpoint  # noqa: F401
+from .cache import load_native, save_native  # noqa: F401

@@ -46,7 +46,6 @@ def _is_stop(tok: jnp.ndarray, stop_ids: Tuple[int, ...]) -> jnp.ndarray:
     return hit
 
 
-@functools.lru_cache(maxsize=64)
 def make_generate_fn(
     cfg: LlamaConfig,
     max_new: int,
@@ -54,6 +53,23 @@ def make_generate_fn(
     stop_ids: Tuple[int, ...],
     mesh=None,
     attn_impl: Optional[str] = None,
+):
+    """Resolve the attention impl *outside* the cache boundary so a
+    set_attention_impl() flip between calls maps to a different cache key
+    (and thus a fresh compilation) even for callers that omit attn_impl."""
+    return _make_generate_fn(
+        cfg, max_new, sampling, stop_ids, mesh, attn_impl or attention_impl(mesh)
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _make_generate_fn(
+    cfg: LlamaConfig,
+    max_new: int,
+    sampling: SamplingParams,
+    stop_ids: Tuple[int, ...],
+    mesh,
+    attn_impl: str,
 ):
     """Build + jit a generate function for a fixed decode budget and sampler.
 
@@ -66,11 +82,7 @@ def make_generate_fn(
     carry their own NamedShardings in, and GSPMD lays the collectives.
     """
     pad_id = cfg.pad_id
-    # The impl is part of the lru_cache key (callers resolve
-    # attention_impl(mesh) per generate call), so flipping
-    # set_attention_impl() between calls picks up a fresh compilation
-    # instead of silently reusing the old path.
-    impl = attn_impl or attention_impl(mesh)
+    impl = attn_impl
 
     def gen(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray, key: jax.Array):
         b, t = tokens.shape
@@ -141,7 +153,9 @@ class InferenceEngine:
             params = shard_params(params, cfg, mesh)
         self.params = params
         self.stop_ids = tuple(stop_ids) if stop_ids is not None else (cfg.eos_id,)
-        self.prompt_bucket = prompt_bucket
+        # A bucket as large as the whole context would leave no decode room
+        # after bucketing even a short prompt; cap at half the context.
+        self.prompt_bucket = min(prompt_bucket, max(1, cfg.max_seq_len // 2))
 
     def generate(
         self,

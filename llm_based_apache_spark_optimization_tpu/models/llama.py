@@ -32,7 +32,7 @@ from jax import lax
 
 from ..ops.attention import attention_mask, gqa_attention
 from ..ops.norm import rms_norm
-from ..ops.pallas import attention_impl, flash_gqa_attention
+from ..ops.pallas import flash_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
 from .configs import LlamaConfig
 

@@ -49,6 +49,32 @@ class EngineBackend:
         self.add_bos = add_bos
         self._lock = threading.Lock()
 
+    @classmethod
+    def from_hf_checkpoint(
+        cls,
+        ckpt_dir: str,
+        tokenizer: Tokenizer,
+        mesh=None,
+        dtype=None,
+        prompt_bucket: int = 128,
+        stop_ids: Optional[Sequence[int]] = None,
+        **kwargs,
+    ) -> "EngineBackend":
+        """Stand up a backend straight from an HF-format checkpoint directory
+        (the deployment path: weights land pre-sharded on the mesh)."""
+        import jax.numpy as jnp
+
+        from ..checkpoint import load_hf_checkpoint
+
+        cfg, params = load_hf_checkpoint(
+            ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=mesh
+        )
+        engine = InferenceEngine(
+            cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
+            stop_ids=stop_ids,
+        )
+        return cls(engine, tokenizer, **kwargs)
+
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)

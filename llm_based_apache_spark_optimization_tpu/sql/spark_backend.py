@@ -54,8 +54,11 @@ class SparkBackend:
         if not result.rows:
             # createDataFrame([]) cannot infer types; an empty result is a
             # successful query — write the header-only CSV directly (same
-            # output shape the SQLite backend produces).
-            out.write_text(",".join(result.columns) + "\n")
+            # output shape the SQLite backend produces, incl. quoting).
+            import csv
+
+            with out.open("w", newline="") as f:
+                csv.writer(f).writerow(result.columns)
             return str(out)
         df = self._spark.createDataFrame(result.rows, schema=list(result.columns))
         tmp = tempfile.mkdtemp(prefix="spark_out_")

@@ -198,17 +198,26 @@ def test_api_path_traversal_rejected(api):
 
 
 def test_multipart_preserves_trailing_newlines(cfg, tmp_path):
-    """Upload bytes must be staged exactly — including trailing blank lines."""
+    """Upload bytes must reach the SQL backend exactly — including trailing
+    blank lines. Captured at load_csv time because the staged copy lives in a
+    per-request unique directory that is deleted after the run."""
     content = CSV + "\n"  # trailing blank line
-    app = create_web_app(make_service(), SQLiteBackend, SQLiteHistory(), cfg)
+    seen = {}
+
+    class CapturingBackend(SQLiteBackend):
+        def load_csv(self, path, view_name="temp_view"):
+            seen["bytes"] = Path(path).read_bytes()
+            seen["name"] = Path(path).name
+            return super().load_csv(path, view_name)
+
+    app = create_web_app(make_service(), CapturingBackend, SQLiteHistory(), cfg)
     client = app.test_client()
     client.post_multipart("/process-data/", fields={"input_text": "q"},
                           files={"file": ("taxi.csv", content.encode())})
-    # Uploads stage into a per-request unique subdirectory (concurrent
-    # same-name uploads must not overwrite each other).
-    staged_paths = list(Path(cfg.input_dir).glob("*/taxi.csv"))
-    assert len(staged_paths) == 1
-    assert staged_paths[0].read_bytes() == content.encode()
+    assert seen["bytes"] == content.encode()
+    assert seen["name"] == "taxi.csv"
+    # ... and the per-request staging directory is cleaned up afterwards.
+    assert list(Path(cfg.input_dir).glob("*/*")) == []
 
 
 def test_readonly_poll_does_not_clobber_session_result(web):

@@ -73,22 +73,18 @@ def test_flash_sliding_window():
 def test_generate_parity_pallas_vs_xla(tiny_model):
     """Whole generate loop: flash path produces the same tokens as einsum."""
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
-    from llm_based_apache_spark_optimization_tpu.engine.generate import (
-        make_generate_fn,
-    )
 
     cfg, params = tiny_model
     prompts = [[1, 7, 11, 2], [1, 5]]
+    # No cache_clear needed: the resolved impl is part of the generate-fn
+    # cache key, so flipping set_attention_impl() compiles a fresh fn.
     try:
         set_attention_impl("xla")
-        make_generate_fn.cache_clear()
         eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
         ref = eng.generate(prompts, max_new_tokens=6)
         set_attention_impl("pallas")
-        make_generate_fn.cache_clear()
         eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
         out = eng.generate(prompts, max_new_tokens=6)
     finally:
         set_attention_impl("auto")
-        make_generate_fn.cache_clear()
     assert ref == out
