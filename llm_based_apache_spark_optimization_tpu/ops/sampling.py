@@ -44,7 +44,8 @@ def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     return jnp.where(logits < kth, NEG_INF, logits)
 
 
-def _apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+def _apply_top_p(logits: jnp.ndarray, p) -> jnp.ndarray:
+    """`p` may be a python float or a per-row [B, 1] array (runtime nucleus)."""
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
@@ -70,3 +71,27 @@ def sample(
     if params.top_p < 1.0:
         logits = _apply_top_p(logits, params.top_p)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_runtime(
+    logits: jnp.ndarray,       # [B, V] f32
+    temperature: jnp.ndarray,  # [B] f32; <= 0 means greedy for that row
+    top_p: jnp.ndarray,        # [B] f32; >= 1 disables nucleus for that row
+    key: jax.Array,
+) -> jnp.ndarray:
+    """Per-row runtime sampling for mixed batches (continuous batching).
+
+    Unlike `sample`, temperature/top_p are traced [B] arrays, so one compiled
+    decode program serves a batch mixing greedy NL→SQL requests with sampled
+    error-analysis requests (BASELINE.json config 5) — the per-slot knobs
+    change per step without recompilation. Runtime top-k is deliberately not
+    offered: a data-dependent k can't keep the sort/cutoff shape static.
+    Cost: every row pays the vocab sort even if all-greedy; the all-greedy
+    single-signature path (`sample`) skips it.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy_tok = greedy(logits)
+    t = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = _apply_top_p(logits / t, top_p[:, None])
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_tok, sampled)

@@ -1,5 +1,6 @@
 """Generation service: model registry, prompt templates, backends."""
 
 from .backends import Completion, EngineBackend, FakeBackend  # noqa: F401
+from .scheduler import ContinuousBatchingScheduler, SchedulerBackend  # noqa: F401
 from .service import GenerateResult, GenerationService  # noqa: F401
 from .templates import TEMPLATES  # noqa: F401

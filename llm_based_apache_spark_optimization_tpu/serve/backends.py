@@ -23,6 +23,15 @@ class Completion:
     output_tokens: int
 
 
+def trim_stop_texts(text: str, stop_texts: Sequence[str]) -> str:
+    """Cut the completion at the first occurrence of any stop string."""
+    for stop in stop_texts:
+        cut = text.find(stop)
+        if cut != -1:
+            text = text[:cut]
+    return text
+
+
 class EngineBackend:
     """Tokenize → engine.generate → detokenize. Thread-safe: one lock per
     backend serializes device work (the continuous-batching scheduler
@@ -101,11 +110,7 @@ class EngineBackend:
         # Strip the stop token itself from the text.
         if out and out[-1] in self.engine.stop_ids:
             out = out[:-1]
-        text = self.tokenizer.decode(out)
-        for stop in self.stop_texts:
-            cut = text.find(stop)
-            if cut != -1:
-                text = text[:cut]
+        text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
         return Completion(text=text, output_tokens=len(out))
 
 

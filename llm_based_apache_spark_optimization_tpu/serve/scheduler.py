@@ -1,0 +1,427 @@
+"""Continuous-batching scheduler: concurrent serving on one persistent cache.
+
+This is the TPU-native replacement for Ollama's request queue + llama.cpp's
+slot scheduler (the reference serializes everything: one blocking
+`ollama.generate` per HTTP handler, reference `Flask/app.py:102-107`,
+`FastAPI/app.py:85-90`). Concurrent FastAPI requests here share ONE decode
+batch on the device (BASELINE.json config 5: mixed NL→SQL + error-analysis
+serving), instead of queueing behind a per-backend lock.
+
+Design (slot-based continuous batching, TPU/XLA-shaped):
+
+- A fixed pool of `num_slots` sequence slots backs a persistent KV cache
+  [L, num_slots, S_max, K, H] that lives across jit calls. Both jitted
+  programs donate the cache buffers, so XLA updates HBM in place — no
+  per-request allocation, no growth, static shapes forever.
+- **Prefill** is one jitted fn per prompt-length bucket: run the prompt
+  through the stack against the slot's cache row (sliced out with
+  `dynamic_slice`, written back with `dynamic_update_slice`) and sample the
+  first token.
+- **Decode** is one jitted fn total: a `lax.scan` of `decode_chunk` single
+  token steps over the whole slot batch. Chunking amortizes the host↔device
+  sync to 1/chunk per token; the host inspects tokens between chunks to
+  retire finished sequences and admit pending ones into freed slots.
+- Mixed sampling rides per-slot runtime arrays (ops/sampling.sample_runtime):
+  greedy SQL generation and temperature/top-p error analysis share one
+  compiled decode program.
+- Free slots keep decoding garbage at a frozen position. That is safe by the
+  cache-visibility invariant (engine/kvcache.py): admission prefill
+  overwrites slots [0, T), and beyond T the new sequence's own decode writes
+  position p before p ever becomes visible to attention.
+- Tensor parallelism: pass a mesh with dp=1 — request parallelism comes from
+  slots (the batch axis stays unsharded because slots are dynamically
+  indexed), TP shards heads/MLP exactly as in engine/generate.py.
+
+Bounds: a request needs `bucket_len(prompt) + max_new + decode_chunk
+<= S_max` — the chunk term because the device can overshoot a budget or a
+stop token by up to chunk-1 steps before the host notices (those tokens are
+discarded; their cache writes are garbage covered by the invariant above).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from concurrent.futures import Future
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..engine.kvcache import bucket_len, init_cache
+from ..models.configs import LlamaConfig
+from ..models.llama import Params, forward
+from ..ops.pallas import attention_impl
+from ..ops.sampling import SamplingParams, sample_runtime
+from ..parallel.sharding import shard_params, validate_tp
+
+
+@dataclasses.dataclass
+class _Request:
+    ids: List[int]
+    max_new: int
+    temperature: float
+    top_p: float
+    future: Future
+    # live state (set at admission)
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+
+class ContinuousBatchingScheduler:
+    """Admit → prefill → batched chunked decode → retire, on one device batch.
+
+    `submit()` is thread-safe and returns a Future of generated token ids
+    (stop token stripped). A daemon thread owns all device work.
+    """
+
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params: Params,
+        num_slots: int = 8,
+        max_seq: Optional[int] = None,
+        decode_chunk: int = 8,
+        prompt_bucket: int = 128,
+        stop_ids: Optional[Sequence[int]] = None,
+        mesh=None,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            if dict(mesh.shape).get("dp", 1) != 1:
+                raise ValueError(
+                    "scheduler mesh must have dp=1: request parallelism comes "
+                    "from slots; the slot axis is dynamically indexed and "
+                    "cannot shard"
+                )
+            validate_tp(cfg, mesh.shape["tp"])
+            params = shard_params(params, cfg, mesh)
+        self.params = params
+        self.num_slots = num_slots
+        self.max_seq = min(max_seq or cfg.max_seq_len, cfg.max_seq_len)
+        self.decode_chunk = decode_chunk
+        self.prompt_bucket = min(prompt_bucket, max(1, self.max_seq // 2))
+        self.stop_ids = tuple(stop_ids) if stop_ids is not None else (cfg.eos_id,)
+        self._impl = attention_impl(mesh)
+
+        dtype = jax.tree.leaves(params)[0].dtype
+        cache = init_cache(cfg, num_slots, self.max_seq, dtype=dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = P(None, None, None, "tp", None)  # slots unsharded, KV heads on tp
+            cache = jax.tree.map(
+                lambda x: jax.device_put(x, NamedSharding(mesh, spec)), cache
+            )
+        self._ck, self._cv = cache["k"], cache["v"]
+
+        # Per-slot device state (replicated scalars, updated between chunks).
+        self._cur = np.zeros(num_slots, np.int32)        # next token to feed
+        self._pos = np.zeros(num_slots, np.int32)        # its absolute position
+        self._temps = np.zeros(num_slots, np.float32)
+        self._topps = np.ones(num_slots, np.float32)
+        self._slot_req: List[Optional[_Request]] = [None] * num_slots
+
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._crash: Optional[BaseException] = None
+        # Guards the closed-check+enqueue in submit() against the final queue
+        # drain in _close(): a request either lands before the drain starts
+        # (and is drained) or submit() observes _closed and raises.
+        self._submit_lock = threading.Lock()
+        self._closed = False
+        self._step = 0
+        self._key = jax.random.key(0)
+        self._prefill_fns: Dict[int, object] = {}
+        self._decode_fn = self._build_decode()
+
+    # ---------------------------------------------------------------- jitted
+
+    def _build_prefill(self, t_bucket: int):
+        cfg, impl = self.cfg, self._impl
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill(params, ck, cv, tokens, length, slot, temp, topp, key):
+            row_k = lax.dynamic_slice_in_dim(ck, slot, 1, axis=1)
+            row_v = lax.dynamic_slice_in_dim(cv, slot, 1, axis=1)
+            positions = jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
+            logits, new = forward(
+                cfg, params, tokens, positions, {"k": row_k, "v": row_v},
+                logit_indices=length - 1, attn_impl=impl,
+            )
+            ck = lax.dynamic_update_slice_in_dim(ck, new["k"], slot, axis=1)
+            cv = lax.dynamic_update_slice_in_dim(cv, new["v"], slot, axis=1)
+            tok = sample_runtime(logits[:, 0], temp, topp, key)
+            return ck, cv, tok
+
+        return prefill
+
+    def _build_decode(self):
+        cfg, impl, chunk = self.cfg, self._impl, self.decode_chunk
+        pad_id = cfg.pad_id
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode(params, ck, cv, cur, pos, active, temps, topps, key):
+            def step(carry, i):
+                ck, cv, cur, pos = carry
+                logits, cache = forward(
+                    cfg, params, cur[:, None], pos[:, None],
+                    {"k": ck, "v": cv}, attn_impl=impl,
+                )
+                nxt = sample_runtime(
+                    logits[:, 0], temps, topps, jax.random.fold_in(key, i)
+                )
+                nxt = jnp.where(active, nxt, pad_id)
+                pos = jnp.where(active, pos + 1, pos)
+                return (cache["k"], cache["v"], nxt, pos), nxt
+
+            (ck, cv, cur, pos), toks = lax.scan(
+                step, (ck, cv, cur, pos), jnp.arange(chunk)
+            )
+            return ck, cv, cur, pos, toks.T  # toks: [num_slots, chunk]
+
+        return decode
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "ContinuousBatchingScheduler":
+        if self._thread is None:
+            if self._crash is not None:
+                raise RuntimeError("scheduler loop crashed") from self._crash
+            self._stop_evt.clear()
+            with self._submit_lock:
+                self._closed = False
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        if self._thread is not None:
+            self._stop_evt.set()
+            self._queue.put(None)  # wake the loop
+            self._thread.join()
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    # ---------------------------------------------------------------- client
+
+    def submit(
+        self,
+        ids: Sequence[int],
+        max_new_tokens: int = 256,
+        sampling: SamplingParams = SamplingParams(),
+        # Accepted for engine-API parity but IGNORED: under continuous
+        # batching, sampled tokens draw from the scheduler's shared key
+        # stream, whose state depends on how concurrent requests interleave —
+        # per-request stochastic reproducibility is not available here (use
+        # InferenceEngine directly when it matters; greedy is always exact).
+        seed: int = 0,  # noqa: ARG002
+    ) -> "Future[List[int]]":
+        if not ids:
+            raise ValueError("empty prompt")
+        if sampling.top_k:
+            raise ValueError(
+                "runtime top-k is not supported under continuous batching "
+                "(static-shape constraint); use top_p/temperature"
+            )
+        need = bucket_len(len(ids), self.prompt_bucket) + max_new_tokens + self.decode_chunk
+        if need > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(ids)} tokens, bucketed) + max_new_tokens "
+                f"({max_new_tokens}) + decode_chunk ({self.decode_chunk}) "
+                f"= {need} exceeds scheduler max_seq={self.max_seq}"
+            )
+        req = _Request(
+            ids=list(ids), max_new=max_new_tokens,
+            temperature=sampling.temperature, top_p=sampling.top_p,
+            future=Future(),
+        )
+        with self._submit_lock:
+            if self._closed:
+                if self._crash is not None:
+                    raise RuntimeError("scheduler loop crashed") from self._crash
+                raise RuntimeError("scheduler has shut down")
+            self._queue.put(req)
+        return req.future
+
+    def generate(
+        self,
+        prompts: List[List[int]],
+        max_new_tokens: int = 256,
+        sampling: SamplingParams = SamplingParams(),
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Synchronous batch helper (engine-compatible signature)."""
+        futs = [
+            self.submit(p, max_new_tokens=max_new_tokens, sampling=sampling, seed=seed)
+            for p in prompts
+        ]
+        return [f.result() for f in futs]
+
+    # ------------------------------------------------------------ event loop
+
+    def _next_key(self) -> jax.Array:
+        self._step += 1
+        return jax.random.fold_in(self._key, self._step)
+
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        """Prefill `req` into `slot`; may retire immediately on a stop token."""
+        t = bucket_len(len(req.ids), self.prompt_bucket)
+        if t not in self._prefill_fns:
+            self._prefill_fns[t] = self._build_prefill(t)
+        tokens = jnp.asarray(
+            [req.ids + [self.cfg.pad_id] * (t - len(req.ids))], jnp.int32
+        )
+        self._ck, self._cv, tok = self._prefill_fns[t](
+            self.params, self._ck, self._cv, tokens,
+            jnp.asarray([len(req.ids)], jnp.int32), jnp.int32(slot),
+            jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32), self._next_key(),
+        )
+        first = int(jax.device_get(tok)[0])
+        if first in self.stop_ids or req.max_new < 1:
+            req.future.set_result([])
+            return
+        req.generated.append(first)
+        if req.max_new == 1:
+            req.future.set_result(req.generated)
+            return
+        self._slot_req[slot] = req
+        self._cur[slot] = first
+        self._pos[slot] = len(req.ids)
+        self._temps[slot] = req.temperature
+        self._topps[slot] = req.top_p
+
+    def _decode_round(self) -> None:
+        active = np.asarray([r is not None for r in self._slot_req])
+        self._ck, self._cv, cur, pos, toks = self._decode_fn(
+            self.params, self._ck, self._cv,
+            jnp.asarray(self._cur), jnp.asarray(self._pos), jnp.asarray(active),
+            jnp.asarray(self._temps), jnp.asarray(self._topps), self._next_key(),
+        )
+        # np.array copies: device_get hands back read-only views of device
+        # buffers, and _admit mutates these in place.
+        self._cur, self._pos = np.array(jax.device_get(cur)), np.array(jax.device_get(pos))
+        toks = np.asarray(jax.device_get(toks))
+        for i, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            done = False
+            for tok in toks[i]:
+                tok = int(tok)
+                if tok in self.stop_ids:
+                    done = True
+                    break
+                req.generated.append(tok)
+                if len(req.generated) >= req.max_new:
+                    done = True
+                    break
+            if done:
+                req.future.set_result(req.generated)
+                self._slot_req[i] = None
+
+    def _run(self) -> None:
+        try:
+            self._loop()
+            self._close(RuntimeError("scheduler shut down mid-request"))
+        except BaseException as exc:  # noqa: BLE001 — a dead loop must not hang clients
+            self._crash = exc
+            self._close(exc)
+            raise
+
+    def _close(self, exc: BaseException) -> None:
+        """Fail every in-flight and queued request; reject future submits."""
+        with self._submit_lock:
+            self._closed = True
+        for i, req in enumerate(self._slot_req):
+            if req is not None:
+                req.future.set_exception(exc)
+                self._slot_req[i] = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.future.set_exception(exc)
+
+    def _loop(self) -> None:
+        while not self._stop_evt.is_set():
+            # Admit pending requests into every free slot, then run one decode
+            # chunk; when fully idle, block briefly for work instead of spinning.
+            while self._free_slots():
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if req is not None:
+                    self._admit(self._free_slots()[0], req)
+            if any(r is not None for r in self._slot_req):
+                self._decode_round()
+            else:
+                try:
+                    req = self._queue.get(timeout=0.05)
+                    if req is not None:
+                        self._admit(0, req)
+                except queue.Empty:
+                    pass
+
+
+class SchedulerBackend:
+    """`serve.GenerationService`-compatible backend over the scheduler.
+
+    Drop-in for `EngineBackend` (same `.complete()` seam, backends.py): N
+    HTTP handler threads calling `complete()` concurrently share one decode
+    batch instead of serializing on a lock.
+    """
+
+    def __init__(
+        self,
+        scheduler: ContinuousBatchingScheduler,
+        tokenizer,
+        max_new_tokens: int = 256,
+        sampling: SamplingParams = SamplingParams(),
+        stop_texts: Sequence[str] = (),
+        add_bos: bool = True,
+    ):
+        self.scheduler = scheduler.start()
+        self.tokenizer = tokenizer
+        self.max_new_tokens = max_new_tokens
+        self.sampling = sampling
+        self.stop_texts = tuple(stop_texts)
+        self.add_bos = add_bos
+
+    def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
+                 sampling: Optional[SamplingParams] = None, seed: int = 0):
+        from .backends import Completion, trim_stop_texts
+
+        sched = self.scheduler
+        ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+        room = sched.max_seq - sched.decode_chunk - bucket_len(
+            len(ids), sched.prompt_bucket
+        )
+        if room < 1:
+            raise ValueError(
+                f"prompt ({len(ids)} tokens) leaves no room in the "
+                f"{sched.max_seq}-token scheduler window of {sched.cfg.name}"
+            )
+        budget = min(max_new_tokens or self.max_new_tokens, room)
+        out = sched.submit(
+            ids, max_new_tokens=budget, sampling=sampling or self.sampling,
+            seed=seed,
+        ).result()
+        text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
+        return Completion(text=text, output_tokens=len(out))
