@@ -83,6 +83,11 @@ def _make_generate_fn(
     """
     pad_id = cfg.pad_id
     impl = attn_impl
+    # With a sequence-parallel axis in the mesh, prefill runs ring attention
+    # (sequence sharded over sp, KV blocks rotating on ICI); decode keeps the
+    # resolved single-block impl — its T=1 queries have nothing to shard.
+    sp = dict(mesh.shape).get("sp", 1) if mesh is not None else 1
+    prefill_impl = "ring" if sp > 1 else impl
 
     def gen(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray, key: jax.Array):
         b, t = tokens.shape
@@ -95,7 +100,7 @@ def _make_generate_fn(
         # prefill unembed to [B, 1, V].
         logits, cache = forward(
             cfg, params, tokens, positions, cache,
-            logit_indices=lengths - 1, attn_impl=impl,
+            logit_indices=lengths - 1, attn_impl=prefill_impl, mesh=mesh,
         )
         first = sample(logits[:, 0], sampling, jax.random.fold_in(key, 0))
         done = _is_stop(first, stop_ids)
@@ -157,6 +162,16 @@ class InferenceEngine:
         # after bucketing even a short prompt; cap at half the context.
         self.prompt_bucket = min(prompt_bucket, max(1, cfg.max_seq_len // 2))
 
+    def padded_prompt_len(self, n: int) -> int:
+        """Device-side prompt length for an n-token prompt: bucketed, then —
+        on an sp mesh — padded so ring prefill gives each device an equal
+        sequence block. Callers budgeting decode room against max_seq_len
+        (serve/backends.py) must use this, not bucket_len alone."""
+        t = bucket_len(n, self.prompt_bucket)
+        if self.mesh is not None:
+            t += -t % dict(self.mesh.shape).get("sp", 1)
+        return t
+
     def generate(
         self,
         prompts: List[List[int]],
@@ -166,7 +181,7 @@ class InferenceEngine:
     ) -> List[List[int]]:
         assert prompts and all(len(p) >= 1 for p in prompts), "empty prompt"
         b = len(prompts)
-        t = bucket_len(max(len(p) for p in prompts), self.prompt_bucket)
+        t = self.padded_prompt_len(max(len(p) for p in prompts))
         if t + max_new_tokens > self.cfg.max_seq_len:
             raise ValueError(
                 f"bucketed prompt ({t}) + max_new_tokens ({max_new_tokens}) "

@@ -33,6 +33,7 @@ from jax import lax
 from ..ops.attention import attention_mask, gqa_attention
 from ..ops.norm import rms_norm
 from ..ops.pallas import flash_gqa_attention
+from ..ops.ring_attention import ring_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
 from .configs import LlamaConfig
 
@@ -90,7 +91,8 @@ def forward(
     positions: jnp.ndarray,   # [B, T] int32 — absolute position of each token
     cache: Optional[Dict[str, jnp.ndarray]] = None,  # {"k","v"}: [L, B, S, K, H]
     logit_indices: Optional[jnp.ndarray] = None,  # [B] int32 — unembed only these T-indices
-    attn_impl: str = "xla",  # "xla" | "pallas"; resolve via ops.pallas.attention_impl(mesh)
+    attn_impl: str = "xla",  # "xla" | "pallas" | "ring"; resolve via ops.pallas.attention_impl
+    mesh=None,  # required for attn_impl="ring" (context-parallel prefill)
 ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
     """Run T tokens through the stack; returns (logits f32, cache').
 
@@ -119,6 +121,8 @@ def forward(
     # unsharded operands (or an explicit shard_map) — callers that know the
     # placement (engine/generate.py) pass the resolved impl explicitly.
     impl = attn_impl
+    if impl == "ring" and mesh is None:
+        raise ValueError('attn_impl="ring" requires a mesh with an "sp" axis')
     mask = (
         attention_mask(positions, kv_size, cfg.sliding_window)
         if impl == "xla"
@@ -145,6 +149,15 @@ def forward(
         if impl == "pallas":
             attn = flash_gqa_attention(
                 q, k_full, v_full, positions, cfg.sliding_window
+            )
+        elif impl == "ring":
+            # Context-parallel self-attention over the fresh K/V of this call's
+            # tokens (ring over the mesh "sp" axis; sequence axis sharded).
+            # Correct only for prefill-from-position-0: the cache holds nothing
+            # earlier than these tokens, so self-attention == cache attention.
+            # K/V are still written to the cache above for later decode steps.
+            attn = ring_gqa_attention(
+                mesh, q, k, v, positions, sliding_window=cfg.sliding_window
             )
         else:
             attn = gqa_attention(q, k_full, v_full, mask)

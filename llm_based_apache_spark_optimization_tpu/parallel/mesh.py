@@ -8,10 +8,13 @@ communication (all-reduce after row-parallel matmuls) onto ICI.
 
 Axes:
   dp — data/request parallelism: batch dimension of serving requests.
+  sp — sequence/context parallelism: the sequence axis of long prompts,
+       attended via the ring kernel (ops/ring_attention.py) whose ppermute
+       hops ride neighboring ICI links.
   tp — tensor parallelism: attention heads / MLP hidden dim (Megatron-style).
 
 A v5e-8 slice is typically meshed as dp=2, tp=4 or dp=1, tp=8 (BASELINE.json
-configs 4/5).
+configs 4/5); sp enters only for long-context prefill (sp=1 otherwise).
 """
 
 from __future__ import annotations
@@ -25,17 +28,21 @@ from jax.sharding import Mesh
 
 def make_mesh(
     dp: int = 1,
+    sp: int = 1,
     tp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (dp, tp) mesh over `devices` (default: all local devices).
+    """Build a (dp, sp, tp) mesh over `devices` (default: all local devices).
 
     tp is placed on the fastest-varying axis so tensor-parallel collectives
-    ride neighboring ICI links.
+    ride neighboring ICI links; sp sits between dp and tp so ring ppermute
+    neighbors are one ICI hop apart for the common tp=1 long-context layout.
     """
     if devices is None:
         devices = jax.devices()
-    if dp * tp != len(devices):
-        raise ValueError(f"dp*tp = {dp * tp} != device count {len(devices)}")
-    arr = np.asarray(devices).reshape(dp, tp)
-    return Mesh(arr, ("dp", "tp"))
+    if dp * sp * tp != len(devices):
+        raise ValueError(
+            f"dp*sp*tp = {dp * sp * tp} != device count {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(arr, ("dp", "sp", "tp"))

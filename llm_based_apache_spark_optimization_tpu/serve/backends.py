@@ -88,12 +88,11 @@ class EngineBackend:
                  sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
         # Clamp the decode budget to what fits the model context after the
-        # bucketed prompt: a serving backend degrades to a shorter completion
-        # instead of erroring (the engine itself raises on overflow).
-        from ..engine.kvcache import bucket_len
-
+        # bucketed (and sp-padded, on a sequence-parallel mesh) prompt: a
+        # serving backend degrades to a shorter completion instead of
+        # erroring (the engine itself raises on overflow).
         cfg = self.engine.cfg
-        room = cfg.max_seq_len - bucket_len(len(ids), self.engine.prompt_bucket)
+        room = cfg.max_seq_len - self.engine.padded_prompt_len(len(ids))
         if room < 1:
             raise ValueError(
                 f"prompt ({len(ids)} tokens) leaves no room in the "

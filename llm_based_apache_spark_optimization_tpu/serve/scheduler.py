@@ -251,6 +251,11 @@ class ContinuousBatchingScheduler:
                 if self._crash is not None:
                     raise RuntimeError("scheduler loop crashed") from self._crash
                 raise RuntimeError("scheduler has shut down")
+            if self._thread is None:
+                raise RuntimeError(
+                    "scheduler not started — call start() or use it as a "
+                    "context manager (a queued Future would never resolve)"
+                )
             self._queue.put(req)
         return req.future
 

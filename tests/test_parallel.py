@@ -18,7 +18,9 @@ from llm_based_apache_spark_optimization_tpu.parallel import (
 
 def test_mesh_shape_and_axes():
     mesh = make_mesh(dp=4, tp=2)
-    assert mesh.shape == {"dp": 4, "tp": 2}
+    assert mesh.shape == {"dp": 4, "sp": 1, "tp": 2}
+    mesh3 = make_mesh(dp=2, sp=2, tp=2)
+    assert mesh3.shape == {"dp": 2, "sp": 2, "tp": 2}
     with pytest.raises(ValueError):
         make_mesh(dp=3, tp=2)
 
