@@ -12,3 +12,4 @@ block, cast to the serving dtype, and place directly onto a TP×DP mesh.
 
 from .hf import config_from_hf, load_hf_checkpoint, save_hf_checkpoint  # noqa: F401
 from .cache import load_native, save_native  # noqa: F401
+from .gguf import config_from_gguf, load_gguf_checkpoint, write_gguf  # noqa: F401

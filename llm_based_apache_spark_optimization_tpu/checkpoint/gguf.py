@@ -1,0 +1,353 @@
+"""GGUF checkpoint loading/export: llama.cpp model blobs <-> the param tree.
+
+GGUF is the weight format of the reference's whole model zoo — Ollama stores
+`duckdb-nsql`, `llama3.2` and `mistral` as GGUF blobs run by llama.cpp
+(SURVEY.md §2.3). Reading uses the in-tree C++ parser/dequantizer
+(native/src/gguf.cpp) through `native.GGUFReader`; this module maps
+llama.cpp tensor names onto the scanned param tree:
+
+    token_embd.weight            [V, D]   -> embed
+    blk.{i}.attn_q.weight        [N*H, D] -> blocks.wq[i]  (T, unpermute)
+    blk.{i}.attn_k.weight        [K*H, D] -> blocks.wk[i]  (T, unpermute)
+    blk.{i}.attn_v.weight        [K*H, D] -> blocks.wv[i]  (T)
+    blk.{i}.attn_output.weight   [D, N*H] -> blocks.wo[i]  (T)
+    blk.{i}.ffn_gate.weight      [F, D]   -> blocks.wg[i]  (T)
+    blk.{i}.ffn_up.weight        [F, D]   -> blocks.wu[i]  (T)
+    blk.{i}.ffn_down.weight      [D, F]   -> blocks.wd[i]  (T)
+    blk.{i}.attn_norm.weight     [D]      -> blocks.ln_attn[i]
+    blk.{i}.ffn_norm.weight      [D]      -> blocks.ln_mlp[i]
+    output_norm.weight           [D]      -> final_norm
+    output.weight                [V, D]   -> lm_head (absent when tied)
+
+(T): GGUF keeps torch-Linear [out, in] memory order; our matmuls are x @ W.
+(unpermute): llama.cpp's HF->GGUF converter reorders Q/K rows per head from
+HF's split-half rope layout to GGML's interleaved-pair layout; `ops/rope.py`
+uses the HF convention, so rows are permuted back on load (and forward on
+export). Without this the model runs but attention silently degrades — the
+classic GGUF conversion trap called out in SURVEY.md §7 "hard parts".
+
+`write_gguf` is the inverse: export the param tree as a GGUF blob (f32 /
+f16 / q8_0 / q4_0), making in-tree models loadable by the llama.cpp
+ecosystem and giving the reader tests a bit-exact round-trip target.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..models.configs import LlamaConfig
+
+__all__ = ["config_from_gguf", "load_gguf_checkpoint", "write_gguf"]
+
+_F32, _F16, _Q4_0, _Q8_0 = 0, 1, 2, 8
+_QUANT_IDS = {"f32": _F32, "f16": _F16, "q4_0": _Q4_0, "q8_0": _Q8_0}
+
+
+# ---------------------------------------------------------------------------
+# Q/K rope-layout permutation (see module docstring).
+
+def _unpermute_qk(w: np.ndarray, n_head: int) -> np.ndarray:
+    """GGUF (interleaved-pair) row order -> HF (split-half). w: [n_head*hd, in]."""
+    rows, cols = w.shape
+    hd = rows // n_head
+    return (
+        w.reshape(n_head, hd // 2, 2, cols)
+        .swapaxes(1, 2)
+        .reshape(rows, cols)
+    )
+
+
+def _permute_qk(w: np.ndarray, n_head: int) -> np.ndarray:
+    """HF row order -> GGUF (inverse of _unpermute_qk)."""
+    rows, cols = w.shape
+    hd = rows // n_head
+    return (
+        w.reshape(n_head, 2, hd // 2, cols)
+        .swapaxes(1, 2)
+        .reshape(rows, cols)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Reading
+
+def config_from_gguf(reader, name: Optional[str] = None) -> LlamaConfig:
+    """Build a LlamaConfig from GGUF `llama.*` metadata keys.
+
+    Note: llama-3.x rope scaling travels as a `rope_freqs.weight` tensor in
+    GGUF, not as metadata — pass an explicit REGISTRY config for those
+    models (loaders accept cfg=...) or the scaling is silently absent.
+    """
+    def num(key, default=None):
+        v = reader.meta_num(key)
+        if v is None:
+            if default is None:
+                raise KeyError(f"GGUF metadata missing {key}")
+            return default
+        return v
+
+    arch = reader.meta_str("general.architecture") or "llama"
+    heads = int(num(f"{arch}.attention.head_count"))
+    d = int(num(f"{arch}.embedding_length"))
+    vocab, d_emb = reader.shape("token_embd.weight")
+    assert d_emb == d, f"embedding_length {d} != token_embd dim {d_emb}"
+    return LlamaConfig(
+        name=name or reader.meta_str("general.name") or "gguf-model",
+        vocab_size=int(vocab),
+        hidden_size=d,
+        intermediate_size=int(num(f"{arch}.feed_forward_length")),
+        num_layers=int(num(f"{arch}.block_count")),
+        num_heads=heads,
+        num_kv_heads=int(num(f"{arch}.attention.head_count_kv", heads)),
+        head_dim=int(num(f"{arch}.attention.key_length", d // heads)),
+        max_seq_len=int(num(f"{arch}.context_length", 4096)),
+        rope_theta=float(num(f"{arch}.rope.freq_base", 10000.0)),
+        norm_eps=float(num(f"{arch}.attention.layer_norm_rms_epsilon", 1e-5)),
+        tie_embeddings="output.weight" not in reader.tensor_names,
+        sliding_window=(
+            int(num(f"{arch}.attention.sliding_window", 0)) or None
+        ),
+        bos_id=int(num("tokenizer.ggml.bos_token_id", 1)),
+        eos_id=int(num("tokenizer.ggml.eos_token_id", 2)),
+        pad_id=int(num("tokenizer.ggml.padding_token_id", 0)),
+    )
+
+
+def load_gguf_checkpoint(
+    path: str | Path,
+    cfg: Optional[LlamaConfig] = None,
+    dtype=None,
+    mesh=None,
+) -> Tuple[LlamaConfig, Dict[str, Any]]:
+    """Load a GGUF blob into (config, param tree); mirrors load_hf_checkpoint.
+
+    Quantized tensors (q8_0/q4_0) dequantize to f32 in C++ and land as
+    `dtype` (default bf16) on device. With a mesh, each stacked parameter is
+    placed with its TP NamedSharding.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..native import GGUFReader
+    from .hf import _put  # same placement helper
+
+    if dtype is None:
+        dtype = jnp.bfloat16
+
+    with GGUFReader(path) as r:
+        if cfg is None:
+            cfg = config_from_gguf(r)
+        if mesh is not None:
+            from ..parallel.sharding import param_specs, validate_tp
+
+            validate_tp(cfg, mesh.shape["tp"])
+            specs = param_specs(cfg)
+        else:
+            specs = None
+
+        def spec_for(*p):
+            node = specs
+            if node is None:
+                return None
+            for k in p:
+                node = node[k]
+            return node
+
+        L = cfg.num_layers
+
+        def stack(tmpl: str, transpose: bool, unpermute_heads: int = 0):
+            mats = []
+            for i in range(L):
+                t = r.tensor_f32(tmpl.format(i=i))
+                if unpermute_heads:
+                    t = _unpermute_qk(t, unpermute_heads)
+                mats.append(t.T if transpose else t)
+            return np.stack(mats, axis=0)
+
+        blocks = {
+            "wq": stack("blk.{i}.attn_q.weight", True, cfg.num_heads),
+            "wk": stack("blk.{i}.attn_k.weight", True, cfg.num_kv_heads),
+            "wv": stack("blk.{i}.attn_v.weight", True),
+            "wo": stack("blk.{i}.attn_output.weight", True),
+            "wg": stack("blk.{i}.ffn_gate.weight", True),
+            "wu": stack("blk.{i}.ffn_up.weight", True),
+            "wd": stack("blk.{i}.ffn_down.weight", True),
+            "ln_attn": stack("blk.{i}.attn_norm.weight", False),
+            "ln_mlp": stack("blk.{i}.ffn_norm.weight", False),
+        }
+        params: Dict[str, Any] = {
+            "embed": _put(
+                r.tensor_f32("token_embd.weight"), dtype, mesh,
+                spec_for("embed"),
+            ),
+            "blocks": {
+                k: _put(v, dtype, mesh, spec_for("blocks", k))
+                for k, v in blocks.items()
+            },
+            "final_norm": _put(
+                r.tensor_f32("output_norm.weight"), dtype, mesh,
+                spec_for("final_norm"),
+            ),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = _put(
+                r.tensor_f32("output.weight"), dtype, mesh, spec_for("lm_head")
+            )
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# Writing (pure Python — export path, not perf-critical)
+
+def _quantize(a: np.ndarray, quant: str) -> bytes:
+    """Serialize a float array in the given GGML dtype's data layout."""
+    flat = np.ascontiguousarray(a, np.float32).reshape(-1)
+    if quant == "f32":
+        return flat.tobytes()
+    if quant == "f16":
+        return flat.astype(np.float16).tobytes()
+    n = flat.size
+    assert n % 32 == 0, "quantized tensors need multiple-of-32 elements"
+    blocks = flat.reshape(-1, 32)
+    if quant == "q8_0":
+        # Per-block absmax/127 scale, stored f16; dequant uses the f16 value,
+        # so quantize against the rounded scale for a faithful round-trip.
+        scale = np.abs(blocks).max(axis=1) / 127.0
+        scale16 = scale.astype(np.float16)
+        s = scale16.astype(np.float32)
+        s[s == 0] = 1.0
+        q = np.clip(np.rint(blocks / s[:, None]), -127, 127).astype(np.int8)
+        out = bytearray()
+        for i in range(blocks.shape[0]):
+            out += scale16[i].tobytes() + q[i].tobytes()
+        return bytes(out)
+    if quant == "q4_0":
+        # llama.cpp q4_0: d = signed-max / -8, q = round(x/d) + 8 in [0, 15],
+        # low nibbles hold elements 0..15, high nibbles 16..31.
+        idx = np.abs(blocks).argmax(axis=1)
+        m = blocks[np.arange(blocks.shape[0]), idx]
+        d = m / -8.0
+        d16 = d.astype(np.float16)
+        df = d16.astype(np.float32)
+        df[df == 0] = 1.0
+        q = np.clip(np.rint(blocks / df[:, None]) + 8, 0, 15).astype(np.uint8)
+        packed = (q[:, :16] | (q[:, 16:] << 4)).astype(np.uint8)
+        out = bytearray()
+        for i in range(blocks.shape[0]):
+            out += d16[i].tobytes() + packed[i].tobytes()
+        return bytes(out)
+    raise ValueError(f"unknown quant {quant!r}")
+
+
+def _kv_str(key: str, val: str) -> bytes:
+    kb, vb = key.encode(), val.encode()
+    return (struct.pack("<Q", len(kb)) + kb + struct.pack("<I", 8)
+            + struct.pack("<Q", len(vb)) + vb)
+
+
+def _kv_u32(key: str, val: int) -> bytes:
+    kb = key.encode()
+    return struct.pack("<Q", len(kb)) + kb + struct.pack("<II", 4, val)
+
+
+def _kv_f32(key: str, val: float) -> bytes:
+    kb = key.encode()
+    return struct.pack("<Q", len(kb)) + kb + struct.pack("<If", 6, val)
+
+
+def write_gguf(
+    cfg: LlamaConfig,
+    params: Dict[str, Any],
+    path: str | Path,
+    quant: str = "f16",
+) -> None:
+    """Export the param tree as a GGUF v3 blob.
+
+    `quant` applies to the 2-D matmul weights; norms stay f32 (llama.cpp
+    convention — they're tiny and numerically sensitive).
+    """
+    import jax
+
+    if quant not in _QUANT_IDS:
+        raise ValueError(f"quant must be one of {sorted(_QUANT_IDS)}")
+
+    def host(x, transpose=False, permute_heads=0):
+        a = np.asarray(jax.device_get(x), np.float32)
+        if transpose:
+            a = a.T
+        if permute_heads:
+            a = _permute_qk(a, permute_heads)
+        return np.ascontiguousarray(a)
+
+    # name -> (array [out, in] or [d], quant kind)
+    tensors: Dict[str, Tuple[np.ndarray, str]] = {
+        "token_embd.weight": (host(params["embed"]), quant),
+        "output_norm.weight": (host(params["final_norm"]), "f32"),
+    }
+    if not cfg.tie_embeddings:
+        tensors["output.weight"] = (host(params["lm_head"]), quant)
+    b = params["blocks"]
+    for i in range(cfg.num_layers):
+        p = f"blk.{i}."
+        tensors[p + "attn_q.weight"] = (
+            host(b["wq"][i], True, cfg.num_heads), quant)
+        tensors[p + "attn_k.weight"] = (
+            host(b["wk"][i], True, cfg.num_kv_heads), quant)
+        tensors[p + "attn_v.weight"] = (host(b["wv"][i], True), quant)
+        tensors[p + "attn_output.weight"] = (host(b["wo"][i], True), quant)
+        tensors[p + "ffn_gate.weight"] = (host(b["wg"][i], True), quant)
+        tensors[p + "ffn_up.weight"] = (host(b["wu"][i], True), quant)
+        tensors[p + "ffn_down.weight"] = (host(b["wd"][i], True), quant)
+        tensors[p + "attn_norm.weight"] = (host(b["ln_attn"][i]), "f32")
+        tensors[p + "ffn_norm.weight"] = (host(b["ln_mlp"][i]), "f32")
+
+    kvs = [
+        _kv_str("general.architecture", "llama"),
+        _kv_str("general.name", cfg.name),
+        _kv_u32("general.alignment", 32),
+        _kv_u32("llama.block_count", cfg.num_layers),
+        _kv_u32("llama.embedding_length", cfg.hidden_size),
+        _kv_u32("llama.feed_forward_length", cfg.intermediate_size),
+        _kv_u32("llama.attention.head_count", cfg.num_heads),
+        _kv_u32("llama.attention.head_count_kv", cfg.num_kv_heads),
+        _kv_u32("llama.attention.key_length", cfg.head_dim),
+        _kv_u32("llama.context_length", cfg.max_seq_len),
+        _kv_f32("llama.rope.freq_base", cfg.rope_theta),
+        _kv_f32("llama.attention.layer_norm_rms_epsilon", cfg.norm_eps),
+        _kv_u32("tokenizer.ggml.bos_token_id", cfg.bos_id),
+        _kv_u32("tokenizer.ggml.eos_token_id", cfg.eos_id),
+        _kv_u32("tokenizer.ggml.padding_token_id", cfg.pad_id),
+    ]
+    if cfg.sliding_window is not None:
+        kvs.append(_kv_u32("llama.attention.sliding_window", cfg.sliding_window))
+
+    infos = bytearray()
+    payloads = []
+    offset = 0
+    for name, (arr, kind) in tensors.items():
+        data = _quantize(arr, kind)
+        nb = name.encode()
+        dims = tuple(reversed(arr.shape))  # GGUF order: innermost first
+        infos += struct.pack("<Q", len(nb)) + nb
+        infos += struct.pack("<I", len(dims))
+        for d in dims:
+            infos += struct.pack("<Q", d)
+        infos += struct.pack("<IQ", _QUANT_IDS[kind], offset)
+        payloads.append(data)
+        offset += len(data)
+        offset += -offset % 32  # next tensor starts 32-aligned
+
+    header = b"GGUF" + struct.pack("<IQQ", 3, len(tensors), len(kvs))
+    meta = header + b"".join(kvs) + bytes(infos)
+    pad = -len(meta) % 32
+
+    with open(path, "wb") as f:
+        f.write(meta)
+        f.write(b"\x00" * pad)
+        for data in payloads:
+            f.write(data)
+            f.write(b"\x00" * (-len(data) % 32))

@@ -1,0 +1,390 @@
+// GGUF model-file reader + dequantizer.
+//
+// GGUF is the weight format of the reference's entire model zoo: Ollama
+// stores duckdb-nsql / llama3.2 / mistral as GGUF blobs executed by
+// llama.cpp (SURVEY.md §2.3). This reader lets the in-tree JAX engine load
+// those exact blobs: it parses the v2/v3 header + metadata KVs + tensor
+// directory, and dequantizes F32/F16/Q8_0/Q4_0 tensor data into float32
+// buffers that Python wraps as numpy/jax arrays (checkpoint/gguf.py maps
+// llama.cpp tensor names onto the param tree and un-permutes Q/K).
+//
+// Layout (little-endian): magic "GGUF", u32 version, u64 n_tensors, u64 n_kv,
+// then KVs (string key, u32 type, value), then tensor infos (string name,
+// u32 ndim, u64 dims[ndim] innermost-first, u32 dtype, u64 offset relative to
+// the aligned data section), then padding to `general.alignment` (default
+// 32), then tensor data.
+
+#include "lsot_native.h"
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_err;
+
+// GGUF metadata value type ids.
+enum : uint32_t {
+  KV_U8 = 0, KV_I8 = 1, KV_U16 = 2, KV_I16 = 3, KV_U32 = 4, KV_I32 = 5,
+  KV_F32 = 6, KV_BOOL = 7, KV_STRING = 8, KV_ARRAY = 9, KV_U64 = 10,
+  KV_I64 = 11, KV_F64 = 12,
+};
+
+struct TensorInfo {
+  std::string name;
+  uint32_t ndim = 0;
+  uint64_t dims[4] = {1, 1, 1, 1};
+  uint32_t dtype = 0;
+  uint64_t offset = 0; // relative to data section start
+};
+
+struct Gguf {
+  FILE *f = nullptr;
+  std::vector<TensorInfo> tensors;
+  std::unordered_map<std::string, std::string> str_kv;
+  std::unordered_map<std::string, double> num_kv;
+  uint64_t data_start = 0;
+  ~Gguf() {
+    if (f) fclose(f);
+  }
+};
+
+bool read_exact(FILE *f, void *dst, size_t n) {
+  return fread(dst, 1, n, f) == n;
+}
+
+template <typename T> bool read_pod(FILE *f, T *v) {
+  return read_exact(f, v, sizeof(T));
+}
+
+bool read_str(FILE *f, std::string *s) {
+  uint64_t len;
+  if (!read_pod(f, &len)) return false;
+  if (len > (1ull << 32)) return false; // corrupt
+  s->resize(len);
+  return len == 0 || read_exact(f, &(*s)[0], len);
+}
+
+size_t kv_scalar_size(uint32_t type) {
+  switch (type) {
+  case KV_U8: case KV_I8: case KV_BOOL: return 1;
+  case KV_U16: case KV_I16: return 2;
+  case KV_U32: case KV_I32: case KV_F32: return 4;
+  case KV_U64: case KV_I64: case KV_F64: return 8;
+  default: return 0;
+  }
+}
+
+bool read_num(FILE *f, uint32_t type, double *out) {
+  unsigned char buf[8];
+  size_t sz = kv_scalar_size(type);
+  if (!sz || !read_exact(f, buf, sz)) return false;
+  switch (type) {
+  case KV_U8: *out = *reinterpret_cast<uint8_t *>(buf); break;
+  case KV_I8: *out = *reinterpret_cast<int8_t *>(buf); break;
+  case KV_BOOL: *out = buf[0] != 0; break;
+  case KV_U16: *out = *reinterpret_cast<uint16_t *>(buf); break;
+  case KV_I16: *out = *reinterpret_cast<int16_t *>(buf); break;
+  case KV_U32: *out = *reinterpret_cast<uint32_t *>(buf); break;
+  case KV_I32: *out = *reinterpret_cast<int32_t *>(buf); break;
+  case KV_F32: *out = *reinterpret_cast<float *>(buf); break;
+  case KV_U64: *out = static_cast<double>(*reinterpret_cast<uint64_t *>(buf)); break;
+  case KV_I64: *out = static_cast<double>(*reinterpret_cast<int64_t *>(buf)); break;
+  case KV_F64: *out = *reinterpret_cast<double *>(buf); break;
+  default: return false;
+  }
+  return true;
+}
+
+// Skip a value of the given type (used for arrays, which we index past but
+// do not surface through the C API).
+bool skip_value(FILE *f, uint32_t type) {
+  if (type == KV_STRING) {
+    std::string s;
+    return read_str(f, &s);
+  }
+  if (type == KV_ARRAY) {
+    uint32_t elem_type;
+    uint64_t count;
+    if (!read_pod(f, &elem_type) || !read_pod(f, &count)) return false;
+    for (uint64_t i = 0; i < count; ++i)
+      if (!skip_value(f, elem_type)) return false;
+    return true;
+  }
+  size_t sz = kv_scalar_size(type);
+  return sz && fseek(f, static_cast<long>(sz), SEEK_CUR) == 0;
+}
+
+inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ff;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else { // subnormal: normalize
+      exp = 127 - 15 + 1;
+      while (!(mant & 0x400)) {
+        mant <<= 1;
+        --exp;
+      }
+      mant &= 0x3ff;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+uint64_t tensor_nelems(const TensorInfo &t) {
+  uint64_t n = 1;
+  for (uint32_t d = 0; d < t.ndim; ++d) n *= t.dims[d];
+  return n;
+}
+
+// Byte size of a tensor's data on disk.
+bool tensor_nbytes(const TensorInfo &t, uint64_t *out) {
+  uint64_t n = tensor_nelems(t);
+  switch (t.dtype) {
+  case LSOT_GGUF_F32: *out = n * 4; return true;
+  case LSOT_GGUF_F16: *out = n * 2; return true;
+  case LSOT_GGUF_Q8_0: // blocks of 32: fp16 scale + 32 * i8
+    if (n % 32) return false;
+    *out = (n / 32) * 34;
+    return true;
+  case LSOT_GGUF_Q4_0: // blocks of 32: fp16 scale + 16 packed bytes
+    if (n % 32) return false;
+    *out = (n / 32) * 18;
+    return true;
+  default: return false;
+  }
+}
+
+} // namespace
+
+extern "C" {
+
+const char *lsot_gguf_last_error(void) { return g_err.c_str(); }
+
+void *lsot_gguf_open(const char *path) {
+  auto g = new Gguf;
+  g->f = fopen(path, "rb");
+  if (!g->f) {
+    g_err = std::string("cannot open ") + path;
+    delete g;
+    return nullptr;
+  }
+  char magic[4];
+  uint32_t version;
+  uint64_t n_tensors, n_kv;
+  if (!read_exact(g->f, magic, 4) || std::memcmp(magic, "GGUF", 4) != 0) {
+    g_err = "bad magic (not a GGUF file)";
+    delete g;
+    return nullptr;
+  }
+  if (!read_pod(g->f, &version) || (version != 2 && version != 3)) {
+    g_err = "unsupported GGUF version";
+    delete g;
+    return nullptr;
+  }
+  if (!read_pod(g->f, &n_tensors) || !read_pod(g->f, &n_kv) ||
+      n_tensors > (1u << 20) || n_kv > (1u << 20)) {
+    g_err = "corrupt header";
+    delete g;
+    return nullptr;
+  }
+
+  for (uint64_t i = 0; i < n_kv; ++i) {
+    std::string key;
+    uint32_t type;
+    if (!read_str(g->f, &key) || !read_pod(g->f, &type)) {
+      g_err = "truncated metadata";
+      delete g;
+      return nullptr;
+    }
+    if (type == KV_STRING) {
+      std::string val;
+      if (!read_str(g->f, &val)) {
+        g_err = "truncated string value";
+        delete g;
+        return nullptr;
+      }
+      g->str_kv[key] = std::move(val);
+    } else if (type == KV_ARRAY) {
+      if (!skip_value(g->f, type)) {
+        g_err = "truncated array value";
+        delete g;
+        return nullptr;
+      }
+    } else {
+      double v;
+      if (!read_num(g->f, type, &v)) {
+        g_err = "bad scalar value for key " + key;
+        delete g;
+        return nullptr;
+      }
+      g->num_kv[key] = v;
+    }
+  }
+
+  g->tensors.reserve(n_tensors);
+  for (uint64_t i = 0; i < n_tensors; ++i) {
+    TensorInfo t;
+    if (!read_str(g->f, &t.name) || !read_pod(g->f, &t.ndim) || t.ndim > 4) {
+      g_err = "truncated tensor info";
+      delete g;
+      return nullptr;
+    }
+    for (uint32_t d = 0; d < t.ndim; ++d)
+      if (!read_pod(g->f, &t.dims[d])) {
+        g_err = "truncated tensor dims";
+        delete g;
+        return nullptr;
+      }
+    if (!read_pod(g->f, &t.dtype) || !read_pod(g->f, &t.offset)) {
+      g_err = "truncated tensor dtype/offset";
+      delete g;
+      return nullptr;
+    }
+    g->tensors.push_back(std::move(t));
+  }
+
+  uint64_t align = 32;
+  auto it = g->num_kv.find("general.alignment");
+  if (it != g->num_kv.end() && it->second >= 1) {
+    align = static_cast<uint64_t>(it->second);
+  }
+  long pos = ftell(g->f);
+  if (pos < 0) {
+    g_err = "ftell failed";
+    delete g;
+    return nullptr;
+  }
+  g->data_start = (static_cast<uint64_t>(pos) + align - 1) / align * align;
+  return g;
+}
+
+void lsot_gguf_close(void *h) { delete static_cast<Gguf *>(h); }
+
+int32_t lsot_gguf_n_tensors(void *h) {
+  return static_cast<int32_t>(static_cast<Gguf *>(h)->tensors.size());
+}
+
+const char *lsot_gguf_tensor_name(void *h, int32_t i) {
+  auto *g = static_cast<Gguf *>(h);
+  if (i < 0 || i >= static_cast<int32_t>(g->tensors.size())) return nullptr;
+  return g->tensors[i].name.c_str();
+}
+
+int32_t lsot_gguf_tensor_ndim(void *h, int32_t i) {
+  auto *g = static_cast<Gguf *>(h);
+  if (i < 0 || i >= static_cast<int32_t>(g->tensors.size())) return -1;
+  return static_cast<int32_t>(g->tensors[i].ndim);
+}
+
+uint64_t lsot_gguf_tensor_dim(void *h, int32_t i, int32_t d) {
+  auto *g = static_cast<Gguf *>(h);
+  if (i < 0 || i >= static_cast<int32_t>(g->tensors.size()) || d < 0 || d > 3)
+    return 0;
+  return g->tensors[i].dims[d];
+}
+
+int32_t lsot_gguf_tensor_dtype(void *h, int32_t i) {
+  auto *g = static_cast<Gguf *>(h);
+  if (i < 0 || i >= static_cast<int32_t>(g->tensors.size())) return -1;
+  return static_cast<int32_t>(g->tensors[i].dtype);
+}
+
+uint64_t lsot_gguf_tensor_nelems(void *h, int32_t i) {
+  auto *g = static_cast<Gguf *>(h);
+  if (i < 0 || i >= static_cast<int32_t>(g->tensors.size())) return 0;
+  return tensor_nelems(g->tensors[i]);
+}
+
+int32_t lsot_gguf_read_f32(void *h, int32_t i, float *out, uint64_t cap) {
+  auto *g = static_cast<Gguf *>(h);
+  if (i < 0 || i >= static_cast<int32_t>(g->tensors.size())) {
+    g_err = "tensor index out of range";
+    return 1;
+  }
+  const TensorInfo &t = g->tensors[i];
+  uint64_t n = tensor_nelems(t);
+  if (cap < n) {
+    g_err = "output buffer too small";
+    return 2;
+  }
+  uint64_t nbytes;
+  if (!tensor_nbytes(t, &nbytes)) {
+    g_err = "unsupported tensor dtype " + std::to_string(t.dtype) +
+            " for tensor " + t.name;
+    return 3;
+  }
+  if (fseek(g->f, static_cast<long>(g->data_start + t.offset), SEEK_SET) != 0) {
+    g_err = "seek failed";
+    return 4;
+  }
+  std::vector<unsigned char> raw(nbytes);
+  if (!read_exact(g->f, raw.data(), nbytes)) {
+    g_err = "truncated tensor data for " + t.name;
+    return 5;
+  }
+  const unsigned char *p = raw.data();
+  switch (t.dtype) {
+  case LSOT_GGUF_F32:
+    std::memcpy(out, p, n * 4);
+    break;
+  case LSOT_GGUF_F16:
+    for (uint64_t k = 0; k < n; ++k)
+      out[k] = f16_to_f32(reinterpret_cast<const uint16_t *>(p)[k]);
+    break;
+  case LSOT_GGUF_Q8_0:
+    for (uint64_t blk = 0; blk < n / 32; ++blk) {
+      const unsigned char *b = p + blk * 34;
+      float scale = f16_to_f32(*reinterpret_cast<const uint16_t *>(b));
+      const int8_t *q = reinterpret_cast<const int8_t *>(b + 2);
+      for (int k = 0; k < 32; ++k) out[blk * 32 + k] = scale * q[k];
+    }
+    break;
+  case LSOT_GGUF_Q4_0:
+    for (uint64_t blk = 0; blk < n / 32; ++blk) {
+      const unsigned char *b = p + blk * 18;
+      float scale = f16_to_f32(*reinterpret_cast<const uint16_t *>(b));
+      const unsigned char *q = b + 2;
+      // llama.cpp layout: low nibbles are elements 0..15, high nibbles 16..31.
+      for (int k = 0; k < 16; ++k) {
+        out[blk * 32 + k] = scale * (static_cast<int>(q[k] & 0x0f) - 8);
+        out[blk * 32 + 16 + k] = scale * (static_cast<int>(q[k] >> 4) - 8);
+      }
+    }
+    break;
+  default:
+    g_err = "unsupported dtype";
+    return 3;
+  }
+  return 0;
+}
+
+const char *lsot_gguf_meta_str(void *h, const char *key) {
+  auto *g = static_cast<Gguf *>(h);
+  auto it = g->str_kv.find(key);
+  return it == g->str_kv.end() ? nullptr : it->second.c_str();
+}
+
+int32_t lsot_gguf_meta_f64(void *h, const char *key, double *out) {
+  auto *g = static_cast<Gguf *>(h);
+  auto it = g->num_kv.find(key);
+  if (it == g->num_kv.end()) return 0;
+  *out = it->second;
+  return 1;
+}
+
+} // extern "C"

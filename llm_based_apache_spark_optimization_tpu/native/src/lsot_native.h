@@ -1,0 +1,62 @@
+/* C ABI for the in-tree native runtime core (loaded from Python via ctypes).
+ *
+ * This is the framework's replacement for the native layer the reference
+ * delegates to llama.cpp (SURVEY.md §2.3: tokenization and GGUF weight
+ * handling live in C++ there too). Two components:
+ *
+ *   bpe_*  — byte-level BPE encoder hot loop (heap-based, O(n log n));
+ *            semantics identical to tokenizer/bpe.py's Python reference.
+ *   gguf_* — GGUF v2/v3 model-file parser + dequantizer (F32/F16/Q8_0/Q4_0)
+ *            so the engine can load the exact Ollama-style model blobs the
+ *            reference's models ship as.
+ */
+#ifndef LSOT_NATIVE_H
+#define LSOT_NATIVE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- BPE tokenizer core ---- */
+
+/* pairs: flat [a0, b0, a1, b1, ...]; merging pair i yields id base + i where
+ * base = n_special + 256. Returns an opaque handle (never NULL). */
+void *lsot_bpe_new(const int32_t *pairs, int32_t n_merges, int32_t n_special);
+void lsot_bpe_free(void *h);
+/* Encode n UTF-8 bytes. Writes <= n ids into out (cap >= n required);
+ * returns the id count, or -1 if cap is too small. */
+int32_t lsot_bpe_encode(void *h, const uint8_t *bytes, int32_t n,
+                        int32_t *out, int32_t cap);
+
+/* ---- GGUF reader ---- */
+
+/* Tensor dtypes (GGML type ids as stored in GGUF). */
+#define LSOT_GGUF_F32 0
+#define LSOT_GGUF_F16 1
+#define LSOT_GGUF_Q4_0 2
+#define LSOT_GGUF_Q8_0 8
+
+void *lsot_gguf_open(const char *path); /* NULL on error (see last_error) */
+void lsot_gguf_close(void *h);
+int32_t lsot_gguf_n_tensors(void *h);
+const char *lsot_gguf_tensor_name(void *h, int32_t i);
+int32_t lsot_gguf_tensor_ndim(void *h, int32_t i);
+/* Dim d in GGUF order: d=0 is the innermost/fastest-varying axis. */
+uint64_t lsot_gguf_tensor_dim(void *h, int32_t i, int32_t d);
+int32_t lsot_gguf_tensor_dtype(void *h, int32_t i);
+uint64_t lsot_gguf_tensor_nelems(void *h, int32_t i);
+/* Dequantize tensor i into out (f32, memory order). 0 on success. */
+int32_t lsot_gguf_read_f32(void *h, int32_t i, float *out, uint64_t cap);
+/* Metadata: returns NULL / 0 when the key is absent or of another type.
+ * All integer/float scalar types surface through meta_f64. */
+const char *lsot_gguf_meta_str(void *h, const char *key);
+int32_t lsot_gguf_meta_f64(void *h, const char *key, double *out);
+const char *lsot_gguf_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* LSOT_NATIVE_H */

@@ -84,6 +84,32 @@ class EngineBackend:
         )
         return cls(engine, tokenizer, **kwargs)
 
+    @classmethod
+    def from_gguf(
+        cls,
+        gguf_path: str,
+        tokenizer: Tokenizer,
+        cfg=None,
+        mesh=None,
+        dtype=None,
+        prompt_bucket: int = 128,
+        stop_ids: Optional[Sequence[int]] = None,
+        **kwargs,
+    ) -> "EngineBackend":
+        """Stand up a backend from a GGUF blob — the exact file format the
+        reference's Ollama models ship as (parsed + dequantized by the
+        in-tree C++ core, native/src/gguf.cpp)."""
+        from ..checkpoint import load_gguf_checkpoint
+
+        cfg, params = load_gguf_checkpoint(
+            gguf_path, cfg=cfg, dtype=dtype, mesh=mesh
+        )
+        engine = InferenceEngine(
+            cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
+            stop_ids=stop_ids,
+        )
+        return cls(engine, tokenizer, **kwargs)
+
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)

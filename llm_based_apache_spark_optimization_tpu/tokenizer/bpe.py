@@ -44,14 +44,23 @@ class BPETokenizer:
         for (a, b), new_id in self.merges.items():
             assert new_id == len(self._bytes), "merges must be rank-ordered"
             self._bytes.append(self._bytes[a] + self._bytes[b])
+        # C++ hot loop (native/src/bpe.cpp) when the toolchain is available;
+        # None -> the Python _merge below (identical output, asserted in
+        # tests/test_native.py).
+        from ..native import NativeBPE
+
+        self._native = NativeBPE.create(list(merges), n_special)
 
     @property
     def vocab_size(self) -> int:
         return self.base + len(self.merges)
 
     def encode(self, text: str, add_bos: bool = True) -> List[int]:
-        ids = [self.n_special + b for b in text.encode("utf-8")]
-        ids = self._merge(ids)
+        data = text.encode("utf-8")
+        if self._native is not None:
+            ids = self._native.encode_bytes(data)
+        else:
+            ids = self._merge([self.n_special + b for b in data])
         return [self.bos_id] + ids if add_bos else ids
 
     def _merge(self, ids: List[int]) -> List[int]:

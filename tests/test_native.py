@@ -1,0 +1,162 @@
+"""Native C++ core: BPE encoder parity and GGUF reader/writer round-trips.
+
+The C++ library builds on demand via g++ (native/__init__.py); these tests
+fail loudly if the toolchain is missing — the native core is a first-class
+component, not an optional extra.
+"""
+
+import numpy as np
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.native import (
+    GGUFReader,
+    NativeBPE,
+    load_native,
+)
+from llm_based_apache_spark_optimization_tpu.tokenizer import BPETokenizer, train_bpe
+
+
+def test_native_lib_builds():
+    assert load_native() is not None, "g++ build of native core failed"
+
+
+# ---------------------------------------------------------------------------
+# BPE
+
+
+CORPUS = [
+    "SELECT * FROM temp_view WHERE passenger_count > 2",
+    "SELECT vendor_id, SUM(fare_amount) FROM temp_view GROUP BY vendor_id",
+    "the quick brown fox jumps over the lazy dog",
+    "ßßß unicode ÿ mixed 日本語 text",
+]
+
+
+@pytest.fixture(scope="module")
+def trained():
+    return train_bpe(CORPUS * 4, num_merges=80)
+
+
+def test_native_bpe_matches_python(trained):
+    tok = trained
+    assert tok._native is not None
+    for text in CORPUS + ["", "a", "SELECT COUNT(*) FROM t;", "日本語だけ"]:
+        py = tok._merge([tok.n_special + b for b in text.encode("utf-8")])
+        nat = tok._native.encode_bytes(text.encode("utf-8"))
+        assert nat == py, f"divergence on {text!r}"
+
+
+def test_native_bpe_roundtrip(trained):
+    for text in CORPUS:
+        ids = trained.encode(text, add_bos=False)
+        assert trained.decode(ids) == text
+
+
+def test_native_bpe_long_input(trained):
+    text = " ".join(CORPUS) * 200  # ~10k chars: the hot-loop case
+    py_tok = BPETokenizer(
+        sorted(trained.merges, key=lambda p: trained.merges[p]),
+        n_special=trained.n_special,
+    )
+    py_tok._native = None  # force the Python path
+    assert trained.encode(text) == py_tok.encode(text)
+
+
+def test_fallback_when_disabled(monkeypatch, trained):
+    monkeypatch.setenv("LSOT_NO_NATIVE", "1")
+    tok = train_bpe(CORPUS, num_merges=10)
+    assert tok._native is None
+    assert tok.decode(tok.encode("SELECT 1", add_bos=False)) == "SELECT 1"
+
+
+# ---------------------------------------------------------------------------
+# GGUF
+
+
+@pytest.mark.parametrize("quant,tol", [
+    ("f32", 0.0),
+    ("f16", 1e-3),
+    ("q8_0", 2e-2),
+    ("q4_0", 2e-1),
+])
+def test_gguf_roundtrip(tiny_model, tmp_path, quant, tol):
+    import jax
+
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        load_gguf_checkpoint,
+        write_gguf,
+    )
+
+    cfg, params = tiny_model
+    path = tmp_path / f"model-{quant}.gguf"
+    write_gguf(cfg, params, path, quant=quant)
+    cfg2, params2 = load_gguf_checkpoint(path, dtype=np.float32)
+    assert cfg2.num_layers == cfg.num_layers
+    assert cfg2.num_heads == cfg.num_heads
+    assert cfg2.num_kv_heads == cfg.num_kv_heads
+    assert cfg2.vocab_size == cfg.vocab_size
+    assert cfg2.tie_embeddings == cfg.tie_embeddings
+
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    flat2 = dict(jax.tree_util.tree_leaves_with_path(params2))
+    for path_key, ref in flat:
+        got = np.asarray(flat2[path_key], np.float32)
+        ref = np.asarray(ref, np.float32)
+        scale = max(np.abs(ref).max(), 1e-6)
+        if quant == "f32":
+            np.testing.assert_array_equal(got, ref, err_msg=str(path_key))
+        else:
+            np.testing.assert_allclose(
+                got, ref, atol=tol * scale, err_msg=str(path_key)
+            )
+
+
+def test_gguf_forward_parity(tiny_model, tmp_path):
+    """f32 export -> C++ parse -> forward must be bit-identical: catches any
+    Q/K permute asymmetry between writer and loader (SURVEY.md §7 risk #1)."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        load_gguf_checkpoint,
+        write_gguf,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import forward
+
+    cfg, params = tiny_model
+    path = tmp_path / "model.gguf"
+    write_gguf(cfg, params, path, quant="f32")
+    _, params2 = load_gguf_checkpoint(path, cfg=cfg, dtype=jnp.float32)
+
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(3, cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    pos = jnp.broadcast_to(jnp.arange(12, dtype=jnp.int32)[None], (2, 12))
+    ref, _ = forward(cfg, params, tokens, pos, None)
+    got, _ = forward(cfg, params2, tokens, pos, None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_gguf_metadata(tiny_model, tmp_path):
+    from llm_based_apache_spark_optimization_tpu.checkpoint import write_gguf
+
+    cfg, params = tiny_model
+    path = tmp_path / "m.gguf"
+    write_gguf(cfg, params, path, quant="f16")
+    with GGUFReader(path) as r:
+        assert r.meta_str("general.architecture") == "llama"
+        assert r.meta_num("llama.block_count") == cfg.num_layers
+        assert r.meta_num("llama.rope.freq_base") == pytest.approx(cfg.rope_theta)
+        assert "token_embd.weight" in r.tensor_names
+        assert r.shape("token_embd.weight") == (cfg.vocab_size, cfg.hidden_size)
+        assert r.dtype("blk.0.attn_q.weight") == GGUFReader.F16
+        assert r.dtype("blk.0.attn_norm.weight") == GGUFReader.F32
+
+
+def test_gguf_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.gguf"
+    bad.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="magic"):
+        GGUFReader(bad)
+    with pytest.raises(ValueError):
+        GGUFReader(tmp_path / "missing.gguf")
