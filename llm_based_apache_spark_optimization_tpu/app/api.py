@@ -72,4 +72,10 @@ def create_api_app(
             "stats": service.stats,
         })
 
+    @app.route("/metrics")
+    def metrics(req: Request) -> Response:
+        """Per-model serving aggregates (p50/p95 latency, decode tok/s) —
+        the observability surface the reference never had (SURVEY.md §5)."""
+        return Response.json(service.metrics.snapshot())
+
     return app

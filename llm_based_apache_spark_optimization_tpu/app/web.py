@@ -105,6 +105,11 @@ def create_web_app(
     def status(req: Request) -> Response:
         return Response.json(board.get(session_id(req)))
 
+    @app.route("/metrics")
+    def metrics(req: Request) -> Response:
+        """Per-model serving aggregates (SURVEY.md §5 observability)."""
+        return Response.json(service.metrics.snapshot())
+
     @app.route("/static/styles.css")
     def styles(req: Request) -> Response:
         body = (_STATIC_DIR / "styles.css").read_bytes()

@@ -3,10 +3,16 @@
 Replaces llama.cpp's KV-cache management (the reference's context handling all
 lives inside Ollama — SURVEY.md §5 "Long-context"). Layout:
 
-    {"k": [L, B, S_max, K, H], "v": [L, B, S_max, K, H]}
+    {"k": [L, B, K, S_max, H], "v": [L, B, K, S_max, H]}
 
 - Leading L axis matches the scan-over-layers parameter stacking in
   models/llama.py, so one `lax.scan` carries cache slices alongside weights.
+- KV heads sit *outside* the sequence axis: per (batch, head) the cache is a
+  contiguous [S, H] tile — the shape the MXU wants for the attention
+  contraction and the Pallas flash kernel's block grid wants for streaming
+  (TPU blocks must tile the trailing (sublane, lane) = (S, H) dims; a
+  [S, K, H] layout would put a singleton in the sublane dim per head,
+  which the Mosaic lowering rejects).
 - The whole generate call (prefill + decode loop) is one jitted XLA program:
   the cache is allocated inside it and carried through the `lax.while_loop`,
   so XLA keeps it in HBM and updates it in place across decode steps — no
@@ -35,7 +41,10 @@ from ..models.configs import LlamaConfig
 def init_cache(
     cfg: LlamaConfig, batch: int, max_seq: int, dtype=jnp.bfloat16
 ) -> Dict[str, jnp.ndarray]:
-    shape = (cfg.num_layers, batch, max_seq, cfg.num_kv_heads, cfg.head_dim)
+    # S rounds up to a sublane multiple so Pallas KV blocks tile cleanly; the
+    # extra slots sit past every reachable position and stay causally masked.
+    max_seq += -max_seq % 8
+    shape = (cfg.num_layers, batch, cfg.num_kv_heads, max_seq, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 

@@ -74,14 +74,15 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def _update_cache(cache: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
-    """Write `new` [B, T, K, H] into `cache` [B, S, K, H] at per-batch offsets.
+    """Write `new` [B, T, K, H] into `cache` [B, K, S, H] at per-batch offsets.
 
     vmap of dynamic_update_slice lowers to an efficient batched scatter; each
-    sequence writes a contiguous [T, K, H] block starting at its own position.
+    sequence writes a contiguous [T, H] block per KV head starting at its own
+    position along the S axis.
     """
     return jax.vmap(
-        lambda c, n, s: lax.dynamic_update_slice(c, n, (s, 0, 0))
-    )(cache, new, start.astype(jnp.int32))
+        lambda c, n, s: lax.dynamic_update_slice(c, n, (0, s, 0))
+    )(cache, new.transpose(0, 2, 1, 3), start.astype(jnp.int32))
 
 
 def forward(
@@ -89,7 +90,7 @@ def forward(
     params: Params,
     tokens: jnp.ndarray,      # [B, T] int32
     positions: jnp.ndarray,   # [B, T] int32 — absolute position of each token
-    cache: Optional[Dict[str, jnp.ndarray]] = None,  # {"k","v"}: [L, B, S, K, H]
+    cache: Optional[Dict[str, jnp.ndarray]] = None,  # {"k","v"}: [L, B, K, S, H]
     logit_indices: Optional[jnp.ndarray] = None,  # [B] int32 — unembed only these T-indices
     attn_impl: str = "xla",  # "xla" | "pallas" | "ring"; resolve via ops.pallas.attention_impl
     mesh=None,  # required for attn_impl="ring" (context-parallel prefill)
@@ -115,7 +116,7 @@ def forward(
     if cache is None:
         kv_size = t
     else:
-        kv_size = cache["k"].shape[2]
+        kv_size = cache["k"].shape[3]
     # Default is the always-correct einsum path: a bare forward() cannot see
     # whether its inputs are TP-sharded, and the pallas kernel requires
     # unsharded operands (or an explicit shard_map) — callers that know the
@@ -140,7 +141,8 @@ def forward(
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if k_cache is None:
-            k_full, v_full = k, v
+            # Match the cache layout: [B, T, K, H] -> [B, K, T, H].
+            k_full, v_full = k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
             k_out = v_out = None
         else:
             k_full = _update_cache(k_cache, k, start)

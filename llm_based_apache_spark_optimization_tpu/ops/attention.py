@@ -49,21 +49,20 @@ def attention_mask(
 
 def gqa_attention(
     q: jnp.ndarray,  # [B, T, N, H]
-    k: jnp.ndarray,  # [B, S, K, H]
-    v: jnp.ndarray,  # [B, S, K, H]
+    k: jnp.ndarray,  # [B, K, S, H]  (head-major cache layout, engine/kvcache.py)
+    v: jnp.ndarray,  # [B, K, S, H]
     mask: jnp.ndarray,  # [B, T, S] bool
 ) -> jnp.ndarray:
     """Returns [B, T, N, H]. N = K * G."""
     b, t, n, h = q.shape
-    s = k.shape[1]
-    kh = k.shape[2]
+    kh, s = k.shape[1], k.shape[2]
     g = n // kh
     scale = h ** -0.5
     q5 = q.reshape(b, t, kh, g, h)
     # [B, K, G, T, S] score tensor, f32 accumulation on the MXU.
-    scores = jnp.einsum("btkgh,bskh->bkgts", q5, k, preferred_element_type=jnp.float32)
+    scores = jnp.einsum("btkgh,bksh->bkgts", q5, k, preferred_element_type=jnp.float32)
     scores = scores * scale
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskh->btkgh", probs.astype(v.dtype), v)
+    out = jnp.einsum("bkgts,bksh->btkgh", probs.astype(v.dtype), v)
     return out.reshape(b, t, n, h)

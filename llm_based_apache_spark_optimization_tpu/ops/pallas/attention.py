@@ -1,7 +1,7 @@
 """Flash GQA attention over the preallocated KV cache, as a Pallas TPU kernel.
 
 One kernel serves prefill (T = prompt bucket) and decode (T = 1): both are a
-causal read of the full [B, S, K, H] cache masked by absolute query positions
+causal read of the full [B, K, S, H] cache masked by absolute query positions
 (same contract as `ops.attention.gqa_attention`, which is the golden
 reference in tests).
 
@@ -40,10 +40,10 @@ _LANES = 128  # VMEM lane width: scratch row-stats are kept lane-broadcast
 
 
 def _flash_kernel(
-    qpos_ref,  # [1, GT] i32   (positions tiled over the G query groups)
+    qpos_ref,  # [1, 1, GT] i32   (positions tiled over the G query groups)
     q_ref,     # [1, 1, GT, H]
-    k_ref,     # [1, BLK, 1, H]
-    v_ref,     # [1, BLK, 1, H]
+    k_ref,     # [1, 1, BLK, H]
+    v_ref,     # [1, 1, BLK, H]
     o_ref,     # [1, 1, GT, H]
     m_ref,     # [GT, LANES] f32 scratch — running row max (lane-broadcast)
     l_ref,     # [GT, LANES] f32 scratch — running denominator
@@ -54,7 +54,7 @@ def _flash_kernel(
     kv_len: int,
 ):
     s_idx = pl.program_id(2)
-    blk = k_ref.shape[1]
+    blk = k_ref.shape[2]
 
     @pl.when(s_idx == 0)
     def _init():
@@ -63,8 +63,8 @@ def _flash_kernel(
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     q = q_ref[0, 0]            # [GT, H]
-    k = k_ref[0, :, 0]         # [BLK, H]
-    v = v_ref[0, :, 0]         # [BLK, H]
+    k = k_ref[0, 0]            # [BLK, H]
+    v = v_ref[0, 0]            # [BLK, H]
     # A ragged final block reads past S: those rows are padding garbage
     # (possibly NaN), and 0 * NaN = NaN would leak through the p @ v matmul
     # even with p zeroed — zero the rows themselves.
@@ -79,7 +79,7 @@ def _flash_kernel(
         preferred_element_type=jnp.float32,
     ) * scale  # [GT, BLK]
 
-    qp = qpos_ref[0][:, None]  # [GT, 1]
+    qp = qpos_ref[0, 0][:, None]  # [GT, 1]
     kv_pos = s_idx * blk + jax.lax.broadcasted_iota(
         jnp.int32, scores.shape, dimension=1
     )
@@ -120,8 +120,8 @@ def _flash_kernel(
 )
 def flash_gqa_attention(
     q: jnp.ndarray,            # [B, T, N, H]
-    k: jnp.ndarray,            # [B, S, K, H]
-    v: jnp.ndarray,            # [B, S, K, H]
+    k: jnp.ndarray,            # [B, K, S, H]  (head-major cache layout)
+    v: jnp.ndarray,            # [B, K, S, H]
     q_positions: jnp.ndarray,  # [B, T] i32 — absolute position of each query
     sliding_window: Optional[int] = None,
     *,
@@ -133,19 +133,27 @@ def flash_gqa_attention(
     Returns [B, T, N, H] in q's dtype.
     """
     b, t, n, h = q.shape
-    s, kh = k.shape[1], k.shape[2]
+    kh, s = k.shape[1], k.shape[2]
     g = n // kh
     gt = g * t
 
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    if not interpret and s % 8:
+        raise ValueError(
+            f"flash kernel needs sublane-aligned S (multiple of 8) on TPU, "
+            f"got {s}; engine/kvcache.init_cache rounds cache length up for this"
+        )
     blk = min(block_kv, s)
     grid = (b, kh, pl.cdiv(s, blk))
 
     # [B, T, N, H] -> [B, K, G*T, H]: fold query groups into rows per KV head.
     q5 = q.reshape(b, t, kh, g, h).transpose(0, 2, 3, 1, 4).reshape(b, kh, gt, h)
-    # Row r = g*T + t attends from position q_positions[b, r % T].
-    qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))  # [B, GT]
+    # Row r = g*T + t attends from position q_positions[b, r % T]. The
+    # singleton middle axis keeps the BlockSpec's trailing two dims equal to
+    # the array dims — the TPU lowering requires (8, 128)-divisible or
+    # full-dim blocks, and a (1, GT) block over [B, GT] violates that.
+    qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))[:, None, :]  # [B, 1, GT]
 
     out = pl.pallas_call(
         functools.partial(
@@ -154,10 +162,10 @@ def flash_gqa_attention(
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, gt), lambda bi, ki, si: (bi, 0)),
+            pl.BlockSpec((1, 1, gt), lambda bi, ki, si: (bi, 0, 0)),
             pl.BlockSpec((1, 1, gt, h), lambda bi, ki, si: (bi, ki, 0, 0)),
-            pl.BlockSpec((1, blk, 1, h), lambda bi, ki, si: (bi, si, ki, 0)),
-            pl.BlockSpec((1, blk, 1, h), lambda bi, ki, si: (bi, si, ki, 0)),
+            pl.BlockSpec((1, 1, blk, h), lambda bi, ki, si: (bi, ki, si, 0)),
+            pl.BlockSpec((1, 1, blk, h), lambda bi, ki, si: (bi, ki, si, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, gt, h), lambda bi, ki, si: (bi, ki, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((b, kh, gt, h), q.dtype),

@@ -65,8 +65,8 @@ def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
 
 
 def cache_spec() -> P:
-    """[L, B, S, K, H]: batch over dp, KV heads over tp."""
-    return P(None, "dp", None, "tp", None)
+    """[L, B, K, S, H]: batch over dp, KV heads over tp."""
+    return P(None, "dp", "tp", None, None)
 
 
 def batch_spec(ndim: int = 2) -> P:

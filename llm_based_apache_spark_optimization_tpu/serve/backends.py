@@ -21,6 +21,7 @@ from ..tokenizer.base import Tokenizer
 class Completion:
     text: str
     output_tokens: int
+    prompt_tokens: int = 0
 
 
 def trim_stop_texts(text: str, stop_texts: Sequence[str]) -> str:
@@ -136,7 +137,7 @@ class EngineBackend:
         if out and out[-1] in self.engine.stop_ids:
             out = out[:-1]
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
-        return Completion(text=text, output_tokens=len(out))
+        return Completion(text=text, output_tokens=len(out), prompt_tokens=len(ids))
 
 
 class FakeBackend:
@@ -150,4 +151,8 @@ class FakeBackend:
                  sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
         self.calls.append(prompt)
         text = self.fn(prompt)
-        return Completion(text=text, output_tokens=len(text.split()))
+        return Completion(
+            text=text,
+            output_tokens=len(text.split()),
+            prompt_tokens=len(prompt.split()),
+        )

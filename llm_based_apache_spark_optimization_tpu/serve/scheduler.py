@@ -113,7 +113,7 @@ class ContinuousBatchingScheduler:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            spec = P(None, None, None, "tp", None)  # slots unsharded, KV heads on tp
+            spec = P(None, None, "tp", None, None)  # slots unsharded, KV heads on tp
             cache = jax.tree.map(
                 lambda x: jax.device_put(x, NamedSharding(mesh, spec)), cache
             )

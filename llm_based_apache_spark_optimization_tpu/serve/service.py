@@ -16,6 +16,7 @@ import time
 from typing import Dict, Optional
 
 from ..ops.sampling import SamplingParams
+from ..utils.observability import MetricsRegistry, RequestMetrics, trace_capture
 from .templates import TEMPLATES, Template
 
 
@@ -48,6 +49,7 @@ class GenerationService:
         self._models: Dict[str, ModelEntry] = {}
         self._lock = threading.Lock()
         self.stats: Dict[str, Dict[str, float]] = {}
+        self.metrics = MetricsRegistry()
 
     def register(self, name: str, backend, template: str = "completion") -> None:
         if template not in TEMPLATES:
@@ -77,15 +79,23 @@ class GenerationService:
             )
         rendered = entry.template(system, prompt)
         t0 = time.perf_counter()
-        completion = entry.backend.complete(
-            rendered, max_new_tokens=max_new_tokens, sampling=sampling, seed=seed
-        )
+        with trace_capture(f"generate-{model}"):
+            completion = entry.backend.complete(
+                rendered, max_new_tokens=max_new_tokens, sampling=sampling,
+                seed=seed,
+            )
         latency = time.perf_counter() - t0
         with self._lock:
             s = self.stats[model]
             s["requests"] += 1
             s["total_latency_s"] += latency
             s["total_tokens"] += completion.output_tokens
+        self.metrics.record(RequestMetrics(
+            model=model,
+            prompt_tokens=completion.prompt_tokens,
+            output_tokens=completion.output_tokens,
+            latency_s=latency,
+        ))
         return GenerateResult(
             response=completion.text,
             model=model,

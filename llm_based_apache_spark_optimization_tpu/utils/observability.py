@@ -1,0 +1,156 @@
+"""Observability: per-request metrics, stage timers, and profiler capture.
+
+The reference's only instruments are `print()` statements and one wall-clock
+bracket in its eval harness (SURVEY.md §5 "Tracing/profiling",
+`Model_Evaluation_&_Comparision.py:42-44`). Here the serving stack gets real
+counters:
+
+- `StageTimer` — wall-clock spans around pipeline stages (prefill vs decode,
+  SQL exec, persistence), cheap enough to always be on.
+- `RequestMetrics` / `MetricsRegistry` — per-request records (prompt/output
+  tokens, decode tok/s, end-to-end latency) with process-lifetime aggregates
+  (count, p50/p95 latency, aggregate tok/s), surfaced by the app's
+  `/metrics` endpoint and printed by the bench harness.
+- `trace_capture` — `jax.profiler` trace of a code region, gated behind the
+  LSOT_TRACE_DIR env var: zero overhead when unset, a TensorBoard-loadable
+  trace directory when set.
+
+Everything is thread-safe: the serving layer calls this from request
+threads and the continuous-batching scheduler loop alike.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, Iterator, List, Optional
+
+log = logging.getLogger("lsot.metrics")
+
+
+class StageTimer:
+    """Accumulates named wall-clock spans: `with timer.stage("prefill"): ...`.
+
+    Re-entering a stage name accumulates (decode chunks sum into one
+    "decode" figure)."""
+
+    def __init__(self):
+        self._spans: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def stage(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._spans[name] = self._spans.get(name, 0.0) + dt
+
+    @property
+    def spans(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._spans)
+
+
+@dataclasses.dataclass
+class RequestMetrics:
+    model: str
+    prompt_tokens: int
+    output_tokens: int
+    latency_s: float
+    stages: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def decode_tok_s(self) -> float:
+        decode = self.stages.get("decode")
+        span = decode if decode else self.latency_s
+        return self.output_tokens / span if span > 0 else 0.0
+
+    def to_dict(self) -> Dict:
+        return {
+            "model": self.model,
+            "prompt_tokens": self.prompt_tokens,
+            "output_tokens": self.output_tokens,
+            "latency_s": round(self.latency_s, 4),
+            "decode_tok_s": round(self.decode_tok_s, 2),
+            "stages": {k: round(v, 4) for k, v in self.stages.items()},
+        }
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class MetricsRegistry:
+    """Process-lifetime request aggregates, keyed by model name.
+
+    Bounded memory: keeps only the last `window` per-request records per
+    model for percentiles; counters are exact over the full lifetime.
+    """
+
+    def __init__(self, window: int = 1024):
+        self._window = window
+        self._lock = threading.Lock()
+        self._recent: Dict[str, List[RequestMetrics]] = {}
+        self._count: Dict[str, int] = {}
+        self._tokens: Dict[str, int] = {}
+        self._time: Dict[str, float] = {}
+
+    def record(self, m: RequestMetrics) -> None:
+        with self._lock:
+            recent = self._recent.setdefault(m.model, [])
+            recent.append(m)
+            if len(recent) > self._window:
+                del recent[: len(recent) - self._window]
+            self._count[m.model] = self._count.get(m.model, 0) + 1
+            self._tokens[m.model] = self._tokens.get(m.model, 0) + m.output_tokens
+            self._time[m.model] = self._time.get(m.model, 0.0) + m.latency_s
+        log.info("request %s", json.dumps(m.to_dict()))
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            out = {}
+            for model, recent in self._recent.items():
+                lats = sorted(r.latency_s for r in recent)
+                toks = sum(r.output_tokens for r in recent)
+                span = sum(r.latency_s for r in recent)
+                out[model] = {
+                    "requests": self._count[model],
+                    "output_tokens": self._tokens[model],
+                    "p50_latency_s": round(_percentile(lats, 0.50), 4),
+                    "p95_latency_s": round(_percentile(lats, 0.95), 4),
+                    "avg_decode_tok_s": round(toks / span, 2) if span else 0.0,
+                }
+            return out
+
+
+# Default process-wide registry the serving layer records into.
+registry = MetricsRegistry()
+
+
+@contextlib.contextmanager
+def trace_capture(name: str = "lsot") -> Iterator[None]:
+    """jax.profiler trace of the enclosed region when LSOT_TRACE_DIR is set.
+
+    The resulting directory loads in TensorBoard/XProf and shows XLA op
+    timelines on the TPU — the profiling story SURVEY.md §5 requires.
+    """
+    trace_dir = os.environ.get("LSOT_TRACE_DIR")
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(os.path.join(trace_dir, name)):
+        yield

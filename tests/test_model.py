@@ -74,14 +74,14 @@ def test_gqa_matches_mha_when_kv_repeated():
     rng = np.random.default_rng(0)
     b, t, n, k, h = 2, 4, 4, 2, 8
     q = jnp.asarray(rng.normal(size=(b, t, n, h)), jnp.float32)
-    kv_k = jnp.asarray(rng.normal(size=(b, t, k, h)), jnp.float32)
-    kv_v = jnp.asarray(rng.normal(size=(b, t, k, h)), jnp.float32)
+    kv_k = jnp.asarray(rng.normal(size=(b, k, t, h)), jnp.float32)
+    kv_v = jnp.asarray(rng.normal(size=(b, k, t, h)), jnp.float32)
     pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     mask = attention_mask(pos, t)
     out_gqa = gqa_attention(q, kv_k, kv_v, mask)
     # Repeat KV heads to full MHA and compare.
-    rep_k = jnp.repeat(kv_k, n // k, axis=2)
-    rep_v = jnp.repeat(kv_v, n // k, axis=2)
+    rep_k = jnp.repeat(kv_k, n // k, axis=1)
+    rep_v = jnp.repeat(kv_v, n // k, axis=1)
     out_mha = gqa_attention(q, rep_k, rep_v, mask)
     np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha), rtol=1e-5, atol=1e-5)
 

@@ -25,8 +25,8 @@ def _ref_and_flash(b, t, s, n, kh, h, *, window=None, block_kv=512, seed=0):
     key = jax.random.key(seed)
     kq, kk, kv, kp = jax.random.split(key, 4)
     q = jax.random.normal(kq, (b, t, n, h), jnp.float32)
-    k = jax.random.normal(kk, (b, s, kh, h), jnp.float32)
-    v = jax.random.normal(kv, (b, s, kh, h), jnp.float32)
+    k = jax.random.normal(kk, (b, kh, s, h), jnp.float32)
+    v = jax.random.normal(kv, (b, kh, s, h), jnp.float32)
     # Absolute positions: contiguous runs starting at a random per-batch
     # offset, like a mid-decode cache read.
     starts = jax.random.randint(kp, (b,), 0, max(1, s - t + 1))

@@ -27,8 +27,12 @@ def _rand_qkv(key, b, t, n, kh, h, dtype=jnp.float32):
 
 
 def _dense_golden(q, k, v, positions, sliding_window=None):
+    # k/v arrive sequence-major [B, T, K, H] (the ring interface); the dense
+    # reference reads the head-major cache layout [B, K, S, H].
     mask = attention_mask(positions, k.shape[1], sliding_window)
-    return gqa_attention(q, k, v, mask)
+    return gqa_attention(
+        q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), mask
+    )
 
 
 @pytest.mark.parametrize(
