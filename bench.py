@@ -52,6 +52,11 @@ def main() -> None:
           f"B={batch} prompt={prompt_len} new={max_new}", file=sys.stderr)
 
     params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    quant = os.environ.get("BENCH_QUANT", "")
+    if quant == "int8":
+        from llm_based_apache_spark_optimization_tpu.ops import quantize_params
+
+        params = quantize_params(params)
     # stop_ids=(-1,): never stops — random weights would otherwise emit eos at
     # arbitrary points and under-count the decode work.
     eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=prompt_len)
@@ -76,7 +81,8 @@ def main() -> None:
         best = max(best, toks / dt)
 
     result = {
-        "metric": f"aggregate greedy decode throughput ({cfg_name}, B={batch}, "
+        "metric": f"aggregate greedy decode throughput ({cfg_name}"
+                  f"{'-int8' if quant == 'int8' else ''}, B={batch}, "
                   f"prompt={prompt_len}, new={max_new})",
         "value": round(best, 1),
         "unit": "output tok/s",

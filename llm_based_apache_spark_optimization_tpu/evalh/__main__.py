@@ -1,0 +1,79 @@
+"""Run the eval harness / BASELINE configs from the command line.
+
+    python -m llm_based_apache_spark_optimization_tpu.evalh            # 4-query suite, both models
+    python -m llm_based_apache_spark_optimization_tpu.evalh --configs  # the 5 BASELINE configs
+    python -m llm_based_apache_spark_optimization_tpu.evalh --backend tiny --configs 4-spider-batch32-tp4
+
+This is the CLI twin of the reference's `Model_Evaluation_&_Comparision.py`
+(run directly against a live Ollama there; against the in-tree service
+here). `--backend tiny` runs the real engine path with random weights —
+numbers are plumbing-true but quality metrics are meaningless; point
+checkpoints at the service (app/__main__.py wiring) for real scores.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(prog="evalh")
+    ap.add_argument("--backend", choices=("tiny", "fake"), default="fake")
+    ap.add_argument("--configs", nargs="*", metavar="KEY",
+                    help="run BASELINE configs (all when no KEY given)")
+    ap.add_argument("--spider", metavar="DEV_JSON",
+                    help="evaluate on real Spider data at this path")
+    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from ..app.__main__ import make_fake_service, make_tiny_service
+    from .configs import CONFIGS, run_config
+    from .fixtures import FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM
+    from .harness import evaluate_models, format_summary
+
+    service = (make_tiny_service(args.max_new_tokens)
+               if args.backend == "tiny" else make_fake_service())
+
+    if args.configs is not None:
+        keys = args.configs or list(CONFIGS)
+        for key in keys:
+            if key not in CONFIGS:
+                sys.exit(f"unknown config {key!r}; choices: {list(CONFIGS)}")
+            cfg = CONFIGS[key]
+            rep = run_config(service, cfg, max_new_tokens=args.max_new_tokens)
+            print(json.dumps({
+                "config": key,
+                "description": cfg.description,
+                "cases": len(rep.cases),
+                "exact_match_rate": round(rep.exact_match_rate, 2),
+                "avg_edit_distance": round(rep.avg_edit_distance, 2),
+                "avg_latency_s": round(rep.avg_latency_s, 4),
+                "aggregate_tok_per_s": round(rep.aggregate_tok_per_s, 1),
+            }))
+        return
+
+    if args.spider:
+        from .spider import load_spider
+
+        cases = [c.as_eval_case() for c in load_spider(args.spider, limit=100)]
+        system = ""  # schemas ride per-case; simple shared-system fallback
+    else:
+        cases, system = FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM
+
+    reports = evaluate_models(
+        service, service.models(), cases, system,
+        max_new_tokens=args.max_new_tokens,
+    )
+    print(format_summary(reports))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,9 @@ class CaseResult:
 class ModelReport:
     model: str
     cases: List[CaseResult]
+    # Set by the batched path: true wall-clock of all batches. Without it,
+    # aggregate tok/s divides by summed per-case latencies (sequential path).
+    wall_clock_s: float = 0.0
 
     @property
     def exact_match_rate(self) -> float:
@@ -47,7 +50,7 @@ class ModelReport:
 
     @property
     def aggregate_tok_per_s(self) -> float:
-        total_t = sum(c.latency_s for c in self.cases)
+        total_t = self.wall_clock_s or sum(c.latency_s for c in self.cases)
         return sum(c.output_tokens for c in self.cases) / total_t if total_t else 0.0
 
 
@@ -76,6 +79,41 @@ def evaluate_model(
             output_tokens=res.output_tokens,
         ))
     return ModelReport(model=model, cases=results)
+
+
+def evaluate_model_batched(
+    service: GenerationService,
+    model: str,
+    cases: Sequence[EvalCase],
+    system: str,
+    max_new_tokens: int = 256,
+    batch_size: int = 32,
+) -> ModelReport:
+    """Batched scoring (BASELINE configs 3/4): cases run `batch_size` at a
+    time through one device program; per-case latency is the batch
+    wall-clock, so aggregate_tok_per_s reflects batched throughput."""
+    results: List[CaseResult] = []
+    wall = 0.0
+    for i in range(0, len(cases), batch_size):
+        chunk = cases[i : i + batch_size]
+        outs = service.generate_batch(
+            model=model, prompts=[c.nl for c in chunk], system=system,
+            max_new_tokens=max_new_tokens,
+        )
+        wall += outs[0].latency_s
+        for case, res in zip(chunk, outs):
+            generated = res.response.strip()
+            expected = case.expected_sql.strip()
+            results.append(CaseResult(
+                nl=case.nl,
+                generated_sql=generated,
+                expected_sql=expected,
+                exact_match=exact_match(generated, expected),
+                edit_distance=edit_distance(generated, expected),
+                latency_s=res.latency_s,
+                output_tokens=res.output_tokens,
+            ))
+    return ModelReport(model=model, cases=results, wall_clock_s=wall)
 
 
 def evaluate_models(

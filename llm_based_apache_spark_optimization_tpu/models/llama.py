@@ -33,6 +33,7 @@ from jax import lax
 from ..ops.attention import attention_mask, gqa_attention
 from ..ops.norm import rms_norm
 from ..ops.pallas import flash_gqa_attention
+from ..ops.quant import mm
 from ..ops.ring_attention import ring_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
 from .configs import LlamaConfig
@@ -135,9 +136,10 @@ def forward(
     def block(x, layer_in):
         p, k_cache, v_cache = layer_in
         h = rms_norm(x, p["ln_attn"], cfg.norm_eps)
-        q = (h @ p["wq"]).reshape(b, t, nh, hd)
-        k = (h @ p["wk"]).reshape(b, t, kh, hd)
-        v = (h @ p["wv"]).reshape(b, t, kh, hd)
+        # mm() transparently handles int8 QTensors (ops/quant.py).
+        q = mm(h, p["wq"]).reshape(b, t, nh, hd)
+        k = mm(h, p["wk"]).reshape(b, t, kh, hd)
+        v = mm(h, p["wv"]).reshape(b, t, kh, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if k_cache is None:
@@ -163,10 +165,10 @@ def forward(
             )
         else:
             attn = gqa_attention(q, k_full, v_full, mask)
-        x = x + attn.reshape(b, t, nh * hd) @ p["wo"]
+        x = x + mm(attn.reshape(b, t, nh * hd), p["wo"])
         h2 = rms_norm(x, p["ln_mlp"], cfg.norm_eps)
-        gate = jax.nn.silu((h2 @ p["wg"]).astype(jnp.float32)).astype(x.dtype)
-        x = x + (gate * (h2 @ p["wu"])) @ p["wd"]
+        gate = jax.nn.silu(mm(h2, p["wg"]).astype(jnp.float32)).astype(x.dtype)
+        x = x + mm(gate * mm(h2, p["wu"]), p["wd"])
         return x, (k_out, v_out)
 
     if cache is None:

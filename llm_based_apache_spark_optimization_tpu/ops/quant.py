@@ -1,0 +1,65 @@
+"""Int8 weight-only quantization for the transformer matmuls.
+
+This is the TPU counterpart of llama.cpp's quantized serving (the
+reference's models ship as Q4/Q8 GGUF blobs run by llama.cpp —
+SURVEY.md §2.3). Decode throughput is HBM-bandwidth-bound: every step
+streams the full weight set once, so int8 storage halves weight traffic
+vs bf16 and directly buys decode tok/s. Scheme:
+
+- Symmetric per-output-channel scaling over the contracted (input) axis:
+  q8 = round(W / s), s = absmax_in(W) / 127, stored as
+  {"q8": int8 [..., in, out], "s": f32 [..., out]}.
+- Compute stays in the activation dtype: XLA fuses the int8->bf16 convert
+  and the per-column rescale into the matmul, so the MXU sees a normal
+  bf16 contraction fed by int8 HBM reads.
+- Only the seven block matmul weights quantize; embeddings, unembedding
+  and norms stay high-precision (quality-sensitive, small share of bytes —
+  the same split llama.cpp's quant presets make).
+
+A QTensor is a plain dict, so the params tree stays a vanilla pytree:
+`lax.scan` slices the stacked [L, ...] leaves per layer, `jax.tree.map`
+and checkpointing traverse it, and `parallel.sharding` shards q8 like the
+original weight and s by its surviving out axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+QUANT_KEYS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+
+
+def is_qtensor(w: Any) -> bool:
+    return isinstance(w, dict) and "q8" in w
+
+
+def quantize_weight(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """[..., in, out] float -> {"q8": int8, "s": f32 [..., out]}."""
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=-2) / 127.0  # [..., out]
+    s = jnp.where(s == 0.0, 1.0, s)
+    q8 = jnp.clip(jnp.round(w32 / s[..., None, :]), -127, 127).astype(jnp.int8)
+    return {"q8": q8, "s": s}
+
+
+def dequantize_weight(w: Dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.ndarray:
+    return (w["q8"].astype(jnp.float32) * w["s"][..., None, :]).astype(dtype)
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize the block matmul weights of a model/checkpoint param tree."""
+    out = dict(params)
+    out["blocks"] = {
+        k: quantize_weight(v) if k in QUANT_KEYS else v
+        for k, v in params["blocks"].items()
+    }
+    return out
+
+
+def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+    """x @ w for a plain array or a QTensor (dequant fused into the matmul)."""
+    if is_qtensor(w):
+        return (x @ w["q8"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w

@@ -1,6 +1,12 @@
 """Device mesh, TP/DP sharding specs, collective-by-construction parallelism."""
 
 from .mesh import make_mesh  # noqa: F401
+from .multihost import (  # noqa: F401
+    global_mesh,
+    init_distributed,
+    is_primary,
+    process_local_batch,
+)
 from .sharding import (  # noqa: F401
     batch_spec,
     cache_spec,

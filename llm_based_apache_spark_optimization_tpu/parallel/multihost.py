@@ -1,0 +1,111 @@
+"""Multi-host (multi-slice) runtime: the framework's DCN-scale story.
+
+The reference has no distributed layer at all (SURVEY.md §2.4 — its only
+transports are HTTP/py4j/MySQL-wire); the scaling analog in GPU stacks is
+NCCL/MPI process groups. The TPU-native equivalent is JAX's distributed
+runtime: every host runs the same program, `jax.distributed.initialize`
+wires the hosts into one system, and a mesh built over `jax.devices()`
+(which, after initialization, spans *all* hosts' chips) makes GSPMD compile
+cross-host collectives — intra-slice traffic rides ICI, inter-slice rides
+DCN. No NCCL, no MPI: placement specs are the whole communication story.
+
+Layout convention: `global_mesh` keeps dp outermost so data parallelism
+crosses slices over DCN (cheap, gradient/result-sized transfers — or in
+this serving stack, independent requests), while sp/tp stay inside a slice
+where the ring/all-reduce traffic belongs on ICI. This follows the standard
+mesh recipe (the scaling-book ordering: DCN-friendly axes outermost).
+
+Single-host runs need none of this: every entry point treats "no
+coordinator configured, one process" as the degenerate case and becomes a
+no-op, so the same code path serves laptop CI and a v5e pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_initialized = False
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join this process into the multi-host runtime; returns True if it did.
+
+    Arguments default to the standard env vars (LSOT_COORDINATOR,
+    LSOT_NUM_PROCESSES, LSOT_PROCESS_ID, falling back to JAX's own
+    auto-detection on Cloud TPU where the metadata server provides them).
+    Safe to call unconditionally: a single-process run with no coordinator
+    is a no-op.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coordinator_address = coordinator_address or os.environ.get("LSOT_COORDINATOR")
+    num_processes = num_processes or _int_env("LSOT_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _int_env("LSOT_PROCESS_ID")
+    if coordinator_address is None and num_processes is None:
+        return False  # single-process mode
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def global_mesh(
+    dp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(dp, sp, tp) mesh over every device in the system (all hosts).
+
+    dp is outermost so its boundaries align with host/slice boundaries and
+    cross-slice traffic stays DCN-friendly; sp/tp vary fastest so their
+    collectives stay on ICI within a slice. Works identically single-host
+    (where it matches `mesh.make_mesh`).
+    """
+    if devices is None:
+        devices = jax.devices()  # global list after init_distributed
+    if dp * sp * tp != len(devices):
+        raise ValueError(
+            f"dp*sp*tp = {dp * sp * tp} != global device count {len(devices)}"
+        )
+    arr = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(arr, ("dp", "sp", "tp"))
+
+
+def process_local_batch(global_batch, mesh: Mesh):
+    """Assemble a dp-sharded global array from per-host local batch rows.
+
+    Each host feeds only its own requests (`global_batch` here is the host's
+    local [B_local, ...] numpy array); the result is a global jax.Array of
+    shape [B_local * num_processes, ...] sharded over dp without any host
+    ever materializing the full batch — the multi-host analog of
+    `sharding.shard_batch`.
+    """
+    spec = P("dp", *([None] * (np.ndim(global_batch) - 1)))
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), np.asarray(global_batch)
+    )
+
+
+def is_primary() -> bool:
+    """True on the process that should do singleton work (logging, serving
+    the HTTP frontend, writing history rows)."""
+    return jax.process_index() == 0

@@ -42,18 +42,28 @@ def validate_tp(cfg: LlamaConfig, tp: int) -> None:
         )
 
 
-def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
-    """PartitionSpec tree matching models.llama.init_params exactly."""
+def param_specs(cfg: LlamaConfig, quantized: bool = False) -> Dict[str, Any]:
+    """PartitionSpec tree matching models.llama.init_params exactly.
+
+    With `quantized=True` the seven matmul weights become QTensor dicts
+    (ops/quant.py): q8 shards exactly like the original weight; the
+    per-output-channel scale keeps only the out axis, so it shards over tp
+    for column-parallel weights and replicates for row-parallel ones (the
+    scale multiply happens after GSPMD's all-reduce of the partial sums).
+    """
+    def w(spec: P) -> Any:
+        return {"q8": spec, "s": P(spec[0], spec[2])} if quantized else spec
+
     specs: Dict[str, Any] = {
         "embed": P(None, None),
         "blocks": {
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "wg": P(None, None, "tp"),
-            "wu": P(None, None, "tp"),
-            "wd": P(None, "tp", None),
+            "wq": w(P(None, None, "tp")),
+            "wk": w(P(None, None, "tp")),
+            "wv": w(P(None, None, "tp")),
+            "wo": w(P(None, "tp", None)),
+            "wg": w(P(None, None, "tp")),
+            "wu": w(P(None, None, "tp")),
+            "wd": w(P(None, "tp", None)),
             "ln_attn": P(None, None),
             "ln_mlp": P(None, None),
         },
@@ -76,8 +86,10 @@ def batch_spec(ndim: int = 2) -> P:
 
 def shard_params(params: Pytree, cfg: LlamaConfig, mesh: Mesh) -> Pytree:
     """Place a (host or single-device) param tree onto the mesh."""
+    from ..ops.quant import is_qtensor
+
     validate_tp(cfg, mesh.shape["tp"])
-    specs = param_specs(cfg)
+    specs = param_specs(cfg, quantized=is_qtensor(params["blocks"]["wq"]))
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
         is_leaf=lambda x: isinstance(x, P),

@@ -68,17 +68,35 @@ class EngineBackend:
         dtype=None,
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
+        quantize_int8: bool = False,
         **kwargs,
     ) -> "EngineBackend":
         """Stand up a backend straight from an HF-format checkpoint directory
-        (the deployment path: weights land pre-sharded on the mesh)."""
+        (the deployment path: weights land pre-sharded on the mesh).
+
+        `quantize_int8=True` converts the block matmul weights to int8
+        QTensors before placement (ops/quant.py) — halves weight HBM
+        traffic for bandwidth-bound decode."""
         import jax.numpy as jnp
 
         from ..checkpoint import load_hf_checkpoint
 
-        cfg, params = load_hf_checkpoint(
-            ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=mesh
-        )
+        if quantize_int8:
+            from ..ops.quant import quantize_params
+            from ..parallel.sharding import shard_params
+
+            # Load host-side, quantize, then place: the int8 tree is what
+            # ships to devices, not the full-precision one.
+            cfg, params = load_hf_checkpoint(
+                ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=None
+            )
+            params = quantize_params(params)
+            if mesh is not None:
+                params = shard_params(params, cfg, mesh)
+        else:
+            cfg, params = load_hf_checkpoint(
+                ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=mesh
+            )
         engine = InferenceEngine(
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
             stop_ids=stop_ids,
@@ -139,6 +157,36 @@ class EngineBackend:
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
         return Completion(text=text, output_tokens=len(out), prompt_tokens=len(ids))
 
+    def complete_batch(
+        self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None, seed: int = 0,
+    ) -> List[Completion]:
+        """One batched device program for many prompts (BASELINE config 4:
+        batch=32 Spider questions) — amortizes weight streaming across the
+        whole batch instead of paying it per request."""
+        ids = [self.tokenizer.encode(p, add_bos=self.add_bos) for p in prompts]
+        room = self.engine.cfg.max_seq_len - self.engine.padded_prompt_len(
+            max(len(i) for i in ids)
+        )
+        if room < 1:
+            raise ValueError("longest prompt leaves no decode room")
+        budget = min(max_new_tokens or self.max_new_tokens, room)
+        with self._lock:
+            outs = self.engine.generate(
+                ids, max_new_tokens=budget,
+                sampling=sampling or self.sampling, seed=seed,
+            )
+        completions = []
+        for prompt_ids, out in zip(ids, outs):
+            if out and out[-1] in self.engine.stop_ids:
+                out = out[:-1]
+            text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
+            completions.append(Completion(
+                text=text, output_tokens=len(out),
+                prompt_tokens=len(prompt_ids),
+            ))
+        return completions
+
 
 class FakeBackend:
     """Deterministic canned backend: `fn(prompt) -> text`."""
@@ -156,3 +204,7 @@ class FakeBackend:
             output_tokens=len(text.split()),
             prompt_tokens=len(prompt.split()),
         )
+
+    def complete_batch(self, prompts, max_new_tokens=None, sampling=None,
+                       seed: int = 0) -> List[Completion]:
+        return [self.complete(p, max_new_tokens, sampling, seed) for p in prompts]

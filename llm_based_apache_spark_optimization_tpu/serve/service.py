@@ -102,3 +102,49 @@ class GenerationService:
             latency_s=latency,
             output_tokens=completion.output_tokens,
         )
+
+    def generate_batch(
+        self,
+        model: str,
+        prompts: "list[str]",
+        system: str = "",
+        max_new_tokens: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None,
+        seed: int = 0,
+    ) -> "list[GenerateResult]":
+        """Batched twin of generate(): one device program for all prompts.
+
+        Latency reported per result is the batch wall-clock (that IS each
+        request's latency when submitted together); tok/s aggregates across
+        the batch in the metrics registry.
+        """
+        entry = self._models.get(model)
+        if entry is None:
+            raise KeyError(
+                f"model {model!r} is not registered; available: {self.models()}"
+            )
+        rendered = [entry.template(system, p) for p in prompts]
+        t0 = time.perf_counter()
+        with trace_capture(f"generate-batch-{model}"):
+            completions = entry.backend.complete_batch(
+                rendered, max_new_tokens=max_new_tokens, sampling=sampling,
+                seed=seed,
+            )
+        latency = time.perf_counter() - t0
+        with self._lock:
+            s = self.stats[model]
+            s["requests"] += len(prompts)
+            s["total_latency_s"] += latency
+            s["total_tokens"] += sum(c.output_tokens for c in completions)
+        for c in completions:
+            self.metrics.record(RequestMetrics(
+                model=model, prompt_tokens=c.prompt_tokens,
+                output_tokens=c.output_tokens, latency_s=latency,
+            ))
+        return [
+            GenerateResult(
+                response=c.text, model=model, latency_s=latency,
+                output_tokens=c.output_tokens,
+            )
+            for c in completions
+        ]

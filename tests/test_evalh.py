@@ -53,3 +53,94 @@ def test_evaluate_models_summary_format():
     assert "Exact Match Rate: 0.00%" in out
     assert "Average Edit Distance:" in out
     assert reports["bad"].avg_edit_distance > 0
+
+
+# ---------------------------------------------------------------------------
+# Spider fixtures + BASELINE configs
+
+
+def _fake_service():
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+
+    svc = GenerationService()
+    svc.register("duckdb-nsql", FakeBackend(lambda p: "SELECT COUNT(*) FROM singer;"))
+    svc.register("llama3.2", FakeBackend(lambda p: "Column name is misspelled."))
+    return svc
+
+
+def test_spider_smoke_fixture_shape():
+    from llm_based_apache_spark_optimization_tpu.evalh.spider import SPIDER_SMOKE
+
+    assert len(SPIDER_SMOKE) >= 10
+    dbs = {c.db_id for c in SPIDER_SMOKE}
+    assert len(dbs) >= 3
+    for c in SPIDER_SMOKE:
+        assert c.schema_ddl.startswith("CREATE TABLE")
+        assert c.expected_sql.strip().upper().startswith("SELECT")
+
+
+def test_load_spider_real_format(tmp_path):
+    import json
+
+    from llm_based_apache_spark_optimization_tpu.evalh.spider import load_spider
+
+    (tmp_path / "dev.json").write_text(json.dumps([
+        {"db_id": "db1", "question": "How many users?",
+         "query": "SELECT COUNT(*) FROM users"},
+    ]))
+    (tmp_path / "tables.json").write_text(json.dumps([
+        {"db_id": "db1", "table_names_original": ["users"],
+         "column_names_original": [[-1, "*"], [0, "id"], [0, "name"]],
+         "column_types": ["text", "number", "text"]},
+    ]))
+    cases = load_spider(tmp_path / "dev.json")
+    assert len(cases) == 1
+    assert cases[0].schema_ddl == "CREATE TABLE users (id number, name text);"
+    assert cases[0].nl == "How many users?"
+
+
+def test_evaluate_model_batched():
+    from llm_based_apache_spark_optimization_tpu.evalh.harness import (
+        evaluate_model_batched,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.spider import SPIDER_SMOKE
+
+    svc = _fake_service()
+    cases = [c.as_eval_case() for c in SPIDER_SMOKE]
+    rep = evaluate_model_batched(
+        svc, "duckdb-nsql", cases, system="schema", batch_size=4
+    )
+    assert len(rep.cases) == len(cases)
+    assert rep.wall_clock_s > 0
+    assert rep.exact_match_rate > 0  # first smoke case matches the canned SQL
+
+
+def test_run_all_baseline_configs():
+    from llm_based_apache_spark_optimization_tpu.evalh.configs import (
+        CONFIGS,
+        run_config,
+    )
+
+    svc = _fake_service()
+    assert set(CONFIGS) == {
+        "1-cpu-greedy", "2-error-greedy", "3-topp-batch8",
+        "4-spider-batch32-tp4", "5-concurrent-mixed-tp8",
+    }
+    for key, cfg in CONFIGS.items():
+        rep = run_config(svc, cfg, max_new_tokens=16)
+        expected = {
+            "single": 1, "batched": cfg.batch_size,
+            "concurrent": cfg.batch_size * 2,
+        }[cfg.mode]
+        assert len(rep.cases) == expected, key
+        assert rep.aggregate_tok_per_s > 0, key
+
+
+def test_service_generate_batch_metrics():
+    svc = _fake_service()
+    outs = svc.generate_batch("duckdb-nsql", ["q1", "q2", "q3"], system="s")
+    assert len(outs) == 3
+    assert svc.metrics.snapshot()["duckdb-nsql"]["requests"] == 3

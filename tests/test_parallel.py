@@ -94,3 +94,24 @@ def test_sharded_generate_pads_non_divisible_batch(tiny_model):
         prompts, max_new_tokens=5
     )
     assert got == ref
+
+
+def test_multihost_single_process_degenerates():
+    """Single-process: init is a no-op, global_mesh == local mesh, primary."""
+    from llm_based_apache_spark_optimization_tpu.parallel import (
+        global_mesh,
+        init_distributed,
+        is_primary,
+        process_local_batch,
+    )
+
+    assert init_distributed() is False  # no coordinator configured
+    assert is_primary()
+    mesh = global_mesh(dp=4, sp=1, tp=2)
+    assert mesh.shape == {"dp": 4, "sp": 1, "tp": 2}
+    batch = np.arange(8, dtype=np.int32).reshape(4, 2)
+    arr = process_local_batch(batch, mesh)
+    assert arr.shape == (4, 2)
+    np.testing.assert_array_equal(np.asarray(arr), batch)
+    with pytest.raises(ValueError):
+        global_mesh(dp=3)
