@@ -1,0 +1,47 @@
+"""Smoke-test client for the headless JSON API — parity with the reference's
+`FastAPI/run.ipynb` (its cell 0 posts `{"file_name": ..., "input_text": ...}`
+to `http://127.0.0.1:8000/process-data/` and prints the JSON).
+
+Start the service first:
+
+    python -m llm_based_apache_spark_optimization_tpu.app --api --backend fake --cpu
+
+then:
+
+    python examples/client.py [--file data.csv] [--question "..."]
+
+Uses only the standard library so it runs anywhere the server does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import urllib.request
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--url", default="http://127.0.0.1:8000/process-data/")
+    ap.add_argument("--file", default="data.csv",
+                    help="CSV name under the service's input dir")
+    ap.add_argument("--question", default="Get all rows with more than 2 passengers.")
+    args = ap.parse_args()
+
+    body = json.dumps({
+        "file_name": args.file,
+        "input_text": args.question,
+    }).encode()
+    req = urllib.request.Request(
+        args.url, data=body, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            print(json.dumps(json.loads(resp.read()), indent=2))
+    except urllib.error.HTTPError as e:
+        print(f"HTTP {e.code}:")
+        print(json.dumps(json.loads(e.read()), indent=2))
+
+
+if __name__ == "__main__":
+    main()
