@@ -62,51 +62,62 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0]            # [GT, H]
-    k = k_ref[0, 0]            # [BLK, H]
-    v = v_ref[0, 0]            # [BLK, H]
-    # A ragged final block reads past S: those rows are padding garbage
-    # (possibly NaN), and 0 * NaN = NaN would leak through the p @ v matmul
-    # even with p zeroed — zero the rows themselves.
-    row_pos = s_idx * blk + jax.lax.broadcasted_iota(
-        jnp.int32, v.shape, dimension=0
-    )
-    v = jnp.where(row_pos < kv_len, v, 0)
+    qp_row = qpos_ref[0, 0]       # [GT]
 
-    scores = jax.lax.dot_general(
-        q, k,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # [GT, BLK]
+    # Causal block skip: a KV block whose first slot already exceeds every
+    # query position in this (batch, head) contributes nothing — skip its
+    # matmuls entirely. For a from-zero prefill this halves average work
+    # (the classic upper-triangle saving of causal flash attention). The
+    # grid step still runs (Pallas can't skip grid cells), but the MXU does
+    # nothing and the accumulators stay untouched.
+    @pl.when(s_idx * blk <= jnp.max(qp_row))
+    def _compute():
+        q = q_ref[0, 0]            # [GT, H]
+        k = k_ref[0, 0]            # [BLK, H]
+        v = v_ref[0, 0]            # [BLK, H]
+        # A ragged final block reads past S: those rows are padding garbage
+        # (possibly NaN), and 0 * NaN = NaN would leak through the p @ v
+        # matmul even with p zeroed — zero the rows themselves.
+        row_pos = s_idx * blk + jax.lax.broadcasted_iota(
+            jnp.int32, v.shape, dimension=0
+        )
+        v_z = jnp.where(row_pos < kv_len, v, 0)
 
-    qp = qpos_ref[0, 0][:, None]  # [GT, 1]
-    kv_pos = s_idx * blk + jax.lax.broadcasted_iota(
-        jnp.int32, scores.shape, dimension=1
-    )
-    mask = kv_pos <= qp
-    if sliding_window is not None:
-        mask = mask & (qp - kv_pos < sliding_window)
-    scores = jnp.where(mask, scores, NEG_INF)
+        scores = jax.lax.dot_general(
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [GT, BLK]
 
-    m_prev = m_ref[:, :1]                                   # [GT, 1]
-    l_prev = l_ref[:, :1]
-    m_cur = jnp.max(scores, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)                          # [GT, 1]
-    p = jnp.exp(scores - m_new)                              # [GT, BLK]
-    # Fully-masked-so-far rows keep m == NEG_INF; exp(NEG_INF - NEG_INF) = 1
-    # would pollute l with BLK, so zero p where the mask killed the score.
-    p = jnp.where(mask, p, 0.0)
-    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        qp = qp_row[:, None]  # [GT, 1]
+        kv_pos = s_idx * blk + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, dimension=1
+        )
+        mask = kv_pos <= qp
+        if sliding_window is not None:
+            mask = mask & (qp - kv_pos < sliding_window)
+        scores = jnp.where(mask, scores, NEG_INF)
 
-    pv = jax.lax.dot_general(
-        p.astype(v.dtype), v,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # [GT, H]
-    acc_ref[:] = acc_ref[:] * alpha + pv
-    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_prev = m_ref[:, :1]                                   # [GT, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                          # [GT, 1]
+        p = jnp.exp(scores - m_new)                              # [GT, BLK]
+        # Fully-masked-so-far rows keep m == NEG_INF; exp(NEG_INF - NEG_INF)
+        # = 1 would pollute l with BLK, so zero p where the mask killed the
+        # score.
+        p = jnp.where(mask, p, 0.0)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        pv = jax.lax.dot_general(
+            p.astype(v_z.dtype), v_z,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [GT, H]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
     @pl.when(s_idx == pl.num_programs(2) - 1)
     def _finalize():
@@ -174,6 +185,12 @@ def flash_gqa_attention(
             pltpu.VMEM((gt, _LANES), jnp.float32),
             pltpu.VMEM((gt, h), jnp.float32),
         ],
+        # batch and KV-head cells are independent -> megacore can split them;
+        # the S axis carries the online-softmax accumulators and must run
+        # in order on one core.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
         interpret=interpret,
     )(qpos, q5, k, v)
 

@@ -1,5 +1,8 @@
 """Engine tests: generate loop, stop tokens, sampling, batching raggedness."""
 
+import json
+from pathlib import Path
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -86,3 +89,21 @@ def test_generate_fn_cache_reuse(tiny_model):
     f1 = make_generate_fn(cfg, 8, SamplingParams(), (2,))
     f2 = make_generate_fn(cfg, 8, SamplingParams(), (2,))
     assert f1 is f2
+
+
+def test_golden_decode_pinned_tokens(tiny_model):
+    """Regression pin: greedy decode from fixed weights/prompt must produce
+    the exact same tokens forever (SURVEY.md §4 golden-decode tests). If an
+    intentional numerics change (new kernel, dtype policy) breaks this,
+    verify the change on real weights and re-pin."""
+    cfg, params = tiny_model
+    eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+    out = eng.generate([[1, 17, 93, 5]], max_new_tokens=8)[0]
+    golden_path = Path(__file__).parent / "golden" / "tiny_greedy.json"
+    if not golden_path.exists():
+        golden_path.parent.mkdir(exist_ok=True)
+        golden_path.write_text(json.dumps(out))
+    golden = json.loads(golden_path.read_text())
+    assert out == golden, (
+        f"greedy decode drifted from pinned golden: {out} != {golden}"
+    )
