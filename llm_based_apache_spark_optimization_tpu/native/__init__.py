@@ -21,7 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 _SRC_DIR = Path(__file__).parent / "src"
 _LIB_DIR = Path(__file__).parent / "lib"
 _LIB_PATH = _LIB_DIR / "liblsot_native.so"
-_SOURCES = ("bpe.cpp", "gguf.cpp")
+_SOURCES = ("bpe.cpp", "gguf.cpp", "csvscan.cpp")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -112,6 +112,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     ]
     lib.lsot_gguf_last_error.restype = c.c_char_p
     lib.lsot_gguf_last_error.argtypes = []
+    lib.lsot_csv_scan.restype = c.c_int32
+    lib.lsot_csv_scan.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int32), c.c_int32, c.POINTER(c.c_int64),
+    ]
 
 
 class NativeBPE:
@@ -148,6 +152,26 @@ class NativeBPE:
         h, lib = getattr(self, "_h", None), getattr(self, "_lib", None)
         if h and lib is not None:
             lib.lsot_bpe_free(h)
+
+
+#: Dtype code -> Spark-compatible dtype name (lsot_native.h LSOT_CSV_*).
+CSV_DTYPE_NAMES = ("string", "int", "bigint", "double", "timestamp")
+
+
+def csv_scan(path: str | os.PathLike, max_cols: int = 4096):
+    """Native CSV schema-inference scan: (dtype names, data-row count), or
+    None when the native lib is unavailable or the file is malformed —
+    callers fall back to the Python inference pass."""
+    lib = load_native()
+    if lib is None:
+        return None
+    dtypes = (ctypes.c_int32 * max_cols)()
+    n_rows = ctypes.c_int64()
+    n = lib.lsot_csv_scan(str(path).encode(), dtypes, max_cols,
+                          ctypes.byref(n_rows))
+    if n < 0:
+        return None
+    return [CSV_DTYPE_NAMES[dtypes[i]] for i in range(n)], int(n_rows.value)
 
 
 class GGUFReader:

@@ -55,6 +55,21 @@ const char *lsot_gguf_meta_str(void *h, const char *key);
 int32_t lsot_gguf_meta_f64(void *h, const char *key, double *out);
 const char *lsot_gguf_last_error(void);
 
+/* ---- CSV schema-inference scanner (native data-loader core) ---- */
+
+/* Dtype codes (shared with sql/sqlite_backend.py). */
+#define LSOT_CSV_STRING 0
+#define LSOT_CSV_INT 1
+#define LSOT_CSV_BIGINT 2
+#define LSOT_CSV_DOUBLE 3
+#define LSOT_CSV_TIMESTAMP 4
+
+/* Infer per-column dtypes over all data rows (header skipped). Returns the
+ * column count; -1 I/O error/empty, -2 row wider than header, -3 header
+ * wider than max_cols. */
+int32_t lsot_csv_scan(const char *path, int32_t *dtypes, int32_t max_cols,
+                      int64_t *n_rows);
+
 #ifdef __cplusplus
 }
 #endif

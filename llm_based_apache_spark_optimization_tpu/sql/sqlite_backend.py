@@ -81,10 +81,20 @@ class SQLiteBackend:
             except StopIteration:
                 raise ValueError(f"empty CSV: {path}")
             rows = list(reader)
-        dtypes = tuple(
-            _infer_dtype([r[i] if i < len(r) else "" for r in rows])
-            for i in range(len(header))
-        )
+        # Inference pass: the C++ scanner (native/src/csvscan.cpp) is the
+        # fast path — this is the role of Spark's inferSchema native scan in
+        # the reference (SURVEY.md §3.1). The Python pass below is the
+        # behavioral reference and the fallback (no toolchain / ragged rows).
+        from ..native import csv_scan
+
+        scanned = csv_scan(p)
+        if scanned is not None and len(scanned[0]) == len(header):
+            dtypes = tuple(scanned[0])
+        else:
+            dtypes = tuple(
+                _infer_dtype([r[i] if i < len(r) else "" for r in rows])
+                for i in range(len(header))
+            )
         cols = ", ".join(
             f'"{c}" {_AFFINITY[t]}' for c, t in zip(header, dtypes)
         )

@@ -160,3 +160,86 @@ def test_gguf_rejects_garbage(tmp_path):
         GGUFReader(bad)
     with pytest.raises(ValueError):
         GGUFReader(tmp_path / "missing.gguf")
+
+
+# ---------------------------------------------------------------------------
+# CSV schema-inference scanner
+
+
+def _py_infer(path):
+    import csv as csvlib
+
+    from llm_based_apache_spark_optimization_tpu.sql.sqlite_backend import (
+        _infer_dtype,
+    )
+
+    with open(path, newline="") as f:
+        reader = csvlib.reader(f)
+        header = next(reader)
+        rows = list(reader)
+    return [
+        _infer_dtype([r[i] if i < len(r) else "" for r in rows])
+        for i in range(len(header))
+    ], len(rows)
+
+
+def test_csv_scan_matches_python_inference(tmp_path):
+    from llm_based_apache_spark_optimization_tpu.native import csv_scan
+
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "id,big,price,when,label,mixed,empty,signed\n"
+        '1,3000000000,1.5,2024-01-02,abc,"quoted, comma",,+5\n'
+        '2,1,2e3,2024-01-02 10:30,"multi\nline",7,,-2147483648\n'
+        "3,-4000000000,.5,2024-01-02T10:30:45.123,x,2024-01-01,,  12  \n"
+    )
+    got = csv_scan(p)
+    assert got is not None
+    py_dtypes, py_rows = _py_infer(p)
+    assert got[0] == py_dtypes
+    assert got[1] == py_rows
+    assert got[0] == [
+        "int", "bigint", "double", "timestamp", "string", "string",
+        "string", "bigint",  # -2147483648: |v| > 2**31-1, Spark calls it bigint
+    ]
+
+
+def test_csv_scan_randomized_parity(tmp_path):
+    import random
+
+    from llm_based_apache_spark_optimization_tpu.native import csv_scan
+
+    rng = random.Random(7)
+    pools = [
+        lambda: str(rng.randint(-10, 10)),
+        lambda: str(rng.randint(-2**40, 2**40)),
+        lambda: f"{rng.uniform(-5, 5):.3f}",
+        lambda: f"{rng.uniform(-5, 5):.2e}",
+        lambda: "2023-05-0%d" % rng.randint(1, 9),
+        lambda: "2023-05-01 12:3%d" % rng.randint(0, 9),
+        lambda: rng.choice(["abc", "NaN", "inf", "", "  ", "1.2.3", "0x1f"]),
+    ]
+    for trial in range(5):
+        n_cols = rng.randint(1, 6)
+        col_pools = [rng.choice(pools) for _ in range(n_cols)]
+        lines = [",".join(f"c{i}" for i in range(n_cols))]
+        for _ in range(30):
+            lines.append(",".join(g() for g in col_pools))
+        p = tmp_path / f"r{trial}.csv"
+        p.write_text("\n".join(lines) + "\n")
+        got = csv_scan(p)
+        assert got is not None, trial
+        py_dtypes, py_rows = _py_infer(p)
+        assert got[0] == py_dtypes, (trial, p.read_text())
+        assert got[1] == py_rows, trial
+
+
+def test_csv_scan_used_by_backend(tmp_path):
+    from llm_based_apache_spark_optimization_tpu.sql.sqlite_backend import (
+        SQLiteBackend,
+    )
+
+    p = tmp_path / "d.csv"
+    p.write_text("a,b\n1,x\n2,y\n")
+    schema = SQLiteBackend().load_csv(str(p))
+    assert schema.dtypes == ("int", "string")
