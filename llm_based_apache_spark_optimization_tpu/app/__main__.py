@@ -55,10 +55,57 @@ def make_fake_service() -> GenerationService:
     return svc
 
 
+def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
+    """Real deployment: load duckdb-nsql (NL→SQL) and llama3.2 (error
+    analysis) from HF directories or GGUF blobs onto one mesh."""
+    from ..parallel import make_mesh
+    from ..serve import EngineBackend
+    from ..tokenizer import HFTokenizer
+
+    mesh = None
+    if args.dp * args.sp * args.tp > 1:
+        mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+
+    def build(src: str, add_bos: bool = True):
+        path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
+        tok = HFTokenizer(tok_dir or path)
+        if path.endswith(".gguf"):
+            return EngineBackend.from_gguf(
+                path, tok, mesh=mesh, max_new_tokens=max_new_tokens,
+                add_bos=add_bos,
+            )
+        return EngineBackend.from_hf_checkpoint(
+            path, tok, mesh=mesh, quantize_int8=args.int8,
+            max_new_tokens=max_new_tokens, add_bos=add_bos,
+        )
+
+    svc = GenerationService()
+    svc.register("duckdb-nsql", build(args.sql_model_path))
+    # llama3-chat's rendered prompt starts with <|begin_of_text|>: the
+    # tokenizer must not prepend a second BOS (serve/backends.py docstring).
+    svc.register(
+        "llama3.2",
+        build(args.error_model_path or args.sql_model_path, add_bos=False),
+        template="llama3-chat",
+    )
+    return svc
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="llm_based_apache_spark_optimization_tpu.app")
     ap.add_argument("--api", action="store_true", help="headless JSON API instead of the web UI")
-    ap.add_argument("--backend", choices=("tiny", "fake"), default="fake")
+    ap.add_argument("--backend", choices=("tiny", "fake", "checkpoint"),
+                    default="fake")
+    ap.add_argument("--sql-model-path", metavar="DIR_OR_GGUF[:TOKDIR]",
+                    help="duckdb-nsql weights (HF dir or .gguf) for --backend checkpoint")
+    ap.add_argument("--error-model-path", metavar="DIR_OR_GGUF[:TOKDIR]",
+                    help="llama3.2 weights; defaults to --sql-model-path")
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--sp", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--int8", action="store_true",
+                    help="int8 weight-only quantization (HF checkpoints)")
+    ap.add_argument("--max-new-tokens", type=int, default=256)
     ap.add_argument("--host", default=None)
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--cpu", action="store_true",
@@ -77,9 +124,14 @@ def main(argv=None) -> None:
         cfg = type(cfg)(**{**cfg.__dict__, "port": args.port})
     cfg.ensure_dirs()
 
-    # max_new small for the tiny demo model: it babbles bytes, not SQL.
-    service = (make_tiny_service(32) if args.backend == "tiny"
-               else make_fake_service())
+    if args.backend == "checkpoint":
+        if not args.sql_model_path:
+            ap.error("--backend checkpoint requires --sql-model-path")
+        service = make_checkpoint_service(args, args.max_new_tokens)
+    else:
+        # max_new small for the tiny demo model: it babbles bytes, not SQL.
+        service = (make_tiny_service(32) if args.backend == "tiny"
+                   else make_fake_service())
     history = SQLiteHistory(cfg.history_db)
     factory = create_api_app if args.api else create_web_app
     # Pass the backend factory, not an instance: each request gets an

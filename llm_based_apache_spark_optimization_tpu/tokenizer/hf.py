@@ -19,6 +19,10 @@ class HFTokenizer:
             from tokenizers import Tokenizer as _HFT
         except ImportError as e:  # pragma: no cover
             raise RuntimeError("the 'tokenizers' package is required for HFTokenizer") from e
+        import os
+
+        if os.path.isdir(path):  # checkpoint dir -> its tokenizer.json
+            path = os.path.join(path, "tokenizer.json")
         self._tok = _HFT.from_file(path)
         def _id(*names: str) -> Optional[int]:
             for n in names:

@@ -246,3 +246,42 @@ def test_pipeline_runs_are_isolated_per_backend_factory(cfg, tmp_path):
     client.post_json("/process-data/", {"input_text": "q", "file_name": "taxi.csv"})
     client.post_json("/process-data/", {"input_text": "q", "file_name": "taxi.csv"})
     assert len(calls) == 2
+
+
+def test_checkpoint_backend_cli_wiring(tiny_model, tmp_path):
+    """--backend checkpoint: HF dir + tokenizer.json -> live service."""
+    import argparse
+
+    from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+        make_checkpoint_service,
+    )
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        save_hf_checkpoint,
+    )
+
+    cfg_m, params = tiny_model
+    ckpt = tmp_path / "ckpt"
+    save_hf_checkpoint(cfg_m, params, ckpt)
+
+    # Minimal real tokenizer.json (WordLevel over a tiny vocab) so the HF
+    # adapter path is exercised end to end.
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<s>": 1, "</s>": 2, "[UNK]": 0}
+    for i, w in enumerate("select from where count sum vendor fare".split()):
+        vocab[w] = 3 + i
+    tok = Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    tok.save(str(ckpt / "tokenizer.json"))
+
+    args = argparse.Namespace(
+        sql_model_path=str(ckpt), error_model_path=None,
+        dp=1, sp=1, tp=1, int8=True,
+    )
+    svc = make_checkpoint_service(args, max_new_tokens=4)
+    assert sorted(svc.models()) == ["duckdb-nsql", "llama3.2"]
+    out = svc.generate("duckdb-nsql", "select vendor", system="from fare")
+    assert isinstance(out.response, str)
+    assert out.output_tokens >= 1
