@@ -43,6 +43,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+from collections import deque
 from concurrent.futures import Future
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -69,6 +70,10 @@ class _Request:
     future: Future
     # live state (set at admission)
     generated: List[int] = dataclasses.field(default_factory=list)
+    # chunked-prefill progress: prompt tokens already written to the cache.
+    # A slot is decode-eligible only once the whole prompt is in (`ready`).
+    prefilled: int = 0
+    ready: bool = False
 
 
 class ContinuousBatchingScheduler:
@@ -121,12 +126,21 @@ class ContinuousBatchingScheduler:
 
         # Per-slot device state (replicated scalars, updated between chunks).
         self._cur = np.zeros(num_slots, np.int32)        # next token to feed
-        self._pos = np.zeros(num_slots, np.int32)        # its absolute position
+        # Inactive slots "park" at the last cache slot: decode rounds write
+        # garbage K/V for every slot in the batch, and a parked write lands
+        # where no query can ever see it (visibility needs query position
+        # >= max_seq-1, and submit() caps requests at max_seq-2). This is
+        # what makes chunked prefill safe: while a slot's prompt streams in
+        # over several chunks, interleaved decode rounds keep scribbling at
+        # the park slot, not inside the freshly written prompt region.
+        self._park = self.max_seq - 1
+        self._pos = np.full(num_slots, self._park, np.int32)  # absolute position
         self._temps = np.zeros(num_slots, np.float32)
         self._topps = np.ones(num_slots, np.float32)
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
 
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._prefill_q: "deque[Tuple[int, _Request]]" = deque()
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._crash: Optional[BaseException] = None
@@ -146,10 +160,13 @@ class ContinuousBatchingScheduler:
         cfg, impl = self.cfg, self._impl
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, ck, cv, tokens, length, slot, temp, topp, key):
+        def prefill(params, ck, cv, tokens, length, slot, start, temp, topp, key):
+            """One prompt chunk: tokens occupy absolute positions
+            [start, start+length); sample from the chunk's last real logit
+            (meaningful — and used — only on the final chunk)."""
             row_k = lax.dynamic_slice_in_dim(ck, slot, 1, axis=1)
             row_v = lax.dynamic_slice_in_dim(cv, slot, 1, axis=1)
-            positions = jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
+            positions = start + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
             logits, new = forward(
                 cfg, params, tokens, positions, {"k": row_k, "v": row_v},
                 logit_indices=length - 1, attn_impl=impl,
@@ -235,7 +252,7 @@ class ContinuousBatchingScheduler:
                 "(static-shape constraint); use top_p/temperature"
             )
         need = bucket_len(len(ids), self.prompt_bucket) + max_new_tokens + self.decode_chunk
-        if need > self.max_seq:
+        if need > self.max_seq - 1:  # the last cache slot is the parking spot
             raise ValueError(
                 f"prompt ({len(ids)} tokens, bucketed) + max_new_tokens "
                 f"({max_new_tokens}) + decode_chunk ({self.decode_chunk}) "
@@ -283,35 +300,56 @@ class ContinuousBatchingScheduler:
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
     def _admit(self, slot: int, req: _Request) -> None:
-        """Prefill `req` into `slot`; may retire immediately on a stop token."""
-        t = bucket_len(len(req.ids), self.prompt_bucket)
+        """Reserve `slot` and queue the prompt for chunked prefill."""
+        self._slot_req[slot] = req
+        # Park the slot's decode writes before its prompt starts streaming in
+        # (it may still be frozen at the previous occupant's position).
+        self._pos[slot] = self._park
+        self._cur[slot] = self.cfg.pad_id
+        self._prefill_q.append((slot, req))
+
+    def _prefill_step(self) -> None:
+        """Run ONE prompt chunk (Sarathi-style chunked prefill): long prompts
+        interleave with decode rounds instead of stalling every active slot
+        for a whole-prompt forward (SURVEY.md §7 'without starving either')."""
+        slot, req = self._prefill_q.popleft()
+        chunk_ids = req.ids[req.prefilled : req.prefilled + self.prompt_bucket]
+        last = req.prefilled + len(chunk_ids) >= len(req.ids)
+        t = self.prompt_bucket
         if t not in self._prefill_fns:
             self._prefill_fns[t] = self._build_prefill(t)
         tokens = jnp.asarray(
-            [req.ids + [self.cfg.pad_id] * (t - len(req.ids))], jnp.int32
+            [chunk_ids + [self.cfg.pad_id] * (t - len(chunk_ids))], jnp.int32
         )
         self._ck, self._cv, tok = self._prefill_fns[t](
             self.params, self._ck, self._cv, tokens,
-            jnp.asarray([len(req.ids)], jnp.int32), jnp.int32(slot),
+            jnp.asarray([len(chunk_ids)], jnp.int32), jnp.int32(slot),
+            jnp.int32(req.prefilled),
             jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32), self._next_key(),
         )
+        req.prefilled += len(chunk_ids)
+        if not last:
+            self._prefill_q.append((slot, req))
+            return
         first = int(jax.device_get(tok)[0])
         if first in self.stop_ids or req.max_new < 1:
             req.future.set_result([])
+            self._slot_req[slot] = None
             return
         req.generated.append(first)
         if req.max_new == 1:
             req.future.set_result(req.generated)
+            self._slot_req[slot] = None
             return
-        self._slot_req[slot] = req
+        req.ready = True
         self._cur[slot] = first
         self._pos[slot] = len(req.ids)
         self._temps[slot] = req.temperature
         self._topps[slot] = req.top_p
 
     def _decode_round(self) -> None:
-        active = np.asarray([r is not None for r in self._slot_req])
+        active = np.asarray([r is not None and r.ready for r in self._slot_req])
         self._ck, self._cv, cur, pos, toks = self._decode_fn(
             self.params, self._ck, self._cv,
             jnp.asarray(self._cur), jnp.asarray(self._pos), jnp.asarray(active),
@@ -322,8 +360,8 @@ class ContinuousBatchingScheduler:
         self._cur, self._pos = np.array(jax.device_get(cur)), np.array(jax.device_get(pos))
         toks = np.asarray(jax.device_get(toks))
         for i, req in enumerate(self._slot_req):
-            if req is None:
-                continue
+            if req is None or not req.ready:
+                continue  # free, or still prefilling (its toks are garbage)
             done = False
             for tok in toks[i]:
                 tok = int(tok)
@@ -351,6 +389,7 @@ class ContinuousBatchingScheduler:
         """Fail every in-flight and queued request; reject future submits."""
         with self._submit_lock:
             self._closed = True
+        self._prefill_q.clear()  # their requests fail via the slot sweep below
         for i, req in enumerate(self._slot_req):
             if req is not None:
                 req.future.set_exception(exc)
@@ -374,9 +413,14 @@ class ContinuousBatchingScheduler:
                     break
                 if req is not None:
                     self._admit(self._free_slots()[0], req)
-            if any(r is not None for r in self._slot_req):
+            # Fair interleave: at most one prompt chunk, then one decode
+            # chunk — admission work is bounded per decode round, so active
+            # slots never wait longer than one prompt_bucket forward.
+            if self._prefill_q:
+                self._prefill_step()
+            if any(r is not None and r.ready for r in self._slot_req):
                 self._decode_round()
-            else:
+            elif not self._prefill_q:
                 try:
                     req = self._queue.get(timeout=0.05)
                     if req is not None:
