@@ -1,34 +1,156 @@
 """Single-line benchmark: aggregate output tok/s of the in-tree engine.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE JSON line on stdout:
+    {"metric", "value", "unit", "vs_baseline", "platform", ...}
+and exits 0 even when the accelerator backend is unusable — a flaky TPU
+tunnel must degrade to an annotated CPU number (or an annotated error line),
+never to a stack trace (round-1 failure: BENCH_r01.json rc=1 because
+jax TPU init hung and nothing bounded it).
+
+Structure: this file is its own watchdog. The parent process (no jax import —
+an in-process backend-init hang cannot be cancelled) launches itself as a
+subprocess with BENCH_INNER=1 and a hard wall-clock timeout, retries the
+accelerator attempt with backoff, then falls back to forced-CPU, and finally
+emits an error line if everything failed. The inner process does the actual
+measurement.
 
 What it measures: batched greedy decode throughput (output tokens/second,
 summed over the batch) for an NL→SQL-shaped workload — a schema-sized prompt
-prefill followed by a SQL-sized completion — on whatever accelerator jax
-provides (the real TPU chip under the driver; BENCH_FORCE_CPU=1 for hermetic
-runs).
+prefill followed by a SQL-sized completion. BENCH_DETAIL=1 adds a perf
+breakdown: prefill vs decode split, decode MFU vs the chip's peak, and HBM
+bandwidth utilization (decode is weight+cache streaming bound).
 
 Baseline derivation (BASELINE.md): the reference's best model (DuckDB-NSQL via
 Ollama) averages 8.05 s per NL→SQL query over its four-query suite for
 completions of roughly 50 tokens — an effective ~6.2 output tok/s, single
-request, CPU-class Ollama. vs_baseline = value / 6.2.
+request, CPU-class Ollama (measuring instrument:
+reference `Model_Evaluation_&_Comparision.py:42-44`). vs_baseline = value/6.2.
 
 Weights are random (no checkpoint assets in this environment) — throughput is
 architecture+shape-bound, not weight-bound, so random weights measure the same
 thing the loaded model would.
+
+Knobs (env): BENCH_CONFIG (model registry name, default bench-1b), BENCH_BATCH,
+BENCH_PROMPT, BENCH_NEW (auto-clamped to the config's max_seq_len),
+BENCH_QUANT=int8, BENCH_REPS, BENCH_DETAIL=1, BENCH_FORCE_CPU=1,
+BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT (s), BENCH_TPU_RETRIES.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 REFERENCE_TOKS_PER_S = 6.2  # 50-token SQL / 8.05 s avg latency (BASELINE.md)
 
+# Peak specs by TPU generation for MFU / bandwidth accounting:
+# substring of device_kind (lowercased) -> (bf16 TFLOP/s, int8 TOP/s, HBM GB/s).
+PEAKS = {
+    "v6": (918.0, 1836.0, 1640.0),
+    "v5e": (197.0, 394.0, 819.0),
+    "v5 lite": (197.0, 394.0, 819.0),
+    "v5p": (459.0, 918.0, 2765.0),
+    "v4": (275.0, 275.0, 1228.0),
+}
 
-def main() -> None:
+
+def _emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
+
+
+# --------------------------------------------------------------------------
+# Outer watchdog
+# --------------------------------------------------------------------------
+
+def outer() -> int:
+    """Run the inner bench under a hard timeout; retry accel, fall back to CPU."""
+    force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
+    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "600"))
+    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1800"))
+    tpu_retries = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
+
+    attempts = []
+    if not force_cpu:
+        attempts += [("accel", tpu_timeout)] * max(1, tpu_retries)
+    attempts += [("cpu", cpu_timeout)]
+
+    backoff = 10.0
+    last_err = "no attempts ran"
+    for i, (kind, timeout_s) in enumerate(attempts):
+        if i > 0 and kind == "accel":
+            time.sleep(backoff)
+            backoff *= 3
+        env = dict(os.environ)
+        env["BENCH_INNER"] = "1"
+        if kind == "cpu":
+            env["BENCH_FORCE_CPU"] = "1"
+        print(f"bench[outer]: attempt {i + 1}/{len(attempts)} ({kind}, "
+              f"timeout {timeout_s}s)", file=sys.stderr)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, timeout=timeout_s, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"{kind} attempt timed out after {timeout_s}s"
+            print(f"bench[outer]: {last_err}", file=sys.stderr)
+            continue
+        sys.stderr.write(r.stderr[-4000:])
+        line = next(
+            (ln for ln in reversed(r.stdout.splitlines()) if ln.strip()), ""
+        )
+        if r.returncode == 0 and line:
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                last_err = f"{kind} attempt printed non-JSON: {line[:200]}"
+                continue
+            if "value" in parsed:
+                if kind == "cpu" and not force_cpu:
+                    parsed["note"] = (
+                        "accelerator attempts failed; CPU fallback — " + last_err
+                    )
+                _emit(parsed)
+                return 0
+        last_err = (
+            f"{kind} attempt rc={r.returncode}: "
+            + (r.stderr.strip().splitlines()[-1][-300:] if r.stderr.strip() else "no stderr")
+        )
+        print(f"bench[outer]: {last_err}", file=sys.stderr)
+
+    _emit({
+        "metric": "aggregate greedy decode throughput",
+        "value": 0.0,
+        "unit": "output tok/s",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "error": last_err,
+    })
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Inner measurement
+# --------------------------------------------------------------------------
+
+def _peak_for(device_kind: str, quant: str):
+    dk = device_kind.lower()
+    for key, (bf16_tf, int8_tf, bw) in PEAKS.items():
+        if key in dk:
+            return (int8_tf if quant == "int8" else bf16_tf) * 1e12, bw * 1e9
+    return None, None
+
+
+def _param_bytes(params) -> int:
+    import jax
+
+    return sum(x.nbytes for x in jax.tree.leaves(params))
+
+
+def inner() -> int:
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         import jax
 
@@ -40,15 +162,24 @@ def main() -> None:
     from llm_based_apache_spark_optimization_tpu.models import REGISTRY, init_params
 
     cfg_name = os.environ.get("BENCH_CONFIG", "bench-1b")
+    if cfg_name not in REGISTRY:
+        print(f"bench: unknown BENCH_CONFIG={cfg_name!r}; "
+              f"choices: {sorted(REGISTRY)}", file=sys.stderr)
+        return 2
+    cfg = REGISTRY[cfg_name]
+
     batch = int(os.environ.get("BENCH_BATCH", "8"))
-    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
-    max_new = int(os.environ.get("BENCH_NEW", "64"))
+    # Clamp the workload shape to the model's context: prompt to half the
+    # context (the engine's own bucket cap), completion to the room left.
+    # Round-1 bug: BENCH_CONFIG=tiny crashed because 128+64 > tiny's 128.
+    prompt_len = min(int(os.environ.get("BENCH_PROMPT", "128")), cfg.max_seq_len // 2)
+    max_new = min(int(os.environ.get("BENCH_NEW", "64")), cfg.max_seq_len - prompt_len)
+    detail = os.environ.get("BENCH_DETAIL") == "1"
     dtype = jnp.float32 if os.environ.get("BENCH_FORCE_CPU") == "1" else jnp.bfloat16
 
-    if cfg_name not in REGISTRY:
-        sys.exit(f"bench: unknown BENCH_CONFIG={cfg_name!r}; choices: {sorted(REGISTRY)}")
-    cfg = REGISTRY[cfg_name]
-    print(f"bench: {cfg_name} on {jax.devices()[0].platform}, "
+    dev = jax.devices()[0]
+    platform, device_kind = dev.platform, dev.device_kind
+    print(f"bench: {cfg_name} on {platform} ({device_kind}), "
           f"B={batch} prompt={prompt_len} new={max_new}", file=sys.stderr)
 
     params = init_params(cfg, jax.random.key(0), dtype=dtype)
@@ -72,24 +203,94 @@ def main() -> None:
     print(f"bench: warmup+compile {compile_s:.1f}s", file=sys.stderr)
 
     reps = int(os.environ.get("BENCH_REPS", "3"))
-    best = 0.0
+    best_tok_s, best_dt = 0.0, float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         out = eng.generate(prompts, max_new_tokens=max_new)
         dt = time.perf_counter() - t0
         toks = sum(len(o) for o in out)
-        best = max(best, toks / dt)
+        if toks / dt > best_tok_s:
+            best_tok_s, best_dt = toks / dt, dt
 
     result = {
         "metric": f"aggregate greedy decode throughput ({cfg_name}"
                   f"{'-int8' if quant == 'int8' else ''}, B={batch}, "
                   f"prompt={prompt_len}, new={max_new})",
-        "value": round(best, 1),
+        "value": round(best_tok_s, 1),
         "unit": "output tok/s",
-        "vs_baseline": round(best / REFERENCE_TOKS_PER_S, 2),
+        "vs_baseline": round(best_tok_s / REFERENCE_TOKS_PER_S, 2),
+        "platform": platform,
+        "device_kind": device_kind,
+        "compile_s": round(compile_s, 1),
     }
-    print(json.dumps(result))
+
+    if detail:
+        result.update(_detail(
+            cfg, eng, prompts, prompt_len, max_new, batch, best_dt,
+            params, quant, device_kind,
+        ))
+
+    _emit(result)
+    return 0
+
+
+def _detail(cfg, eng, prompts, prompt_len, max_new, batch, full_dt,
+            params, quant, device_kind) -> dict:
+    """Prefill/decode split + roofline placement.
+
+    Prefill time is approximated by a generate call with max_new_tokens=1
+    (prefill + first-token sample, zero decode-loop steps); decode time is
+    the remainder of the full run. FLOP model: 2·P per token for the dense
+    matmuls plus 4·S·L·heads·head_dim for attention score/value contractions.
+    Decode HBM traffic per step: the full weight set streamed once plus the
+    K/V cache read at the current context length.
+    """
+    eng.generate(prompts, max_new_tokens=1)  # compile the prefill-only variant
+    t_pre = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        eng.generate(prompts, max_new_tokens=1)
+        t_pre = min(t_pre, time.perf_counter() - t0)
+    decode_dt = max(full_dt - t_pre, 1e-9)
+    decode_steps = max_new - 1
+    decode_tok_s = batch * decode_steps / decode_dt
+
+    p = cfg.num_params
+    attn_flops_tok = 4 * cfg.num_layers * cfg.num_heads * cfg.head_dim
+    s_avg = prompt_len + max_new // 2
+    flops_per_tok = 2 * p + attn_flops_tok * s_avg
+    prefill_flops = batch * prompt_len * (2 * p + attn_flops_tok * prompt_len // 2)
+
+    from llm_based_apache_spark_optimization_tpu.engine.kvcache import cache_bytes
+
+    pbytes = _param_bytes(params)
+    itemsize = 2  # bf16 cache
+    kv_read = cache_bytes(cfg, batch, s_avg, itemsize)
+    bytes_per_step = pbytes + kv_read
+
+    peak_flops, peak_bw = _peak_for(device_kind, quant)
+    out = {
+        "prefill_s": round(t_pre, 4),
+        "decode_s": round(decode_dt, 4),
+        "decode_tok_s": round(decode_tok_s, 1),
+        "prefill_tok_s": round(batch * prompt_len / t_pre, 1),
+        "param_bytes": pbytes,
+        "quant": quant or "bf16",
+    }
+    decode_flop_s = batch * decode_steps * flops_per_tok / decode_dt
+    prefill_flop_s = prefill_flops / t_pre
+    decode_bw = bytes_per_step * decode_steps / decode_dt
+    out["decode_achieved_tflop_s"] = round(decode_flop_s / 1e12, 3)
+    out["prefill_achieved_tflop_s"] = round(prefill_flop_s / 1e12, 3)
+    out["decode_hbm_gb_s"] = round(decode_bw / 1e9, 1)
+    if peak_flops:
+        out["decode_mfu"] = round(decode_flop_s / peak_flops, 4)
+        out["prefill_mfu"] = round(prefill_flop_s / peak_flops, 4)
+        out["decode_hbm_util"] = round(decode_bw / peak_bw, 4)
+    return out
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_INNER") == "1":
+        sys.exit(inner())
+    sys.exit(outer())

@@ -114,7 +114,8 @@ def _make_generate_fn(
         def body(carry):
             out, cur, pos, done, cache, step = carry
             logits, cache = forward(
-                cfg, params, cur[:, None], pos[:, None], cache, attn_impl=impl
+                cfg, params, cur[:, None], pos[:, None], cache,
+                attn_impl=impl, mesh=mesh,
             )
             nxt = sample(logits[:, 0], sampling, jax.random.fold_in(key, step))
             nxt = jnp.where(done, pad_id, nxt)

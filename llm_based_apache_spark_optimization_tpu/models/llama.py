@@ -32,7 +32,7 @@ from jax import lax
 
 from ..ops.attention import attention_mask, gqa_attention
 from ..ops.norm import rms_norm
-from ..ops.pallas import flash_gqa_attention
+from ..ops.pallas import flash_gqa_attention, sharded_flash_gqa_attention
 from ..ops.quant import mm
 from ..ops.ring_attention import ring_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
@@ -151,9 +151,16 @@ def forward(
             v_full = _update_cache(v_cache, v, start)
             k_out, v_out = k_full, v_full
         if impl == "pallas":
-            attn = flash_gqa_attention(
-                q, k_full, v_full, positions, cfg.sliding_window
-            )
+            if mesh is not None:
+                # Per-device kernel over the tp-sharded KV heads / dp-sharded
+                # batch (shard_map); single-device pallas_call otherwise.
+                attn = sharded_flash_gqa_attention(
+                    mesh, q, k_full, v_full, positions, cfg.sliding_window
+                )
+            else:
+                attn = flash_gqa_attention(
+                    q, k_full, v_full, positions, cfg.sliding_window
+                )
         elif impl == "ring":
             # Context-parallel self-attention over the fresh K/V of this call's
             # tokens (ring over the mesh "sp" axis; sequence axis sharded).

@@ -10,5 +10,5 @@ reference stack (reference `Flask/app.py:102-107` delegates inference to
 Ollama/llama.cpp, whose C++/CUDA kernels are the analogous hot loop).
 """
 
-from .attention import flash_gqa_attention  # noqa: F401
+from .attention import flash_gqa_attention, sharded_flash_gqa_attention  # noqa: F401
 from .dispatch import attention_impl, set_attention_impl  # noqa: F401

@@ -196,3 +196,47 @@ def flash_gqa_attention(
 
     # [B, K, G*T, H] -> [B, T, N, H]
     return out.reshape(b, kh, g, t, h).transpose(0, 3, 1, 2, 4).reshape(b, t, n, h)
+
+
+def sharded_flash_gqa_attention(
+    mesh,
+    q: jnp.ndarray,            # [B, T, N, H] — N tp-sharded, B dp-sharded
+    k: jnp.ndarray,            # [B, K, S, H] — K tp-sharded (cache layout)
+    v: jnp.ndarray,            # [B, K, S, H]
+    q_positions: jnp.ndarray,  # [B, T] i32
+    sliding_window: Optional[int] = None,
+    *,
+    block_kv: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """The flash kernel under a dp×tp mesh, via `jax.shard_map`.
+
+    Attention is embarrassingly parallel over batch rows and KV heads, and the
+    TP layout (parallel/sharding.py) shards exactly those axes: each device
+    already holds its own heads' Q/K/V shard, so the per-device body is just
+    the single-device kernel on local shapes — no collective inside. Head
+    alignment holds because tp divides num_kv_heads (validate_tp) and GSPMD
+    chunks both the N and K head axes contiguously, so a device's G·K_local
+    query heads attend to its own K_local KV heads. The row-parallel `wo`
+    all-reduce that follows attention is GSPMD's, outside this wrapper,
+    unchanged. The "sp" mesh axis is unmentioned — replicated — because ring
+    attention owns sp>1 prefill and decode's T=1 has no sequence to shard.
+
+    check_vma=False: pallas_call carries no varying-manual-axes info, so the
+    replication checker can't see through it.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    q_spec = P("dp", None, "tp", None)
+    kv_spec = P("dp", "tp", None, None)
+    body = functools.partial(
+        flash_gqa_attention,
+        sliding_window=sliding_window, block_kv=block_kv, interpret=interpret,
+    )
+    return jax.shard_map(
+        lambda q_, k_, v_, p_: body(q_, k_, v_, p_),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, P("dp", None)),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k, v, q_positions)

@@ -3,10 +3,11 @@
 Modes:
 - "xla"    — always the einsum reference path (`ops.attention.gqa_attention`).
 - "pallas" — always the flash kernel (interpreted off-TPU).
-- "auto"   — (default) flash kernel on single-device TPU programs, einsum
-  otherwise. Under a TP mesh the einsum path stays default because GSPMD
-  partitions it across the "tp"-sharded KV-head axis for free, while a
-  pallas_call would need an explicit shard_map wrapper (planned follow-up).
+- "auto"   — (default) flash kernel on TPU, einsum otherwise. Under a mesh
+  the kernel runs per-device through the `shard_map` wrapper
+  (`ops.pallas.attention.sharded_flash_gqa_attention`) over the tp-sharded
+  KV-head axis and dp-sharded batch — the HBM-bound TP serving configs
+  (BASELINE 4/5) are exactly where the kernel matters most.
 
 Selected once per `forward` trace; override globally with
 `set_attention_impl(...)` or per-process with LBASO_ATTENTION_IMPL.
@@ -42,6 +43,4 @@ def attention_impl(mesh=None) -> str:
         raise ValueError(f"LBASO_ATTENTION_IMPL={mode!r} not in {_VALID}")
     if mode != "auto":
         return mode
-    if mesh is not None:
-        return "xla"
     return "pallas" if jax.devices()[0].platform == "tpu" else "xla"

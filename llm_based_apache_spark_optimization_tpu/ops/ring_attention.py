@@ -19,9 +19,13 @@ the dense `ops.attention.gqa_attention` reference to float tolerance
 Causality over the distributed sequence: each device is told which global
 KV chunk it holds at step i (`(my_index - i) mod sp`) and builds the mask
 from global positions, so the math is identical to the single-device causal
-mask. Fully-masked blocks (query chunk strictly left of the KV chunk) waste
-their FLOPs — acceptable for the first cut; a skip via `lax.cond` on
-`chunk_id > max_q_chunk` is a known follow-up that halves average work.
+mask. Fully-masked blocks (KV chunk strictly right of every query position,
+or — sliding window — strictly out of the window on the left) skip their
+score/accumulate math entirely via `lax.cond`: the predicate is a per-device
+scalar so the cond stays a real branch under shard_map, and for a from-zero
+causal prefill this halves average FLOPs (the upper-triangle saving). The
+`ppermute` rotation stays *outside* the cond — every device must join the
+collective on every ring step or the program deadlocks.
 """
 
 from __future__ import annotations
@@ -62,29 +66,50 @@ def _ring_attention_sharded(
     qp = q_positions.astype(jnp.int32)[:, :, None]  # [B, Tq, 1]
 
     perm = [(j, (j + 1) % sp) for j in range(sp)]
+    qp_max = jnp.max(qp)
+    qp_min = jnp.min(qp)
 
     def step(i, carry):
         o, m, l, k, v = carry
         # Global chunk id of the KV shard this device holds at ring step i:
         # shards rotate forward, so what started on device (my - i) is here now.
         chunk = (my - i) % sp
-        kv_idx = chunk * tk + jnp.arange(tk, dtype=jnp.int32)[None, None, :]
-        mask = kv_idx <= qp  # [B, Tq, Tk]
+
+        def compute(o, m, l):
+            kv_idx = chunk * tk + jnp.arange(tk, dtype=jnp.int32)[None, None, :]
+            mask = kv_idx <= qp  # [B, Tq, Tk]
+            if sliding_window is not None:
+                mask = mask & (qp - kv_idx < sliding_window)
+            s = _block_scores(q5, k, scale)  # [B, K, G, Tq, Tk]
+            mask5 = mask[:, None, None, :, :]
+            s = jnp.where(mask5, s, NEG_INF)
+            m_new = jnp.maximum(m, s.max(axis=-1))  # [B, K, G, Tq]
+            # exp(s - m_new) is garbage (=1) where s was masked AND the whole
+            # row is masked (m_new == NEG_INF, so s - m_new == 0); zero it
+            # explicitly.
+            p = jnp.exp(s - m_new[..., None]) * mask5  # f32 [B, K, G, Tq, Tk]
+            alpha = jnp.exp(m - m_new)  # [B, K, G, Tq]
+            l_new = l * alpha + p.sum(axis=-1)
+            pv = jnp.einsum("bkgts,bskh->btkgh", p.astype(v.dtype), v)
+            o_new = (
+                o * alpha[..., None].transpose(0, 3, 1, 2, 4)
+                + pv.astype(jnp.float32)
+            )
+            return o_new, m_new, l_new
+
+        # Causal block skip: a KV chunk whose first global slot exceeds every
+        # query position here contributes nothing; with a sliding window the
+        # chunk can also fall entirely off the left edge. The predicate is a
+        # per-device scalar (reduced over this shard's positions), so cond is
+        # a genuine branch — skipped chunks cost zero MXU work.
+        visible = chunk * tk <= qp_max
         if sliding_window is not None:
-            mask = mask & (qp - kv_idx < sliding_window)
-        s = _block_scores(q5, k, scale)  # [B, K, G, Tq, Tk]
-        mask5 = mask[:, None, None, :, :]
-        s = jnp.where(mask5, s, NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))  # [B, K, G, Tq]
-        # exp(s - m_new) is garbage (=1) where s was masked AND the whole row
-        # is masked (m_new == NEG_INF, so s - m_new == 0); zero it explicitly.
-        p = jnp.exp(s - m_new[..., None]) * mask5  # f32 [B, K, G, Tq, Tk]
-        alpha = jnp.exp(m - m_new)  # [B, K, G, Tq]
-        l = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum("bkgts,bskh->btkgh", p.astype(v.dtype), v)
-        o = o * alpha[..., None].transpose(0, 3, 1, 2, 4) + pv.astype(jnp.float32)
+            visible = visible & (qp_min - (chunk * tk + tk - 1) < sliding_window)
+        o, m, l = jax.lax.cond(
+            visible, compute, lambda o, m, l: (o, m, l), o, m, l
+        )
         k2, v2 = jax.lax.ppermute((k, v), axis_name, perm)
-        return o, m_new, l, k2, v2
+        return o, m, l, k2, v2
 
     o0 = jnp.zeros((b, tq, kh, g, h), jnp.float32)
     m0 = jnp.full((b, kh, g, tq), NEG_INF, jnp.float32)

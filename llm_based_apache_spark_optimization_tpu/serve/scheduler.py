@@ -157,7 +157,7 @@ class ContinuousBatchingScheduler:
     # ---------------------------------------------------------------- jitted
 
     def _build_prefill(self, t_bucket: int):
-        cfg, impl = self.cfg, self._impl
+        cfg, impl, mesh = self.cfg, self._impl, self.mesh
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def prefill(params, ck, cv, tokens, length, slot, start, temp, topp, key):
@@ -169,7 +169,7 @@ class ContinuousBatchingScheduler:
             positions = start + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
             logits, new = forward(
                 cfg, params, tokens, positions, {"k": row_k, "v": row_v},
-                logit_indices=length - 1, attn_impl=impl,
+                logit_indices=length - 1, attn_impl=impl, mesh=mesh,
             )
             ck = lax.dynamic_update_slice_in_dim(ck, new["k"], slot, axis=1)
             cv = lax.dynamic_update_slice_in_dim(cv, new["v"], slot, axis=1)
@@ -180,6 +180,7 @@ class ContinuousBatchingScheduler:
 
     def _build_decode(self):
         cfg, impl, chunk = self.cfg, self._impl, self.decode_chunk
+        mesh = self.mesh
         pad_id = cfg.pad_id
 
         @partial(jax.jit, donate_argnums=(1, 2))
@@ -188,7 +189,7 @@ class ContinuousBatchingScheduler:
                 ck, cv, cur, pos = carry
                 logits, cache = forward(
                     cfg, params, cur[:, None], pos[:, None],
-                    {"k": ck, "v": cv}, attn_impl=impl,
+                    {"k": ck, "v": cv}, attn_impl=impl, mesh=mesh,
                 )
                 nxt = sample_runtime(
                     logits[:, 0], temps, topps, jax.random.fold_in(key, i)

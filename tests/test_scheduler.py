@@ -161,3 +161,24 @@ def test_tp_sharded_scheduler(tiny_model_module):
     dp_mesh = make_mesh(dp=2, tp=1, devices=jax.devices()[:2])
     with pytest.raises(ValueError, match="dp=1"):
         ContinuousBatchingScheduler(cfg, params, mesh=dp_mesh)
+
+
+def test_tp_sharded_scheduler_pallas(tiny_model_module):
+    """TP mesh + flash kernel (the BASELINE 4/5 serving stack): the scheduler
+    must route its forward() calls through the shard_map pallas wrapper and
+    still match the unsharded einsum golden token-for-token."""
+    import jax
+
+    from llm_based_apache_spark_optimization_tpu.ops.pallas import set_attention_impl
+    from llm_based_apache_spark_optimization_tpu.parallel import make_mesh
+
+    cfg, params = tiny_model_module
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    golden = engine_golden(cfg, params, PROMPTS[:2], max_new=5)
+    try:
+        set_attention_impl("pallas")
+        with make_sched(cfg, params, mesh=mesh) as sched:
+            out = sched.generate(PROMPTS[:2], max_new_tokens=5)
+    finally:
+        set_attention_impl("auto")
+    assert out == golden
