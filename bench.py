@@ -68,8 +68,12 @@ def _emit(obj: dict) -> None:
 def outer() -> int:
     """Run the inner bench under a hard timeout; retry accel, fall back to CPU."""
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
-    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "600"))
-    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1800"))
+    # Budgets: a healthy TPU run is compile (~20-40s) + seconds of measuring;
+    # 420s/attempt absorbs a slow tunnel bring-up. Worst case (tunnel dead,
+    # 2 accel attempts + backoff + CPU fallback) stays under ~35 min so the
+    # driver's end-of-round bench never sees a hung process.
+    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "420"))
+    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1200"))
     tpu_retries = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
 
     attempts = []

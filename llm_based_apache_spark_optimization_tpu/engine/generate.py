@@ -56,7 +56,14 @@ def make_generate_fn(
 ):
     """Resolve the attention impl *outside* the cache boundary so a
     set_attention_impl() flip between calls maps to a different cache key
-    (and thus a fresh compilation) even for callers that omit attn_impl."""
+    (and thus a fresh compilation) even for callers that omit attn_impl.
+
+    `max_new` here is the compile-time CAP (output buffer width / cache
+    allocation); the returned fn takes a traced `budget` argument that bounds
+    the decode loop at runtime, so callers can serve any budget <= cap from
+    one compilation (serving backends bucket the cap — see
+    InferenceEngine.new_bucket — instead of compiling per distinct budget).
+    """
     return _make_generate_fn(
         cfg, max_new, sampling, stop_ids, mesh, attn_impl or attention_impl(mesh)
     )
@@ -71,11 +78,12 @@ def _make_generate_fn(
     mesh,
     attn_impl: str,
 ):
-    """Build + jit a generate function for a fixed decode budget and sampler.
+    """Build + jit a generate function for a fixed decode-budget cap and sampler.
 
-    Returned fn: (params, tokens [B,T] i32, lengths [B] i32, key) ->
-    (out_tokens [B, max_new] i32, gen_lens [B] i32). Cached so repeated calls
-    with the same signature reuse the compiled executable.
+    Returned fn: (params, tokens [B,T] i32, lengths [B] i32, budget [] i32,
+    key) -> (out_tokens [B, max_new] i32, gen_lens [B] i32), with the loop
+    stopping at the traced `budget` (<= max_new cap). Cached so repeated
+    calls with the same signature reuse the compiled executable.
 
     With a `jax.sharding.Mesh`, the KV cache allocated inside the program is
     pinned to the TP×DP layout (parallel/sharding.cache_spec); params/tokens
@@ -89,7 +97,13 @@ def _make_generate_fn(
     sp = dict(mesh.shape).get("sp", 1) if mesh is not None else 1
     prefill_impl = "ring" if sp > 1 else impl
 
-    def gen(params: Params, tokens: jnp.ndarray, lengths: jnp.ndarray, key: jax.Array):
+    def gen(
+        params: Params,
+        tokens: jnp.ndarray,
+        lengths: jnp.ndarray,
+        budget: jnp.ndarray,
+        key: jax.Array,
+    ):
         b, t = tokens.shape
         cache = init_cache(cfg, b, t + max_new, dtype=params["embed"].dtype)
         if mesh is not None:
@@ -109,7 +123,7 @@ def _make_generate_fn(
 
         def cond(carry):
             out, cur, pos, done, cache, step = carry
-            return (step < max_new) & ~jnp.all(done)
+            return (step < budget) & ~jnp.all(done)
 
         def body(carry):
             out, cur, pos, done, cache, step = carry
@@ -130,7 +144,7 @@ def _make_generate_fn(
         gen_lens = jnp.where(
             jnp.any(stops, axis=1),
             jnp.argmax(stops, axis=1).astype(jnp.int32) + 1,
-            jnp.int32(max_new),
+            budget.astype(jnp.int32),
         )
         return out, gen_lens
 
@@ -152,6 +166,7 @@ class InferenceEngine:
         stop_ids: Optional[Sequence[int]] = None,
         prompt_bucket: int = 128,
         mesh=None,
+        new_bucket: int = 64,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -162,6 +177,12 @@ class InferenceEngine:
         # A bucket as large as the whole context would leave no decode room
         # after bucketing even a short prompt; cap at half the context.
         self.prompt_bucket = min(prompt_bucket, max(1, cfg.max_seq_len // 2))
+        # Decode budgets are bucketed the same way prompts are: the compiled
+        # program's cap rounds up to a multiple of new_bucket and the loop
+        # stops at the traced budget, so serving backends that clamp
+        # max_new to per-prompt context room (serve/backends.py) don't
+        # compile one program per distinct budget value.
+        self.new_bucket = max(1, new_bucket)
 
     def padded_prompt_len(self, n: int) -> int:
         """Device-side prompt length for an n-token prompt: bucketed, then —
@@ -200,10 +221,15 @@ class InferenceEngine:
         lengths = jnp.asarray([len(p) for p in padded], jnp.int32)
         if self.mesh is not None:
             tokens, lengths = shard_batch((tokens, lengths), self.mesh)
+        cap = min(bucket_len(int(max_new_tokens), self.new_bucket),
+                  self.cfg.max_seq_len - t)
         fn = make_generate_fn(
-            self.cfg, int(max_new_tokens), sampling, self.stop_ids, self.mesh,
+            self.cfg, cap, sampling, self.stop_ids, self.mesh,
             attention_impl(self.mesh),
         )
-        out, gen_lens = fn(self.params, tokens, lengths, jax.random.key(seed))
+        out, gen_lens = fn(
+            self.params, tokens, lengths, jnp.int32(max_new_tokens),
+            jax.random.key(seed),
+        )
         out, gen_lens = jax.device_get(out), jax.device_get(gen_lens)
         return [list(map(int, out[i, : gen_lens[i]])) for i in range(b)]

@@ -73,25 +73,49 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _apply_top_k_runtime(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Top-k with a *traced* per-row k [B] i32 (0 disables that row).
+
+    Shape-static despite the runtime k: the cutoff is a dynamic gather
+    (`take_along_axis`) into the descending sort at index k-1 — the sort and
+    every mask keep the full [B, V] shape, so one compiled program serves any
+    per-slot k mix.
+    """
+    v = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    idx = jnp.clip(k - 1, 0, v - 1).astype(jnp.int32)[:, None]
+    kth = jnp.take_along_axis(sorted_desc, idx, axis=-1)  # [B, 1]
+    return jnp.where((k > 0)[:, None] & (logits < kth), NEG_INF, logits)
+
+
 def sample_runtime(
     logits: jnp.ndarray,       # [B, V] f32
     temperature: jnp.ndarray,  # [B] f32; <= 0 means greedy for that row
     top_p: jnp.ndarray,        # [B] f32; >= 1 disables nucleus for that row
-    key: jax.Array,
+    top_k: jnp.ndarray,        # [B] i32; 0 disables top-k for that row
+    keys: jax.Array,           # [B] typed PRNG keys — one independent stream/row
 ) -> jnp.ndarray:
     """Per-row runtime sampling for mixed batches (continuous batching).
 
-    Unlike `sample`, temperature/top_p are traced [B] arrays, so one compiled
-    decode program serves a batch mixing greedy NL→SQL requests with sampled
-    error-analysis requests (BASELINE.json config 5) — the per-slot knobs
-    change per step without recompilation. Runtime top-k is deliberately not
-    offered: a data-dependent k can't keep the sort/cutoff shape static.
-    Cost: every row pays the vocab sort even if all-greedy; the all-greedy
-    single-signature path (`sample`) skips it.
+    Unlike `sample`, temperature/top_p/top_k are traced [B] arrays, so one
+    compiled decode program serves a batch mixing greedy NL→SQL requests with
+    sampled error-analysis requests (BASELINE.json config 5) — the per-slot
+    knobs change per step without recompilation. Runtime top-k stays
+    shape-static via a dynamic gather into the vocab sort
+    (`_apply_top_k_runtime`).
+
+    `keys` carries one key per row: each request samples from its own seeded
+    stream, so a request's tokens are reproducible regardless of what other
+    traffic shares the batch (the scheduler derives
+    `fold_in(key(request_seed), tokens_sampled_so_far)` per slot).
+    Cost: every row pays the vocab sorts even if all-greedy; the all-greedy
+    single-signature path (`sample`) skips them.
     """
     logits = logits.astype(jnp.float32)
     greedy_tok = greedy(logits)
     t = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = _apply_top_p(logits / t, top_p[:, None])
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    scaled = _apply_top_p(_apply_top_k_runtime(logits / t, top_k), top_p[:, None])
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row)
+    )(keys, scaled).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)

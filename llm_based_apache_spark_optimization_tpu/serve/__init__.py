@@ -1,6 +1,10 @@
 """Generation service: model registry, prompt templates, backends."""
 
 from .backends import Completion, EngineBackend, FakeBackend  # noqa: F401
-from .scheduler import ContinuousBatchingScheduler, SchedulerBackend  # noqa: F401
+from .scheduler import (  # noqa: F401
+    ContinuousBatchingScheduler,
+    SchedulerBackend,
+    SchedulerPool,
+)
 from .service import GenerateResult, GenerationService  # noqa: F401
 from .templates import TEMPLATES  # noqa: F401

@@ -22,8 +22,13 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   sync to 1/chunk per token; the host inspects tokens between chunks to
   retire finished sequences and admit pending ones into freed slots.
 - Mixed sampling rides per-slot runtime arrays (ops/sampling.sample_runtime):
-  greedy SQL generation and temperature/top-p error analysis share one
+  greedy SQL generation and temperature/top-p/top-k error analysis share one
   compiled decode program.
+- Per-request RNG streams: slot s samples token i with
+  `fold_in(key(request_seed), i)` — each request owns an independent seeded
+  stream, so resubmitting (prompt, seed, sampling) reproduces the same
+  completion no matter what other traffic shares the batch (asserted in
+  tests/test_scheduler.py).
 - Free slots keep decoding garbage at a frozen position. That is safe by the
   cache-visibility invariant (engine/kvcache.py): admission prefill
   overwrites slots [0, T), and beyond T the new sequence's own decode writes
@@ -31,6 +36,12 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
 - Tensor parallelism: pass a mesh with dp=1 — request parallelism comes from
   slots (the batch axis stays unsharded because slots are dynamically
   indexed), TP shards heads/MLP exactly as in engine/generate.py.
+- Data parallelism (dp>1) is request-level BY DESIGN: the slot axis cannot
+  shard (dynamic per-slot cache indexing), so dp means independent scheduler
+  replicas — each with its own params copy and tp-submesh — behind one
+  `SchedulerPool` that round-robins admissions. That matches the workload:
+  serving throughput scales with independent replicas; there is no gradient
+  all-reduce to motivate a fused dp program (inference-only framework).
 
 Bounds: a request needs `bucket_len(prompt) + max_new + decode_chunk
 <= S_max` — the chunk term because the device can overshoot a budget or a
@@ -67,6 +78,8 @@ class _Request:
     max_new: int
     temperature: float
     top_p: float
+    top_k: int
+    seed: int
     future: Future
     # live state (set at admission)
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -137,7 +150,21 @@ class ContinuousBatchingScheduler:
         self._pos = np.full(num_slots, self._park, np.int32)  # absolute position
         self._temps = np.zeros(num_slots, np.float32)
         self._topps = np.ones(num_slots, np.float32)
+        self._topks = np.zeros(num_slots, np.int32)
+        # Per-request RNG: seed + tokens-sampled-so-far give slot s's key for
+        # its next token as fold_in(key(seed), count) — independent of what
+        # else is in the batch.
+        self._seeds = np.zeros(num_slots, np.uint32)
+        self._counts = np.zeros(num_slots, np.int32)
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
+        # Prompt-chunk buckets: powers of two up to prompt_bucket, so a short
+        # prompt pays a small forward instead of a full prompt_bucket one
+        # (one compiled prefill program per bucket, built lazily).
+        b, buckets = min(16, self.prompt_bucket), []
+        while b < self.prompt_bucket:
+            buckets.append(b)
+            b *= 2
+        self._buckets = buckets + [self.prompt_bucket]
 
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._prefill_q: "deque[Tuple[int, _Request]]" = deque()
@@ -149,8 +176,6 @@ class ContinuousBatchingScheduler:
         # (and is drained) or submit() observes _closed and raises.
         self._submit_lock = threading.Lock()
         self._closed = False
-        self._step = 0
-        self._key = jax.random.key(0)
         self._prefill_fns: Dict[int, object] = {}
         self._decode_fn = self._build_decode()
 
@@ -160,10 +185,12 @@ class ContinuousBatchingScheduler:
         cfg, impl, mesh = self.cfg, self._impl, self.mesh
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, ck, cv, tokens, length, slot, start, temp, topp, key):
+        def prefill(params, ck, cv, tokens, length, slot, start, temp, topp,
+                    topk, seed):
             """One prompt chunk: tokens occupy absolute positions
             [start, start+length); sample from the chunk's last real logit
-            (meaningful — and used — only on the final chunk)."""
+            (meaningful — and used — only on the final chunk, with the
+            request's own stream at fold index 0)."""
             row_k = lax.dynamic_slice_in_dim(ck, slot, 1, axis=1)
             row_v = lax.dynamic_slice_in_dim(cv, slot, 1, axis=1)
             positions = start + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
@@ -173,7 +200,8 @@ class ContinuousBatchingScheduler:
             )
             ck = lax.dynamic_update_slice_in_dim(ck, new["k"], slot, axis=1)
             cv = lax.dynamic_update_slice_in_dim(cv, new["v"], slot, axis=1)
-            tok = sample_runtime(logits[:, 0], temp, topp, key)
+            keys = jax.random.fold_in(jax.random.key(seed), 0)[None]
+            tok = sample_runtime(logits[:, 0], temp, topp, topk, keys)
             return ck, cv, tok
 
         return prefill
@@ -184,16 +212,21 @@ class ContinuousBatchingScheduler:
         pad_id = cfg.pad_id
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def decode(params, ck, cv, cur, pos, active, temps, topps, key):
+        def decode(params, ck, cv, cur, pos, active, temps, topps, topks,
+                   seeds, counts):
             def step(carry, i):
                 ck, cv, cur, pos = carry
                 logits, cache = forward(
                     cfg, params, cur[:, None], pos[:, None],
                     {"k": ck, "v": cv}, attn_impl=impl, mesh=mesh,
                 )
-                nxt = sample_runtime(
-                    logits[:, 0], temps, topps, jax.random.fold_in(key, i)
-                )
+                # Slot s's i-th token of this chunk is sample number
+                # counts[s]+i of its request's stream — reproducible across
+                # any batch composition.
+                keys = jax.vmap(
+                    lambda s, c: jax.random.fold_in(jax.random.key(s), c)
+                )(seeds, counts + i)
+                nxt = sample_runtime(logits[:, 0], temps, topps, topks, keys)
                 nxt = jnp.where(active, nxt, pad_id)
                 pos = jnp.where(active, pos + 1, pos)
                 return (cache["k"], cache["v"], nxt, pos), nxt
@@ -238,20 +271,15 @@ class ContinuousBatchingScheduler:
         ids: Sequence[int],
         max_new_tokens: int = 256,
         sampling: SamplingParams = SamplingParams(),
-        # Accepted for engine-API parity but IGNORED: under continuous
-        # batching, sampled tokens draw from the scheduler's shared key
-        # stream, whose state depends on how concurrent requests interleave —
-        # per-request stochastic reproducibility is not available here (use
-        # InferenceEngine directly when it matters; greedy is always exact).
-        seed: int = 0,  # noqa: ARG002
+        # Honored: the request samples from its own fold_in(key(seed), i)
+        # stream, so (ids, sampling, seed, max_new) reproduces the same
+        # tokens regardless of concurrent traffic. (Note the stream indexing
+        # differs from InferenceEngine's shared-batch keys, so scheduler and
+        # engine agree token-for-token on greedy but not on sampled runs.)
+        seed: int = 0,
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
-        if sampling.top_k:
-            raise ValueError(
-                "runtime top-k is not supported under continuous batching "
-                "(static-shape constraint); use top_p/temperature"
-            )
         need = bucket_len(len(ids), self.prompt_bucket) + max_new_tokens + self.decode_chunk
         if need > self.max_seq - 1:  # the last cache slot is the parking spot
             raise ValueError(
@@ -262,6 +290,7 @@ class ContinuousBatchingScheduler:
         req = _Request(
             ids=list(ids), max_new=max_new_tokens,
             temperature=sampling.temperature, top_p=sampling.top_p,
+            top_k=sampling.top_k, seed=seed,
             future=Future(),
         )
         with self._submit_lock:
@@ -293,10 +322,6 @@ class ContinuousBatchingScheduler:
 
     # ------------------------------------------------------------ event loop
 
-    def _next_key(self) -> jax.Array:
-        self._step += 1
-        return jax.random.fold_in(self._key, self._step)
-
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
@@ -312,11 +337,15 @@ class ContinuousBatchingScheduler:
     def _prefill_step(self) -> None:
         """Run ONE prompt chunk (Sarathi-style chunked prefill): long prompts
         interleave with decode rounds instead of stalling every active slot
-        for a whole-prompt forward (SURVEY.md §7 'without starving either')."""
+        for a whole-prompt forward (SURVEY.md §7 'without starving either').
+        The chunk size is the smallest power-of-two bucket covering what's
+        left of the prompt (self._buckets), so short prompts pay a small
+        forward instead of a full prompt_bucket one."""
         slot, req = self._prefill_q.popleft()
-        chunk_ids = req.ids[req.prefilled : req.prefilled + self.prompt_bucket]
+        remaining = len(req.ids) - req.prefilled
+        t = next((b for b in self._buckets if b >= remaining), self.prompt_bucket)
+        chunk_ids = req.ids[req.prefilled : req.prefilled + t]
         last = req.prefilled + len(chunk_ids) >= len(req.ids)
-        t = self.prompt_bucket
         if t not in self._prefill_fns:
             self._prefill_fns[t] = self._build_prefill(t)
         tokens = jnp.asarray(
@@ -327,7 +356,9 @@ class ContinuousBatchingScheduler:
             jnp.asarray([len(chunk_ids)], jnp.int32), jnp.int32(slot),
             jnp.int32(req.prefilled),
             jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_p], jnp.float32), self._next_key(),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.top_k], jnp.int32),
+            jnp.uint32(req.seed & 0xFFFFFFFF),
         )
         req.prefilled += len(chunk_ids)
         if not last:
@@ -348,14 +379,22 @@ class ContinuousBatchingScheduler:
         self._pos[slot] = len(req.ids)
         self._temps[slot] = req.temperature
         self._topps[slot] = req.top_p
+        self._topks[slot] = req.top_k
+        self._seeds[slot] = np.uint32(req.seed & 0xFFFFFFFF)
+        self._counts[slot] = 1  # the prefill sample consumed fold index 0
 
     def _decode_round(self) -> None:
         active = np.asarray([r is not None and r.ready for r in self._slot_req])
         self._ck, self._cv, cur, pos, toks = self._decode_fn(
             self.params, self._ck, self._cv,
             jnp.asarray(self._cur), jnp.asarray(self._pos), jnp.asarray(active),
-            jnp.asarray(self._temps), jnp.asarray(self._topps), self._next_key(),
+            jnp.asarray(self._temps), jnp.asarray(self._topps),
+            jnp.asarray(self._topks), jnp.asarray(self._seeds),
+            jnp.asarray(self._counts),
         )
+        # Every active slot consumed decode_chunk samples from its stream
+        # (host-tracked so the device fn stays stateless).
+        self._counts[active] += self.decode_chunk
         # np.array copies: device_get hands back read-only views of device
         # buffers, and _admit mutates these in place.
         self._cur, self._pos = np.array(jax.device_get(cur)), np.array(jax.device_get(pos))
@@ -428,6 +467,59 @@ class ContinuousBatchingScheduler:
                         self._admit(0, req)
                 except queue.Empty:
                     pass
+
+
+class SchedulerPool:
+    """dp>1 for continuous batching: k independent scheduler replicas behind
+    one `submit()`.
+
+    The slot axis can't shard over a mesh "dp" axis (slots are dynamically
+    indexed per request), so data parallelism is request-level: each replica
+    owns its own params placement — typically a disjoint tp-submesh of the
+    same slice — and the pool round-robins admissions across them. This is
+    the scale-out story SURVEY.md §2.4 calls "DP / request-level
+    parallelism", played by scheduler replicas instead of Ollama instances.
+    """
+
+    def __init__(self, schedulers: Sequence[ContinuousBatchingScheduler]):
+        if not schedulers:
+            raise ValueError("SchedulerPool needs at least one scheduler")
+        self.schedulers = list(schedulers)
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def start(self) -> "SchedulerPool":
+        for s in self.schedulers:
+            s.start()
+        return self
+
+    def shutdown(self) -> None:
+        for s in self.schedulers:
+            s.shutdown()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def submit(self, ids, max_new_tokens: int = 256,
+               sampling: SamplingParams = SamplingParams(), seed: int = 0):
+        with self._lock:
+            sched = self.schedulers[self._rr % len(self.schedulers)]
+            self._rr += 1
+        return sched.submit(
+            ids, max_new_tokens=max_new_tokens, sampling=sampling, seed=seed
+        )
+
+    def generate(self, prompts, max_new_tokens: int = 256,
+                 sampling: SamplingParams = SamplingParams(), seed: int = 0):
+        futs = [
+            self.submit(p, max_new_tokens=max_new_tokens, sampling=sampling,
+                        seed=seed)
+            for p in prompts
+        ]
+        return [f.result() for f in futs]
 
 
 class SchedulerBackend:

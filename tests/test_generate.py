@@ -84,6 +84,25 @@ def test_top_p_masks_tail():
     assert counts <= {0, 1}  # tail tokens masked out
 
 
+def test_budget_bucketing_one_compilation(tiny_model):
+    """Distinct max_new values inside one new_bucket share a compiled fn
+    (the serving anti-churn fix): the loop stops at the traced budget."""
+    from llm_based_apache_spark_optimization_tpu.engine.generate import (
+        _make_generate_fn,
+    )
+
+    cfg, params = tiny_model
+    eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                          new_bucket=16)
+    before = _make_generate_fn.cache_info().currsize
+    out5 = eng.generate([[1, 17, 93, 5]], max_new_tokens=5)[0]
+    out12 = eng.generate([[1, 17, 93, 5]], max_new_tokens=12)[0]
+    after = _make_generate_fn.cache_info().currsize
+    assert after - before == 1  # both budgets bucket to a cap of 16
+    assert len(out5) == 5 and len(out12) == 12
+    assert out12[:5] == out5  # greedy: shorter budget is a prefix
+
+
 def test_generate_fn_cache_reuse(tiny_model):
     cfg, params = tiny_model
     f1 = make_generate_fn(cfg, 8, SamplingParams(), (2,))
