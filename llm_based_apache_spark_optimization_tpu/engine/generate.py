@@ -105,6 +105,11 @@ def _make_generate_fn(
         key: jax.Array,
     ):
         b, t = tokens.shape
+        # The output buffer and cache are sized for the compile-time cap; a
+        # caller-passed budget beyond it would silently corrupt both, so
+        # clamp (InferenceEngine always passes budget <= cap, but this fn is
+        # exported for direct use).
+        budget = jnp.minimum(budget, max_new)
         cache = init_cache(cfg, b, t + max_new, dtype=params["embed"].dtype)
         if mesh is not None:
             cache = constrain_cache(cache, mesh)

@@ -73,21 +73,6 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def _apply_top_k_runtime(logits: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
-    """Top-k with a *traced* per-row k [B] i32 (0 disables that row).
-
-    Shape-static despite the runtime k: the cutoff is a dynamic gather
-    (`take_along_axis`) into the descending sort at index k-1 — the sort and
-    every mask keep the full [B, V] shape, so one compiled program serves any
-    per-slot k mix.
-    """
-    v = logits.shape[-1]
-    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
-    idx = jnp.clip(k - 1, 0, v - 1).astype(jnp.int32)[:, None]
-    kth = jnp.take_along_axis(sorted_desc, idx, axis=-1)  # [B, 1]
-    return jnp.where((k > 0)[:, None] & (logits < kth), NEG_INF, logits)
-
-
 def sample_runtime(
     logits: jnp.ndarray,       # [B, V] f32
     temperature: jnp.ndarray,  # [B] f32; <= 0 means greedy for that row
@@ -101,8 +86,7 @@ def sample_runtime(
     compiled decode program serves a batch mixing greedy NL→SQL requests with
     sampled error-analysis requests (BASELINE.json config 5) — the per-slot
     knobs change per step without recompilation. Runtime top-k stays
-    shape-static via a dynamic gather into the vocab sort
-    (`_apply_top_k_runtime`).
+    shape-static via a dynamic gather into the vocab sort.
 
     `keys` carries one key per row: each request samples from its own seeded
     stream, so a request's tokens are reproducible regardless of what other
@@ -114,8 +98,23 @@ def sample_runtime(
     logits = logits.astype(jnp.float32)
     greedy_tok = greedy(logits)
     t = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = _apply_top_p(_apply_top_k_runtime(logits / t, top_k), top_p[:, None])
+    scaled = logits / t
+    # ONE descending sort serves both cutoffs (this runs inside the decode
+    # scan — the sort is the step's dominant sampling cost). Top-k keeps
+    # ranks < k; top-p keeps the smallest prefix of the k-filtered,
+    # renormalized distribution with mass >= p. Both keep-sets are prefixes
+    # of the sort order, so their intersection's size indexes the cutoff.
+    v = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+    keep_k = (top_k[:, None] <= 0) | (ranks < top_k[:, None])
+    probs = jax.nn.softmax(jnp.where(keep_k, sorted_desc, NEG_INF), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = keep_k & ((cum - probs) < top_p[:, None])  # always keeps rank 0
+    kth = jnp.sum(keep, axis=-1)  # kept-prefix length per row
+    cutoff = jnp.take_along_axis(sorted_desc, (kth - 1)[:, None], axis=-1)
+    masked = jnp.where(scaled < cutoff, NEG_INF, scaled)
     sampled = jax.vmap(
         lambda k, row: jax.random.categorical(k, row)
-    )(keys, scaled).astype(jnp.int32)
+    )(keys, masked).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)

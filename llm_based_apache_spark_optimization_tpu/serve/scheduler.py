@@ -505,12 +505,18 @@ class SchedulerPool:
 
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0):
-        with self._lock:
-            sched = self.schedulers[self._rr % len(self.schedulers)]
-            self._rr += 1
-        return sched.submit(
-            ids, max_new_tokens=max_new_tokens, sampling=sampling, seed=seed
-        )
+        # Skip replicas whose event loop has crashed: a dead scheduler must
+        # not keep failing its round-robin share while healthy ones idle.
+        for _ in range(len(self.schedulers)):
+            with self._lock:
+                sched = self.schedulers[self._rr % len(self.schedulers)]
+                self._rr += 1
+            if sched._crash is None:
+                return sched.submit(
+                    ids, max_new_tokens=max_new_tokens, sampling=sampling,
+                    seed=seed,
+                )
+        raise RuntimeError("all scheduler replicas have crashed")
 
     def generate(self, prompts, max_new_tokens: int = 256,
                  sampling: SamplingParams = SamplingParams(), seed: int = 0):

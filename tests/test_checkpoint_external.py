@@ -231,12 +231,10 @@ def test_unpermute_is_llamacpp_inverse():
     )
 
 
-def test_injected_transpose_bug_fails(hf_checkpoint, monkeypatch):
+def test_injected_transpose_bug_fails(hf_checkpoint):
     """Meta-test for the fixture's power: break one loader convention (skip
     the Q-matrix transpose) and the external parity must fail loudly."""
     d, _, ref = hf_checkpoint
-    import llm_based_apache_spark_optimization_tpu.checkpoint.hf as hf_mod
-
     cfg, params = load_hf_checkpoint(d, dtype=jnp.float32)
     broken = {**params, "blocks": dict(params["blocks"])}
     # Simulate the transpose bug: wq stored [out,in] instead of [in,out].

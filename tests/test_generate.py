@@ -126,3 +126,50 @@ def test_golden_decode_pinned_tokens(tiny_model):
     assert out == golden, (
         f"greedy decode drifted from pinned golden: {out} != {golden}"
     )
+
+
+def test_sample_runtime_fused_cutoffs():
+    """The single-sort top-k∩top-p cutoff restricts support exactly: k=2
+    draws stay in the top-2 set; p-only draws stay inside the nucleus."""
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        sample_runtime,
+    )
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(3, 32)), jnp.float32)
+    temps = jnp.asarray([1.0, 1.0, 0.0], jnp.float32)  # row 2: greedy
+    topps = jnp.asarray([1.0, 0.6, 1.0], jnp.float32)
+    topks = jnp.asarray([2, 0, 0], jnp.int32)
+
+    # Numpy reference supports.
+    l0 = np.asarray(logits[0])
+    top2 = set(np.argsort(l0)[-2:])
+    l1 = np.asarray(logits[1])
+    order = np.argsort(l1)[::-1]
+    probs = np.exp(l1[order] - l1.max())
+    probs /= probs.sum()
+    cum = np.cumsum(probs)
+    nucleus = set(order[: int(np.sum((cum - probs) < 0.6))])
+
+    draws = {0: set(), 1: set()}
+    for s in range(64):
+        keys = jax.vmap(jax.random.key)(jnp.asarray([s, s + 1, s + 2], jnp.uint32))
+        toks = sample_runtime(logits, temps, topps, topks, keys)
+        draws[0].add(int(toks[0]))
+        draws[1].add(int(toks[1]))
+        assert int(toks[2]) == int(jnp.argmax(logits[2]))  # greedy row
+    assert draws[0] <= top2 and len(draws[0]) == 2
+    assert draws[1] <= nucleus
+
+
+def test_generate_fn_budget_clamped_to_cap(tiny_model):
+    """Direct make_generate_fn misuse (budget > cap) degrades to cap, not
+    silent buffer/cache corruption."""
+    cfg, params = tiny_model
+    fn = make_generate_fn(cfg, 6, SamplingParams(), (-1,))
+    tokens = jnp.asarray([[1, 17, 93, 5]], jnp.int32)
+    out, lens = fn(params, tokens, jnp.asarray([4], jnp.int32),
+                   jnp.int32(50), jax.random.key(0))
+    assert out.shape == (1, 6) and int(lens[0]) == 6

@@ -250,3 +250,22 @@ def test_tp_sharded_scheduler_pallas(tiny_model_module):
     finally:
         set_attention_impl("auto")
     assert out == golden
+
+
+def test_scheduler_pool_skips_crashed_replica(tiny_model_module):
+    """A crashed replica must not keep eating its round-robin share."""
+    from llm_based_apache_spark_optimization_tpu.serve import SchedulerPool
+
+    cfg, params = tiny_model_module
+    golden = engine_golden(cfg, params, PROMPTS[:2], max_new=4)
+    pool = SchedulerPool([make_sched(cfg, params), make_sched(cfg, params)])
+    with pool:
+        dead = pool.schedulers[0]
+        dead._crash = RuntimeError("simulated device loss")  # as _run would
+        out = pool.generate(PROMPTS[:2], max_new_tokens=4)
+        assert out == golden  # both served by the healthy replica
+        pool.schedulers[1]._crash = RuntimeError("second loss")
+        with pytest.raises(RuntimeError, match="all scheduler replicas"):
+            pool.submit(PROMPTS[0])
+        for s in pool.schedulers:
+            s._crash = None  # let shutdown() join cleanly
