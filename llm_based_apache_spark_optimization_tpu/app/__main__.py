@@ -80,14 +80,22 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
         )
 
     svc = GenerationService()
-    svc.register("duckdb-nsql", build(args.sql_model_path))
+    sql_backend = build(args.sql_model_path)
+    svc.register("duckdb-nsql", sql_backend)
     # llama3-chat's rendered prompt starts with <|begin_of_text|>: the
     # tokenizer must not prepend a second BOS (serve/backends.py docstring).
-    svc.register(
-        "llama3.2",
-        build(args.error_model_path or args.sql_model_path, add_bos=False),
-        template="llama3-chat",
-    )
+    if args.error_model_path:
+        error_backend = build(args.error_model_path, add_bos=False)
+    else:
+        # Same weights for both roles: reuse the loaded engine/params rather
+        # than reading + placing the checkpoint twice (double host load time
+        # and double HBM for identical arrays) — only the template and
+        # add_bos differ.
+        error_backend = EngineBackend(
+            sql_backend.engine, sql_backend.tokenizer,
+            max_new_tokens=max_new_tokens, add_bos=False,
+        )
+    svc.register("llama3.2", error_backend, template="llama3-chat")
     return svc
 
 

@@ -18,6 +18,7 @@
 
 #include <cstdio>
 #include <cstring>
+#include <exception>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -25,6 +26,40 @@
 namespace {
 
 thread_local std::string g_err;
+
+// 64-bit-clean seek/tell: plain fseek takes a long, which truncates offsets
+// past 2 GiB on LLP64 platforms — real 7B GGUF blobs are larger than that.
+bool seek_abs(FILE *f, uint64_t off) {
+#if defined(_WIN32)
+  return _fseeki64(f, static_cast<long long>(off), SEEK_SET) == 0;
+#else
+  return fseeko(f, static_cast<off_t>(off), SEEK_SET) == 0;
+#endif
+}
+
+bool seek_rel(FILE *f, uint64_t delta) {
+#if defined(_WIN32)
+  return _fseeki64(f, static_cast<long long>(delta), SEEK_CUR) == 0;
+#else
+  return fseeko(f, static_cast<off_t>(delta), SEEK_CUR) == 0;
+#endif
+}
+
+int64_t tell64(FILE *f) {
+#if defined(_WIN32)
+  return _ftelli64(f);
+#else
+  return static_cast<int64_t>(ftello(f));
+#endif
+}
+
+bool seek_end(FILE *f) {
+#if defined(_WIN32)
+  return _fseeki64(f, 0, SEEK_END) == 0;
+#else
+  return fseeko(f, 0, SEEK_END) == 0;
+#endif
+}
 
 // GGUF metadata value type ids.
 enum : uint32_t {
@@ -63,7 +98,10 @@ template <typename T> bool read_pod(FILE *f, T *v) {
 bool read_str(FILE *f, std::string *s) {
   uint64_t len;
   if (!read_pod(f, &len)) return false;
-  if (len > (1ull << 32)) return false; // corrupt
+  // Keys/names/values in real models are tens of bytes; 1 MiB is a generous
+  // sanity cap that keeps a corrupt length from driving a multi-GiB resize
+  // (whose bad_alloc would otherwise unwind into the ctypes boundary).
+  if (len > (1ull << 20)) return false; // corrupt
   s->resize(len);
   return len == 0 || read_exact(f, &(*s)[0], len);
 }
@@ -115,7 +153,7 @@ bool skip_value(FILE *f, uint32_t type) {
     return true;
   }
   size_t sz = kv_scalar_size(type);
-  return sz && fseek(f, static_cast<long>(sz), SEEK_CUR) == 0;
+  return sz && seek_rel(f, sz);
 }
 
 inline float f16_to_f32(uint16_t h) {
@@ -145,15 +183,26 @@ inline float f16_to_f32(uint16_t h) {
   return out;
 }
 
+// 0 on overflow — a corrupt dims product must not wrap to a small "valid"
+// element count (the bypass would defeat the file-extent validation below).
 uint64_t tensor_nelems(const TensorInfo &t) {
   uint64_t n = 1;
-  for (uint32_t d = 0; d < t.ndim; ++d) n *= t.dims[d];
+  for (uint32_t d = 0; d < t.ndim; ++d) {
+    if (t.dims[d] != 0 && n > UINT64_MAX / t.dims[d]) return 0;
+    n *= t.dims[d];
+  }
   return n;
 }
 
 // Byte size of a tensor's data on disk.
 bool tensor_nbytes(const TensorInfo &t, uint64_t *out) {
   uint64_t n = tensor_nelems(t);
+  if (n == 0 && t.ndim > 0) {
+    bool any_zero = false;
+    for (uint32_t d = 0; d < t.ndim; ++d) any_zero |= t.dims[d] == 0;
+    if (!any_zero) return false; // nelems overflowed
+  }
+  if (n > UINT64_MAX / 4) return false; // n*4 below must not wrap
   switch (t.dtype) {
   case LSOT_GGUF_F32: *out = n * 4; return true;
   case LSOT_GGUF_F16: *out = n * 2; return true;
@@ -175,7 +224,10 @@ extern "C" {
 
 const char *lsot_gguf_last_error(void) { return g_err.c_str(); }
 
-void *lsot_gguf_open(const char *path) {
+// Parse body; may throw std::bad_alloc on corrupt sizes — the extern "C"
+// wrapper below converts that to the error-code path (an exception must
+// never unwind across the ctypes boundary: that is UB/process abort).
+static void *gguf_open_impl(const char *path) {
   auto g = new Gguf;
   g->f = fopen(path, "rb");
   if (!g->f) {
@@ -263,14 +315,50 @@ void *lsot_gguf_open(const char *path) {
   if (it != g->num_kv.end() && it->second >= 1) {
     align = static_cast<uint64_t>(it->second);
   }
-  long pos = ftell(g->f);
+  int64_t pos = tell64(g->f);
   if (pos < 0) {
     g_err = "ftell failed";
     delete g;
     return nullptr;
   }
   g->data_start = (static_cast<uint64_t>(pos) + align - 1) / align * align;
+
+  // Validate every tensor's extent against the real file size now, so a
+  // corrupt dims/offset can never drive a huge allocation or short read in
+  // the data path.
+  if (!seek_end(g->f)) {
+    g_err = "seek-to-end failed";
+    delete g;
+    return nullptr;
+  }
+  uint64_t fsize = static_cast<uint64_t>(tell64(g->f));
+  for (const TensorInfo &t : g->tensors) {
+    uint64_t nbytes;
+    if (!tensor_nbytes(t, &nbytes)) {
+      g_err = "unsupported dtype or overflowing dims for tensor " + t.name +
+              " (dtype " + std::to_string(t.dtype) + ")";
+      delete g;
+      return nullptr;
+    }
+    // Term-by-term comparisons: a summed bound could wrap uint64 and pass.
+    if (g->data_start > fsize || t.offset > fsize - g->data_start ||
+        nbytes > fsize - g->data_start - t.offset) {
+      g_err = "tensor " + t.name + " extends past end of file (corrupt dims "
+              "or offset)";
+      delete g;
+      return nullptr;
+    }
+  }
   return g;
+}
+
+void *lsot_gguf_open(const char *path) {
+  try {
+    return gguf_open_impl(path);
+  } catch (const std::exception &e) {
+    g_err = std::string("gguf open failed: ") + e.what();
+    return nullptr;
+  }
 }
 
 void lsot_gguf_close(void *h) { delete static_cast<Gguf *>(h); }
@@ -310,7 +398,7 @@ uint64_t lsot_gguf_tensor_nelems(void *h, int32_t i) {
   return tensor_nelems(g->tensors[i]);
 }
 
-int32_t lsot_gguf_read_f32(void *h, int32_t i, float *out, uint64_t cap) {
+static int32_t gguf_read_f32_impl(void *h, int32_t i, float *out, uint64_t cap) {
   auto *g = static_cast<Gguf *>(h);
   if (i < 0 || i >= static_cast<int32_t>(g->tensors.size())) {
     g_err = "tensor index out of range";
@@ -328,7 +416,7 @@ int32_t lsot_gguf_read_f32(void *h, int32_t i, float *out, uint64_t cap) {
             " for tensor " + t.name;
     return 3;
   }
-  if (fseek(g->f, static_cast<long>(g->data_start + t.offset), SEEK_SET) != 0) {
+  if (!seek_abs(g->f, g->data_start + t.offset)) {
     g_err = "seek failed";
     return 4;
   }
@@ -371,6 +459,15 @@ int32_t lsot_gguf_read_f32(void *h, int32_t i, float *out, uint64_t cap) {
     return 3;
   }
   return 0;
+}
+
+int32_t lsot_gguf_read_f32(void *h, int32_t i, float *out, uint64_t cap) {
+  try {
+    return gguf_read_f32_impl(h, i, out, cap);
+  } catch (const std::exception &e) {
+    g_err = std::string("gguf read failed: ") + e.what();
+    return 6;
+  }
 }
 
 const char *lsot_gguf_meta_str(void *h, const char *key) {

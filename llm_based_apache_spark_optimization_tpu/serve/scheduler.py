@@ -507,15 +507,25 @@ class SchedulerPool:
                sampling: SamplingParams = SamplingParams(), seed: int = 0):
         # Skip replicas whose event loop has crashed: a dead scheduler must
         # not keep failing its round-robin share while healthy ones idle.
+        # The try/except covers the race where a replica dies between the
+        # _crash check and its submit() — fail over, don't fail the request.
         for _ in range(len(self.schedulers)):
             with self._lock:
                 sched = self.schedulers[self._rr % len(self.schedulers)]
                 self._rr += 1
-            if sched._crash is None:
+            if sched._crash is not None:
+                continue
+            try:
                 return sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
                     seed=seed,
                 )
+            except ValueError:
+                # Request-shape rejection (oversize prompt): identical on
+                # every replica — re-raise rather than spinning the ring.
+                raise
+            except RuntimeError:
+                continue  # crashed/closed under us; try the next replica
         raise RuntimeError("all scheduler replicas have crashed")
 
     def generate(self, prompts, max_new_tokens: int = 256,

@@ -134,12 +134,16 @@ class GenerationService:
         with self._lock:
             s = self.stats[model]
             s["requests"] += len(prompts)
+            # total_latency_s is DISTINCT wall-clock in both paths: the
+            # sequential path adds each request's own wall; here the batch
+            # wall counts once, not once per member.
             s["total_latency_s"] += latency
             s["total_tokens"] += sum(c.output_tokens for c in completions)
         for c in completions:
             self.metrics.record(RequestMetrics(
                 model=model, prompt_tokens=c.prompt_tokens,
                 output_tokens=c.output_tokens, latency_s=latency,
+                wall_share_s=latency / len(completions),
             ))
         return [
             GenerateResult(

@@ -34,6 +34,12 @@ def _infer_dtype(values: List[str]) -> str:
     for v in values:
         if v == "" or v is None:
             continue
+        if not v.isascii() or "_" in v:
+            # CPython's int()/float() accept '_' separators and non-ASCII
+            # digits; Spark's inferSchema does not, and neither does the
+            # native fast path (strtoll/strtod in native/src/csvscan.cpp).
+            # Classify them as strings so all three agree.
+            return "string"
         try:
             i = int(v)
             saw_int = True

@@ -65,7 +65,18 @@ class RequestMetrics:
     prompt_tokens: int
     output_tokens: int
     latency_s: float
+    # This request's share of *distinct* wall-clock. For a request served in
+    # a batch of B, latency_s is the batch wall (what the caller truly
+    # waited) while wall_share_s is wall/B — aggregate tok/s must divide by
+    # distinct time, not by the same wall counted B times (mirrors
+    # evalh.ModelReport.wall_clock_s). 0.0 means "same as latency_s"
+    # (sequential request).
+    wall_share_s: float = 0.0
     stages: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def distinct_wall_s(self) -> float:
+        return self.wall_share_s or self.latency_s
 
     @property
     def decode_tok_s(self) -> float:
@@ -115,7 +126,7 @@ class MetricsRegistry:
                 del recent[: len(recent) - self._window]
             self._count[m.model] = self._count.get(m.model, 0) + 1
             self._tokens[m.model] = self._tokens.get(m.model, 0) + m.output_tokens
-            self._time[m.model] = self._time.get(m.model, 0.0) + m.latency_s
+            self._time[m.model] = self._time.get(m.model, 0.0) + m.distinct_wall_s
         log.info("request %s", json.dumps(m.to_dict()))
 
     def snapshot(self) -> Dict[str, Dict]:
@@ -124,7 +135,9 @@ class MetricsRegistry:
             for model, recent in self._recent.items():
                 lats = sorted(r.latency_s for r in recent)
                 toks = sum(r.output_tokens for r in recent)
-                span = sum(r.latency_s for r in recent)
+                # Distinct wall-clock: batch members contribute wall/B each,
+                # so batched throughput isn't understated by ~batch_size.
+                span = sum(r.distinct_wall_s for r in recent)
                 out[model] = {
                     "requests": self._count[model],
                     "output_tokens": self._tokens[model],

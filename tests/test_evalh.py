@@ -144,3 +144,23 @@ def test_service_generate_batch_metrics():
     outs = svc.generate_batch("duckdb-nsql", ["q1", "q2", "q3"], system="s")
     assert len(outs) == 3
     assert svc.metrics.snapshot()["duckdb-nsql"]["requests"] == 3
+
+
+def test_report_renders_reference_shape():
+    """evalh.report renders the comparison-report tables (per-query,
+    aggregates, configs, conclusion) from a fake service."""
+    from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+        make_fake_service,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import generate
+
+    text = generate(
+        make_fake_service(), backend_desc="fake", with_configs=True,
+        quality_meaningful=False,
+    )
+    assert "## Four-query suite — per query" in text
+    assert "## Four-query suite — aggregates" in text
+    assert "## BASELINE configs" in text
+    assert "## Conclusion" in text
+    assert "5-concurrent-mixed-tp8" in text
+    assert "Smoke-model run" in text  # quality disclaimer present

@@ -217,7 +217,13 @@ def test_csv_scan_randomized_parity(tmp_path):
         lambda: f"{rng.uniform(-5, 5):.2e}",
         lambda: "2023-05-0%d" % rng.randint(1, 9),
         lambda: "2023-05-01 12:3%d" % rng.randint(0, 9),
-        lambda: rng.choice(["abc", "NaN", "inf", "", "  ", "1.2.3", "0x1f"]),
+        # CPython-only numeric spellings (underscore separators, non-ASCII
+        # digits) must classify as string on BOTH sides (ADVICE r1): Spark's
+        # inferSchema rejects them, the native strtoll/strtod path rejects
+        # them, and _infer_dtype now guards them explicitly.
+        lambda: rng.choice(["abc", "NaN", "inf", "", "  ", "1.2.3", "0x1f",
+                            "1_000", "1_0.5", "١٢٣",
+                            "٣.٥", "1 "]),
     ]
     for trial in range(5):
         n_cols = rng.randint(1, 6)
@@ -243,3 +249,39 @@ def test_csv_scan_used_by_backend(tmp_path):
     p.write_text("a,b\n1,x\n2,y\n")
     schema = SQLiteBackend().load_csv(str(p))
     assert schema.dtypes == ("int", "string")
+
+
+def test_gguf_corrupt_dims_rejected(tmp_path):
+    """A tensor whose dims/offset extend past EOF must fail cleanly at open
+    (error-code path), never via an allocation exception crossing ctypes
+    (ADVICE r1: bad_alloc through extern "C" is UB)."""
+    import struct
+
+    from llm_based_apache_spark_optimization_tpu.native import GGUFReader
+
+    # Minimal GGUF v3: 1 tensor claiming 2^30 f32 elems in a 100-byte file.
+    name = b"huge.weight"
+    blob = b"GGUF" + struct.pack("<IQQ", 3, 1, 0)
+    blob += struct.pack("<Q", len(name)) + name
+    blob += struct.pack("<I", 2)                    # ndim
+    blob += struct.pack("<QQ", 1 << 15, 1 << 15)    # dims
+    blob += struct.pack("<IQ", 0, 0)                # f32, offset 0
+    p = tmp_path / "corrupt.gguf"
+    p.write_bytes(blob + b"\x00" * 64)
+    with pytest.raises(Exception, match="past end of file|corrupt"):
+        GGUFReader(p).__enter__()
+
+
+def test_gguf_corrupt_string_len_rejected(tmp_path):
+    """A metadata key with a multi-GiB claimed length must hit the sanity
+    cap, not a giant resize."""
+    import struct
+
+    from llm_based_apache_spark_optimization_tpu.native import GGUFReader
+
+    blob = b"GGUF" + struct.pack("<IQQ", 3, 0, 1)
+    blob += struct.pack("<Q", 1 << 31)  # absurd key length
+    p = tmp_path / "badstr.gguf"
+    p.write_bytes(blob + b"x" * 32)
+    with pytest.raises(Exception):
+        GGUFReader(p).__enter__()
