@@ -19,6 +19,7 @@
 #include <cstdio>
 #include <cstring>
 #include <exception>
+#include <memory>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -228,11 +229,14 @@ const char *lsot_gguf_last_error(void) { return g_err.c_str(); }
 // wrapper below converts that to the error-code path (an exception must
 // never unwind across the ctypes boundary: that is UB/process abort).
 static void *gguf_open_impl(const char *path) {
-  auto g = new Gguf;
+  // unique_ptr: the ~14 error returns and any bad_alloc thrown mid-parse
+  // must all close the FILE* and free the struct (the extern "C" wrapper
+  // catches the exception but could not reach a raw `g`).
+  auto owned = std::make_unique<Gguf>();
+  Gguf *g = owned.get();
   g->f = fopen(path, "rb");
   if (!g->f) {
     g_err = std::string("cannot open ") + path;
-    delete g;
     return nullptr;
   }
   char magic[4];
@@ -240,18 +244,15 @@ static void *gguf_open_impl(const char *path) {
   uint64_t n_tensors, n_kv;
   if (!read_exact(g->f, magic, 4) || std::memcmp(magic, "GGUF", 4) != 0) {
     g_err = "bad magic (not a GGUF file)";
-    delete g;
     return nullptr;
   }
   if (!read_pod(g->f, &version) || (version != 2 && version != 3)) {
     g_err = "unsupported GGUF version";
-    delete g;
     return nullptr;
   }
   if (!read_pod(g->f, &n_tensors) || !read_pod(g->f, &n_kv) ||
       n_tensors > (1u << 20) || n_kv > (1u << 20)) {
     g_err = "corrupt header";
-    delete g;
     return nullptr;
   }
 
@@ -260,28 +261,24 @@ static void *gguf_open_impl(const char *path) {
     uint32_t type;
     if (!read_str(g->f, &key) || !read_pod(g->f, &type)) {
       g_err = "truncated metadata";
-      delete g;
       return nullptr;
     }
     if (type == KV_STRING) {
       std::string val;
       if (!read_str(g->f, &val)) {
         g_err = "truncated string value";
-        delete g;
         return nullptr;
       }
       g->str_kv[key] = std::move(val);
     } else if (type == KV_ARRAY) {
       if (!skip_value(g->f, type)) {
         g_err = "truncated array value";
-        delete g;
         return nullptr;
       }
     } else {
       double v;
       if (!read_num(g->f, type, &v)) {
         g_err = "bad scalar value for key " + key;
-        delete g;
         return nullptr;
       }
       g->num_kv[key] = v;
@@ -293,18 +290,15 @@ static void *gguf_open_impl(const char *path) {
     TensorInfo t;
     if (!read_str(g->f, &t.name) || !read_pod(g->f, &t.ndim) || t.ndim > 4) {
       g_err = "truncated tensor info";
-      delete g;
       return nullptr;
     }
     for (uint32_t d = 0; d < t.ndim; ++d)
       if (!read_pod(g->f, &t.dims[d])) {
         g_err = "truncated tensor dims";
-        delete g;
         return nullptr;
       }
     if (!read_pod(g->f, &t.dtype) || !read_pod(g->f, &t.offset)) {
       g_err = "truncated tensor dtype/offset";
-      delete g;
       return nullptr;
     }
     g->tensors.push_back(std::move(t));
@@ -318,7 +312,6 @@ static void *gguf_open_impl(const char *path) {
   int64_t pos = tell64(g->f);
   if (pos < 0) {
     g_err = "ftell failed";
-    delete g;
     return nullptr;
   }
   g->data_start = (static_cast<uint64_t>(pos) + align - 1) / align * align;
@@ -328,16 +321,19 @@ static void *gguf_open_impl(const char *path) {
   // the data path.
   if (!seek_end(g->f)) {
     g_err = "seek-to-end failed";
-    delete g;
     return nullptr;
   }
-  uint64_t fsize = static_cast<uint64_t>(tell64(g->f));
+  int64_t fsize_s = tell64(g->f);
+  if (fsize_s < 0) {
+    g_err = "ftell-at-end failed"; // unchecked, UINT64_MAX would vacuously
+    return nullptr;                // pass every extent check below
+  }
+  uint64_t fsize = static_cast<uint64_t>(fsize_s);
   for (const TensorInfo &t : g->tensors) {
     uint64_t nbytes;
     if (!tensor_nbytes(t, &nbytes)) {
       g_err = "unsupported dtype or overflowing dims for tensor " + t.name +
               " (dtype " + std::to_string(t.dtype) + ")";
-      delete g;
       return nullptr;
     }
     // Term-by-term comparisons: a summed bound could wrap uint64 and pass.
@@ -345,11 +341,10 @@ static void *gguf_open_impl(const char *path) {
         nbytes > fsize - g->data_start - t.offset) {
       g_err = "tensor " + t.name + " extends past end of file (corrupt dims "
               "or offset)";
-      delete g;
       return nullptr;
     }
   }
-  return g;
+  return owned.release();
 }
 
 void *lsot_gguf_open(const char *path) {

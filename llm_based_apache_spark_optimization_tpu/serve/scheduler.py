@@ -33,6 +33,19 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   cache-visibility invariant (engine/kvcache.py): admission prefill
   overwrites slots [0, T), and beyond T the new sequence's own decode writes
   position p before p ever becomes visible to attention.
+- **Prefix caching** (block-chained, vLLM-style at block granularity): the
+  NL→SQL workload repeats one system prefix — the table schema — across
+  every request for a table (reference `Flask/app.py:102-106` rebuilds the
+  same system prompt per query). K/V for completed prefix blocks of
+  `_pblock` tokens is kept in an LRU keyed by the *token content* of the
+  whole prefix up to that block (hash-chain semantics: a block is reusable
+  only when everything before it matched too). Admission copies matching
+  blocks into the slot's cache rows device-to-device and skips their
+  prefill entirely. Content keys mean no invalidation is ever needed, and
+  positions line up because a shared prefix occupies the same absolute
+  positions [0, n) in every request. Memory: one block for a 7B bf16 model
+  is ~17 MB (2·L·K·16·H·2B); `prefix_cache_blocks` caps the LRU (0
+  disables).
 - Tensor parallelism: pass a mesh with dp=1 — request parallelism comes from
   slots (the batch axis stays unsharded because slots are dynamically
   indexed), TP shards heads/MLP exactly as in engine/generate.py.
@@ -54,7 +67,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import Future
 from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -106,6 +119,7 @@ class ContinuousBatchingScheduler:
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
         mesh=None,
+        prefix_cache_blocks: int = 64,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -166,6 +180,18 @@ class ContinuousBatchingScheduler:
             b *= 2
         self._buckets = buckets + [self.prompt_bucket]
 
+        # Prefix cache: block size = the smallest bucket, so chunk boundaries
+        # always land on block boundaries. OrderedDict as LRU of
+        # content-keyed K/V blocks ([L, 1, K, pblock, H] device arrays).
+        self._pblock = self._buckets[0]
+        self._prefix_cache_blocks = max(0, prefix_cache_blocks)
+        self._prefix_cache: "OrderedDict[Tuple[int, ...], Tuple[jax.Array, jax.Array]]" = (
+            OrderedDict()
+        )
+        self._prefix_hits = 0
+        self._prefix_blocks_reused = 0
+        self._slice_block_fn, self._restore_block_fn = self._build_block_ops()
+
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._prefill_q: "deque[Tuple[int, _Request]]" = deque()
         self._thread: Optional[threading.Thread] = None
@@ -180,6 +206,31 @@ class ContinuousBatchingScheduler:
         self._decode_fn = self._build_decode()
 
     # ---------------------------------------------------------------- jitted
+
+    def _build_block_ops(self):
+        """Jitted device-to-device prefix-block copy ops.
+
+        slice:   cache [L, B, K, S, H] -> block [L, 1, K, pblock, H]
+        restore: write a block back into a slot row at a block-aligned start.
+        Both are pure data movement — no compute — so a cache hit costs HBM
+        copies instead of a transformer forward."""
+        L, K, H = self.cfg.num_layers, self.cfg.num_kv_heads, self.cfg.head_dim
+        pb = self._pblock
+
+        @jax.jit
+        def slice_block(ck, cv, slot, start):
+            sizes = (L, 1, K, pb, H)
+            bk = lax.dynamic_slice(ck, (0, slot, 0, start, 0), sizes)
+            bv = lax.dynamic_slice(cv, (0, slot, 0, start, 0), sizes)
+            return bk, bv
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def restore_block(ck, cv, bk, bv, slot, start):
+            ck = lax.dynamic_update_slice(ck, bk, (0, slot, 0, start, 0))
+            cv = lax.dynamic_update_slice(cv, bv, (0, slot, 0, start, 0))
+            return ck, cv
+
+        return slice_block, restore_block
 
     def _build_prefill(self, t_bucket: int):
         cfg, impl, mesh = self.cfg, self._impl, self.mesh
@@ -320,18 +371,52 @@ class ContinuousBatchingScheduler:
         ]
         return [f.result() for f in futs]
 
+    @property
+    def prefix_stats(self) -> Dict[str, int]:
+        """Prefix-cache observability: requests that reused any blocks, total
+        blocks reused (each one is a skipped pblock-token prefill), and the
+        current LRU size."""
+        return {
+            "hits": self._prefix_hits,
+            "blocks_reused": self._prefix_blocks_reused,
+            "cached_blocks": len(self._prefix_cache),
+        }
+
     # ------------------------------------------------------------ event loop
 
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
     def _admit(self, slot: int, req: _Request) -> None:
-        """Reserve `slot` and queue the prompt for chunked prefill."""
+        """Reserve `slot` and queue the prompt for chunked prefill, reusing
+        any cached prefix blocks first (device-to-device copy, no forward)."""
         self._slot_req[slot] = req
         # Park the slot's decode writes before its prompt starts streaming in
         # (it may still be frozen at the previous occupant's position).
         self._pos[slot] = self._park
         self._cur[slot] = self.cfg.pad_id
+        if self._prefix_cache_blocks:
+            pb = self._pblock
+            # At least one prompt token must go through real prefill: the
+            # final chunk's logit samples the first output token.
+            max_blocks = (len(req.ids) - 1) // pb
+            n = 0
+            while n < max_blocks:
+                if tuple(req.ids[: (n + 1) * pb]) not in self._prefix_cache:
+                    break
+                n += 1
+            for j in range(n):
+                key = tuple(req.ids[: (j + 1) * pb])
+                bk, bv = self._prefix_cache[key]
+                self._prefix_cache.move_to_end(key)  # LRU touch
+                self._ck, self._cv = self._restore_block_fn(
+                    self._ck, self._cv, bk, bv, jnp.int32(slot),
+                    jnp.int32(j * pb),
+                )
+            if n:
+                req.prefilled = n * pb
+                self._prefix_hits += 1
+                self._prefix_blocks_reused += n
         self._prefill_q.append((slot, req))
 
     def _prefill_step(self) -> None:
@@ -360,7 +445,24 @@ class ContinuousBatchingScheduler:
             jnp.asarray([req.top_k], jnp.int32),
             jnp.uint32(req.seed & 0xFFFFFFFF),
         )
+        chunk_start = req.prefilled
         req.prefilled += len(chunk_ids)
+        if self._prefix_cache_blocks:
+            # Publish the chunk's completed blocks (chunk_start is always
+            # block-aligned: reuse stops on block boundaries and every
+            # non-final chunk is a bucket = multiple of pblock).
+            pb = self._pblock
+            for b0 in range(chunk_start // pb, req.prefilled // pb):
+                key = tuple(req.ids[: (b0 + 1) * pb])
+                if key in self._prefix_cache:
+                    self._prefix_cache.move_to_end(key)
+                    continue
+                bk, bv = self._slice_block_fn(
+                    self._ck, self._cv, jnp.int32(slot), jnp.int32(b0 * pb)
+                )
+                self._prefix_cache[key] = (bk, bv)
+                while len(self._prefix_cache) > self._prefix_cache_blocks:
+                    self._prefix_cache.popitem(last=False)
         if not last:
             self._prefill_q.append((slot, req))
             return
@@ -525,7 +627,13 @@ class SchedulerPool:
                 # every replica — re-raise rather than spinning the ring.
                 raise
             except RuntimeError:
-                continue  # crashed/closed under us; try the next replica
+                # Failover only for genuine crashes that landed between the
+                # _crash check and submit(); lifecycle misuse ("not started",
+                # "has shut down" without a crash) is the caller's bug and
+                # its accurate error must propagate.
+                if sched._crash is None:
+                    raise
+                continue
         raise RuntimeError("all scheduler replicas have crashed")
 
     def generate(self, prompts, max_new_tokens: int = 256,
