@@ -43,8 +43,9 @@ from ..models.configs import LlamaConfig
 
 __all__ = ["config_from_gguf", "load_gguf_checkpoint", "write_gguf"]
 
-_F32, _F16, _Q4_0, _Q8_0 = 0, 1, 2, 8
-_QUANT_IDS = {"f32": _F32, "f16": _F16, "q4_0": _Q4_0, "q8_0": _Q8_0}
+_F32, _F16, _Q4_0, _Q8_0, _Q6_K = 0, 1, 2, 8, 14
+_QUANT_IDS = {"f32": _F32, "f16": _F16, "q4_0": _Q4_0, "q8_0": _Q8_0,
+              "q6_k": _Q6_K}
 
 
 # ---------------------------------------------------------------------------
@@ -78,10 +79,12 @@ def _permute_qk(w: np.ndarray, n_head: int) -> np.ndarray:
 def config_from_gguf(reader, name: Optional[str] = None) -> LlamaConfig:
     """Build a LlamaConfig from GGUF `llama.*` metadata keys.
 
-    Note: llama-3.x rope scaling travels as a `rope_freqs.weight` tensor in
-    GGUF, not as metadata — pass an explicit REGISTRY config for those
-    models (loaders accept cfg=...) or the scaling is silently absent.
+    llama-3.x rope scaling travels as a `rope_freqs.weight` tensor in GGUF
+    (per-dim inverse-frequency divisors baked by llama.cpp's converter), not
+    as metadata keys; when present it loads as `RopeFreqFactors` so scaled
+    models reproduce the original rope exactly with no explicit cfg.
     """
+    from ..ops.rope import RopeFreqFactors
     def num(key, default=None):
         v = reader.meta_num(key)
         if v is None:
@@ -95,6 +98,11 @@ def config_from_gguf(reader, name: Optional[str] = None) -> LlamaConfig:
     d = int(num(f"{arch}.embedding_length"))
     vocab, d_emb = reader.shape("token_embd.weight")
     assert d_emb == d, f"embedding_length {d} != token_embd dim {d_emb}"
+    scaling = None
+    if "rope_freqs.weight" in reader.tensor_names:
+        scaling = RopeFreqFactors(
+            tuple(float(x) for x in reader.tensor_f32("rope_freqs.weight"))
+        )
     return LlamaConfig(
         name=name or reader.meta_str("general.name") or "gguf-model",
         vocab_size=int(vocab),
@@ -106,6 +114,7 @@ def config_from_gguf(reader, name: Optional[str] = None) -> LlamaConfig:
         head_dim=int(num(f"{arch}.attention.key_length", d // heads)),
         max_seq_len=int(num(f"{arch}.context_length", 4096)),
         rope_theta=float(num(f"{arch}.rope.freq_base", 10000.0)),
+        rope_scaling=scaling,
         norm_eps=float(num(f"{arch}.attention.layer_norm_rms_epsilon", 1e-5)),
         tie_embeddings="output.weight" not in reader.tensor_names,
         sliding_window=(
@@ -225,6 +234,41 @@ def _quantize(a: np.ndarray, quant: str) -> bytes:
         for i in range(blocks.shape[0]):
             out += scale16[i].tobytes() + q[i].tobytes()
         return bytes(out)
+    if quant == "q6_k":
+        # K-quant 256-element super-block: ql[128] low nibbles, qh[64] high
+        # 2-bit planes, 16 int8 sub-block scales, f16 super scale. Element
+        # e = d * sc8[e//16] * (q6 - 32), q6 in [0, 63]. This is the format
+        # current Ollama llama3.2/mistral blobs ship; exporting it gives the
+        # C++ reader a bit-exact in-tree round-trip target.
+        assert n % 256 == 0, "q6_k needs multiple-of-256 elements"
+        out = bytearray()
+        for block in flat.reshape(-1, 256):
+            sub = block.reshape(16, 16)
+            s = np.abs(sub).max(axis=1) / 31.0
+            d16 = np.float16(s.max() / 127.0)
+            d = np.float32(d16)
+            if d == 0:
+                d16 = np.float16(1.0)
+                d = np.float32(1.0)
+            sc8 = np.clip(np.rint(s / d), -128, 127).astype(np.int8)
+            eff = d * sc8.astype(np.float32)
+            eff_safe = np.where(eff == 0, 1.0, eff)
+            q = np.clip(
+                np.rint(sub / eff_safe[:, None]) + 32, 0, 63
+            ).astype(np.uint8).reshape(256)
+            ql = np.empty(128, np.uint8)
+            qh = np.empty(64, np.uint8)
+            for half in range(2):
+                b0 = 128 * half
+                q1, q2 = q[b0 : b0 + 32], q[b0 + 32 : b0 + 64]
+                q3, q4 = q[b0 + 64 : b0 + 96], q[b0 + 96 : b0 + 128]
+                ql[64 * half : 64 * half + 32] = (q1 & 0x0F) | ((q3 & 0x0F) << 4)
+                ql[64 * half + 32 : 64 * half + 64] = (q2 & 0x0F) | ((q4 & 0x0F) << 4)
+                qh[32 * half : 32 * half + 32] = (
+                    (q1 >> 4) | ((q2 >> 4) << 2) | ((q3 >> 4) << 4) | ((q4 >> 4) << 6)
+                )
+            out += ql.tobytes() + qh.tobytes() + sc8.tobytes() + d16.tobytes()
+        return bytes(out)
     if quant == "q4_0":
         # llama.cpp q4_0: d = signed-max / -8, q = round(x/d) + 8 in [0, 15],
         # low nibbles hold elements 0..15, high nibbles 16..31.
@@ -288,6 +332,19 @@ def write_gguf(
         "token_embd.weight": (host(params["embed"]), quant),
         "output_norm.weight": (host(params["final_norm"]), "f32"),
     }
+    if cfg.rope_scaling is not None:
+        # llama.cpp convention: scaling ships as the per-dim divisor tensor
+        # (see config_from_gguf), so an in-tree llama3.2-style export loads
+        # back with correct rope in any GGUF consumer, including ourselves.
+        from ..ops.rope import freq_factors_for
+
+        tensors["rope_freqs.weight"] = (
+            np.asarray(
+                freq_factors_for(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling),
+                np.float32,
+            ),
+            "f32",
+        )
     if not cfg.tie_embeddings:
         tensors["output.weight"] = (host(params["lm_head"]), quant)
     b = params["blocks"]

@@ -60,7 +60,12 @@ def config_from_hf(hf: Dict[str, Any], name: str = "hf-model") -> LlamaConfig:
         )
     heads = hf["num_attention_heads"]
     eos = hf.get("eos_token_id", 2)
-    if isinstance(eos, list):  # llama-3.x ships a list of stop ids
+    extra_stops: tuple = ()
+    if isinstance(eos, list):
+        # llama-3.x ships a LIST of stop ids (e.g. [128001, 128008, 128009]);
+        # chat turns end at <|eot_id|>, so the whole list must reach the
+        # engine's stop set, not just the first entry.
+        extra_stops = tuple(int(e) for e in eos[1:])
         eos = eos[0]
     return LlamaConfig(
         name=name,
@@ -80,6 +85,7 @@ def config_from_hf(hf: Dict[str, Any], name: str = "hf-model") -> LlamaConfig:
         bos_id=hf.get("bos_token_id", 1),
         eos_id=eos,
         pad_id=hf.get("pad_token_id") or 0,
+        extra_stop_ids=extra_stops,
     )
 
 
@@ -255,13 +261,28 @@ def save_hf_checkpoint(
         "rms_norm_eps": cfg.norm_eps,
         "tie_word_embeddings": cfg.tie_embeddings,
         "bos_token_id": cfg.bos_id,
-        "eos_token_id": cfg.eos_id,
+        "eos_token_id": (
+            [cfg.eos_id, *cfg.extra_stop_ids] if cfg.extra_stop_ids
+            else cfg.eos_id
+        ),
         "pad_token_id": cfg.pad_id,
     }
     if cfg.sliding_window is not None:
         hf_cfg["sliding_window"] = cfg.sliding_window
         hf_cfg["architectures"] = ["MistralForCausalLM"]
-    if cfg.rope_scaling is not None:
+    if cfg.rope_scaling is not None and not isinstance(cfg.rope_scaling,
+                                                       RopeScaling):
+        # RopeFreqFactors (GGUF-loaded explicit divisors) has no HF
+        # config.json representation; dropping it silently would produce a
+        # checkpoint that reloads with unscaled rope and wrong long-context
+        # logits. Export such configs via write_gguf instead.
+        raise ValueError(
+            f"{cfg.name}: rope scaling of type "
+            f"{type(cfg.rope_scaling).__name__} cannot be represented in an "
+            "HF config.json — export this model with checkpoint.write_gguf "
+            "(which bakes it into rope_freqs.weight)"
+        )
+    if isinstance(cfg.rope_scaling, RopeScaling):
         s = cfg.rope_scaling
         hf_cfg["rope_scaling"] = {
             "rope_type": "llama3",

@@ -178,7 +178,7 @@ class InferenceEngine:
         if mesh is not None:
             params = shard_params(params, cfg, mesh)
         self.params = params
-        self.stop_ids = tuple(stop_ids) if stop_ids is not None else (cfg.eos_id,)
+        self.stop_ids = tuple(stop_ids) if stop_ids is not None else cfg.stop_ids
         # A bucket as large as the whole context would leave no decode room
         # after bucketing even a short prompt; cap at half the context.
         self.prompt_bucket = min(prompt_bucket, max(1, cfg.max_seq_len // 2))

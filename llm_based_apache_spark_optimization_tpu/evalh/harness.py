@@ -35,6 +35,10 @@ class ModelReport:
     # Set by the batched path: true wall-clock of all batches. Without it,
     # aggregate tok/s divides by summed per-case latencies (sequential path).
     wall_clock_s: float = 0.0
+    # What mesh the run ACTUALLY executed on (e.g. "tp=4" or
+    # "tp=1 (requested tp=4; 1 device)") — config rows must not print a
+    # tp they never built (VERDICT r2 weak #4). Set by configs.run_config.
+    mesh: str = ""
 
     @property
     def exact_match_rate(self) -> float:

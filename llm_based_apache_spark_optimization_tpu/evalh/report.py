@@ -103,16 +103,18 @@ def render_report(
         "",
     ]
 
-    # BASELINE configs (the five north-star scenarios).
+    # BASELINE configs (the five north-star scenarios). The Mesh column
+    # states what actually ran — never the tp a config merely requested.
     if config_rows:
         lines += ["## BASELINE configs", ""]
         lines += [
-            "| Config | Cases | Exact % | Avg edit | Avg latency | tok/s |",
-            "|---|---|---|---|---|---|",
+            "| Config | Mesh | Cases | Exact % | Avg edit | Avg latency | tok/s |",
+            "|---|---|---|---|---|---|---|",
         ]
         for r in config_rows:
             lines.append(
-                f"| {r['config']} — {r['description']} | {r['cases']} "
+                f"| {r['config']} — {r['description']} "
+                f"| {r.get('mesh') or 'tp=1'} | {r['cases']} "
                 f"| {_fmt(r['exact_match_rate'], 1)} "
                 f"| {_fmt(r['avg_edit_distance'], 1)} "
                 f"| {_fmt(r['avg_latency_s'], 3)} s "
@@ -146,6 +148,7 @@ def generate(
     with_configs: bool = True,
     quality_meaningful: bool = False,
     timestamp: Optional[str] = None,
+    service_factory=None,
 ) -> str:
     import jax
 
@@ -158,11 +161,13 @@ def generate(
     config_rows = []
     if with_configs:
         for key, cfg in CONFIGS.items():
-            rep = run_config(service, cfg, max_new_tokens=max_new_tokens)
+            rep = run_config(service, cfg, max_new_tokens=max_new_tokens,
+                             service_factory=service_factory)
             config_rows.append({
                 "config": key,
                 "description": cfg.description,
                 "cases": len(rep.cases),
+                "mesh": rep.mesh,
                 "exact_match_rate": rep.exact_match_rate,
                 "avg_edit_distance": rep.avg_edit_distance,
                 "avg_latency_s": rep.avg_latency_s,
@@ -178,6 +183,10 @@ def generate(
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="evalh.report")
     ap.add_argument("--backend", choices=("tiny", "fake"), default="tiny")
+    ap.add_argument("--scheduler", action="store_true",
+                    help="serve the tiny models through continuous-batching "
+                         "schedulers (config 5 then batches concurrent "
+                         "requests on device, as in production serving)")
     ap.add_argument("-o", "--out", default="-", help="output path (- = stdout)")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--cpu", action="store_true")
@@ -190,9 +199,16 @@ def main(argv=None) -> None:
 
     from ..app.__main__ import make_fake_service, make_tiny_service
 
+    factory = None
     if args.backend == "tiny":
-        service = make_tiny_service(args.max_new_tokens)
-        desc = "tiny in-tree engine, random weights (smoke)"
+        service = make_tiny_service(args.max_new_tokens,
+                                    scheduler=args.scheduler)
+        desc = ("tiny in-tree engine, random weights (smoke"
+                + (", scheduler backends)" if args.scheduler else ")"))
+
+        def factory(tp):
+            return make_tiny_service(args.max_new_tokens,
+                                     scheduler=args.scheduler, tp=tp)
     else:
         service = make_fake_service()
         desc = "fake canned backend (contract smoke)"
@@ -200,6 +216,7 @@ def main(argv=None) -> None:
         service, backend_desc=desc, max_new_tokens=args.max_new_tokens,
         quality_meaningful=False,
         timestamp=datetime.datetime.now().strftime("%Y-%m-%d %H:%M"),
+        service_factory=factory,
     )
     if args.out == "-":
         sys.stdout.write(text)

@@ -95,6 +95,9 @@ def forward(
     logit_indices: Optional[jnp.ndarray] = None,  # [B] int32 — unembed only these T-indices
     attn_impl: str = "xla",  # "xla" | "pallas" | "ring"; resolve via ops.pallas.attention_impl
     mesh=None,  # required for attn_impl="ring" (context-parallel prefill)
+    kv_lens: Optional[jnp.ndarray] = None,  # [B] i32 — live KV slots per row
+                                            # (pallas impl: bounds HBM
+                                            # streaming; 0 parks a row)
 ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
     """Run T tokens through the stack; returns (logits f32, cache').
 
@@ -155,11 +158,12 @@ def forward(
                 # Per-device kernel over the tp-sharded KV heads / dp-sharded
                 # batch (shard_map); single-device pallas_call otherwise.
                 attn = sharded_flash_gqa_attention(
-                    mesh, q, k_full, v_full, positions, cfg.sliding_window
+                    mesh, q, k_full, v_full, positions, cfg.sliding_window,
+                    kv_lens,
                 )
             else:
                 attn = flash_gqa_attention(
-                    q, k_full, v_full, positions, cfg.sliding_window
+                    q, k_full, v_full, positions, cfg.sliding_window, kv_lens
                 )
         elif impl == "ring":
             # Context-parallel self-attention over the fresh K/V of this call's

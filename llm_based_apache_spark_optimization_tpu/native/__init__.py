@@ -175,9 +175,13 @@ def csv_scan(path: str | os.PathLike, max_cols: int = 4096):
 
 
 class GGUFReader:
-    """Parsed GGUF file: tensor directory + metadata + f32 dequantization."""
+    """Parsed GGUF file: tensor directory + metadata + f32 dequantization.
+
+    Dequantizes F32/F16/Q8_0/Q4_0 and the K-quants (Q4_K/Q5_K/Q6_K) that
+    current Ollama/llama.cpp model blobs actually ship."""
 
     F32, F16, Q4_0, Q8_0 = 0, 1, 2, 8
+    Q4_K, Q5_K, Q6_K = 12, 13, 14
 
     def __init__(self, path: str | os.PathLike):
         lib = load_native()

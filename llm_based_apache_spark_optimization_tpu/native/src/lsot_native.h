@@ -6,9 +6,10 @@
  *
  *   bpe_*  — byte-level BPE encoder hot loop (heap-based, O(n log n));
  *            semantics identical to tokenizer/bpe.py's Python reference.
- *   gguf_* — GGUF v2/v3 model-file parser + dequantizer (F32/F16/Q8_0/Q4_0)
- *            so the engine can load the exact Ollama-style model blobs the
- *            reference's models ship as.
+ *   gguf_* — GGUF v2/v3 model-file parser + dequantizer (F32/F16/Q8_0/Q4_0
+ *            plus the K-quants Q4_K/Q5_K/Q6_K that current Ollama/llama.cpp
+ *            distributions actually ship) so the engine can load the exact
+ *            Ollama-style model blobs the reference's models come as.
  */
 #ifndef LSOT_NATIVE_H
 #define LSOT_NATIVE_H
@@ -37,6 +38,9 @@ int32_t lsot_bpe_encode(void *h, const uint8_t *bytes, int32_t n,
 #define LSOT_GGUF_F16 1
 #define LSOT_GGUF_Q4_0 2
 #define LSOT_GGUF_Q8_0 8
+#define LSOT_GGUF_Q4_K 12
+#define LSOT_GGUF_Q5_K 13
+#define LSOT_GGUF_Q6_K 14
 
 void *lsot_gguf_open(const char *path); /* NULL on error (see last_error) */
 void lsot_gguf_close(void *h);

@@ -13,7 +13,7 @@ Design notes (TPU-first):
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple, Union
 
 import jax.numpy as jnp
 
@@ -36,12 +36,42 @@ class RopeScaling:
     original_max_position_embeddings: int = 8192
 
 
-def _inv_freq(head_dim: int, theta: float, scaling: Optional[RopeScaling]) -> jnp.ndarray:
+@dataclasses.dataclass(frozen=True)
+class RopeFreqFactors:
+    """Explicit per-dimension frequency divisors (GGUF convention).
+
+    llama.cpp's HF->GGUF converter bakes llama3-style rescaling into a
+    `rope_freqs.weight` tensor of [head_dim/2] factors applied as
+    `inv_freq / factor` per dim (1.0 = unchanged, `factor` = slowed) —
+    no scaling metadata keys exist in GGUF. Loading that tensor as this
+    type reproduces the original model's rope exactly (and hashes, so
+    configs carrying it stay valid jit static args)."""
+
+    factors: Tuple[float, ...]
+
+
+RopeScalingLike = Union[RopeScaling, RopeFreqFactors]
+
+
+def freq_factors_for(
+    head_dim: int, theta: float, scaling: RopeScalingLike
+) -> jnp.ndarray:
+    """The per-dim divisor tensor [head_dim/2] equivalent to `scaling`
+    (what llama.cpp stores as `rope_freqs.weight`)."""
+    base = _inv_freq(head_dim, theta, None)
+    return base / _inv_freq(head_dim, theta, scaling)
+
+
+def _inv_freq(
+    head_dim: int, theta: float, scaling: Optional[RopeScalingLike]
+) -> jnp.ndarray:
     """Inverse frequencies [head_dim/2] in float32, with llama3 rescaling."""
     exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
     inv_freq = 1.0 / (theta ** exponents)
     if scaling is None:
         return inv_freq
+    if isinstance(scaling, RopeFreqFactors):
+        return inv_freq / jnp.asarray(scaling.factors, jnp.float32)
     # Llama-3 rescaling: wavelengths longer than original_ctx/low_freq_factor
     # are slowed by `factor`; shorter than original_ctx/high_freq_factor kept;
     # smooth ramp in between.
@@ -65,7 +95,7 @@ def rope_cos_sin(
     positions: jnp.ndarray,
     head_dim: int,
     theta: float,
-    scaling: Optional[RopeScaling] = None,
+    scaling: Optional[RopeScalingLike] = None,
 ):
     """cos/sin tables for integer `positions` [...]; returns ([..., h/2], [..., h/2])."""
     inv_freq = _inv_freq(head_dim, theta, scaling)

@@ -17,6 +17,18 @@ from ..ops.sampling import SamplingParams
 from ..tokenizer.base import Tokenizer
 
 
+def resolve_stop_ids(cfg, tokenizer) -> tuple:
+    """Union of the checkpoint config's stop list and every stop token the
+    tokenizer's vocabulary declares (HFTokenizer.eos_ids). Either source
+    alone under-stops llama-3.x chat models: the config may carry only
+    <|end_of_text|> while the turn actually ends at <|eot_id|>."""
+    ids = list(cfg.stop_ids)
+    for i in getattr(tokenizer, "eos_ids", ()):
+        if i not in ids:
+            ids.append(i)
+    return tuple(ids)
+
+
 @dataclasses.dataclass
 class Completion:
     text: str
@@ -99,7 +111,8 @@ class EngineBackend:
             )
         engine = InferenceEngine(
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
-            stop_ids=stop_ids,
+            stop_ids=stop_ids if stop_ids is not None
+            else resolve_stop_ids(cfg, tokenizer),
         )
         return cls(engine, tokenizer, **kwargs)
 
@@ -125,7 +138,8 @@ class EngineBackend:
         )
         engine = InferenceEngine(
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
-            stop_ids=stop_ids,
+            stop_ids=stop_ids if stop_ids is not None
+            else resolve_stop_ids(cfg, tokenizer),
         )
         return cls(engine, tokenizer, **kwargs)
 

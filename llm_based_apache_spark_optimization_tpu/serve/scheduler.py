@@ -137,7 +137,7 @@ class ContinuousBatchingScheduler:
         self.max_seq = min(max_seq or cfg.max_seq_len, cfg.max_seq_len)
         self.decode_chunk = decode_chunk
         self.prompt_bucket = min(prompt_bucket, max(1, self.max_seq // 2))
-        self.stop_ids = tuple(stop_ids) if stop_ids is not None else (cfg.eos_id,)
+        self.stop_ids = tuple(stop_ids) if stop_ids is not None else cfg.stop_ids
         self._impl = attention_impl(mesh)
 
         dtype = jax.tree.leaves(params)[0].dtype
@@ -270,6 +270,11 @@ class ContinuousBatchingScheduler:
                 logits, cache = forward(
                     cfg, params, cur[:, None], pos[:, None],
                     {"k": ck, "v": cv}, attn_impl=impl, mesh=mesh,
+                    # Parked slots (decoding garbage at the park position)
+                    # stream ZERO KV blocks; live slots stream only up to
+                    # their own position — without this every decode step
+                    # pays S_max bandwidth per slot (pallas impl only).
+                    kv_lens=jnp.where(active, pos + 1, 0),
                 )
                 # Slot s's i-th token of this chunk is sample number
                 # counts[s]+i of its request's stream — reproducible across
@@ -670,24 +675,136 @@ class SchedulerBackend:
         self.stop_texts = tuple(stop_texts)
         self.add_bos = add_bos
 
+    @classmethod
+    def from_hf_checkpoint(
+        cls,
+        ckpt_dir: str,
+        tokenizer,
+        mesh=None,
+        dtype=None,
+        num_slots: int = 8,
+        prompt_bucket: int = 128,
+        stop_ids: Optional[Sequence[int]] = None,
+        quantize_int8: bool = False,
+        max_seq: Optional[int] = None,
+        decode_chunk: int = 8,
+        **kwargs,
+    ) -> "SchedulerBackend":
+        """Deployment path for concurrent serving: HF checkpoint straight
+        into a continuous-batching scheduler (the product's `--scheduler`
+        flag, app/__main__.py). Mirrors `EngineBackend.from_hf_checkpoint`
+        incl. int8 weight-only quantization; the mesh (if any) must be
+        dp=1 — request parallelism comes from slots."""
+        import jax.numpy as jnp
+
+        from ..checkpoint import load_hf_checkpoint
+        from .backends import resolve_stop_ids
+
+        if quantize_int8:
+            from ..ops.quant import quantize_params
+
+            cfg, params = load_hf_checkpoint(
+                ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=None
+            )
+            params = quantize_params(params)
+            # Placement happens in the scheduler __init__ (shard_params).
+            sched_mesh = mesh
+        else:
+            cfg, params = load_hf_checkpoint(
+                ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=mesh
+            )
+            sched_mesh = mesh
+        sched = ContinuousBatchingScheduler(
+            cfg, params, num_slots=num_slots, max_seq=max_seq,
+            decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
+            stop_ids=stop_ids if stop_ids is not None
+            else resolve_stop_ids(cfg, tokenizer),
+            mesh=sched_mesh,
+        )
+        return cls(sched, tokenizer, **kwargs)
+
+    @classmethod
+    def from_gguf(
+        cls,
+        gguf_path: str,
+        tokenizer,
+        cfg=None,
+        mesh=None,
+        dtype=None,
+        num_slots: int = 8,
+        prompt_bucket: int = 128,
+        stop_ids: Optional[Sequence[int]] = None,
+        max_seq: Optional[int] = None,
+        decode_chunk: int = 8,
+        **kwargs,
+    ) -> "SchedulerBackend":
+        """GGUF blob -> continuous-batching scheduler (C++ parse + dequant,
+        native/src/gguf.cpp)."""
+        from ..checkpoint import load_gguf_checkpoint
+        from .backends import resolve_stop_ids
+
+        cfg, params = load_gguf_checkpoint(
+            gguf_path, cfg=cfg, dtype=dtype, mesh=mesh
+        )
+        sched = ContinuousBatchingScheduler(
+            cfg, params, num_slots=num_slots, max_seq=max_seq,
+            decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
+            stop_ids=stop_ids if stop_ids is not None
+            else resolve_stop_ids(cfg, tokenizer),
+            mesh=mesh,
+        )
+        return cls(sched, tokenizer, **kwargs)
+
+    def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
+        sched = self.scheduler
+        room = sched.max_seq - 1 - sched.decode_chunk - bucket_len(
+            n_prompt_tokens, sched.prompt_bucket
+        )
+        if room < 1:
+            raise ValueError(
+                f"prompt ({n_prompt_tokens} tokens) leaves no room in the "
+                f"{sched.max_seq}-token scheduler window of {sched.cfg.name}"
+            )
+        return min(max_new_tokens or self.max_new_tokens, room)
+
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0):
         from .backends import Completion, trim_stop_texts
 
-        sched = self.scheduler
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
-        room = sched.max_seq - sched.decode_chunk - bucket_len(
-            len(ids), sched.prompt_bucket
-        )
-        if room < 1:
-            raise ValueError(
-                f"prompt ({len(ids)} tokens) leaves no room in the "
-                f"{sched.max_seq}-token scheduler window of {sched.cfg.name}"
-            )
-        budget = min(max_new_tokens or self.max_new_tokens, room)
-        out = sched.submit(
-            ids, max_new_tokens=budget, sampling=sampling or self.sampling,
-            seed=seed,
+        out = self.scheduler.submit(
+            ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
+            sampling=sampling or self.sampling, seed=seed,
         ).result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
-        return Completion(text=text, output_tokens=len(out))
+        return Completion(text=text, output_tokens=len(out),
+                          prompt_tokens=len(ids))
+
+    def complete_batch(
+        self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None, seed: int = 0,
+    ):
+        """Submit the whole batch at once: the scheduler interleaves the
+        prompts through its slot pool, so this IS continuous batching —
+        unlike EngineBackend's single padded program, raggedness costs
+        nothing beyond bucketing."""
+        from .backends import Completion, trim_stop_texts
+
+        ids_list = [
+            self.tokenizer.encode(p, add_bos=self.add_bos) for p in prompts
+        ]
+        futs = [
+            self.scheduler.submit(
+                ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
+                sampling=sampling or self.sampling, seed=seed,
+            )
+            for ids in ids_list
+        ]
+        completions = []
+        for ids, fut in zip(ids_list, futs):
+            out = fut.result()
+            text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
+            completions.append(Completion(
+                text=text, output_tokens=len(out), prompt_tokens=len(ids)
+            ))
+        return completions

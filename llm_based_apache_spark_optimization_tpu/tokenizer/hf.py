@@ -39,6 +39,20 @@ class HFTokenizer:
         self.bos_id = 1 if bos_id is None else bos_id
         self.eos_id = 2 if eos_id is None else eos_id
         self.pad_id = pad_id
+        # The FULL stop set present in this vocabulary: llama-3.x chat turns
+        # end at <|eot_id|> (tool calls at <|eom_id|>) while plain completion
+        # ends at <|end_of_text|> — a chat model served with only one of
+        # these runs past the real stop. Backends union this with the
+        # checkpoint config's stop list (serve/backends.py).
+        self.eos_ids: tuple = tuple(
+            i for i in (
+                self.eos_id,
+                _id("</s>"), _id("<|end_of_text|>"),
+                _id("<|eot_id|>"), _id("<|eom_id|>"),
+            )
+            if i is not None
+        )
+        self.eos_ids = tuple(dict.fromkeys(self.eos_ids))  # dedupe, keep order
 
     @property
     def vocab_size(self) -> int:

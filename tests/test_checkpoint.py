@@ -151,3 +151,75 @@ def test_hf_load_onto_mesh_is_sharded_and_correct(tmp_path):
     out = InferenceEngine(cfg_m, params_mesh, stop_ids=(-1,), prompt_bucket=4,
                           mesh=mesh).generate([prompt], max_new_tokens=6)
     assert ref == out
+
+
+def test_config_from_hf_eos_list_keeps_full_stop_set():
+    """llama-3.x ships eos_token_id as a LIST; the whole list must survive
+    into the config's stop set (<|eot_id|> ends chat turns, VERDICT r2 #6)."""
+    hf = {
+        "vocab_size": 128256, "hidden_size": 2048, "intermediate_size": 8192,
+        "num_hidden_layers": 16, "num_attention_heads": 32,
+        "num_key_value_heads": 8, "head_dim": 64,
+        "max_position_embeddings": 131072, "rope_theta": 500000.0,
+        "rms_norm_eps": 1e-5, "tie_word_embeddings": True,
+        "bos_token_id": 128000,
+        "eos_token_id": [128001, 128008, 128009],
+    }
+    cfg = config_from_hf(hf, name="l32-chat")
+    assert cfg.eos_id == 128001
+    assert cfg.extra_stop_ids == (128008, 128009)
+    assert cfg.stop_ids == (128001, 128008, 128009)
+
+
+def test_eos_list_roundtrips_through_save(tmp_path):
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, extra_stop_ids=(7, 9))
+    params = init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+    save_hf_checkpoint(cfg, params, tmp_path / "chat")
+    hf_cfg = json.loads((tmp_path / "chat" / "config.json").read_text())
+    assert hf_cfg["eos_token_id"] == [cfg.eos_id, 7, 9]
+    cfg2, _ = load_hf_checkpoint(tmp_path / "chat", dtype=jnp.float32)
+    assert cfg2.stop_ids == cfg.stop_ids
+
+
+def test_from_hf_checkpoint_unions_tokenizer_stop_ids(tmp_path):
+    """EngineBackend.from_hf_checkpoint must thread BOTH the checkpoint's
+    eos list and the tokenizer's declared stop tokens into engine.stop_ids —
+    a llama3-chat completion then stops at <|eot_id|> even when config.json
+    carries only <|end_of_text|> (VERDICT r2 next #6)."""
+    import dataclasses
+
+    from llm_based_apache_spark_optimization_tpu.serve import EngineBackend
+
+    cfg = dataclasses.replace(TINY, extra_stop_ids=(9,))
+    params = init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+    save_hf_checkpoint(cfg, params, tmp_path / "chat2")
+
+    class TokWithStops:
+        eos_id = cfg.eos_id
+        eos_ids = (cfg.eos_id, 11)  # tokenizer knows an extra chat stop
+
+        def encode(self, text, add_bos=True):
+            return [1, 2, 3]
+
+        def decode(self, ids):
+            return ""
+
+    be = EngineBackend.from_hf_checkpoint(
+        str(tmp_path / "chat2"), TokWithStops(), dtype=jnp.float32
+    )
+    assert set(be.engine.stop_ids) == {cfg.eos_id, 9, 11}
+
+
+def test_scheduler_default_stops_include_config_extras(tiny_model):
+    import dataclasses
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny_model
+    chat_cfg = dataclasses.replace(cfg, extra_stop_ids=(7,))
+    sched = ContinuousBatchingScheduler(chat_cfg, params, num_slots=2)
+    assert sched.stop_ids == (chat_cfg.eos_id, 7)

@@ -246,3 +246,42 @@ def test_load_spider_real_format(tmp_path):
     assert len(load_spider(tmp_path / "dev.json", limit=1)) == 1
     ec = c0.as_eval_case()
     assert ec.nl == c0.nl and ec.expected_sql == c0.expected_sql
+
+
+def test_run_config_mesh_honesty():
+    """Config rows must state the mesh that actually ran: with a factory and
+    8 CPU virtual devices the tp=4 config builds a real tp=4 mesh; without a
+    factory the row is annotated, never claiming an unbuilt mesh."""
+    import jax
+
+    from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+        make_tiny_service,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.configs import (
+        CONFIGS,
+        run_config,
+    )
+
+    assert len(jax.devices()) >= 8  # conftest forces 8 virtual CPU devices
+    cfg4 = CONFIGS["4-spider-batch32-tp4"]
+
+    # Without a factory: honest annotation.
+    rep = run_config(_fake_service(), cfg4, max_new_tokens=8)
+    assert rep.mesh.startswith("tp=1 (requested tp=4")
+
+    # With a factory: the named mesh is built and the row says so.
+    built = {}
+
+    def factory(tp):
+        svc = make_tiny_service(8, tp=tp)
+        built["tp"] = tp
+        return svc
+
+    rep = run_config(_fake_service(), cfg4, max_new_tokens=8,
+                     service_factory=factory)
+    assert rep.mesh == "tp=4"
+    assert built["tp"] == 4
+
+    # tp=1 configs stay plain.
+    rep = run_config(_fake_service(), CONFIGS["1-cpu-greedy"], max_new_tokens=8)
+    assert rep.mesh == "tp=1"

@@ -78,6 +78,7 @@ def test_fallback_when_disabled(monkeypatch, trained):
     ("f16", 1e-3),
     ("q8_0", 2e-2),
     ("q4_0", 2e-1),
+    ("q6_k", 2e-2),
 ])
 def test_gguf_roundtrip(tiny_model, tmp_path, quant, tol):
     import jax
@@ -285,3 +286,245 @@ def test_gguf_corrupt_string_len_rejected(tmp_path):
     p.write_bytes(blob + b"x" * 32)
     with pytest.raises(Exception):
         GGUFReader(p).__enter__()
+
+
+def test_gguf_rope_scaling_roundtrip(tiny_model, tmp_path):
+    """TINY has llama3 rope scaling; write_gguf must bake it into a
+    rope_freqs.weight tensor and config_from_gguf must load it back as
+    explicit RopeFreqFactors — a llama3.2-style blob then gets correct
+    rope with NO explicit cfg (VERDICT r2 #8: the documented trap)."""
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        load_gguf_checkpoint,
+        write_gguf,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import forward
+    from llm_based_apache_spark_optimization_tpu.ops.rope import RopeFreqFactors
+
+    cfg, params = tiny_model
+    assert cfg.rope_scaling is not None
+    path = tmp_path / "scaled.gguf"
+    write_gguf(cfg, params, path, quant="f32")
+    cfg2, params2 = load_gguf_checkpoint(path, dtype=jnp.float32)  # no cfg!
+    assert isinstance(cfg2.rope_scaling, RopeFreqFactors)
+    assert len(cfg2.rope_scaling.factors) == cfg.head_dim // 2
+
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(3, cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    pos = jnp.broadcast_to(jnp.arange(12, dtype=jnp.int32)[None], (2, 12))
+    ref, _ = forward(cfg, params, tokens, pos, None)
+    got, _ = forward(cfg2, params2, tokens, pos, None)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_rope_freq_factors_equivalent_to_formula():
+    """RopeFreqFactors(freq_factors_for(scaling)) must reproduce the llama3
+    formula's cos/sin exactly — the GGUF divisor convention is a lossless
+    encoding of the scaling."""
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.ops.rope import (
+        RopeFreqFactors,
+        RopeScaling,
+        freq_factors_for,
+        rope_cos_sin,
+    )
+
+    scaling = RopeScaling(factor=8.0, original_max_position_embeddings=64)
+    factors = RopeFreqFactors(
+        tuple(float(x) for x in freq_factors_for(64, 500000.0, scaling))
+    )
+    pos = jnp.arange(100, dtype=jnp.int32)[None]
+    c1, s1 = rope_cos_sin(pos, 64, 500000.0, scaling)
+    c2, s2 = rope_cos_sin(pos, 64, 500000.0, factors)
+    np.testing.assert_allclose(np.asarray(c1), np.asarray(c2), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# K-quant dequantization parity: C++ reader vs independent numpy goldens.
+# The numpy implementations below follow the public ggml/GGUF K-quant block
+# layouts directly and share no code with native/src/gguf.cpp.
+
+
+def _np_scale_min_k4(j, s):
+    if j < 4:
+        return float(s[j] & 63), float(s[j + 4] & 63)
+    sc = (s[j + 4] & 0x0F) | ((s[j - 4] >> 6) << 4)
+    mn = (s[j + 4] >> 4) | ((s[j] >> 6) << 4)
+    return float(sc), float(mn)
+
+
+def _np_deq_q4k(raw, n):
+    out = np.empty(n, np.float32)
+    for i in range(n // 256):
+        b = raw[i * 144:(i + 1) * 144]
+        d = np.float32(np.frombuffer(b[0:2], np.float16)[0])
+        dmin = np.float32(np.frombuffer(b[2:4], np.float16)[0])
+        scales = np.frombuffer(b[4:16], np.uint8)
+        qs = np.frombuffer(b[16:144], np.uint8)
+        y = np.empty(256, np.float32)
+        for pair in range(4):
+            sc1, mn1 = _np_scale_min_k4(2 * pair, scales)
+            sc2, mn2 = _np_scale_min_k4(2 * pair + 1, scales)
+            d1, m1 = np.float32(d * sc1), np.float32(dmin * mn1)
+            d2, m2 = np.float32(d * sc2), np.float32(dmin * mn2)
+            q = qs[pair * 32:(pair + 1) * 32]
+            y[pair * 64:pair * 64 + 32] = d1 * (q & 0x0F).astype(np.float32) - m1
+            y[pair * 64 + 32:pair * 64 + 64] = d2 * (q >> 4).astype(np.float32) - m2
+        out[i * 256:(i + 1) * 256] = y
+    return out
+
+
+def _np_deq_q5k(raw, n):
+    out = np.empty(n, np.float32)
+    for i in range(n // 256):
+        b = raw[i * 176:(i + 1) * 176]
+        d = np.float32(np.frombuffer(b[0:2], np.float16)[0])
+        dmin = np.float32(np.frombuffer(b[2:4], np.float16)[0])
+        scales = np.frombuffer(b[4:16], np.uint8)
+        qh = np.frombuffer(b[16:48], np.uint8)
+        qs = np.frombuffer(b[48:176], np.uint8)
+        y = np.empty(256, np.float32)
+        u1, u2 = 1, 2
+        for pair in range(4):
+            sc1, mn1 = _np_scale_min_k4(2 * pair, scales)
+            sc2, mn2 = _np_scale_min_k4(2 * pair + 1, scales)
+            d1, m1 = np.float32(d * sc1), np.float32(dmin * mn1)
+            d2, m2 = np.float32(d * sc2), np.float32(dmin * mn2)
+            q = qs[pair * 32:(pair + 1) * 32]
+            hi1 = np.where(qh & u1, 16, 0).astype(np.float32)
+            hi2 = np.where(qh & u2, 16, 0).astype(np.float32)
+            y[pair * 64:pair * 64 + 32] = (
+                d1 * ((q & 0x0F).astype(np.float32) + hi1) - m1
+            )
+            y[pair * 64 + 32:pair * 64 + 64] = (
+                d2 * ((q >> 4).astype(np.float32) + hi2) - m2
+            )
+            u1 <<= 2
+            u2 <<= 2
+        out[i * 256:(i + 1) * 256] = y
+    return out
+
+
+def _np_deq_q6k(raw, n):
+    out = np.empty(n, np.float32)
+    for i in range(n // 256):
+        b = raw[i * 210:(i + 1) * 210]
+        ql = np.frombuffer(b[0:128], np.uint8)
+        qh = np.frombuffer(b[128:192], np.uint8)
+        sc = np.frombuffer(b[192:208], np.int8)
+        d = np.float32(np.frombuffer(b[208:210], np.float16)[0])
+        y = np.empty(256, np.float32)
+        for half in range(2):
+            qlh, qhh = ql[64 * half:64 * half + 64], qh[32 * half:32 * half + 32]
+            sch = sc[8 * half:8 * half + 8]
+            for l in range(32):
+                is_ = l // 16
+                q1 = int((qlh[l] & 0x0F) | ((qhh[l] & 3) << 4)) - 32
+                q2 = int((qlh[l + 32] & 0x0F) | (((qhh[l] >> 2) & 3) << 4)) - 32
+                q3 = int((qlh[l] >> 4) | (((qhh[l] >> 4) & 3) << 4)) - 32
+                q4 = int((qlh[l + 32] >> 4) | (((qhh[l] >> 6) & 3) << 4)) - 32
+                base = 128 * half
+                # Match the C++ association exactly: (d * sc) * q.
+                y[base + l] = (d * np.float32(sch[is_ + 0])) * np.float32(q1)
+                y[base + l + 32] = (d * np.float32(sch[is_ + 2])) * np.float32(q2)
+                y[base + l + 64] = (d * np.float32(sch[is_ + 4])) * np.float32(q3)
+                y[base + l + 96] = (d * np.float32(sch[is_ + 6])) * np.float32(q4)
+        out[i * 256:(i + 1) * 256] = y
+    return out
+
+
+def _write_single_tensor_gguf(path, name, shape, dtype_id, raw):
+    """Minimal GGUF v3 with one tensor of pre-quantized raw bytes, written
+    straight from the spec (no shared writer code)."""
+    import struct
+
+    nb = name.encode()
+    infos = struct.pack("<Q", len(nb)) + nb
+    dims = tuple(reversed(shape))
+    infos += struct.pack("<I", len(dims))
+    for dim in dims:
+        infos += struct.pack("<Q", dim)
+    infos += struct.pack("<IQ", dtype_id, 0)
+    meta = b"GGUF" + struct.pack("<IQQ", 3, 1, 0) + infos
+    with open(path, "wb") as f:
+        f.write(meta)
+        f.write(b"\x00" * (-len(meta) % 32))
+        f.write(raw)
+
+
+@pytest.mark.parametrize("kind", ["q4_k", "q5_k", "q6_k"])
+def test_gguf_kquant_block_parity(tmp_path, kind):
+    """C++ K-quant dequantization must agree bit-for-bit with the numpy
+    golden on random raw super-blocks — every scale-packing path (6-bit
+    scale/min pairs incl. the high-bit split, the fifth-bit plane, the
+    2-bit-plane + int8-scale layout) is exercised by randomized fields."""
+    rng = np.random.default_rng(42)
+    n = 8 * 256  # 8 super-blocks
+    blocks = []
+    for _ in range(n // 256):
+        if kind == "q4_k":
+            blocks.append(
+                rng.uniform(1e-3, 0.1, 2).astype(np.float16).tobytes()
+                + rng.integers(0, 256, 140, dtype=np.uint8).tobytes()
+            )
+        elif kind == "q5_k":
+            blocks.append(
+                rng.uniform(1e-3, 0.1, 2).astype(np.float16).tobytes()
+                + rng.integers(0, 256, 172, dtype=np.uint8).tobytes()
+            )
+        else:
+            blocks.append(
+                rng.integers(0, 256, 192, dtype=np.uint8).tobytes()
+                + rng.integers(-128, 128, 16, dtype=np.int8).tobytes()
+                + rng.uniform(1e-3, 0.1, 1).astype(np.float16).tobytes()
+            )
+    raw = b"".join(blocks)
+    dtype_id = {"q4_k": GGUFReader.Q4_K, "q5_k": GGUFReader.Q5_K,
+                "q6_k": GGUFReader.Q6_K}[kind]
+    golden = {"q4_k": _np_deq_q4k, "q5_k": _np_deq_q5k,
+              "q6_k": _np_deq_q6k}[kind](raw, n)
+
+    path = tmp_path / f"{kind}.gguf"
+    _write_single_tensor_gguf(path, "t.weight", (8, 256), dtype_id, raw)
+    with GGUFReader(path) as r:
+        assert r.dtype("t.weight") == dtype_id
+        got = r.tensor_f32("t.weight")
+    np.testing.assert_array_equal(got.reshape(-1), golden)
+
+
+def test_gguf_q6k_forward_parity(tiny_model, tmp_path):
+    """End-to-end: a Q6_K blob (the format real Ollama llama3.2/mistral
+    blobs ship) loads through the C++ reader and the model's forward stays
+    within quant tolerance of the original weights (VERDICT r2 next #4)."""
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        load_gguf_checkpoint,
+        write_gguf,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import forward
+
+    cfg, params = tiny_model
+    path = tmp_path / "model-q6k.gguf"
+    write_gguf(cfg, params, path, quant="q6_k")
+    with GGUFReader(path) as r:
+        assert r.dtype("blk.0.attn_q.weight") == GGUFReader.Q6_K
+    cfg2, params2 = load_gguf_checkpoint(path, dtype=jnp.float32)
+
+    tokens = jnp.asarray(
+        np.random.default_rng(9).integers(3, cfg.vocab_size, (2, 12)), jnp.int32
+    )
+    pos = jnp.broadcast_to(jnp.arange(12, dtype=jnp.int32)[None], (2, 12))
+    ref, _ = forward(cfg, params, tokens, pos, None)
+    got, _ = forward(cfg2, params2, tokens, pos, None)
+    ref, got = np.asarray(ref), np.asarray(got)
+    # Logit-level quant tolerance: well-correlated and close in magnitude.
+    assert np.abs(got - ref).max() < 0.35 * np.abs(ref).max()
+    corr = np.corrcoef(ref.reshape(-1), got.reshape(-1))[0, 1]
+    assert corr > 0.995

@@ -131,3 +131,92 @@ def test_generate_parity_pallas_vs_xla(tiny_model):
     finally:
         set_attention_impl("auto")
     assert ref == out
+
+
+def test_flash_truncated_streaming_identical(monkeypatch=None):
+    """The truncated-streaming invariant (VERDICT r2 next #3): with kv_lens
+    bounding each row, output must be IDENTICAL whether the cache tail
+    beyond kv_lens holds real data, huge garbage, or anything else — i.e.
+    the kernel provably depends on nothing past the live length (the blocks
+    it no longer streams)."""
+    b, t, s, n, kh, h = 3, 1, 64, 4, 2, 16
+    key = jax.random.key(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, n, h), jnp.float32)
+    k = jax.random.normal(kk, (b, kh, s, h), jnp.float32)
+    v = jax.random.normal(kv, (b, kh, s, h), jnp.float32)
+    # Mixed-age decode batch: positions 5, 37, 11 -> kv_lens 6, 38, 12.
+    positions = jnp.asarray([[5], [37], [11]], jnp.int32)
+    kv_lens = positions[:, 0] + 1
+
+    out_clean = flash_gqa_attention(
+        q, k, v, positions, kv_lens=kv_lens, block_kv=16, interpret=True
+    )
+    # Poison everything beyond each row's live length with huge garbage.
+    sl = jnp.arange(s)[None, None, :, None]
+    poison = jnp.where(sl >= kv_lens[:, None, None, None], 1e30, 0.0)
+    out_poisoned = flash_gqa_attention(
+        q, k + poison, v + poison, positions, kv_lens=kv_lens,
+        block_kv=16, interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out_clean), np.asarray(out_poisoned)
+    )
+    # And the bounded output equals the unbounded golden reference.
+    ref = gqa_attention(q, k, v, attention_mask(positions, s, None))
+    np.testing.assert_allclose(
+        np.asarray(out_clean), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_flash_kv_lens_zero_parks_row():
+    """kv_lens=0 (a parked continuous-batching slot) must yield zeros and
+    touch nothing — the slot pays neither bandwidth nor MXU work."""
+    b, t, s, n, kh, h = 2, 1, 32, 4, 2, 16
+    key = jax.random.key(11)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, n, h), jnp.float32)
+    k = jax.random.normal(kk, (b, kh, s, h), jnp.float32)
+    v = jax.random.normal(kv, (b, kh, s, h), jnp.float32)
+    positions = jnp.asarray([[9], [31]], jnp.int32)  # row 1 parked at S-1
+    kv_lens = jnp.asarray([10, 0], jnp.int32)
+
+    out = flash_gqa_attention(
+        q, k, v, positions, kv_lens=kv_lens, block_kv=8, interpret=True
+    )
+    # Row 0 matches the golden reference; row 1 is exactly zero.
+    ref = gqa_attention(q, k, v, attention_mask(positions, s, None))
+    np.testing.assert_allclose(
+        np.asarray(out)[0], np.asarray(ref)[0], rtol=2e-5, atol=2e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out)[1], np.zeros_like(np.asarray(out)[1])
+    )
+
+
+def test_scheduler_parity_with_pallas_kv_lens(tiny_model):
+    """End-to-end: the scheduler under attn impl 'pallas' (which now passes
+    active-masked kv_lens) must still match the engine goldens exactly."""
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny_model
+    prompts = [[1, 5, 9], [1, 7], [1, 3, 4, 8, 10, 2, 6], [1, 11]]
+    set_attention_impl("pallas")
+    try:
+        golden = [
+            InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+            .generate([p], max_new_tokens=5)[0]
+            for p in prompts
+        ]
+        sched = ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+            stop_ids=(-1,),
+        )
+        with sched:
+            out = sched.generate(prompts, max_new_tokens=5)
+        assert out == golden
+    finally:
+        set_attention_impl("auto")

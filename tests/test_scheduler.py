@@ -328,3 +328,53 @@ def test_prefix_cache_under_tp_mesh(tiny_model_module):
         out += sched.generate(prompts[1:], max_new_tokens=4)
     assert out == golden
     assert sched.prefix_stats["blocks_reused"] >= 3
+
+
+def test_scheduler_backend_complete_batch(tiny_model_module):
+    """complete_batch submits the whole batch through the slot pool and the
+    greedy results match per-request engine goldens."""
+    cfg, params = tiny_model_module
+    from llm_based_apache_spark_optimization_tpu.tokenizer.byte import ByteTokenizer
+
+    tok = ByteTokenizer(bos_id=cfg.bos_id, eos_id=cfg.eos_id, pad_id=cfg.pad_id)
+    sched = make_sched(cfg, params, num_slots=2)
+    backend = SchedulerBackend(sched, tok, max_new_tokens=4)
+    prompts = ["SELECT a", "SELECT bb", "SELECT ccc"]
+    try:
+        outs = backend.complete_batch(prompts)
+        assert len(outs) == 3
+        for p, c in zip(prompts, outs):
+            ids = tok.encode(p, add_bos=True)
+            golden = engine_golden(cfg, params, [ids], max_new=4)[0]
+            assert c.output_tokens == len(golden)
+            assert c.prompt_tokens == len(ids)
+    finally:
+        sched.shutdown()
+
+
+def test_scheduler_backend_from_hf_checkpoint(tiny_model_module, tmp_path):
+    """The deployment factory: HF dir -> scheduler backend, greedy parity
+    with the engine path on the same checkpoint."""
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        save_hf_checkpoint,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer.byte import ByteTokenizer
+
+    cfg, params = tiny_model_module
+    ckpt = tmp_path / "sched_ckpt"
+    save_hf_checkpoint(cfg, params, ckpt)
+    tok = ByteTokenizer(bos_id=cfg.bos_id, eos_id=cfg.eos_id, pad_id=cfg.pad_id)
+
+    backend = SchedulerBackend.from_hf_checkpoint(
+        str(ckpt), tok, dtype=jnp.float32, num_slots=2, decode_chunk=4,
+        prompt_bucket=8, stop_ids=(-1,), max_new_tokens=4,
+    )
+    try:
+        out = backend.complete("SELECT x")
+        ids = tok.encode("SELECT x", add_bos=True)
+        golden = engine_golden(cfg, params, [ids], max_new=4)[0]
+        assert out.output_tokens == len(golden)
+    finally:
+        backend.scheduler.shutdown()
