@@ -331,30 +331,35 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch) -> dict:
 
     slots = int(os.environ.get("BENCH_SCHED_SLOTS", str(batch)))
     n_req = 4 * slots
-    decode_chunk = 8
+    # Throughput-leaning chunk: each decode round costs one host<->device
+    # sync (expensive over a tunneled transport), amortized over
+    # chunk*slots tokens; 16 roughly halves the sync share vs the
+    # scheduler's latency-leaning default of 8.
+    decode_chunk = int(os.environ.get("BENCH_SCHED_CHUNK", "16"))
     # >= 2*prompt so the scheduler's internal prompt_bucket = min(bucket,
     # max_seq//2) clamp doesn't double-bucket the prompt and reject requests.
-    max_seq = min(max(2 * prompt_len, prompt_len + max_new + decode_chunk + 8),
+    max_seq = min(max(2 * prompt_len, prompt_len + max_new + 3 * decode_chunk),
                   cfg.max_seq_len)
-    # Mirror the scheduler's own admission arithmetic (submit()'s bound) so
-    # the budget we ask for is exactly what the window admits.
-    pb = min(prompt_len, max(1, max_seq // 2))
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=slots, max_seq=max_seq,
+        prompt_bucket=prompt_len, stop_ids=(-1,), decode_chunk=decode_chunk,
+    )
+    # Derive the admissible budget from the scheduler's OWN bound (its
+    # resolved prompt_bucket and harvest lag), not a hand-mirrored copy.
+    overshoot = (sched._harvest_lag + 1) * sched.decode_chunk
     max_new = min(
         max_new,
-        max_seq - 1 - decode_chunk - bucket_len(prompt_len, pb),
+        sched.max_seq - 1 - overshoot - bucket_len(prompt_len,
+                                                   sched.prompt_bucket),
     )
     if max_new < 1:
         return {"skipped": f"no decode room at prompt={prompt_len} in "
-                           f"max_seq={max_seq}"}
+                           f"max_seq={sched.max_seq}"}
     rng = np.random.default_rng(1)
     reqs = [
         [int(x) for x in rng.integers(3, cfg.vocab_size, size=prompt_len)]
         for _ in range(n_req)
     ]
-    sched = ContinuousBatchingScheduler(
-        cfg, params, num_slots=slots, max_seq=max_seq,
-        prompt_bucket=prompt_len, stop_ids=(-1,), decode_chunk=decode_chunk,
-    )
     with sched:
         # Warmup: compile prefill + decode programs on a couple of requests.
         sched.generate(reqs[:2], max_new_tokens=max_new)

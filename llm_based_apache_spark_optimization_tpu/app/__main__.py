@@ -57,9 +57,22 @@ def make_tiny_service(
         )
         mesh = make_mesh(dp=1, tp=tp, devices=jax.devices()[:tp])
     params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    # Mistral stand-in: the same tiny shape with sliding-window attention so
+    # the third reference model (Model_Evaluation_&_Comparision.py:69,83)
+    # has a real end-to-end leg — its window path runs in every report.
+    mistral_cfg = dataclasses.replace(
+        cfg, name=cfg.name + "-swa", sliding_window=32
+    )
+    mistral_params = init_params(mistral_cfg, jax.random.key(1),
+                                 dtype=jnp.float32)
     tok = ByteTokenizer()
     svc = GenerationService()
-    for name in ("duckdb-nsql", "llama3.2"):
+    models = (
+        ("duckdb-nsql", cfg, params, "completion"),
+        ("llama3.2", cfg, params, "completion"),
+        ("mistral", mistral_cfg, mistral_params, "mistral-instruct"),
+    )
+    for name, mcfg, mparams, template in models:
         if scheduler:
             from ..serve.scheduler import (
                 ContinuousBatchingScheduler,
@@ -67,16 +80,20 @@ def make_tiny_service(
             )
 
             sched = ContinuousBatchingScheduler(
-                cfg, params, num_slots=8, prompt_bucket=64, mesh=mesh,
+                mcfg, mparams, num_slots=8, prompt_bucket=64, mesh=mesh,
             )
             svc.register(
-                name, SchedulerBackend(sched, tok, max_new_tokens=max_new_tokens)
+                name,
+                SchedulerBackend(sched, tok, max_new_tokens=max_new_tokens),
+                template=template,
             )
         else:
-            eng = InferenceEngine(cfg, params, stop_ids=(cfg.eos_id,),
+            eng = InferenceEngine(mcfg, mparams, stop_ids=(mcfg.eos_id,),
                                   prompt_bucket=64, mesh=mesh)
             svc.register(
-                name, EngineBackend(eng, tok, max_new_tokens=max_new_tokens)
+                name,
+                EngineBackend(eng, tok, max_new_tokens=max_new_tokens),
+                template=template,
             )
     return svc
 
@@ -90,6 +107,12 @@ def make_fake_service() -> GenerationService:
     svc.register(
         "llama3.2",
         FakeBackend(lambda p: "Check that the referenced columns exist in the schema."),
+    )
+    svc.register(
+        "mistral",
+        FakeBackend(lambda p: "Sure! Here is the SQL you asked for: "
+                              "SELECT * FROM temp_view"),
+        template="mistral-instruct",
     )
     return svc
 
@@ -117,6 +140,9 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
 
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
+        if path.endswith(".gguf") and tok_dir is None:
+            sys.exit(f"{path}: GGUF blobs carry no tokenizer.json — pass "
+                     "PATH.gguf:TOKDIR")
         tok = HFTokenizer(tok_dir or path)
         if args.scheduler:
             common = dict(mesh=mesh, max_new_tokens=max_new_tokens,
@@ -136,31 +162,13 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             max_new_tokens=max_new_tokens, add_bos=add_bos,
         )
 
-    svc = GenerationService()
-    sql_backend = build(args.sql_model_path)
-    svc.register("duckdb-nsql", sql_backend)
-    # llama3-chat's rendered prompt starts with <|begin_of_text|>: the
-    # tokenizer must not prepend a second BOS (serve/backends.py docstring).
-    if args.error_model_path:
-        error_backend = build(args.error_model_path, add_bos=False)
-    elif args.scheduler:
-        # Same weights for both roles: share the scheduler (one slot pool,
-        # one cache) — only the template and add_bos differ.
-        error_backend = SchedulerBackend(
-            sql_backend.scheduler, sql_backend.tokenizer,
-            max_new_tokens=max_new_tokens, add_bos=False,
-        )
-    else:
-        # Same weights for both roles: reuse the loaded engine/params rather
-        # than reading + placing the checkpoint twice (double host load time
-        # and double HBM for identical arrays) — only the template and
-        # add_bos differ.
-        error_backend = EngineBackend(
-            sql_backend.engine, sql_backend.tokenizer,
-            max_new_tokens=max_new_tokens, add_bos=False,
-        )
-    svc.register("llama3.2", error_backend, template="llama3-chat")
-    return svc
+    from ..serve.factory import assemble_reference_service
+
+    return assemble_reference_service(
+        build, args.sql_model_path, args.error_model_path,
+        getattr(args, "mistral_model_path", None),
+        max_new_tokens=max_new_tokens,
+    )
 
 
 def main(argv=None) -> None:
@@ -172,6 +180,8 @@ def main(argv=None) -> None:
                     help="duckdb-nsql weights (HF dir or .gguf) for --backend checkpoint")
     ap.add_argument("--error-model-path", metavar="DIR_OR_GGUF[:TOKDIR]",
                     help="llama3.2 weights; defaults to --sql-model-path")
+    ap.add_argument("--mistral-model-path", metavar="DIR_OR_GGUF[:TOKDIR]",
+                    help="optional mistral weights (third comparison model)")
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
@@ -209,8 +219,10 @@ def main(argv=None) -> None:
         service = make_checkpoint_service(args, args.max_new_tokens)
     else:
         # max_new small for the tiny demo model: it babbles bytes, not SQL.
-        service = (make_tiny_service(32, scheduler=args.scheduler)
-                   if args.backend == "tiny" else make_fake_service())
+        service = (
+            make_tiny_service(32, scheduler=args.scheduler, tp=args.tp)
+            if args.backend == "tiny" else make_fake_service()
+        )
     history = SQLiteHistory(cfg.history_db)
     factory = create_api_app if args.api else create_web_app
     # Pass the backend factory, not an instance: each request gets an

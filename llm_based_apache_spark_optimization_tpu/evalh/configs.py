@@ -92,29 +92,45 @@ def run_config(
     cfg: BenchConfig,
     max_new_tokens: int = 64,
     service_factory: Optional[Callable[[int], GenerationService]] = None,
+    service_mesh: Optional[str] = None,
 ) -> ModelReport:
     """Execute one BASELINE config against the service's registered models.
 
     Mesh honesty (VERDICT r2 weak #4): a config naming tp=N either runs on
     the mesh it names — `service_factory(tp)` builds a tp-sharded service
     when enough jax devices exist (CPU virtual devices count) — or the
-    report row says exactly what ran instead ("tp=1 (requested tp=4; ...)").
-    The row never claims a mesh that wasn't built.
+    report row says exactly what ran instead. `service_mesh` describes the
+    mesh the passed-in service ALREADY runs on (e.g. the runbook's
+    "tp=4"), so a service-owned mesh is reported truthfully rather than
+    defaulting to tp=1. The row never claims a mesh that wasn't built.
+
+    Factory-built services are closed after the run (scheduler backends
+    own daemon threads and device slot caches — they must not leak once
+    per tp-config).
     """
-    mesh_desc = "tp=1"
+    mesh_desc = service_mesh or "tp=1"
+    built: Optional[GenerationService] = None
     if cfg.tp > 1:
         import jax
 
         ndev = len(jax.devices())
         if service_factory is not None and ndev >= cfg.tp:
-            service = service_factory(cfg.tp)
+            built = service_factory(cfg.tp)
+            service = built
             mesh_desc = f"tp={cfg.tp}"
         elif service_factory is not None:
             mesh_desc = f"tp=1 (requested tp={cfg.tp}; {ndev} device(s))"
+        elif service_mesh is not None:
+            mesh_desc = (f"{service_mesh} (service-owned; config requested "
+                         f"tp={cfg.tp})")
         else:
             mesh_desc = f"tp=1 (requested tp={cfg.tp}; service owns its mesh)"
 
-    rep = _run_config_body(service, cfg, max_new_tokens)
+    try:
+        rep = _run_config_body(service, cfg, max_new_tokens)
+    finally:
+        if built is not None:
+            built.close()
     return dataclasses.replace(rep, mesh=mesh_desc)
 
 

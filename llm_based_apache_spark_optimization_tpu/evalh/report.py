@@ -149,6 +149,7 @@ def generate(
     quality_meaningful: bool = False,
     timestamp: Optional[str] = None,
     service_factory=None,
+    service_mesh: Optional[str] = None,
 ) -> str:
     import jax
 
@@ -162,7 +163,8 @@ def generate(
     if with_configs:
         for key, cfg in CONFIGS.items():
             rep = run_config(service, cfg, max_new_tokens=max_new_tokens,
-                             service_factory=service_factory)
+                             service_factory=service_factory,
+                             service_mesh=service_mesh)
             config_rows.append({
                 "config": key,
                 "description": cfg.description,

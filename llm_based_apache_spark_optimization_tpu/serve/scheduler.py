@@ -56,10 +56,21 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   serving throughput scales with independent replicas; there is no gradient
   all-reduce to motivate a fused dp program (inference-only framework).
 
-Bounds: a request needs `bucket_len(prompt) + max_new + decode_chunk
-<= S_max` — the chunk term because the device can overshoot a budget or a
-stop token by up to chunk-1 steps before the host notices (those tokens are
-discarded; their cache writes are garbage covered by the invariant above).
+- **Async issue/harvest pipeline**: decode rounds, prompt chunks and
+  admission scatters dispatch without waiting; per-slot state (cur/pos/
+  sampling knobs/RNG counts) lives on device and chains between rounds.
+  The host syncs exactly once per round — to harvest the OLDEST in-flight
+  round's tokens, `_harvest_lag` rounds behind the issue frontier — so the
+  transfer round-trip overlaps the next rounds' compute. This is what makes
+  the loop fast over a high-latency device transport (the measured
+  bottleneck was sync latency, not device FLOPs) and costs one chunk of
+  retirement/admission latency.
+
+Bounds: a request needs `bucket_len(prompt) + max_new +
+(harvest_lag+1)*decode_chunk <= S_max` — the overshoot term because the
+device can run past a budget or a stop token for up to that many steps
+before the host notices (those tokens are discarded; their cache writes are
+garbage covered by the invariant above).
 """
 
 from __future__ import annotations
@@ -151,26 +162,40 @@ class ContinuousBatchingScheduler:
             )
         self._ck, self._cv = cache["k"], cache["v"]
 
-        # Per-slot device state (replicated scalars, updated between chunks).
-        self._cur = np.zeros(num_slots, np.int32)        # next token to feed
+        # Per-slot state lives ON DEVICE and chains between rounds: decode
+        # rounds and admission scatters are issued asynchronously and the
+        # host syncs only to harvest sampled tokens (one transfer per round,
+        # one round LATE — see _loop). On a high-latency transport (this
+        # repo's TPU rides a tunnel) per-round syncs, not device FLOPs, were
+        # the measured serving bottleneck; overlapping the round-trip with
+        # the next round's compute is the fix, and on a local chip the same
+        # structure simply pipelines dispatch.
         # Inactive slots "park" at the last cache slot: decode rounds write
         # garbage K/V for every slot in the batch, and a parked write lands
         # where no query can ever see it (visibility needs query position
-        # >= max_seq-1, and submit() caps requests at max_seq-2). This is
+        # >= max_seq-1, and submit() caps requests below that). This is
         # what makes chunked prefill safe: while a slot's prompt streams in
         # over several chunks, interleaved decode rounds keep scribbling at
         # the park slot, not inside the freshly written prompt region.
         self._park = self.max_seq - 1
-        self._pos = np.full(num_slots, self._park, np.int32)  # absolute position
-        self._temps = np.zeros(num_slots, np.float32)
-        self._topps = np.ones(num_slots, np.float32)
-        self._topks = np.zeros(num_slots, np.int32)
+        self._cur = jnp.full((num_slots,), cfg.pad_id, jnp.int32)
+        self._pos = jnp.full((num_slots,), self._park, jnp.int32)
+        self._temps = jnp.zeros(num_slots, jnp.float32)
+        self._topps = jnp.ones(num_slots, jnp.float32)
+        self._topks = jnp.zeros(num_slots, jnp.int32)
         # Per-request RNG: seed + tokens-sampled-so-far give slot s's key for
         # its next token as fold_in(key(seed), count) — independent of what
-        # else is in the batch.
-        self._seeds = np.zeros(num_slots, np.uint32)
-        self._counts = np.zeros(num_slots, np.int32)
+        # else is in the batch. counts advance on device (decode fn),
+        # mirroring nothing to the host.
+        self._seeds = jnp.zeros(num_slots, jnp.uint32)
+        self._counts = jnp.zeros(num_slots, jnp.int32)
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
+        # In-flight rounds awaiting harvest: (issue-time slot->req list,
+        # toks device array, firsts list of (slot, req, first_tok device)).
+        self._pending: "deque[Tuple[List[Optional[_Request]], jax.Array, list]]" = deque()
+        self._first_pending: list = []
+        self._harvest_lag = 1  # rounds kept in flight before syncing
+        self._park_fn, self._ready_fn = self._build_state_ops()
         # Prompt-chunk buckets: powers of two up to prompt_bucket, so a short
         # prompt pays a small forward instead of a full prompt_bucket one
         # (one compiled prefill program per bucket, built lazily).
@@ -188,6 +213,14 @@ class ContinuousBatchingScheduler:
         self._prefix_cache: "OrderedDict[Tuple[int, ...], Tuple[jax.Array, jax.Array]]" = (
             OrderedDict()
         )
+        # Publish gate: a block is copied out of the cache only once its
+        # content key has been SEEN before (second occurrence onward). A
+        # shared system/schema prefix repeats across requests, so it gets
+        # published on request 2 and hit from request 3 on; one-off prompts
+        # (every block unique) pay zero slice dispatches — publishing every
+        # block of every prompt was a measured per-admission cost on the
+        # serving path with nothing to ever reuse it.
+        self._prefix_seen: "OrderedDict[Tuple[int, ...], None]" = OrderedDict()
         self._prefix_hits = 0
         self._prefix_blocks_reused = 0
         self._slice_block_fn, self._restore_block_fn = self._build_block_ops()
@@ -206,6 +239,36 @@ class ContinuousBatchingScheduler:
         self._decode_fn = self._build_decode()
 
     # ---------------------------------------------------------------- jitted
+
+    def _build_state_ops(self):
+        """Async per-slot state scatters (no host sync; ~bytes of traffic).
+
+        park: point a freshly reserved slot's decode writes at the parking
+        position before its prompt starts streaming in.
+        ready: arm a slot for decode — first sampled token (still a device
+        value from the prefill program), true position, sampling knobs, RNG
+        stream (count=1: the prefill sample consumed fold index 0)."""
+        park = self._park
+        pad = self.cfg.pad_id
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def park_slot(cur, pos, slot):
+            return cur.at[slot].set(pad), pos.at[slot].set(park)
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+        def ready_slot(cur, pos, temps, topps, topks, seeds, counts, slot,
+                       tok, pos_val, temp, topp, topk, seed):
+            return (
+                cur.at[slot].set(tok[0]),
+                pos.at[slot].set(pos_val),
+                temps.at[slot].set(temp),
+                topps.at[slot].set(topp),
+                topks.at[slot].set(topk),
+                seeds.at[slot].set(seed),
+                counts.at[slot].set(1),
+            )
+
+        return park_slot, ready_slot
 
     def _build_block_ops(self):
         """Jitted device-to-device prefix-block copy ops.
@@ -262,7 +325,7 @@ class ContinuousBatchingScheduler:
         mesh = self.mesh
         pad_id = cfg.pad_id
 
-        @partial(jax.jit, donate_argnums=(1, 2))
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 10))
         def decode(params, ck, cv, cur, pos, active, temps, topps, topks,
                    seeds, counts):
             def step(carry, i):
@@ -290,7 +353,10 @@ class ContinuousBatchingScheduler:
             (ck, cv, cur, pos), toks = lax.scan(
                 step, (ck, cv, cur, pos), jnp.arange(chunk)
             )
-            return ck, cv, cur, pos, toks.T  # toks: [num_slots, chunk]
+            # RNG stream bookkeeping advances on device too: every active
+            # slot consumed `chunk` samples.
+            counts = jnp.where(active, counts + chunk, counts)
+            return ck, cv, cur, pos, counts, toks.T  # toks: [num_slots, chunk]
 
         return decode
 
@@ -336,11 +402,16 @@ class ContinuousBatchingScheduler:
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
-        need = bucket_len(len(ids), self.prompt_bucket) + max_new_tokens + self.decode_chunk
+        # Overshoot bound: the device can run (harvest_lag + 1) chunks past
+        # a budget or stop token before the host notices (rounds are
+        # harvested one lag late); those tokens are discarded but their
+        # cache writes must stay inside the window.
+        overshoot = (self._harvest_lag + 1) * self.decode_chunk
+        need = bucket_len(len(ids), self.prompt_bucket) + max_new_tokens + overshoot
         if need > self.max_seq - 1:  # the last cache slot is the parking spot
             raise ValueError(
                 f"prompt ({len(ids)} tokens, bucketed) + max_new_tokens "
-                f"({max_new_tokens}) + decode_chunk ({self.decode_chunk}) "
+                f"({max_new_tokens}) + overshoot ({overshoot}) "
                 f"= {need} exceeds scheduler max_seq={self.max_seq}"
             )
         req = _Request(
@@ -398,8 +469,10 @@ class ContinuousBatchingScheduler:
         self._slot_req[slot] = req
         # Park the slot's decode writes before its prompt starts streaming in
         # (it may still be frozen at the previous occupant's position).
-        self._pos[slot] = self._park
-        self._cur[slot] = self.cfg.pad_id
+        # Async scatter — no host sync.
+        self._cur, self._pos = self._park_fn(
+            self._cur, self._pos, jnp.int32(slot)
+        )
         if self._prefix_cache_blocks:
             pb = self._pblock
             # At least one prompt token must go through real prefill: the
@@ -462,6 +535,12 @@ class ContinuousBatchingScheduler:
                 if key in self._prefix_cache:
                     self._prefix_cache.move_to_end(key)
                     continue
+                if key not in self._prefix_seen:
+                    # First sighting: remember the content, copy nothing.
+                    self._prefix_seen[key] = None
+                    while len(self._prefix_seen) > 4 * self._prefix_cache_blocks:
+                        self._prefix_seen.popitem(last=False)
+                    continue
                 bk, bv = self._slice_block_fn(
                     self._ck, self._cv, jnp.int32(slot), jnp.int32(b0 * pb)
                 )
@@ -471,44 +550,74 @@ class ContinuousBatchingScheduler:
         if not last:
             self._prefill_q.append((slot, req))
             return
-        first = int(jax.device_get(tok)[0])
+        # No sync: arm the slot with the still-on-device first token and
+        # attach it to the next round's harvest. Stop-token / budget checks
+        # on the first token happen there, one round late — the slot may
+        # decode a round of garbage first, which the visibility invariant
+        # absorbs and submit()'s overshoot bound accounts for.
+        req.ready = True
+        (self._cur, self._pos, self._temps, self._topps, self._topks,
+         self._seeds, self._counts) = self._ready_fn(
+            self._cur, self._pos, self._temps, self._topps, self._topks,
+            self._seeds, self._counts, jnp.int32(slot), tok,
+            jnp.int32(len(req.ids)),
+            jnp.float32(req.temperature), jnp.float32(req.top_p),
+            jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
+        )
+        self._first_pending.append((slot, req, tok))
+
+    def _issue_decode(self) -> None:
+        """Dispatch one decode round asynchronously: state chains on device,
+        nothing syncs here. The round's tokens are harvested `_harvest_lag`
+        rounds later so the transfer round-trip overlaps later compute."""
+        active = np.asarray(
+            [r is not None and r.ready for r in self._slot_req]
+        )
+        issue_reqs = [
+            self._slot_req[i] if active[i] else None
+            for i in range(self.num_slots)
+        ]
+        (self._ck, self._cv, self._cur, self._pos, self._counts,
+         toks) = self._decode_fn(
+            self.params, self._ck, self._cv, self._cur, self._pos,
+            jnp.asarray(active), self._temps, self._topps, self._topks,
+            self._seeds, self._counts,
+        )
+        self._pending.append((issue_reqs, toks, self._first_pending))
+        self._first_pending = []
+
+    def _append_first(self, slot: int, req: _Request, first: int) -> None:
+        """Apply a harvested prefill first-token: stop/budget checks run
+        here, one round late (the slot may have decoded a garbage round
+        meanwhile — absorbed by the visibility invariant and submit()'s
+        overshoot bound)."""
+        if req is not self._slot_req[slot]:
+            return  # cleared by shutdown/crash path meanwhile
         if first in self.stop_ids or req.max_new < 1:
             req.future.set_result([])
             self._slot_req[slot] = None
             return
         req.generated.append(first)
-        if req.max_new == 1:
+        if len(req.generated) >= req.max_new:
             req.future.set_result(req.generated)
             self._slot_req[slot] = None
-            return
-        req.ready = True
-        self._cur[slot] = first
-        self._pos[slot] = len(req.ids)
-        self._temps[slot] = req.temperature
-        self._topps[slot] = req.top_p
-        self._topks[slot] = req.top_k
-        self._seeds[slot] = np.uint32(req.seed & 0xFFFFFFFF)
-        self._counts[slot] = 1  # the prefill sample consumed fold index 0
 
-    def _decode_round(self) -> None:
-        active = np.asarray([r is not None and r.ready for r in self._slot_req])
-        self._ck, self._cv, cur, pos, toks = self._decode_fn(
-            self.params, self._ck, self._cv,
-            jnp.asarray(self._cur), jnp.asarray(self._pos), jnp.asarray(active),
-            jnp.asarray(self._temps), jnp.asarray(self._topps),
-            jnp.asarray(self._topks), jnp.asarray(self._seeds),
-            jnp.asarray(self._counts),
+    def _harvest_round(self) -> None:
+        """Sync the OLDEST in-flight round: one device_get brings down its
+        chunk tokens plus any prefill first-tokens attached to it; retire
+        finished requests and free their slots."""
+        issue_reqs, toks_dev, firsts = self._pending.popleft()
+        toks, first_vals = jax.device_get(
+            (toks_dev, [t for (_, _, t) in firsts])
         )
-        # Every active slot consumed decode_chunk samples from its stream
-        # (host-tracked so the device fn stays stateless).
-        self._counts[active] += self.decode_chunk
-        # np.array copies: device_get hands back read-only views of device
-        # buffers, and _admit mutates these in place.
-        self._cur, self._pos = np.array(jax.device_get(cur)), np.array(jax.device_get(pos))
-        toks = np.asarray(jax.device_get(toks))
-        for i, req in enumerate(self._slot_req):
-            if req is None or not req.ready:
-                continue  # free, or still prefilling (its toks are garbage)
+        toks = np.asarray(toks)
+        # Firsts precede the round's chunk tokens in every stream: their
+        # ready-scatter was dispatched before the round was issued.
+        for (slot, req, _), fv in zip(firsts, first_vals):
+            self._append_first(slot, req, int(np.asarray(fv)[0]))
+        for i, req in enumerate(issue_reqs):
+            if req is None or req is not self._slot_req[i]:
+                continue  # inactive at issue, or already retired
             done = False
             for tok in toks[i]:
                 tok = int(tok)
@@ -522,6 +631,15 @@ class ContinuousBatchingScheduler:
             if done:
                 req.future.set_result(req.generated)
                 self._slot_req[i] = None
+
+    def _harvest_firsts(self) -> None:
+        """Drain path: ready slots whose first token never rode a round."""
+        if not self._first_pending:
+            return
+        firsts, self._first_pending = self._first_pending, []
+        vals = jax.device_get([t for (_, _, t) in firsts])
+        for (slot, req, _), fv in zip(firsts, vals):
+            self._append_first(slot, req, int(np.asarray(fv)[0]))
 
     def _run(self) -> None:
         try:
@@ -537,6 +655,8 @@ class ContinuousBatchingScheduler:
         with self._submit_lock:
             self._closed = True
         self._prefill_q.clear()  # their requests fail via the slot sweep below
+        self._pending.clear()    # in-flight rounds: futures fail below
+        self._first_pending = []
         for i, req in enumerate(self._slot_req):
             if req is not None:
                 req.future.set_exception(exc)
@@ -551,8 +671,10 @@ class ContinuousBatchingScheduler:
 
     def _loop(self) -> None:
         while not self._stop_evt.is_set():
-            # Admit pending requests into every free slot, then run one decode
-            # chunk; when fully idle, block briefly for work instead of spinning.
+            # Admit pending requests into every free slot, then issue one
+            # prompt chunk and one decode round — all asynchronously — and
+            # harvest the oldest round once the pipeline is `_harvest_lag`
+            # deep. When fully idle, drain and block for work.
             while self._free_slots():
                 try:
                     req = self._queue.get_nowait()
@@ -560,18 +682,29 @@ class ContinuousBatchingScheduler:
                     break
                 if req is not None:
                     self._admit(self._free_slots()[0], req)
-            # Fair interleave: at most one prompt chunk, then one decode
-            # chunk — admission work is bounded per decode round, so active
-            # slots never wait longer than one prompt_bucket forward.
+            # Fair interleave: at most one prompt chunk per decode round —
+            # admission work is bounded, so active slots never wait longer
+            # than one prompt_bucket forward.
             if self._prefill_q:
                 self._prefill_step()
             if any(r is not None and r.ready for r in self._slot_req):
-                self._decode_round()
+                self._issue_decode()
+                if len(self._pending) > self._harvest_lag:
+                    self._harvest_round()
             elif not self._prefill_q:
+                # Nothing left to issue: drain in-flight rounds and any
+                # unridden first tokens, then wait for new requests.
+                while self._pending:
+                    self._harvest_round()
+                self._harvest_firsts()
+                if self._prefill_q or any(
+                    r is not None for r in self._slot_req
+                ):
+                    continue  # harvests freed work — go admit/issue again
                 try:
                     req = self._queue.get(timeout=0.05)
                     if req is not None:
-                        self._admit(0, req)
+                        self._admit(self._free_slots()[0], req)
                 except queue.Empty:
                     pass
 
@@ -675,6 +808,12 @@ class SchedulerBackend:
         self.stop_texts = tuple(stop_texts)
         self.add_bos = add_bos
 
+    def shutdown(self) -> None:
+        """Stop the scheduler's event loop (idempotent; safe on shared
+        schedulers — GenerationService.close() dedupes by backend, and
+        ContinuousBatchingScheduler.shutdown is itself idempotent)."""
+        self.scheduler.shutdown()
+
     @classmethod
     def from_hf_checkpoint(
         cls,
@@ -757,7 +896,8 @@ class SchedulerBackend:
 
     def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
         sched = self.scheduler
-        room = sched.max_seq - 1 - sched.decode_chunk - bucket_len(
+        overshoot = (sched._harvest_lag + 1) * sched.decode_chunk
+        room = sched.max_seq - 1 - overshoot - bucket_len(
             n_prompt_tokens, sched.prompt_bucket
         )
         if room < 1:

@@ -63,6 +63,19 @@ class GenerationService:
     def models(self):
         return sorted(self._models)
 
+    def close(self) -> None:
+        """Shut down owned backend resources (scheduler threads, slot-pool
+        caches). Idempotent; shared backends (one scheduler behind two
+        model names) shut down once."""
+        seen = set()
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            shutdown = getattr(e.backend, "shutdown", None)
+            if shutdown is not None and id(e.backend) not in seen:
+                seen.add(id(e.backend))
+                shutdown()
+
     def generate(
         self,
         model: str,

@@ -164,6 +164,9 @@ def test_report_renders_reference_shape():
     assert "## Conclusion" in text
     assert "5-concurrent-mixed-tp8" in text
     assert "Smoke-model run" in text  # quality disclaimer present
+    # The reference compares THREE models (Model_Evaluation_&_Comparision.py
+    # :69,83); the demo services now carry all of them.
+    assert "| Query | duckdb-nsql | llama3.2 | mistral |" in text
 
 
 def test_load_spider_real_format(tmp_path):

@@ -199,3 +199,35 @@ def test_engine_default_stop_ids_include_config_extras(tiny_model):
     chat_cfg = dataclasses.replace(cfg, extra_stop_ids=(7, 9))
     eng = InferenceEngine(chat_cfg, params)
     assert eng.stop_ids == (chat_cfg.eos_id, 7, 9)
+
+
+def test_sliding_window_decode_crosses_boundary(tiny_model):
+    """Mistral-style sliding-window attention: cached decode that crosses
+    the window boundary must equal a full no-cache recompute at every step
+    (the window drops the oldest tokens; the cache path must apply the same
+    mask over its persistent buffer). VERDICT r2 next #5's engine-level
+    sliding-window test."""
+    import dataclasses
+
+    cfg0, params = tiny_model
+    cfg = dataclasses.replace(cfg0, name="tiny-swa", sliding_window=8)
+    prompt = [1, 17, 42, 99, 7, 23]
+    n_new = 10  # positions 6..15 — crosses the 8-token window at p=8
+
+    eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+    got = eng.generate([prompt], max_new_tokens=n_new)[0]
+
+    seq = list(prompt)
+    want = []
+    for _ in range(n_new):
+        tokens = jnp.asarray([seq], jnp.int32)
+        pos = jnp.arange(len(seq), dtype=jnp.int32)[None]
+        logits, _ = forward(cfg, params, tokens, pos, None)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        want.append(nxt)
+        seq.append(nxt)
+    assert got == want
+    # The window must actually matter: the unwindowed model diverges.
+    free = InferenceEngine(cfg0, params, stop_ids=(-1,), prompt_bucket=8
+                           ).generate([prompt], max_new_tokens=n_new)[0]
+    assert free != got

@@ -1,0 +1,102 @@
+"""One-command runbook: weights dir -> comparison report, through the cache.
+
+Smoke-tests the operator path end-to-end on the tiny HF-layout fixture
+(VERDICT r2 next #10): first run converts + populates the orbax native
+cache, second run restores from it (without touching the safetensors), and
+both produce the reference-shaped markdown report.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.checkpoint import (
+    save_hf_checkpoint,
+)
+from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+
+tokenizers = pytest.importorskip("tokenizers")
+
+
+@pytest.fixture(scope="module")
+def fixture_ckpt(tmp_path_factory):
+    root = tmp_path_factory.mktemp("runbook_ckpt")
+    params = init_params(TINY, jax.random.key(3), dtype=jnp.float32)
+    save_hf_checkpoint(TINY, params, root)
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<s>": 1, "</s>": 2, "[UNK]": 0}
+    for i, w in enumerate(
+        "select from where count sum vendor fare table schema".split()
+    ):
+        vocab[w] = 3 + i
+    tok = Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    tok.save(str(root / "tokenizer.json"))
+    return root
+
+
+def test_runbook_one_command_report_and_cache(fixture_ckpt, tmp_path, capsys):
+    from llm_based_apache_spark_optimization_tpu import runbook
+
+    cache = tmp_path / "cache"
+    out = tmp_path / "EVAL.md"
+    argv = [
+        "--sql-model", str(fixture_ckpt),
+        "--cache-dir", str(cache),
+        "--max-new-tokens", "8",
+        "--max-seq", "2048",
+        "--slots", "2",
+        "-o", str(out),
+        "--cpu",
+    ]
+    runbook.main(argv)
+    text = out.read_text()
+    # The reference's report shapes (SURVEY.md §6 tables).
+    assert "Four-query suite — per query" in text
+    assert "## BASELINE configs" in text
+    assert "duckdb-nsql" in text and "llama3.2" in text
+    assert "| Config | Mesh |" in text
+    # First run converted and persisted the tree.
+    cached = list(cache.iterdir())
+    assert len(cached) == 1 and (cached[0] / "config.json").exists()
+
+    # Second run restores from the cache (no reconversion) and still
+    # produces the report — delete the safetensors to prove the source
+    # is no longer read.
+    (fixture_ckpt / "model.safetensors").rename(
+        fixture_ckpt / "model.safetensors.bak"
+    )
+    try:
+        out2 = tmp_path / "EVAL2.md"
+        argv2 = [a if a != str(out) else str(out2) for a in argv]
+        runbook.main(argv2)
+        assert "## BASELINE configs" in out2.read_text()
+    finally:
+        (fixture_ckpt / "model.safetensors.bak").rename(
+            fixture_ckpt / "model.safetensors"
+        )
+
+
+def test_runbook_cfg_json_roundtrip():
+    """The cache sidecar must round-trip every config field, including both
+    rope-scaling representations and the stop-id list."""
+    import dataclasses
+
+    from llm_based_apache_spark_optimization_tpu.ops.rope import (
+        RopeFreqFactors,
+    )
+    from llm_based_apache_spark_optimization_tpu.runbook import (
+        _cfg_dump,
+        _cfg_load,
+    )
+
+    cfg = dataclasses.replace(TINY, extra_stop_ids=(7, 9))
+    assert _cfg_load(_cfg_dump(cfg)) == cfg
+    cfg2 = dataclasses.replace(
+        TINY, rope_scaling=RopeFreqFactors((1.0, 2.0, 4.0, 8.0))
+    )
+    assert _cfg_load(_cfg_dump(cfg2)) == cfg2

@@ -84,3 +84,24 @@ def test_engine_backend_end_to_end_text(tiny_model):
     # Deterministic greedy: same call → same text.
     res2 = svc.generate(model="tiny", prompt="hi", system="sys")
     assert res2.response == res.response
+
+
+def test_tiny_service_serves_three_reference_models():
+    """The demo service carries the reference's full comparison set —
+    duckdb-nsql, llama3.2, mistral (Model_Evaluation_&_Comparision.py:69,83)
+    — with mistral on its own [INST] template and sliding-window config."""
+    from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+        make_tiny_service,
+    )
+
+    svc = make_tiny_service(4, scheduler=True)
+    assert svc.models() == ["duckdb-nsql", "llama3.2", "mistral"]
+    entry = svc._models["mistral"]
+    assert entry.template("sys", "hi") == "[INST] sys\n\nhi [/INST]"
+    assert entry.backend.scheduler.cfg.sliding_window == 32
+    try:
+        res = svc.generate("mistral", "SELECT", system="schema")
+        assert isinstance(res.response, str)
+    finally:
+        for name in svc.models():
+            svc._models[name].backend.scheduler.shutdown()
