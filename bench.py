@@ -340,9 +340,13 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch) -> dict:
     # max_seq//2) clamp doesn't double-bucket the prompt and reject requests.
     max_seq = min(max(2 * prompt_len, prompt_len + max_new + 3 * decode_chunk),
                   cfg.max_seq_len)
+    # prefix_cache_blocks=0: best-of-reps resubmits the same prompts, and a
+    # warm prefix cache would skip their prefills in later reps — the bench
+    # must measure cold-path scheduler throughput, not cache reuse.
     sched = ContinuousBatchingScheduler(
         cfg, params, num_slots=slots, max_seq=max_seq,
         prompt_bucket=prompt_len, stop_ids=(-1,), decode_chunk=decode_chunk,
+        prefix_cache_blocks=0,
     )
     # Derive the admissible budget from the scheduler's OWN bound (its
     # resolved prompt_bucket and harvest lag), not a hand-mirrored copy.
@@ -360,25 +364,33 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch) -> dict:
         [int(x) for x in rng.integers(3, cfg.vocab_size, size=prompt_len)]
         for _ in range(n_req)
     ]
+    best_tok_s, best_dt, toks = 0.0, 0.0, 0
+    reps = int(os.environ.get("BENCH_SCHED_REPS", "2"))
     with sched:
         # Warmup: compile prefill + decode programs on a couple of requests.
         sched.generate(reqs[:2], max_new_tokens=max_new)
-        t0 = _t.perf_counter()
-        with ThreadPoolExecutor(max_workers=n_req) as pool:
-            futs = [
-                pool.submit(
-                    lambda r: sched.submit(r, max_new_tokens=max_new).result(),
-                    r,
-                )
-                for r in reqs
-            ]
-            toks = sum(len(f.result()) for f in futs)
-        dt = _t.perf_counter() - t0
+        # Best-of-reps: a tunneled transport shows high run-to-run variance.
+        for _ in range(reps):
+            t0 = _t.perf_counter()
+            with ThreadPoolExecutor(max_workers=n_req) as pool:
+                futs = [
+                    pool.submit(
+                        lambda r: sched.submit(
+                            r, max_new_tokens=max_new
+                        ).result(),
+                        r,
+                    )
+                    for r in reqs
+                ]
+                toks = sum(len(f.result()) for f in futs)
+            dt = _t.perf_counter() - t0
+            if toks / dt > best_tok_s:
+                best_tok_s, best_dt = toks / dt, dt
     return {
-        "tok_s": round(toks / dt, 1),
+        "tok_s": round(best_tok_s, 1),
         "requests": n_req,
         "slots": slots,
-        "wall_s": round(dt, 2),
+        "wall_s": round(best_dt, 2),
     }
 
 

@@ -72,13 +72,19 @@ def _cfg_load(d: dict) -> LlamaConfig:
 # ---------------------------------------------------------------- conversion
 
 def _cache_key(path: Path, dtype_name: str) -> str:
-    # For HF dirs, stat the config.json (stable identity even as sibling
-    # files churn); for GGUF blobs, the file itself.
-    probe = path / "config.json" if path.is_dir() else path
-    st = probe.stat()
-    h = hashlib.sha256(
-        f"{path.resolve()}|{st.st_mtime_ns}|{st.st_size}|{dtype_name}".encode()
-    ).hexdigest()[:16]
+    # Identity = the files whose contents land in the tree: for HF dirs,
+    # config.json plus every weight file's (name, mtime, size) — replacing
+    # safetensors in place (re-download, fine-tune) must invalidate, or the
+    # cache silently serves stale params. For GGUF blobs, the file itself.
+    if path.is_dir():
+        probes = [path / "config.json"] + sorted(path.glob("*.safetensors"))
+    else:
+        probes = [path]
+    parts = [str(path.resolve()), dtype_name]
+    for p in probes:
+        st = p.stat()
+        parts.append(f"{p.name}|{st.st_mtime_ns}|{st.st_size}")
+    h = hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
     return f"{path.name}-{h}"
 
 

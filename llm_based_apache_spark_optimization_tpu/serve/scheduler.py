@@ -204,6 +204,18 @@ class ContinuousBatchingScheduler:
             buckets.append(b)
             b *= 2
         self._buckets = buckets + [self.prompt_bucket]
+        # Batched prefill: up to kmax same-bucket admissions share one
+        # forward (weight streaming amortizes across an admission burst).
+        # Group size pads to a power-of-two k-bucket: a lone admission pays
+        # a 1-row forward (low-concurrency TTFT unchanged), bursts pad at
+        # most 2x, and compiled variants stay bounded at
+        # len(buckets) * len(kbuckets) (built lazily).
+        self._prefill_kmax = min(num_slots, 8)
+        kb, kbuckets = 1, []
+        while kb < self._prefill_kmax:
+            kbuckets.append(kb)
+            kb *= 2
+        self._kbuckets = kbuckets + [self._prefill_kmax]
 
         # Prefix cache: block size = the smallest bucket, so chunk boundaries
         # always land on block boundaries. OrderedDict as LRU of
@@ -235,7 +247,7 @@ class ContinuousBatchingScheduler:
         # (and is drained) or submit() observes _closed and raises.
         self._submit_lock = threading.Lock()
         self._closed = False
-        self._prefill_fns: Dict[int, object] = {}
+        self._prefill_fns: Dict[Tuple[int, int], object] = {}
         self._decode_fn = self._build_decode()
 
     # ---------------------------------------------------------------- jitted
@@ -295,28 +307,40 @@ class ContinuousBatchingScheduler:
 
         return slice_block, restore_block
 
-    def _build_prefill(self, t_bucket: int):
+    def _build_prefill(self, t_bucket: int, k: int):
         cfg, impl, mesh = self.cfg, self._impl, self.mesh
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, ck, cv, tokens, length, slot, start, temp, topp,
-                    topk, seed):
-            """One prompt chunk: tokens occupy absolute positions
-            [start, start+length); sample from the chunk's last real logit
-            (meaningful — and used — only on the final chunk, with the
-            request's own stream at fold index 0)."""
-            row_k = lax.dynamic_slice_in_dim(ck, slot, 1, axis=1)
-            row_v = lax.dynamic_slice_in_dim(cv, slot, 1, axis=1)
-            positions = start + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
-            logits, new = forward(
-                cfg, params, tokens, positions, {"k": row_k, "v": row_v},
-                logit_indices=length - 1, attn_impl=impl, mesh=mesh,
+        def prefill(params, ck, cv, tokens, lengths, slots, starts, temps,
+                    topps, topks, seeds):
+            """One prompt chunk for EACH of k slots in one forward — prefill
+            is MXU-bound and weight streaming amortizes across the batch
+            (admission bursts would otherwise pay a full weight pass per
+            B=1 request). Row i's tokens occupy absolute positions
+            [starts[i], starts[i]+lengths[i]); its last real logit samples
+            with the request's own stream at fold index 0 (used only on
+            final chunks).
+
+            Padding rows carry slot index num_slots (out of bounds): the
+            gather clamps harmlessly and the scatter DROPS their cache
+            writes (jax scatter OOB semantics), so a partially filled
+            k-batch is safe without duplicate-slot scatters."""
+            rows_k = ck[:, slots]  # [L, k, K, S, H] gather
+            rows_v = cv[:, slots]
+            positions = (
+                starts[:, None] + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
             )
-            ck = lax.dynamic_update_slice_in_dim(ck, new["k"], slot, axis=1)
-            cv = lax.dynamic_update_slice_in_dim(cv, new["v"], slot, axis=1)
-            keys = jax.random.fold_in(jax.random.key(seed), 0)[None]
-            tok = sample_runtime(logits[:, 0], temp, topp, topk, keys)
-            return ck, cv, tok
+            logits, new = forward(
+                cfg, params, tokens, positions, {"k": rows_k, "v": rows_v},
+                logit_indices=lengths - 1, attn_impl=impl, mesh=mesh,
+            )
+            ck = ck.at[:, slots].set(new["k"])
+            cv = cv.at[:, slots].set(new["v"])
+            keys = jax.vmap(
+                lambda s: jax.random.fold_in(jax.random.key(s), 0)
+            )(seeds)
+            toks = sample_runtime(logits[:, 0], temps, topps, topks, keys)
+            return ck, cv, toks
 
         return prefill
 
@@ -497,74 +521,120 @@ class ContinuousBatchingScheduler:
                 self._prefix_blocks_reused += n
         self._prefill_q.append((slot, req))
 
-    def _prefill_step(self) -> None:
-        """Run ONE prompt chunk (Sarathi-style chunked prefill): long prompts
-        interleave with decode rounds instead of stalling every active slot
-        for a whole-prompt forward (SURVEY.md §7 'without starving either').
-        The chunk size is the smallest power-of-two bucket covering what's
-        left of the prompt (self._buckets), so short prompts pay a small
-        forward instead of a full prompt_bucket one."""
-        slot, req = self._prefill_q.popleft()
+    def _next_bucket(self, req: _Request) -> int:
         remaining = len(req.ids) - req.prefilled
-        t = next((b for b in self._buckets if b >= remaining), self.prompt_bucket)
-        chunk_ids = req.ids[req.prefilled : req.prefilled + t]
-        last = req.prefilled + len(chunk_ids) >= len(req.ids)
-        if t not in self._prefill_fns:
-            self._prefill_fns[t] = self._build_prefill(t)
-        tokens = jnp.asarray(
-            [chunk_ids + [self.cfg.pad_id] * (t - len(chunk_ids))], jnp.int32
+        return next(
+            (b for b in self._buckets if b >= remaining), self.prompt_bucket
         )
-        self._ck, self._cv, tok = self._prefill_fns[t](
-            self.params, self._ck, self._cv, tokens,
-            jnp.asarray([len(chunk_ids)], jnp.int32), jnp.int32(slot),
-            jnp.int32(req.prefilled),
-            jnp.asarray([req.temperature], jnp.float32),
-            jnp.asarray([req.top_p], jnp.float32),
-            jnp.asarray([req.top_k], jnp.int32),
-            jnp.uint32(req.seed & 0xFFFFFFFF),
+
+    def _prefill_step(self) -> None:
+        """Run ONE prompt chunk for up to `_prefill_kmax` waiting requests
+        in a single batched forward (Sarathi-style chunked prefill, batched
+        over admissions): long prompts interleave with decode rounds instead
+        of stalling every active slot (SURVEY.md §7 'without starving
+        either'), and admission bursts amortize the weight stream across the
+        batch instead of paying a full pass per request. The chunk size is
+        the smallest power-of-two bucket covering what's left of the prompt;
+        only same-bucket entries batch together (one compiled program per
+        (bucket, k-bucket) pair, built lazily)."""
+        slot0, req0 = self._prefill_q.popleft()
+        t = self._next_bucket(req0)
+        group = [(slot0, req0)]
+        deferred = []
+        while self._prefill_q and len(group) < self._prefill_kmax:
+            s, r = self._prefill_q.popleft()
+            if self._next_bucket(r) == t:
+                group.append((s, r))
+            else:
+                deferred.append((s, r))
+        for item in reversed(deferred):  # keep arrival order for next passes
+            self._prefill_q.appendleft(item)
+
+        kb = next(b for b in self._kbuckets if b >= len(group))
+        if (t, kb) not in self._prefill_fns:
+            self._prefill_fns[(t, kb)] = self._build_prefill(t, kb)
+
+        tokens, lengths, slots, starts = [], [], [], []
+        temps, topps, topks, seeds, chunk_lens = [], [], [], [], []
+        for slot, req in group:
+            chunk_ids = req.ids[req.prefilled : req.prefilled + t]
+            tokens.append(chunk_ids + [self.cfg.pad_id] * (t - len(chunk_ids)))
+            lengths.append(len(chunk_ids))
+            chunk_lens.append(len(chunk_ids))
+            slots.append(slot)
+            starts.append(req.prefilled)
+            temps.append(req.temperature)
+            topps.append(req.top_p)
+            topks.append(req.top_k)
+            seeds.append(req.seed & 0xFFFFFFFF)
+        # Padding rows: OOB slot index (writes dropped), positions [0, t)
+        # over the clamped gather row — finite garbage, output discarded.
+        for _ in range(kb - len(group)):
+            tokens.append([self.cfg.pad_id] * t)
+            lengths.append(1)
+            slots.append(self.num_slots)
+            starts.append(0)
+            temps.append(0.0)
+            topps.append(1.0)
+            topks.append(0)
+            seeds.append(0)
+
+        self._ck, self._cv, toks = self._prefill_fns[(t, kb)](
+            self.params, self._ck, self._cv,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(slots, jnp.int32), jnp.asarray(starts, jnp.int32),
+            jnp.asarray(temps, jnp.float32), jnp.asarray(topps, jnp.float32),
+            jnp.asarray(topks, jnp.int32), jnp.asarray(seeds, jnp.uint32),
         )
-        chunk_start = req.prefilled
-        req.prefilled += len(chunk_ids)
-        if self._prefix_cache_blocks:
-            # Publish the chunk's completed blocks (chunk_start is always
-            # block-aligned: reuse stops on block boundaries and every
-            # non-final chunk is a bucket = multiple of pblock).
-            pb = self._pblock
-            for b0 in range(chunk_start // pb, req.prefilled // pb):
-                key = tuple(req.ids[: (b0 + 1) * pb])
-                if key in self._prefix_cache:
-                    self._prefix_cache.move_to_end(key)
-                    continue
-                if key not in self._prefix_seen:
-                    # First sighting: remember the content, copy nothing.
-                    self._prefix_seen[key] = None
-                    while len(self._prefix_seen) > 4 * self._prefix_cache_blocks:
-                        self._prefix_seen.popitem(last=False)
-                    continue
-                bk, bv = self._slice_block_fn(
-                    self._ck, self._cv, jnp.int32(slot), jnp.int32(b0 * pb)
-                )
-                self._prefix_cache[key] = (bk, bv)
-                while len(self._prefix_cache) > self._prefix_cache_blocks:
-                    self._prefix_cache.popitem(last=False)
-        if not last:
-            self._prefill_q.append((slot, req))
-            return
-        # No sync: arm the slot with the still-on-device first token and
-        # attach it to the next round's harvest. Stop-token / budget checks
-        # on the first token happen there, one round late — the slot may
-        # decode a round of garbage first, which the visibility invariant
-        # absorbs and submit()'s overshoot bound accounts for.
-        req.ready = True
-        (self._cur, self._pos, self._temps, self._topps, self._topks,
-         self._seeds, self._counts) = self._ready_fn(
-            self._cur, self._pos, self._temps, self._topps, self._topks,
-            self._seeds, self._counts, jnp.int32(slot), tok,
-            jnp.int32(len(req.ids)),
-            jnp.float32(req.temperature), jnp.float32(req.top_p),
-            jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
-        )
-        self._first_pending.append((slot, req, tok))
+
+        for i, (slot, req) in enumerate(group):
+            chunk_start = req.prefilled
+            req.prefilled += chunk_lens[i]
+            if self._prefix_cache_blocks:
+                self._publish_blocks(slot, req, chunk_start)
+            if req.prefilled < len(req.ids):
+                self._prefill_q.append((slot, req))
+                continue
+            # No sync: arm the slot with the still-on-device first token and
+            # attach it to the next round's harvest. Stop-token / budget
+            # checks on the first token happen there, one round late — the
+            # slot may decode a round of garbage first, which the
+            # visibility invariant absorbs and submit()'s overshoot bound
+            # accounts for.
+            req.ready = True
+            tok = toks[i : i + 1]
+            (self._cur, self._pos, self._temps, self._topps, self._topks,
+             self._seeds, self._counts) = self._ready_fn(
+                self._cur, self._pos, self._temps, self._topps, self._topks,
+                self._seeds, self._counts, jnp.int32(slot), tok,
+                jnp.int32(len(req.ids)),
+                jnp.float32(req.temperature), jnp.float32(req.top_p),
+                jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
+            )
+            self._first_pending.append((slot, req, tok))
+
+    def _publish_blocks(self, slot: int, req: _Request, chunk_start: int) -> None:
+        """Publish the chunk's completed prefix blocks (chunk_start is always
+        block-aligned: reuse stops on block boundaries and every non-final
+        chunk is a bucket = multiple of pblock)."""
+        pb = self._pblock
+        for b0 in range(chunk_start // pb, req.prefilled // pb):
+            key = tuple(req.ids[: (b0 + 1) * pb])
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                continue
+            if key not in self._prefix_seen:
+                # First sighting: remember the content, copy nothing.
+                self._prefix_seen[key] = None
+                while len(self._prefix_seen) > 4 * self._prefix_cache_blocks:
+                    self._prefix_seen.popitem(last=False)
+                continue
+            bk, bv = self._slice_block_fn(
+                self._ck, self._cv, jnp.int32(slot), jnp.int32(b0 * pb)
+            )
+            self._prefix_cache[key] = (bk, bv)
+            while len(self._prefix_cache) > self._prefix_cache_blocks:
+                self._prefix_cache.popitem(last=False)
 
     def _issue_decode(self) -> None:
         """Dispatch one decode round asynchronously: state chains on device,

@@ -64,21 +64,25 @@ def test_runbook_one_command_report_and_cache(fixture_ckpt, tmp_path, capsys):
     cached = list(cache.iterdir())
     assert len(cached) == 1 and (cached[0] / "config.json").exists()
 
-    # Second run restores from the cache (no reconversion) and still
-    # produces the report — delete the safetensors to prove the source
-    # is no longer read.
-    (fixture_ckpt / "model.safetensors").rename(
-        fixture_ckpt / "model.safetensors.bak"
-    )
-    try:
-        out2 = tmp_path / "EVAL2.md"
-        argv2 = [a if a != str(out) else str(out2) for a in argv]
-        runbook.main(argv2)
-        assert "## BASELINE configs" in out2.read_text()
-    finally:
-        (fixture_ckpt / "model.safetensors.bak").rename(
-            fixture_ckpt / "model.safetensors"
-        )
+    # Second run restores from the cache (no reconversion). The cache key
+    # covers the weight files' identity, so we can't delete them to prove
+    # the point (that would — correctly — invalidate); assert the restore
+    # path via its log line instead.
+    capsys.readouterr()
+    out2 = tmp_path / "EVAL2.md"
+    argv2 = [a if a != str(out) else str(out2) for a in argv]
+    runbook.main(argv2)
+    assert "restored native cache" in capsys.readouterr().out
+    assert "## BASELINE configs" in out2.read_text()
+
+    # Touching a weight file invalidates: the third run reconverts.
+    import os
+
+    os.utime(fixture_ckpt / "model.safetensors")
+    out3 = tmp_path / "EVAL3.md"
+    argv3 = [a if a != str(out) else str(out3) for a in argv]
+    runbook.main(argv3)
+    assert "converted + cached" in capsys.readouterr().out
 
 
 def test_runbook_cfg_json_roundtrip():

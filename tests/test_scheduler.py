@@ -176,10 +176,12 @@ def test_multibucket_prefill(tiny_model_module):
     with make_sched(cfg, params, prompt_bucket=16, max_seq=64) as sched:
         out = sched.generate(prompts, max_new_tokens=5)
         assert out == golden
-        # The short prompt (3 tokens) should have compiled only the smallest
-        # bucket (16 is both floor and prompt_bucket here); the long prompt
-        # adds the 16-token chunks — assert the bucket table is in use.
-        assert set(sched._prefill_fns) <= set(sched._buckets)
+        # Compiled prefill variants are keyed (bucket, k-bucket): buckets
+        # come from the bucket table, k from the power-of-two batch widths.
+        assert all(
+            t in sched._buckets and kb in sched._kbuckets
+            for t, kb in sched._prefill_fns
+        )
 
 
 def test_scheduler_pool_round_robin(tiny_model_module):
