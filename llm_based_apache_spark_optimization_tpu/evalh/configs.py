@@ -93,6 +93,7 @@ def run_config(
     max_new_tokens: int = 64,
     service_factory: Optional[Callable[[int], GenerationService]] = None,
     service_mesh: Optional[str] = None,
+    warmup: bool = False,
 ) -> ModelReport:
     """Execute one BASELINE config against the service's registered models.
 
@@ -127,6 +128,13 @@ def run_config(
             mesh_desc = f"tp=1 (requested tp={cfg.tp}; service owns its mesh)"
 
     try:
+        if warmup:
+            # Untimed pass first: scheduler backends compile their
+            # (bucket, k-bucket) prefill variants and decode program on
+            # first contact with each batch shape; including those XLA
+            # compiles in the measured row made batched configs look
+            # slower after every compiled-variant change.
+            _run_config_body(service, cfg, max_new_tokens)
         rep = _run_config_body(service, cfg, max_new_tokens)
     finally:
         if built is not None:

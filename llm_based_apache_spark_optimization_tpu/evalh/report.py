@@ -164,7 +164,7 @@ def generate(
         for key, cfg in CONFIGS.items():
             rep = run_config(service, cfg, max_new_tokens=max_new_tokens,
                              service_factory=service_factory,
-                             service_mesh=service_mesh)
+                             service_mesh=service_mesh, warmup=True)
             config_rows.append({
                 "config": key,
                 "description": cfg.description,

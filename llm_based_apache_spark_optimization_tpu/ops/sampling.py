@@ -92,29 +92,40 @@ def sample_runtime(
     stream, so a request's tokens are reproducible regardless of what other
     traffic shares the batch (the scheduler derives
     `fold_in(key(request_seed), tokens_sampled_so_far)` per slot).
-    Cost: every row pays the vocab sorts even if all-greedy; the all-greedy
-    single-signature path (`sample`) skips them.
+    Cost: the vocab sort runs only when SOME row actually samples — an
+    all-greedy batch (the NL->SQL common case) takes a `lax.cond` fast path
+    that skips sort/softmax/categorical entirely, with identical outputs
+    (greedy rows always return argmax regardless of path).
     """
+    from jax import lax
+
     logits = logits.astype(jnp.float32)
     greedy_tok = greedy(logits)
-    t = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / t
-    # ONE descending sort serves both cutoffs (this runs inside the decode
-    # scan — the sort is the step's dominant sampling cost). Top-k keeps
-    # ranks < k; top-p keeps the smallest prefix of the k-filtered,
-    # renormalized distribution with mass >= p. Both keep-sets are prefixes
-    # of the sort order, so their intersection's size indexes the cutoff.
-    v = scaled.shape[-1]
-    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
-    ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
-    keep_k = (top_k[:, None] <= 0) | (ranks < top_k[:, None])
-    probs = jax.nn.softmax(jnp.where(keep_k, sorted_desc, NEG_INF), axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = keep_k & ((cum - probs) < top_p[:, None])  # always keeps rank 0
-    kth = jnp.sum(keep, axis=-1)  # kept-prefix length per row
-    cutoff = jnp.take_along_axis(sorted_desc, (kth - 1)[:, None], axis=-1)
-    masked = jnp.where(scaled < cutoff, NEG_INF, scaled)
-    sampled = jax.vmap(
-        lambda k, row: jax.random.categorical(k, row)
-    )(keys, masked).astype(jnp.int32)
+
+    def sample_path(_):
+        t = jnp.maximum(temperature, 1e-6)[:, None]
+        scaled = logits / t
+        # ONE descending sort serves both cutoffs (this runs inside the
+        # decode scan — the sort is the step's dominant sampling cost).
+        # Top-k keeps ranks < k; top-p keeps the smallest prefix of the
+        # k-filtered, renormalized distribution with mass >= p. Both
+        # keep-sets are prefixes of the sort order, so their intersection's
+        # size indexes the cutoff.
+        v = scaled.shape[-1]
+        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+        ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
+        keep_k = (top_k[:, None] <= 0) | (ranks < top_k[:, None])
+        probs = jax.nn.softmax(jnp.where(keep_k, sorted_desc, NEG_INF), axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = keep_k & ((cum - probs) < top_p[:, None])  # always keeps rank 0
+        kth = jnp.sum(keep, axis=-1)  # kept-prefix length per row
+        cutoff = jnp.take_along_axis(sorted_desc, (kth - 1)[:, None], axis=-1)
+        masked = jnp.where(scaled < cutoff, NEG_INF, scaled)
+        return jax.vmap(
+            lambda k, row: jax.random.categorical(k, row)
+        )(keys, masked).astype(jnp.int32)
+
+    sampled = lax.cond(
+        jnp.all(temperature <= 0.0), lambda _: greedy_tok, sample_path, None
+    )
     return jnp.where(temperature <= 0.0, greedy_tok, sampled)

@@ -195,7 +195,7 @@ class ContinuousBatchingScheduler:
         self._pending: "deque[Tuple[List[Optional[_Request]], jax.Array, list]]" = deque()
         self._first_pending: list = []
         self._harvest_lag = 1  # rounds kept in flight before syncing
-        self._park_fn, self._ready_fn = self._build_state_ops()
+        self._park_fn, self._ready_fn, self._retire_fn = self._build_state_ops()
         # Prompt-chunk buckets: powers of two up to prompt_bucket, so a short
         # prompt pays a small forward instead of a full prompt_bucket one
         # (one compiled prefill program per bucket, built lazily).
@@ -267,6 +267,19 @@ class ContinuousBatchingScheduler:
         def park_slot(cur, pos, slot):
             return cur.at[slot].set(pad), pos.at[slot].set(park)
 
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def retire_slot(temps, topps, topks, slot):
+            # Reset the sampling knobs so a retired sampled request doesn't
+            # leave temperature > 0 behind: sample_runtime's all-greedy
+            # lax.cond fast path keys on EVERY slot's temperature, and one
+            # stale hot slot would force the full vocab-sort path on all
+            # subsequent rounds of an otherwise greedy workload.
+            return (
+                temps.at[slot].set(0.0),
+                topps.at[slot].set(1.0),
+                topks.at[slot].set(0),
+            )
+
         @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         def ready_slot(cur, pos, temps, topps, topks, seeds, counts, slot,
                        tok, pos_val, temp, topp, topk, seed):
@@ -280,7 +293,7 @@ class ContinuousBatchingScheduler:
                 counts.at[slot].set(1),
             )
 
-        return park_slot, ready_slot
+        return park_slot, ready_slot, retire_slot
 
     def _build_block_ops(self):
         """Jitted device-to-device prefix-block copy ops.
@@ -656,6 +669,16 @@ class ContinuousBatchingScheduler:
         self._pending.append((issue_reqs, toks, self._first_pending))
         self._first_pending = []
 
+    def _retire(self, slot: int, req: _Request, result: List[int]) -> None:
+        """Resolve a finished request, free its slot, and reset the slot's
+        on-device sampling knobs (a lingering temperature > 0 would defeat
+        sample_runtime's all-greedy fast path for every later round)."""
+        req.future.set_result(result)
+        self._slot_req[slot] = None
+        self._temps, self._topps, self._topks = self._retire_fn(
+            self._temps, self._topps, self._topks, jnp.int32(slot)
+        )
+
     def _append_first(self, slot: int, req: _Request, first: int) -> None:
         """Apply a harvested prefill first-token: stop/budget checks run
         here, one round late (the slot may have decoded a garbage round
@@ -664,13 +687,11 @@ class ContinuousBatchingScheduler:
         if req is not self._slot_req[slot]:
             return  # cleared by shutdown/crash path meanwhile
         if first in self.stop_ids or req.max_new < 1:
-            req.future.set_result([])
-            self._slot_req[slot] = None
+            self._retire(slot, req, [])
             return
         req.generated.append(first)
         if len(req.generated) >= req.max_new:
-            req.future.set_result(req.generated)
-            self._slot_req[slot] = None
+            self._retire(slot, req, req.generated)
 
     def _harvest_round(self) -> None:
         """Sync the OLDEST in-flight round: one device_get brings down its
@@ -699,8 +720,7 @@ class ContinuousBatchingScheduler:
                     done = True
                     break
             if done:
-                req.future.set_result(req.generated)
-                self._slot_req[i] = None
+                self._retire(i, req, req.generated)
 
     def _harvest_firsts(self) -> None:
         """Drain path: ready slots whose first token never rode a round."""
