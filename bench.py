@@ -387,14 +387,13 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch) -> dict:
     ]
     best_tok_s, best_dt, toks = 0.0, 0.0, 0
     reps = int(os.environ.get("BENCH_SCHED_REPS", "2"))
+    # Deterministically compile every (bucket, k-bucket) prefill variant the
+    # timed run can form (admission bursts group up to kmax; retirement
+    # waves re-admit in smaller groups) — warming through generate() races
+    # the worker's grouping and can leave variants to compile mid-timing.
+    sched.warmup(prompt_len)
     with sched:
-        # Warmup: compile the decode program AND every (bucket, k-bucket)
-        # prefill variant the timed run can form — admission bursts group
-        # up to kmax requests, and retirement waves re-admit in smaller
-        # groups, so each k-bucket must be compiled before timing starts.
-        for k in sched._kbuckets:
-            sched.generate(reqs[:k], max_new_tokens=min(8, max_new))
-        sched.generate(reqs[:2], max_new_tokens=max_new)
+        sched.generate(reqs[:2], max_new_tokens=max_new)  # decode program
         # Best-of-reps: a tunneled transport shows high run-to-run variance.
         for _ in range(reps):
             t0 = _t.perf_counter()

@@ -18,6 +18,45 @@ class EvalCase:
     expected_sql: str
 
 
+#: Column order of the taxi fixture table (matches TAXI_DDL_SYSTEM).
+TAXI_COLUMNS = (
+    "VendorID", "tpep_pickup_datetime", "tpep_dropoff_datetime",
+    "passenger_count", "trip_distance", "fare_amount", "extra",
+    "tip_amount", "tolls_amount", "improvement_surcharge", "total_amount",
+)
+
+
+def write_taxi_fixture_csv(path, rows: int = 64, seed: int = 0) -> str:
+    """Deterministic synthetic NYC-taxi CSV matching TAXI_DDL_SYSTEM, so
+    execution-match scoring has a table to run the suite's SQL against
+    (2 vendors, a passenger_count spread crossing the `> 2` predicate)."""
+    import csv
+    import random
+
+    rng = random.Random(seed)
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(TAXI_COLUMNS)
+        for i in range(rows):
+            fare = round(rng.uniform(4.0, 60.0), 2)
+            tip = round(rng.uniform(0.0, 12.0), 2)
+            tolls = round(rng.choice([0.0, 0.0, 6.55]), 2)
+            w.writerow([
+                rng.choice([1, 2]),
+                f"2024-01-{(i % 28) + 1:02d} 08:{i % 60:02d}:00",
+                f"2024-01-{(i % 28) + 1:02d} 08:{(i + 17) % 60:02d}:00",
+                float(rng.choice([1, 1, 2, 3, 4, 5])),
+                round(rng.uniform(0.4, 18.0), 2),
+                fare,
+                0.5,
+                tip,
+                tolls,
+                0.3,
+                round(fare + 0.5 + tip + tolls + 0.3, 2),
+            ])
+    return str(path)
+
+
 TAXI_DDL_SYSTEM = (
     "Here is the database schema that the SQL query will run on: "
     "CREATE TABLE taxi (VendorID bigint, tpep_pickup_datetime timestamp, "

@@ -10,11 +10,11 @@ BASELINE.json's north star is denominated in.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from ..serve.service import GenerationService
 from .fixtures import EvalCase
-from .metrics import edit_distance, exact_match
+from .metrics import edit_distance, exact_match, execution_match
 
 
 @dataclasses.dataclass(frozen=True)
@@ -26,6 +26,10 @@ class CaseResult:
     edit_distance: int
     latency_s: float
     output_tokens: int
+    # Execution accuracy (metrics.execution_match): 1/0 when judged against
+    # a SQL backend, None when no backend was given or the expected query
+    # itself fails on the fixture table.
+    execution_match: Optional[int] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +61,35 @@ class ModelReport:
         total_t = self.wall_clock_s or sum(c.latency_s for c in self.cases)
         return sum(c.output_tokens for c in self.cases) / total_t if total_t else 0.0
 
+    @property
+    def execution_match_rate(self) -> Optional[float]:
+        """Execution accuracy over judgeable cases; None when nothing was
+        judged (no backend, or every expected query failed)."""
+        judged = [c.execution_match for c in self.cases
+                  if c.execution_match is not None]
+        if not judged:
+            return None
+        return 100.0 * sum(judged) / len(judged)
+
+
+def _score(case: EvalCase, generated: str, latency_s: float,
+           output_tokens: int, exec_backend=None) -> CaseResult:
+    expected = case.expected_sql.strip()
+    ex = None
+    if exec_backend is not None:
+        m = execution_match(generated, expected, exec_backend)
+        ex = None if m is None else int(m)
+    return CaseResult(
+        nl=case.nl,
+        generated_sql=generated,
+        expected_sql=expected,
+        exact_match=exact_match(generated, expected),
+        edit_distance=edit_distance(generated, expected),
+        latency_s=latency_s,
+        output_tokens=output_tokens,
+        execution_match=ex,
+    )
+
 
 def evaluate_model(
     service: GenerationService,
@@ -64,6 +97,7 @@ def evaluate_model(
     cases: Sequence[EvalCase],
     system: str,
     max_new_tokens: int = 256,
+    exec_backend=None,
 ) -> ModelReport:
     results = []
     for case in cases:
@@ -71,16 +105,9 @@ def evaluate_model(
             model=model, prompt=case.nl, system=system,
             max_new_tokens=max_new_tokens,
         )
-        generated = res.response.strip()
-        expected = case.expected_sql.strip()
-        results.append(CaseResult(
-            nl=case.nl,
-            generated_sql=generated,
-            expected_sql=expected,
-            exact_match=exact_match(generated, expected),
-            edit_distance=edit_distance(generated, expected),
-            latency_s=res.latency_s,
-            output_tokens=res.output_tokens,
+        results.append(_score(
+            case, res.response.strip(), res.latency_s, res.output_tokens,
+            exec_backend,
         ))
     return ModelReport(model=model, cases=results)
 
@@ -92,6 +119,7 @@ def evaluate_model_batched(
     system: str,
     max_new_tokens: int = 256,
     batch_size: int = 32,
+    exec_backend=None,
 ) -> ModelReport:
     """Batched scoring (BASELINE configs 3/4): cases run `batch_size` at a
     time through one device program; per-case latency is the batch
@@ -106,16 +134,9 @@ def evaluate_model_batched(
         )
         wall += outs[0].latency_s
         for case, res in zip(chunk, outs):
-            generated = res.response.strip()
-            expected = case.expected_sql.strip()
-            results.append(CaseResult(
-                nl=case.nl,
-                generated_sql=generated,
-                expected_sql=expected,
-                exact_match=exact_match(generated, expected),
-                edit_distance=edit_distance(generated, expected),
-                latency_s=res.latency_s,
-                output_tokens=res.output_tokens,
+            results.append(_score(
+                case, res.response.strip(), res.latency_s,
+                res.output_tokens, exec_backend,
             ))
     return ModelReport(model=model, cases=results, wall_clock_s=wall)
 
@@ -126,9 +147,11 @@ def evaluate_models(
     cases: Sequence[EvalCase],
     system: str,
     max_new_tokens: int = 256,
+    exec_backend=None,
 ) -> Dict[str, ModelReport]:
     return {
-        m: evaluate_model(service, m, cases, system, max_new_tokens)
+        m: evaluate_model(service, m, cases, system, max_new_tokens,
+                          exec_backend=exec_backend)
         for m in models
     }
 

@@ -1,13 +1,22 @@
-"""Scoring metrics for NL→SQL quality: exact match + Levenshtein distance.
+"""Scoring metrics for NL→SQL quality: exact match, Levenshtein distance,
+and execution match.
 
-Same metrics the reference's harness computes (reference
+Exact match + edit distance are the reference's metrics (reference
 `Model_Evaluation_&_Comparision.py:45-51`: stripped string equality and
 `Levenshtein.distance`). Uses the C-accelerated `Levenshtein` package when
 importable, with an in-tree two-row DP fallback so the harness has zero hard
 dependencies.
+
+`execution_match` goes beyond the reference: string metrics punish
+semantically identical SQL (alias names, whitespace, clause order), so the
+harness can additionally RUN both queries against the in-tree SQL backend
+and compare result sets — Spider's execution-accuracy notion, possible here
+because the framework ships its own SQL engine seam (sql/backend.py).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 try:
     from Levenshtein import distance as _lev
@@ -23,6 +32,66 @@ def edit_distance(a: str, b: str) -> int:
     if _lev is not None:
         return _lev(a, b)
     return _edit_distance_dp(a, b)
+
+
+def _norm_cell(x) -> str:
+    """Value normalization for result comparison: floats round to 6 places
+    (engine-dependent float formatting must not fail a match), everything
+    else compares as its string form."""
+    if isinstance(x, float):
+        return f"{round(x, 6):.6f}"
+    return str(x)
+
+
+def _is_query(sql: str) -> bool:
+    """Read-only guard: only SELECT/WITH statements may run. Generated SQL
+    is model output — a DROP/DELETE would mutate the SHARED fixture backend
+    and silently poison every later case's scoring. (sqlite3's execute also
+    rejects multi-statement strings, so `SELECT 1; DROP ...` cannot ride
+    along.)"""
+    import re
+
+    head = re.match(r"\s*([A-Za-z]+)", sql or "")
+    return bool(head) and head.group(1).upper() in ("SELECT", "WITH")
+
+
+def execution_match(
+    generated: str, expected: str, backend
+) -> Optional[bool]:
+    """Execution accuracy: run both queries on `backend` (sql/backend.py
+    protocol, with the fixture table already loaded) and compare results —
+    column order kept; rows compare as a multiset, EXCEPT when the expected
+    query carries ORDER BY, where row order is part of the asked-for
+    semantics and compares as an ordered list (Spider's test-suite
+    convention).
+
+    Returns None when the EXPECTED query itself fails (the case cannot be
+    judged), False when only the generated query fails or results differ.
+    Non-SELECT statements never execute (see _is_query).
+    """
+    import re
+
+    if not _is_query(expected):
+        return None
+    try:
+        exp = backend.execute(expected)
+    except Exception:
+        return None
+    if not _is_query(generated):
+        return False
+    try:
+        got = backend.execute(generated)
+    except Exception:
+        return False
+    if len(got.columns) != len(exp.columns):
+        return False
+
+    def norm(rows):
+        return [tuple(_norm_cell(x) for x in r) for r in rows]
+
+    if re.search(r"\border\s+by\b", expected, re.IGNORECASE):
+        return norm(got.rows) == norm(exp.rows)
+    return sorted(norm(got.rows)) == sorted(norm(exp.rows))
 
 
 def _edit_distance_dp(a: str, b: str) -> int:

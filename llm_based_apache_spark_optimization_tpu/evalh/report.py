@@ -83,7 +83,9 @@ def render_report(
         lines.append(f"| Q{qi + 1}: {label} | " + " | ".join(cells) + " |")
     lines.append("")
 
-    # Aggregates: the §6.2 shape, plus tok/s.
+    # Aggregates: the §6.2 shape, plus tok/s and execution accuracy (which
+    # the reference never measured — string metrics punish semantically
+    # identical SQL; here both queries RUN on the in-tree SQL backend).
     lines += ["## Four-query suite — aggregates", ""]
     lines += [
         "| Metric | " + " | ".join(models) + " |",
@@ -100,8 +102,18 @@ def render_report(
         "| Aggregate output tok/s | "
         + " | ".join(_fmt(reports[m].aggregate_tok_per_s, 1) for m in models)
         + " |",
-        "",
     ]
+    if any(reports[m].execution_match_rate is not None for m in models):
+        lines.append(
+            "| Execution-match rate | "
+            + " | ".join(
+                (_fmt(r, 1) + " %") if (r := reports[m].execution_match_rate)
+                is not None else "n/a"
+                for m in models
+            )
+            + " |"
+        )
+    lines.append("")
 
     # BASELINE configs (the five north-star scenarios). The Mesh column
     # states what actually ran — never the tp a config merely requested.
@@ -139,6 +151,23 @@ def render_report(
     return "\n".join(lines)
 
 
+def make_taxi_exec_backend():
+    """SQLite backend with the synthetic taxi fixture loaded as table
+    `taxi` — the execution-match scoring target for the taxi suites."""
+    import tempfile
+    from pathlib import Path
+
+    from ..sql.sqlite_backend import SQLiteBackend
+    from .fixtures import write_taxi_fixture_csv
+
+    backend = SQLiteBackend()
+    with tempfile.TemporaryDirectory() as d:
+        backend.load_csv(
+            write_taxi_fixture_csv(Path(d) / "taxi.csv"), view_name="taxi"
+        )
+    return backend
+
+
 def generate(
     service: GenerationService,
     *,
@@ -150,6 +179,7 @@ def generate(
     timestamp: Optional[str] = None,
     service_factory=None,
     service_mesh: Optional[str] = None,
+    exec_match: bool = True,
 ) -> str:
     import jax
 
@@ -158,6 +188,7 @@ def generate(
     reports = evaluate_models(
         service, models, FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM,
         max_new_tokens=max_new_tokens,
+        exec_backend=make_taxi_exec_backend() if exec_match else None,
     )
     config_rows = []
     if with_configs:

@@ -399,6 +399,32 @@ class ContinuousBatchingScheduler:
 
     # ------------------------------------------------------------- lifecycle
 
+    def warmup(self, prompt_len: Optional[int] = None) -> None:
+        """Pre-compile (and execute once) every (bucket, k-bucket) prefill
+        variant for a `prompt_len`-sized prompt — deterministically, unlike
+        warming through generate() (concurrent admission groups race, so
+        some k-buckets can stay uncompiled and stall a later request with
+        an XLA compile). Every row targets the out-of-bounds padding slot:
+        the scatter drops all writes, so no slot or cache state changes.
+        Call before start() (or while the loop is idle)."""
+        want = prompt_len or self.prompt_bucket
+        t = next((b for b in self._buckets if b >= want), self.prompt_bucket)
+        pad = self.cfg.pad_id
+        for kb in self._kbuckets:
+            if (t, kb) not in self._prefill_fns:
+                self._prefill_fns[(t, kb)] = self._build_prefill(t, kb)
+            self._ck, self._cv, _ = self._prefill_fns[(t, kb)](
+                self.params, self._ck, self._cv,
+                jnp.full((kb, t), pad, jnp.int32),
+                jnp.ones(kb, jnp.int32),
+                jnp.full((kb,), self.num_slots, jnp.int32),  # all OOB
+                jnp.zeros(kb, jnp.int32),
+                jnp.zeros(kb, jnp.float32),
+                jnp.ones(kb, jnp.float32),
+                jnp.zeros(kb, jnp.int32),
+                jnp.zeros(kb, jnp.uint32),
+            )
+
     def start(self) -> "ContinuousBatchingScheduler":
         if self._thread is None:
             if self._crash is not None:

@@ -288,3 +288,106 @@ def test_run_config_mesh_honesty():
     # tp=1 configs stay plain.
     rep = run_config(_fake_service(), CONFIGS["1-cpu-greedy"], max_new_tokens=8)
     assert rep.mesh == "tp=1"
+
+
+def test_execution_match_metric():
+    """Execution accuracy: semantically identical SQL matches even when
+    string metrics fail it; wrong results / broken SQL score False; a
+    broken EXPECTED query is unjudgeable (None)."""
+    from llm_based_apache_spark_optimization_tpu.evalh.metrics import (
+        execution_match,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import (
+        make_taxi_exec_backend,
+    )
+
+    b = make_taxi_exec_backend()
+    expected = ("SELECT VendorID, SUM(total_amount) AS Total_Fare FROM taxi "
+                "GROUP BY VendorID;")
+    # Different alias + casing + whitespace: exact match 0, execution 1.
+    same = ("select   VendorID, sum(total_amount) as x from taxi "
+            "group by VendorID")
+    assert execution_match(same, expected, b) is True
+    # Different predicate -> different rows.
+    assert execution_match(
+        "SELECT VendorID, SUM(fare_amount) FROM taxi GROUP BY VendorID",
+        expected, b,
+    ) is False
+    # Generated SQL that doesn't parse.
+    assert execution_match("SELECT FROM WHERE", expected, b) is False
+    # Expected itself broken -> unjudgeable.
+    assert execution_match(same, "NOT SQL AT ALL", b) is None
+
+
+def test_harness_execution_match_rate():
+    """A fake service that echoes each case's expected SQL scores 100%
+    execution match; report rendering shows the row."""
+    from llm_based_apache_spark_optimization_tpu.evalh.fixtures import (
+        FOUR_QUERY_SUITE,
+        TAXI_DDL_SYSTEM,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.harness import (
+        evaluate_model,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import (
+        make_taxi_exec_backend,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+
+    by_nl = {c.nl: c.expected_sql for c in FOUR_QUERY_SUITE}
+
+    svc = GenerationService()
+    svc.register("echo", FakeBackend(
+        lambda p: next(sql for nl, sql in by_nl.items() if nl in p)
+    ))
+    rep = evaluate_model(
+        svc, "echo", FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM,
+        exec_backend=make_taxi_exec_backend(),
+    )
+    assert rep.execution_match_rate == 100.0
+    # And without a backend the rate is None (nothing judged).
+    rep2 = evaluate_model(svc, "echo", FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM)
+    assert rep2.execution_match_rate is None
+
+
+def test_report_includes_execution_match_row():
+    from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+        make_fake_service,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import generate
+
+    text = generate(
+        make_fake_service(), backend_desc="fake", with_configs=False,
+        quality_meaningful=False,
+    )
+    assert "| Execution-match rate |" in text
+
+
+def test_execution_match_guards_and_order():
+    """Read-only guard: DDL/DML never executes (a DROP must not poison the
+    shared fixture); ORDER BY queries compare row order."""
+    from llm_based_apache_spark_optimization_tpu.evalh.metrics import (
+        execution_match,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import (
+        make_taxi_exec_backend,
+    )
+
+    b = make_taxi_exec_backend()
+    expected = ("SELECT VendorID, SUM(total_amount) AS Total_Fare FROM taxi "
+                "GROUP BY VendorID ORDER BY Total_Fare DESC;")
+    # A destructive generation scores False AND leaves the fixture intact.
+    assert execution_match("DROP TABLE taxi", expected, b) is False
+    assert execution_match(expected, expected, b) is True  # still queryable
+    # Wrong direction: same multiset, wrong order -> False for ORDER BY gold.
+    asc = expected.replace("DESC", "ASC")
+    assert execution_match(asc, expected, b) is False
+    # Unordered gold: multiset comparison accepts either order.
+    gold_unordered = ("SELECT VendorID, SUM(total_amount) AS T FROM taxi "
+                      "GROUP BY VendorID")
+    assert execution_match(
+        gold_unordered + " ORDER BY T ASC", gold_unordered, b
+    ) is True

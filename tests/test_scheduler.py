@@ -389,3 +389,20 @@ def test_scheduler_backend_from_hf_checkpoint(tiny_model_module, tmp_path):
         assert out.output_tokens == len(golden)
     finally:
         backend.scheduler.shutdown()
+
+
+def test_warmup_compiles_all_kbuckets_without_state_change(tiny_model_module):
+    """warmup() builds every (bucket, k-bucket) prefill variant and runs
+    them against the OOB padding slot — no slot/cache state changes, and
+    subsequent generates stay engine-exact."""
+    import numpy as np
+
+    cfg, params = tiny_model_module
+    sched = make_sched(cfg, params, num_slots=2)
+    before_k = np.asarray(sched._ck)
+    sched.warmup()
+    assert {kb for (_, kb) in sched._prefill_fns} == set(sched._kbuckets)
+    np.testing.assert_array_equal(np.asarray(sched._ck), before_k)
+    golden = engine_golden(cfg, params, PROMPTS[:2], max_new=4)
+    with sched:
+        assert sched.generate(PROMPTS[:2], max_new_tokens=4) == golden
