@@ -132,11 +132,32 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     from ..tokenizer import HFTokenizer
 
     mesh = None
+    scheduler_meshes = [None]
     if args.dp * args.sp * args.tp > 1:
-        if args.scheduler and (args.dp > 1 or args.sp > 1):
-            sys.exit("--scheduler supports tp-only meshes (dp=sp=1): request "
-                     "parallelism comes from scheduler slots")
-        mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+        if args.scheduler and args.sp > 1:
+            sys.exit("--scheduler has no sp axis (decode's T=1 has no "
+                     "sequence to shard); use sp with --no-scheduler")
+        if args.scheduler and args.dp > 1:
+            # dp>1 for continuous batching = independent scheduler replicas,
+            # each on its own tp-submesh, behind one SchedulerPool (the slot
+            # axis is dynamically indexed and cannot shard — scheduler.py's
+            # SchedulerPool docstring). Requests round-robin across replicas.
+            import jax
+
+            devices = jax.devices()
+            if len(devices) < args.dp * args.tp:
+                sys.exit(f"--dp {args.dp} --tp {args.tp} needs "
+                         f"{args.dp * args.tp} devices, found {len(devices)}")
+            # Every replica gets its own submesh — tp=1 included, so each
+            # replica's params land on ITS device, not all on device 0.
+            scheduler_meshes = [
+                make_mesh(dp=1, sp=1, tp=args.tp,
+                          devices=devices[i * args.tp:(i + 1) * args.tp])
+                for i in range(args.dp)
+            ]
+        else:
+            mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
+            scheduler_meshes = [mesh]
 
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
@@ -145,12 +166,44 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                      "PATH.gguf:TOKDIR")
         tok = HFTokenizer(tok_dir or path)
         if args.scheduler:
-            common = dict(mesh=mesh, max_new_tokens=max_new_tokens,
-                          add_bos=add_bos, num_slots=args.slots)
+            if len(scheduler_meshes) == 1:
+                common = dict(mesh=scheduler_meshes[0],
+                              max_new_tokens=max_new_tokens,
+                              add_bos=add_bos, num_slots=args.slots)
+                if path.endswith(".gguf"):
+                    return SchedulerBackend.from_gguf(path, tok, **common)
+                return SchedulerBackend.from_hf_checkpoint(
+                    path, tok, quantize_int8=args.int8, **common
+                )
+            # dp replicas: load the checkpoint ONCE host-side (and quantize
+            # host-side, so only the int8 tree ever ships — the same order
+            # SchedulerBackend.from_hf_checkpoint uses), then place per
+            # submesh. One disk read for any dp.
+            from ..checkpoint import load_gguf_checkpoint, load_hf_checkpoint
+            from ..serve.backends import resolve_stop_ids
+            from ..serve.scheduler import (
+                ContinuousBatchingScheduler,
+                SchedulerPool,
+            )
+
             if path.endswith(".gguf"):
-                return SchedulerBackend.from_gguf(path, tok, **common)
-            return SchedulerBackend.from_hf_checkpoint(
-                path, tok, quantize_int8=args.int8, **common
+                cfg, params = load_gguf_checkpoint(path, mesh=None)
+            else:
+                cfg, params = load_hf_checkpoint(path, mesh=None)
+            if args.int8:
+                from ..ops.quant import quantize_params
+
+                params = quantize_params(params)
+            scheds = [
+                ContinuousBatchingScheduler(
+                    cfg, params, num_slots=args.slots,
+                    stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
+                )
+                for m in scheduler_meshes
+            ]
+            return SchedulerBackend(
+                SchedulerPool(scheds), tok,
+                max_new_tokens=max_new_tokens, add_bos=add_bos,
             )
         if path.endswith(".gguf"):
             return EngineBackend.from_gguf(

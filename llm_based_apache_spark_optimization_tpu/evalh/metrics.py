@@ -43,16 +43,31 @@ def _norm_cell(x) -> str:
     return str(x)
 
 
+_FORBIDDEN = (
+    "insert", "update", "delete", "drop", "alter", "create", "replace",
+    "attach", "detach", "pragma", "vacuum", "reindex",
+)
+
+
 def _is_query(sql: str) -> bool:
-    """Read-only guard: only SELECT/WITH statements may run. Generated SQL
-    is model output — a DROP/DELETE would mutate the SHARED fixture backend
-    and silently poison every later case's scoring. (sqlite3's execute also
-    rejects multi-statement strings, so `SELECT 1; DROP ...` cannot ride
-    along.)"""
+    """Read-only guard: only SELECT/WITH statements may run, and no
+    mutating keyword may appear ANYWHERE (SQLite allows WITH-prefixed
+    DELETE/UPDATE/INSERT, so checking the head token alone is not enough).
+    Generated SQL is model output — a mutation would corrupt the SHARED
+    fixture backend and silently poison every later case's scoring. A rare
+    false positive (a string literal containing a keyword) just scores the
+    case conservatively. Defense in depth: the fixture backend is also set
+    engine-level read-only (SQLiteBackend.set_read_only), and sqlite3's
+    execute rejects multi-statement strings."""
     import re
 
     head = re.match(r"\s*([A-Za-z]+)", sql or "")
-    return bool(head) and head.group(1).upper() in ("SELECT", "WITH")
+    if not head or head.group(1).upper() not in ("SELECT", "WITH"):
+        return False
+    lowered = sql.lower()
+    return not any(
+        re.search(rf"\b{kw}\b", lowered) for kw in _FORBIDDEN
+    )
 
 
 def execution_match(

@@ -165,6 +165,9 @@ def make_taxi_exec_backend():
         backend.load_csv(
             write_taxi_fixture_csv(Path(d) / "taxi.csv"), view_name="taxi"
         )
+    # Engine-level read-only: model-generated SQL must not be able to
+    # mutate the fixture even if it slips past the string guard.
+    backend.set_read_only()
     return backend
 
 

@@ -844,6 +844,33 @@ class SchedulerPool:
         self._rr = 0
         self._lock = threading.Lock()
 
+    # Admission-arithmetic surface, so SchedulerBackend can wrap a pool the
+    # same way it wraps one scheduler (replicas are homogeneous: same cfg,
+    # window, chunking — submit() re-validates on the chosen replica).
+    @property
+    def cfg(self):
+        return self.schedulers[0].cfg
+
+    @property
+    def max_seq(self) -> int:
+        return self.schedulers[0].max_seq
+
+    @property
+    def decode_chunk(self) -> int:
+        return self.schedulers[0].decode_chunk
+
+    @property
+    def prompt_bucket(self) -> int:
+        return self.schedulers[0].prompt_bucket
+
+    @property
+    def _harvest_lag(self) -> int:
+        return self.schedulers[0]._harvest_lag
+
+    def warmup(self, prompt_len=None) -> None:
+        for s in self.schedulers:
+            s.warmup(prompt_len)
+
     def start(self) -> "SchedulerPool":
         for s in self.schedulers:
             s.start()

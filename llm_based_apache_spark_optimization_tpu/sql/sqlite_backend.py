@@ -117,6 +117,14 @@ class SQLiteBackend:
         self._conn.commit()
         return TableSchema(columns=tuple(header), dtypes=dtypes)
 
+    def set_read_only(self) -> None:
+        """Freeze the session: further statements may only read (sqlite
+        `query_only` pragma). Used by eval execution-match scoring, which
+        runs MODEL-GENERATED SQL against a shared fixture — a DELETE/DROP
+        slipping through a string-level guard must still be refused by the
+        engine itself."""
+        self._conn.execute("PRAGMA query_only = ON")
+
     def execute(self, sql: str) -> ResultTable:
         cur = self._conn.cursor()
         cur.execute(sql)

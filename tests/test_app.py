@@ -342,3 +342,60 @@ def test_checkpoint_backend_cli_scheduler_default(tiny_model, tmp_path):
         assert all(o.output_tokens >= 1 for o in outs)
     finally:
         sql.scheduler.shutdown()
+
+
+def test_checkpoint_backend_cli_scheduler_pool_dp2(tiny_model, tmp_path):
+    """--scheduler --dp 2 --tp 2: each dp replica owns a tp=2 submesh and a
+    slot pool; requests round-robin through one SchedulerPool backend and
+    greedy results stay deterministic across replicas."""
+    import argparse
+    from concurrent.futures import ThreadPoolExecutor
+
+    from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+        make_checkpoint_service,
+    )
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        save_hf_checkpoint,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        SchedulerPool,
+    )
+
+    cfg_m, params = tiny_model
+    ckpt = tmp_path / "ckpt_pool"
+    save_hf_checkpoint(cfg_m, params, ckpt)
+
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    vocab = {"<s>": 1, "</s>": 2, "[UNK]": 0}
+    for i, w in enumerate("select from vendor fare".split()):
+        vocab[w] = 3 + i
+    tok = Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    tok.save(str(ckpt / "tokenizer.json"))
+
+    args = argparse.Namespace(
+        sql_model_path=str(ckpt), error_model_path=None,
+        mistral_model_path=None,
+        dp=2, sp=1, tp=2, int8=False, scheduler=True, slots=2,
+    )
+    svc = make_checkpoint_service(args, max_new_tokens=4)
+    sql = svc._models["duckdb-nsql"].backend
+    assert isinstance(sql.scheduler, SchedulerPool)
+    assert len(sql.scheduler.schedulers) == 2
+    try:
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            outs = [
+                f.result() for f in [
+                    pool.submit(svc.generate, "duckdb-nsql", "select vendor",
+                                "from fare")
+                    for _ in range(4)
+                ]
+            ]
+        # Same prompt, greedy, different replicas -> identical responses.
+        assert len({o.response for o in outs}) == 1
+        assert all(o.output_tokens >= 1 for o in outs)
+    finally:
+        svc.close()

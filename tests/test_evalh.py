@@ -391,3 +391,30 @@ def test_execution_match_guards_and_order():
     assert execution_match(
         gold_unordered + " ORDER BY T ASC", gold_unordered, b
     ) is True
+
+
+def test_execution_match_with_prefixed_dml_blocked():
+    """SQLite allows WITH-prefixed DELETE/UPDATE/INSERT — the guard must
+    reject them, and even a hypothetical bypass is stopped engine-level
+    (the fixture backend is query_only)."""
+    import pytest
+
+    from llm_based_apache_spark_optimization_tpu.evalh.metrics import (
+        _is_query,
+        execution_match,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import (
+        make_taxi_exec_backend,
+    )
+
+    b = make_taxi_exec_backend()
+    gold = "SELECT COUNT(*) FROM taxi"
+    n_before = b.execute(gold).rows[0][0]
+    sneaky = "WITH x AS (SELECT 1) DELETE FROM taxi"
+    assert _is_query(sneaky) is False
+    assert execution_match(sneaky, gold, b) is False
+    assert b.execute(gold).rows[0][0] == n_before  # fixture untouched
+    # Engine-level backstop: direct mutation attempts raise.
+    with pytest.raises(Exception):
+        b.execute("DELETE FROM taxi")
+    assert b.execute(gold).rows[0][0] == n_before

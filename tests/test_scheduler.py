@@ -406,3 +406,28 @@ def test_warmup_compiles_all_kbuckets_without_state_change(tiny_model_module):
     golden = engine_golden(cfg, params, PROMPTS[:2], max_new=4)
     with sched:
         assert sched.generate(PROMPTS[:2], max_new_tokens=4) == golden
+
+
+def test_shutdown_with_in_flight_rounds_fails_futures(tiny_model_module):
+    """Shutdown while rounds are still in flight (pending harvest queue
+    non-empty) must fail every unresolved future with a clear error, not
+    hang or leak — the async pipeline's crash-safety contract."""
+    cfg, params = tiny_model_module
+    sched = make_sched(cfg, params, num_slots=2)
+    sched.start()
+    futs = [sched.submit([1, 5 + i], max_new_tokens=40) for i in range(6)]
+    sched.shutdown()
+    import concurrent.futures
+
+    resolved, failed = 0, 0
+    for f in futs:
+        try:
+            out = f.result(timeout=30)
+            assert isinstance(out, list)
+            resolved += 1
+        except (RuntimeError, concurrent.futures.CancelledError):
+            failed += 1
+    assert resolved + failed == 6
+    # And the scheduler rejects new work after shutdown.
+    with pytest.raises(RuntimeError):
+        sched.submit([1, 2], max_new_tokens=4)
