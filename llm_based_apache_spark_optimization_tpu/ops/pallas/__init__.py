@@ -11,4 +11,8 @@ Ollama/llama.cpp, whose C++/CUDA kernels are the analogous hot loop).
 """
 
 from .attention import flash_gqa_attention, sharded_flash_gqa_attention  # noqa: F401
-from .dispatch import attention_impl, set_attention_impl  # noqa: F401
+from .dispatch import (  # noqa: F401
+    attention_impl,
+    decode_attention_impl,
+    set_attention_impl,
+)

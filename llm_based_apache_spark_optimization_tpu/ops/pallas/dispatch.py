@@ -36,11 +36,49 @@ def set_attention_impl(mode: Optional[str]) -> None:
     _mode = None if mode in (None, "auto") else mode
 
 
-def attention_impl(mesh=None) -> str:
-    """Resolve to 'xla' or 'pallas' for the current trace."""
+def _resolve_mode() -> str:
+    """The effective mode: 'auto', or a forced 'xla'/'pallas'."""
     mode = _mode or os.environ.get("LBASO_ATTENTION_IMPL", "auto")
     if mode not in _VALID:
         raise ValueError(f"LBASO_ATTENTION_IMPL={mode!r} not in {_VALID}")
+    return mode
+
+
+def attention_impl(mesh=None) -> str:
+    """Resolve to 'xla' or 'pallas' for the current trace."""
+    mode = _resolve_mode()
     if mode != "auto":
         return mode
     return "pallas" if jax.devices()[0].platform == "tpu" else "xla"
+
+
+# Auto-mode decode crossover: the flash kernel pays ~0.05 ms/layer of cell
+# overhead at T=1 (measured v5e, K-folded grid), while the einsum path reads
+# the FULL cache but fuses to zero overhead — measured faster up to at least
+# a 1 GB mostly-live cache (bench-1b B=32 S=1024: einsum 4091 tok/s vs
+# kernel 2779). The kernel's per-row kv_lens bounding only pays off when a
+# large persistent cache is mostly DEAD (continuous-batching slots: parked
+# rows, fresh requests at low positions). Assuming ~50% live occupancy,
+# kernel wins when 0.5 * cache_bytes / 819 GB/s > layers * 0.05 ms, i.e.
+# cache over ~1.3-2.6 GB per device; below that einsum wins outright.
+_PALLAS_DECODE_MIN_CACHE_BYTES = int(1.5e9)
+
+
+def decode_attention_impl(mesh=None, cache_bytes_per_device=None) -> str:
+    """Resolve the T=1 (decode) attention impl.
+
+    Honors a forced mode exactly like `attention_impl`. In auto mode decode
+    prefers the XLA einsum path — uniform request-sized caches are mostly
+    live, so bounded streaming saves nothing and the kernel's per-cell
+    overhead is pure loss — unless the caller's persistent cache
+    (`cache_bytes_per_device`) is past the measured crossover where per-row
+    bounded streaming of mostly-dead slots wins (continuous-batching
+    scheduler over a large window)."""
+    mode = _resolve_mode()
+    if mode != "auto":
+        return mode
+    if jax.devices()[0].platform != "tpu":
+        return "xla"
+    if (cache_bytes_per_device or 0) >= _PALLAS_DECODE_MIN_CACHE_BYTES:
+        return "pallas"
+    return "xla"

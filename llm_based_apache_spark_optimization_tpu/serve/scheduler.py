@@ -91,7 +91,7 @@ from jax import lax
 from ..engine.kvcache import bucket_len, init_cache
 from ..models.configs import LlamaConfig
 from ..models.llama import Params, forward
-from ..ops.pallas import attention_impl
+from ..ops.pallas import attention_impl, decode_attention_impl
 from ..ops.sampling import SamplingParams, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
 
@@ -152,6 +152,18 @@ class ContinuousBatchingScheduler:
         self._impl = attention_impl(mesh)
 
         dtype = jax.tree.leaves(params)[0].dtype
+        # Decode impl is cost-aware: the flash kernel's per-row kv_lens
+        # bounding (parked slots stream nothing) only beats the einsum
+        # path's zero-overhead full-cache read once the persistent
+        # [slots, max_seq] cache is large per device — see
+        # ops.pallas.decode_attention_impl for the measured crossover.
+        from ..engine.kvcache import cache_bytes as _cache_bytes
+
+        tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
+        self._decode_impl = decode_attention_impl(
+            mesh,
+            _cache_bytes(cfg, num_slots, self.max_seq, dtype.itemsize) // tp,
+        )
         cache = init_cache(cfg, num_slots, self.max_seq, dtype=dtype)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -358,7 +370,7 @@ class ContinuousBatchingScheduler:
         return prefill
 
     def _build_decode(self):
-        cfg, impl, chunk = self.cfg, self._impl, self.decode_chunk
+        cfg, impl, chunk = self.cfg, self._decode_impl, self.decode_chunk
         mesh = self.mesh
         pad_id = cfg.pad_id
 
