@@ -288,11 +288,14 @@ def _bench_int8(cfg, params, prompt_len, max_new, batch, bf16_tok_s,
 
     rng = np.random.default_rng(0)
 
-    def measure(engine, b):
-        ps = [
+    def make_prompts(b):
+        return [
             [int(x) for x in rng.integers(3, cfg.vocab_size, size=prompt_len)]
             for _ in range(b)
         ]
+
+    def measure(engine, b):
+        ps = make_prompts(b)
         engine.generate(ps, max_new_tokens=max_new)  # warmup+compile
         best = 0.0
         for _ in range(2):
@@ -317,10 +320,7 @@ def _bench_int8(cfg, params, prompt_len, max_new, batch, bf16_tok_s,
     # different decode-cap bucket, so tiny budgets would compare programs
     # of different cache sizes).
     if max_new >= 8:
-        ps = [
-            [int(x) for x in rng.integers(3, cfg.vocab_size, size=prompt_len)]
-            for _ in range(batch)
-        ]
+        ps = make_prompts(batch)
         eng8.generate(ps, max_new_tokens=1)
         t_pre = float("inf")
         for _ in range(2):

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..models.configs import LlamaConfig
-from ..models.llama import Params, forward
+from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
 from ..ops.sampling import SamplingParams, sample
 from ..parallel.sharding import constrain_cache, shard_batch, shard_params
@@ -135,6 +135,10 @@ def _make_generate_fn(
         done = _is_stop(first, stop_ids)
         out = jnp.full((b, max_new), pad_id, jnp.int32)
         out = out.at[:, 0].set(first)
+        # Per-layer weight slices anchored OUTSIDE the decode loop: layout
+        # conversions for the decode matmuls run once per call, not per
+        # token (split_blocks docstring).
+        dec_params = split_blocks(params)
 
         def cond(carry):
             out, cur, pos, done, cache, step = carry
@@ -143,7 +147,7 @@ def _make_generate_fn(
         def body(carry):
             out, cur, pos, done, cache, step = carry
             logits, cache = forward(
-                cfg, params, cur[:, None], pos[:, None], cache,
+                cfg, dec_params, cur[:, None], pos[:, None], cache,
                 attn_impl=decode_impl, mesh=mesh,
             )
             nxt = sample(logits[:, 0], sampling, jax.random.fold_in(key, step))

@@ -84,6 +84,25 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     return params
 
 
+def split_blocks(params: Params) -> Params:
+    """A params variant whose "blocks" is a per-layer LIST of trees (static
+    slices of the stacked [L, ...] weights).
+
+    Decode loops pass this to `forward` so the per-layer slices — and any
+    layout conversions XLA decides the decode matmuls want — are anchored
+    OUTSIDE the `lax.while_loop`/`lax.scan` body and run once per call
+    instead of once per token (see forward's unrolled branch). Slices that
+    need no layout change stay zero-copy bitcast views of the stacked
+    buffer."""
+    blocks = params["blocks"]
+    n_layers = jax.tree.leaves(blocks)[0].shape[0]
+    out = dict(params)
+    out["blocks"] = [
+        jax.tree.map(lambda a, _l=l: a[_l], blocks) for l in range(n_layers)
+    ]
+    return out
+
+
 def _update_cache(cache: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> jnp.ndarray:
     """Write `new` [B, T, K, H] into `cache` [B, K, S, H] at per-batch offsets.
 
@@ -240,9 +259,21 @@ def forward(
         # stacked cache (static layer indices). Scanning the cache through
         # xs/ys copies each layer's cache several times PER STEP — see the
         # module docstring for the measured cost.
+        #
+        # `params["blocks"]` may be a pre-sliced per-layer list
+        # (split_blocks, used by decode loops): slicing the stacked weights
+        # inside a `lax.while_loop` body leaves the layout conversions XLA
+        # wants for the attention matmuls inside the loop (its invariant
+        # code motion won't hoist buffers that large — profiled ~0.47
+        # ms/step of repeated weight re-layout copies); pre-sliced params
+        # anchor those conversions outside the loop, once per call.
+        blocks = params["blocks"]
         ck, cv = cache["k"], cache["v"]
         for l in range(cfg.num_layers):
-            p = jax.tree.map(lambda a, _l=l: a[_l], params["blocks"])
+            if isinstance(blocks, (list, tuple)):
+                p = blocks[l]
+            else:
+                p = jax.tree.map(lambda a, _l=l: a[_l], blocks)
             q, k, v = qkv(p, x)
             ck = _update_cache_layer(ck, k, start, l)
             cv = _update_cache_layer(cv, v, start, l)

@@ -60,6 +60,61 @@ from ..common import NEG_INF
 _LANES = 128  # VMEM lane width: scratch row-stats are kept lane-broadcast
 
 
+def _flash_block_update(
+    q, k, v, qp_row, kvl, s_idx, blk,
+    m_prev, l_prev, acc_prev,
+    *, scale, sliding_window, kv_len,
+):
+    """One online-softmax block update, shared by both kernels.
+
+    Shapes carry a leading Kc axis (KV heads folded into the cell): the
+    prefill kernel passes Kc=1 views, the decode kernel the full K. Inputs:
+    q [Kc, GT, H], k/v [Kc, BLK, H], m/l [Kc, GT, 1], acc [Kc, GT, H].
+    Returns (m_new, l_new, acc_new)."""
+    # A ragged final block reads past S: those rows are padding garbage
+    # (possibly NaN), and 0 * NaN = NaN would leak through the p @ v
+    # matmul even with p zeroed — zero the rows themselves.
+    row_pos = s_idx * blk + jax.lax.broadcasted_iota(
+        jnp.int32, v.shape, dimension=1
+    )
+    v_z = jnp.where(row_pos < kv_len, v, 0)
+
+    scores = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [Kc, GT, BLK]
+
+    qp = qp_row[None, :, None]  # [1, GT, 1]
+    kv_pos = s_idx * blk + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, dimension=2
+    )
+    # kv_pos < kvl: the contract is that output depends ONLY on the first
+    # kv_lens[b] cache slots (the truncated-streaming invariant the tests
+    # assert); callers keep kv_lens > every live position.
+    mask = (kv_pos <= qp) & (kv_pos < kvl)
+    if sliding_window is not None:
+        mask = mask & (qp - kv_pos < sliding_window)
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    m_cur = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                  # [Kc, GT, 1]
+    p = jnp.exp(scores - m_new)                      # [Kc, GT, BLK]
+    # Fully-masked-so-far rows keep m == NEG_INF; exp(NEG_INF - NEG_INF)
+    # = 1 would pollute l with BLK, so zero p where the mask killed the
+    # score.
+    p = jnp.where(mask, p, 0.0)
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+    pv = jax.lax.dot_general(
+        p.astype(v_z.dtype), v_z,
+        dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32,
+    )  # [Kc, GT, H]
+    return m_new, l_new, acc_prev * alpha + pv
+
+
 def _flash_kernel(
     kvlen_ref,  # [B] i32 SMEM (scalar prefetch) — valid KV slots per row
     qpos_ref,  # [1, 1, GT] i32   (positions tiled over the G query groups)
@@ -96,55 +151,14 @@ def _flash_kernel(
     # DMA was elided by the clamped index map and the MXU does nothing.
     @pl.when((s_idx * blk <= jnp.max(qp_row)) & (s_idx * blk < kvl))
     def _compute():
-        q = q_ref[0, 0]            # [GT, H]
-        k = k_ref[0, 0]            # [BLK, H]
-        v = v_ref[0, 0]            # [BLK, H]
-        # A ragged final block reads past S: those rows are padding garbage
-        # (possibly NaN), and 0 * NaN = NaN would leak through the p @ v
-        # matmul even with p zeroed — zero the rows themselves.
-        row_pos = s_idx * blk + jax.lax.broadcasted_iota(
-            jnp.int32, v.shape, dimension=0
+        m_new, l_new, acc_new = _flash_block_update(
+            q_ref[0], k_ref[0], v_ref[0], qp_row, kvl, s_idx, blk,
+            m_ref[:, :1][None], l_ref[:, :1][None], acc_ref[...][None],
+            scale=scale, sliding_window=sliding_window, kv_len=kv_len,
         )
-        v_z = jnp.where(row_pos < kv_len, v, 0)
-
-        scores = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [GT, BLK]
-
-        qp = qp_row[:, None]  # [GT, 1]
-        kv_pos = s_idx * blk + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, dimension=1
-        )
-        # kv_pos < kvl: the contract is that output depends ONLY on the
-        # first kv_lens[b] cache slots (the truncated-streaming invariant
-        # the tests assert); callers keep kv_lens > every live position.
-        mask = (kv_pos <= qp) & (kv_pos < kvl)
-        if sliding_window is not None:
-            mask = mask & (qp - kv_pos < sliding_window)
-        scores = jnp.where(mask, scores, NEG_INF)
-
-        m_prev = m_ref[:, :1]                                   # [GT, 1]
-        l_prev = l_ref[:, :1]
-        m_cur = jnp.max(scores, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)                          # [GT, 1]
-        p = jnp.exp(scores - m_new)                              # [GT, BLK]
-        # Fully-masked-so-far rows keep m == NEG_INF; exp(NEG_INF - NEG_INF)
-        # = 1 would pollute l with BLK, so zero p where the mask killed the
-        # score.
-        p = jnp.where(mask, p, 0.0)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-
-        pv = jax.lax.dot_general(
-            p.astype(v_z.dtype), v_z,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [GT, H]
-        acc_ref[:] = acc_ref[:] * alpha + pv
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        acc_ref[:] = acc_new[0]
+        m_ref[:] = jnp.broadcast_to(m_new[0], m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new[0], l_ref.shape)
 
     @pl.when(s_idx == pl.num_programs(2) - 1)
     def _finalize():
@@ -169,8 +183,9 @@ def _flash_decode_kernel(
     kv_len: int,
 ):
     """Folded-K variant for T == 1: same online-softmax math as
-    `_flash_kernel`, with the KV-head axis inside the cell as the batch dim
-    of batched `dot_general`s. Grid = (B, S_blocks)."""
+    `_flash_kernel` (shared `_flash_block_update`), with the KV-head axis
+    inside the cell as the batch dim of batched `dot_general`s.
+    Grid = (B, S_blocks)."""
     s_idx = pl.program_id(1)
     blk = k_ref.shape[2]
     kvl = kvlen_ref[pl.program_id(0)]
@@ -185,44 +200,12 @@ def _flash_decode_kernel(
 
     @pl.when((s_idx * blk <= jnp.max(qp_row)) & (s_idx * blk < kvl))
     def _compute():
-        q = q_ref[0]               # [K, GT, H]
-        k = k_ref[0]               # [K, BLK, H]
-        v = v_ref[0]
-        row_pos = s_idx * blk + jax.lax.broadcasted_iota(
-            jnp.int32, v.shape, dimension=1
+        m_new, l_new, acc_new = _flash_block_update(
+            q_ref[0], k_ref[0], v_ref[0], qp_row, kvl, s_idx, blk,
+            m_ref[:, :, :1], l_ref[:, :, :1], acc_ref[...],
+            scale=scale, sliding_window=sliding_window, kv_len=kv_len,
         )
-        v_z = jnp.where(row_pos < kv_len, v, 0)
-
-        scores = jax.lax.dot_general(
-            q, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [K, GT, BLK]
-
-        qp = qp_row[None, :, None]  # [1, GT, 1]
-        kv_pos = s_idx * blk + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, dimension=2
-        )
-        mask = (kv_pos <= qp) & (kv_pos < kvl)
-        if sliding_window is not None:
-            mask = mask & (qp - kv_pos < sliding_window)
-        scores = jnp.where(mask, scores, NEG_INF)
-
-        m_prev = m_ref[:, :, :1]                                 # [K, GT, 1]
-        l_prev = l_ref[:, :, :1]
-        m_cur = jnp.max(scores, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)
-        p = jnp.where(mask, p, 0.0)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-
-        pv = jax.lax.dot_general(
-            p.astype(v_z.dtype), v_z,
-            dimension_numbers=(((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32,
-        )  # [K, GT, H]
-        acc_ref[:] = acc_ref[:] * alpha + pv
+        acc_ref[:] = acc_new
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
@@ -292,8 +275,11 @@ def flash_gqa_attention(
 
     if t == 1:
         # Decode: fold the KV-head axis into the cell (see module docstring).
+        # Halving must keep blk sublane-aligned (multiple of 8): S is only
+        # guaranteed a multiple of 8, so e.g. blk=328 would halve to an
+        # unlowerable 164 — round down to the alignment each halving.
         while blk > 8 and kh * blk * h * k.dtype.itemsize > _DECODE_KV_BLOCK_BYTES:
-            blk //= 2
+            blk = max(8, (blk // 2) // 8 * 8)
         grid = (b, pl.cdiv(s, blk))
 
         def kv_map1(bi, si, kvl):

@@ -90,7 +90,7 @@ from jax import lax
 
 from ..engine.kvcache import bucket_len, init_cache
 from ..models.configs import LlamaConfig
-from ..models.llama import Params, forward
+from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
 from ..ops.sampling import SamplingParams, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
@@ -377,6 +377,10 @@ class ContinuousBatchingScheduler:
         @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 10))
         def decode(params, ck, cv, cur, pos, active, temps, topps, topks,
                    seeds, counts):
+            # Per-layer slices outside the chunk scan: decode-matmul layout
+            # conversions run once per round, not per token (split_blocks).
+            params = split_blocks(params)
+
             def step(carry, i):
                 ck, cv, cur, pos = carry
                 logits, cache = forward(
