@@ -137,8 +137,9 @@ def _make_generate_fn(
         out = out.at[:, 0].set(first)
         # Per-layer weight slices anchored OUTSIDE the decode loop: layout
         # conversions for the decode matmuls run once per call, not per
-        # token (split_blocks docstring).
-        dec_params = split_blocks(params)
+        # token (split_blocks docstring). Only the unrolled decode branch
+        # accepts pre-sliced params — a forced ring impl scans instead.
+        dec_params = params if decode_impl == "ring" else split_blocks(params)
 
         def cond(carry):
             out, cur, pos, done, cache, step = carry

@@ -246,6 +246,14 @@ def forward(
         x = attn_mlp(p, x, q, k_full, v_full, k, v)
         return x, (k_out, v_out)
 
+    if isinstance(params["blocks"], (list, tuple)) and not (
+        t == 1 and impl != "ring" and cache is not None
+    ):
+        raise ValueError(
+            "split_blocks params are only valid for the unrolled decode "
+            "path (T == 1, cached, non-ring impl); pass the stacked tree "
+            "for prefill/ring/no-cache forwards"
+        )
     if cache is None:
         # scan with no cache arrays: feed Nones via a python loop over stacked
         # params is wasteful; instead run scan with dummy empty caches.
