@@ -131,6 +131,11 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     from ..serve.scheduler import SchedulerBackend
     from ..tokenizer import HFTokenizer
 
+    if args.scheduler and getattr(args, "speculative", 0) > 0:
+        sys.exit("--speculative needs the engine serving path: the "
+                 "continuous-batching scheduler decodes per-slot chunks and "
+                 "does not speculate — pass --no-scheduler with "
+                 "--speculative")
     mesh = None
     scheduler_meshes = [None]
     if args.dp * args.sp * args.tp > 1:
@@ -208,11 +213,12 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
         if path.endswith(".gguf"):
             return EngineBackend.from_gguf(
                 path, tok, mesh=mesh, max_new_tokens=max_new_tokens,
-                add_bos=add_bos,
+                add_bos=add_bos, speculative_draft=getattr(args, "speculative", 0),
             )
         return EngineBackend.from_hf_checkpoint(
             path, tok, mesh=mesh, quantize_int8=args.int8,
             max_new_tokens=max_new_tokens, add_bos=add_bos,
+            speculative_draft=getattr(args, "speculative", 0),
         )
 
     from ..serve.factory import assemble_reference_service
@@ -238,6 +244,11 @@ def main(argv=None) -> None:
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--sp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--speculative", type=int, default=0, metavar="N",
+                    help="prompt-lookup speculative decoding: draft N tokens "
+                         "per round for greedy requests (engine backends "
+                         "with --no-scheduler; copy-heavy NL→SQL "
+                         "workloads on real checkpoints benefit most)")
     ap.add_argument("--int8", action="store_true",
                     help="int8 weight-only quantization (HF checkpoints)")
     ap.add_argument("--scheduler", action=argparse.BooleanOptionalAction,

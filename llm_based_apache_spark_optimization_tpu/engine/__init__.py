@@ -2,3 +2,7 @@
 
 from .generate import InferenceEngine, make_generate_fn  # noqa: F401
 from .kvcache import bucket_len, cache_bytes, init_cache  # noqa: F401
+from .speculative import (  # noqa: F401
+    make_speculative_generate_fn,
+    ngram_draft,
+)

@@ -187,9 +187,20 @@ class InferenceEngine:
         prompt_bucket: int = 128,
         mesh=None,
         new_bucket: int = 64,
+        speculative_draft: int = 0,
+        speculative_ngram: int = 3,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # Prompt-lookup speculative decoding (engine/speculative.py): greedy
+        # requests draft `speculative_draft` tokens per round by n-gram
+        # lookup over prompt+history and verify them in one forward. 0
+        # disables. Sampled requests always take the vanilla loop.
+        self.speculative_draft = speculative_draft
+        self.speculative_ngram = speculative_ngram
+        # Diagnostics from the last speculative generate: verify rounds vs
+        # tokens emitted (rounds << tokens means drafts were accepted).
+        self.last_spec_rounds: Optional[int] = None
         if mesh is not None:
             params = shard_params(params, cfg, mesh)
         self.params = params
@@ -243,12 +254,25 @@ class InferenceEngine:
             tokens, lengths = shard_batch((tokens, lengths), self.mesh)
         cap = min(bucket_len(int(max_new_tokens), self.new_bucket),
                   self.cfg.max_seq_len - t)
-        fn = make_generate_fn(
-            self.cfg, cap, sampling, self.stop_ids, self.mesh,
-        )
-        out, gen_lens = fn(
-            self.params, tokens, lengths, jnp.int32(max_new_tokens),
-            jax.random.key(seed),
-        )
+        if self.speculative_draft > 0 and sampling.is_greedy:
+            from .speculative import make_speculative_generate_fn
+
+            fn = make_speculative_generate_fn(
+                self.cfg, cap, self.stop_ids, self.mesh,
+                self.speculative_draft, self.speculative_ngram,
+            )
+            out, gen_lens, rounds = fn(
+                self.params, tokens, lengths, jnp.int32(max_new_tokens)
+            )
+            self.last_spec_rounds = int(jax.device_get(rounds))
+        else:
+            self.last_spec_rounds = None  # this call ran no speculation
+            fn = make_generate_fn(
+                self.cfg, cap, sampling, self.stop_ids, self.mesh,
+            )
+            out, gen_lens = fn(
+                self.params, tokens, lengths, jnp.int32(max_new_tokens),
+                jax.random.key(seed),
+            )
         out, gen_lens = jax.device_get(out), jax.device_get(gen_lens)
         return [list(map(int, out[i, : gen_lens[i]])) for i in range(b)]

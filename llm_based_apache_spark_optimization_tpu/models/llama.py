@@ -50,6 +50,12 @@ from .configs import LlamaConfig
 
 Params = Dict[str, jnp.ndarray]
 
+# Cached forwards up to this many tokens take the unrolled layer loop (in-
+# place cache slivers); longer ones (prefill) scan — the scan path's per-call
+# cache restack amortizes over many tokens, and unrolling a long-T body would
+# only grow the program. Covers decode (T=1) and speculative-verify windows.
+_UNROLL_MAX_T = 32
+
 
 def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     """Random-init params with the exact tree structure the weight loader fills.
@@ -246,13 +252,12 @@ def forward(
         x = attn_mlp(p, x, q, k_full, v_full, k, v)
         return x, (k_out, v_out)
 
-    if isinstance(params["blocks"], (list, tuple)) and not (
-        t == 1 and impl != "ring" and cache is not None
-    ):
+    unroll = t <= _UNROLL_MAX_T and impl != "ring" and cache is not None
+    if isinstance(params["blocks"], (list, tuple)) and not unroll:
         raise ValueError(
-            "split_blocks params are only valid for the unrolled decode "
-            "path (T == 1, cached, non-ring impl); pass the stacked tree "
-            "for prefill/ring/no-cache forwards"
+            f"split_blocks params are only valid for the unrolled decode "
+            f"path (T <= {_UNROLL_MAX_T}, cached, non-ring impl); pass the "
+            f"stacked tree for prefill/ring/no-cache forwards"
         )
     if cache is None:
         # scan with no cache arrays: feed Nones via a python loop over stacked
@@ -262,8 +267,9 @@ def forward(
             return y, None
         x, _ = lax.scan(block_nocache, x, params["blocks"])
         new_cache = None
-    elif t == 1 and impl != "ring":
-        # Decode: unrolled layer loop with in-place sliver writes into the
+    elif unroll:
+        # Decode (and small-T cached forwards, e.g. speculative-verify
+        # windows): unrolled layer loop with in-place sliver writes into the
         # stacked cache (static layer indices). Scanning the cache through
         # xs/ys copies each layer's cache several times PER STEP — see the
         # module docstring for the measured cost.

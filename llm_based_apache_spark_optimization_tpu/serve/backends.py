@@ -81,6 +81,7 @@ class EngineBackend:
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
+        speculative_draft: int = 0,
         **kwargs,
     ) -> "EngineBackend":
         """Stand up a backend straight from an HF-format checkpoint directory
@@ -88,7 +89,10 @@ class EngineBackend:
 
         `quantize_int8=True` converts the block matmul weights to int8
         QTensors before placement (ops/quant.py) — halves weight HBM
-        traffic for bandwidth-bound decode."""
+        traffic for bandwidth-bound decode. `speculative_draft=N` turns on
+        prompt-lookup speculative decoding for greedy requests
+        (engine/speculative.py — the NL→SQL copy-heavy workload is its
+        sweet spot)."""
         import jax.numpy as jnp
 
         from ..checkpoint import load_hf_checkpoint
@@ -113,6 +117,7 @@ class EngineBackend:
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
+            speculative_draft=speculative_draft,
         )
         return cls(engine, tokenizer, **kwargs)
 
@@ -126,6 +131,7 @@ class EngineBackend:
         dtype=None,
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
+        speculative_draft: int = 0,
         **kwargs,
     ) -> "EngineBackend":
         """Stand up a backend from a GGUF blob — the exact file format the
@@ -138,6 +144,7 @@ class EngineBackend:
         )
         engine = InferenceEngine(
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
+            speculative_draft=speculative_draft,
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
         )

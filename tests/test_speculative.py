@@ -1,0 +1,147 @@
+"""Prompt-lookup speculative decoding (engine/speculative.py).
+
+The load-bearing property: greedy speculative output is EXACTLY vanilla
+greedy output, regardless of draft quality — drafts only change how many
+forwards it takes, never what gets emitted.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+from llm_based_apache_spark_optimization_tpu.engine.speculative import (
+    make_speculative_generate_fn,
+    ngram_draft,
+)
+from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+from llm_based_apache_spark_optimization_tpu.ops.sampling import SamplingParams
+
+
+def test_ngram_draft_copies_after_last_match():
+    # history: ... [7 8] 5 6 ... [7 8] <- suffix; draft should copy "5 6 ..."
+    # from after the EARLIER [7 8].
+    hist = jnp.asarray([[1, 7, 8, 5, 6, 2, 9, 7, 8, 0, 0, 0]], jnp.int32)
+    hlen = jnp.asarray([9], jnp.int32)  # suffix = hist[7:9] = [7, 8]
+    d = ngram_draft(hist, hlen, draft_len=3, ngram=2)
+    np.testing.assert_array_equal(np.asarray(d)[0], [5, 6, 2])
+
+
+def test_ngram_draft_no_match_is_harmless_shape():
+    hist = jnp.asarray([[1, 2, 3, 4, 5, 0, 0, 0]], jnp.int32)
+    hlen = jnp.asarray([5], jnp.int32)
+    d = ngram_draft(hist, hlen, draft_len=4, ngram=2)
+    assert d.shape == (1, 4)  # contents are a junk draft; verify rejects
+
+
+def test_ngram_draft_picks_most_recent_match():
+    # [3 4] occurs twice before the suffix; the LATER one (followed by 9)
+    # must win over the earlier one (followed by 5).
+    hist = jnp.asarray([[3, 4, 5, 1, 3, 4, 9, 2, 3, 4, 0, 0]], jnp.int32)
+    hlen = jnp.asarray([10], jnp.int32)  # suffix = [3, 4]
+    d = ngram_draft(hist, hlen, draft_len=2, ngram=2)
+    np.testing.assert_array_equal(np.asarray(d)[0], [9, 2])
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TINY
+    params = init_params(cfg, jax.random.key(7), dtype=jnp.float32)
+    return cfg, params
+
+
+PROMPTS = [
+    [1, 5, 9, 5, 9, 5, 9],          # repetitive: drafts should hit
+    [1, 7],                          # short
+    [1, 3, 4, 8, 10, 2, 6, 11, 12],  # mixed
+]
+
+
+@pytest.mark.parametrize("draft_len,ngram", [(4, 2), (8, 3), (2, 2)])
+def test_speculative_matches_vanilla_greedy(tiny, draft_len, ngram):
+    cfg, params = tiny
+    ref = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+    spec = InferenceEngine(
+        cfg, params, stop_ids=(-1,), prompt_bucket=8,
+        speculative_draft=draft_len, speculative_ngram=ngram,
+    )
+    golden = ref.generate(PROMPTS, max_new_tokens=12)
+    out = spec.generate(PROMPTS, max_new_tokens=12)
+    assert out == golden
+    assert spec.last_spec_rounds is not None and spec.last_spec_rounds >= 1
+
+
+def test_speculative_respects_stop_ids(tiny):
+    cfg, params = tiny
+    # Discover what vanilla greedy emits, then declare its 3rd token a stop
+    # id: both engines must truncate identically (stop token included, the
+    # vanilla engine's convention).
+    probe = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+    toks = probe.generate([PROMPTS[2]], max_new_tokens=8)[0]
+    stop = toks[2]
+    ref = InferenceEngine(cfg, params, stop_ids=(stop,), prompt_bucket=8)
+    spec = InferenceEngine(cfg, params, stop_ids=(stop,), prompt_bucket=8,
+                           speculative_draft=4)
+    assert spec.generate(PROMPTS, max_new_tokens=8) == ref.generate(
+        PROMPTS, max_new_tokens=8
+    )
+
+
+def test_speculative_budget_edges(tiny):
+    cfg, params = tiny
+    ref = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+    spec = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                           speculative_draft=8)
+    for budget in (1, 2, 7, 8, 9):
+        assert spec.generate(PROMPTS, max_new_tokens=budget) == ref.generate(
+            PROMPTS, max_new_tokens=budget
+        ), f"divergence at budget={budget}"
+
+
+def test_sampled_requests_fall_back_to_vanilla(tiny):
+    cfg, params = tiny
+    sp = SamplingParams(temperature=0.8, top_p=0.9)
+    ref = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+    spec = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                           speculative_draft=4)
+    assert spec.generate(PROMPTS, max_new_tokens=6, sampling=sp, seed=3) == \
+        ref.generate(PROMPTS, max_new_tokens=6, sampling=sp, seed=3)
+
+
+def test_acceptance_on_copying_model(tiny):
+    """A zeroed-blocks model reduces to logits = rms(embed[tok]) @ embed.T,
+    whose greedy argmax is (for this seed) the input token itself — the
+    model emits an endless repeat. Prompt-lookup drafts nail that, so the
+    loop must finish in far fewer verify rounds than tokens."""
+    cfg, params = tiny
+    zeroed = dict(params)
+    zeroed["blocks"] = {
+        k: (jnp.zeros_like(v) if k.startswith("w") else v)
+        for k, v in params["blocks"].items()
+    }
+    # Confirm the premise (self-argmax) before relying on it.
+    probe = InferenceEngine(cfg, zeroed, stop_ids=(-1,), prompt_bucket=8)
+    toks = probe.generate([[1, 5, 5, 5]], max_new_tokens=8)[0]
+    if len(set(toks)) != 1:
+        pytest.skip("seed does not give a self-copying zeroed model")
+    spec = InferenceEngine(cfg, zeroed, stop_ids=(-1,), prompt_bucket=8,
+                           speculative_draft=8, speculative_ngram=2)
+    out = spec.generate([[1, 5, 5, 5]], max_new_tokens=16)[0]
+    assert out[: len(toks)] == toks  # same stream as vanilla, extended
+    assert len(out) == 16
+    assert spec.last_spec_rounds <= 4, (
+        f"expected heavy draft acceptance, got {spec.last_spec_rounds} rounds "
+        f"for 16 tokens"
+    )
+
+
+def test_speculative_fn_rounds_bounded(tiny):
+    cfg, params = tiny
+    fn = make_speculative_generate_fn(cfg, 8, (-1,), None, 4, 2)
+    tokens = jnp.asarray([[1, 5, 9, 5, 9, 0, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([5], jnp.int32)
+    out, lens, rounds = fn(params, tokens, lengths, jnp.int32(8))
+    assert out.shape == (1, 8)
+    assert int(lens[0]) == 8
+    assert 1 <= int(rounds) <= 8
