@@ -20,14 +20,19 @@ prefill followed by a SQL-sized completion. The detail breakdown (prefill vs
 decode split, decode MFU vs the chip's peak, HBM bandwidth utilization —
 decode is weight+cache streaming bound) is ALWAYS included; on accelerators
 two sub-benchmarks fold into the same JSON line:
-  "int8":      int8 weight-only quant at B=8 (speedup vs the bf16 primary)
-               and B=32 (throughput headline)
-  "scheduler": continuous-batching scheduler driven by 4×slots concurrent
-               submitter threads — the serving path's number (the component
-               that replaces Ollama's queue; reference serializes requests,
-               `FastAPI/app.py:85-90`)
-(BENCH_INT8=0 / BENCH_SCHED=0 skip them; they default off on the CPU
-fallback, where their compile+run time would blow the watchdog budget.)
+  "int8":         int8 weight-only quant at B=8 (speedup vs the bf16
+                  primary, plus the decode-only split) and B=32
+                  (throughput headline)
+  "scheduler":    continuous-batching scheduler driven by 4×slots
+                  concurrent submitter threads — the serving path's number
+                  (the component that replaces Ollama's queue; reference
+                  serializes requests, `FastAPI/app.py:85-90`)
+  "long_context": B=16 prompt=1024 — the shape where KV-cache bytes rival
+                  weight bytes — stacking int8 weights and the int8 KV
+                  cache
+(BENCH_INT8=0 / BENCH_SCHED=0 / BENCH_LONG=0 skip them; they default off on
+the CPU fallback, where their compile+run time would blow the watchdog
+budget.)
 
 Baseline derivation (BASELINE.md): the reference's best model (DuckDB-NSQL via
 Ollama) averages 8.05 s per NL→SQL query over its four-query suite for
@@ -78,12 +83,12 @@ def outer() -> int:
     """Run the inner bench under a hard timeout; retry accel, fall back to CPU."""
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     # Budgets: a healthy TPU run is compiles (primary + int8 engines +
-    # scheduler prefill/decode variants, ~2-4 min total) + tens of seconds
-    # of measuring; 700s/attempt absorbs that plus a slow tunnel bring-up.
-    # Worst case (tunnel dead, 2 accel attempts + backoff + CPU fallback)
-    # stays under ~45 min so the driver's end-of-round bench never sees a
-    # hung process.
-    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "700"))
+    # scheduler prefill/decode variants + 3 long-context engines, ~4-6 min
+    # total) + a minute of measuring; 1100s/attempt absorbs that plus a
+    # slow tunnel bring-up. Worst case (tunnel dead, 2 accel attempts +
+    # backoff + CPU fallback) stays under ~60 min so the driver's
+    # end-of-round bench never sees a hung process.
+    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1100"))
     cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1200"))
     tpu_retries = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
 
@@ -200,6 +205,7 @@ def inner() -> int:
     sub_default = "0" if on_cpu else "1"
     with_int8 = os.environ.get("BENCH_INT8", sub_default) == "1"
     with_sched = os.environ.get("BENCH_SCHED", sub_default) == "1"
+    with_long = os.environ.get("BENCH_LONG", sub_default) == "1"
 
     dev = jax.devices()[0]
     platform, device_kind = dev.platform, dev.device_kind
@@ -262,9 +268,54 @@ def inner() -> int:
         result["scheduler"] = _bench_scheduler(
             cfg, params, prompt_len, max_new, batch,
         )
+    if with_long:
+        result["long_context"] = _bench_long(cfg, params)
 
     _emit(result)
     return 0
+
+
+def _bench_long(cfg, params) -> dict:
+    """Long-context leg: B=16, prompt=1024, new=512 — the shape where the
+    KV cache rivals the weights for decode bytes. Three variants stack the
+    quantization levers: bf16, int8 weights, int8 weights + int8 KV cache
+    (ops/quant.quantize_kv). Lean on purpose (1 timed rep each) to stay
+    inside the outer watchdog."""
+    import time as _t
+
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.ops import quantize_params
+
+    b = int(os.environ.get("BENCH_LONG_BATCH", "16"))
+    p = min(int(os.environ.get("BENCH_LONG_PROMPT", "1024")),
+            cfg.max_seq_len // 2)
+    n = min(int(os.environ.get("BENCH_LONG_NEW", "512")),
+            cfg.max_seq_len - p)
+    rng = np.random.default_rng(2)
+    prompts = [
+        [int(x) for x in rng.integers(3, cfg.vocab_size, size=p)]
+        for _ in range(b)
+    ]
+    out = {"batch": b, "prompt": p, "new": n}
+    params8 = quantize_params(params)
+    for key, ps, kvq in (
+        ("bf16_tok_s", params, None),
+        ("int8_tok_s", params8, None),
+        ("int8_kv8_tok_s", params8, "int8"),
+    ):
+        eng = InferenceEngine(cfg, ps, stop_ids=(-1,), prompt_bucket=p,
+                              kv_quant=kvq)
+        eng.generate(prompts, max_new_tokens=n)  # warmup+compile
+        t0 = _t.perf_counter()
+        res = eng.generate(prompts, max_new_tokens=n)
+        out[key] = round(sum(len(o) for o in res) / (_t.perf_counter() - t0), 1)
+        del eng
+    out["int8_kv8_speedup_vs_bf16"] = round(
+        out["int8_kv8_tok_s"] / out["bf16_tok_s"], 2
+    )
+    return out
 
 
 def _bench_int8(cfg, params, prompt_len, max_new, batch, bf16_tok_s,

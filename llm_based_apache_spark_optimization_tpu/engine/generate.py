@@ -53,6 +53,7 @@ def make_generate_fn(
     stop_ids: Tuple[int, ...],
     mesh=None,
     attn_impl: Optional[str] = None,
+    kv_quant: Optional[str] = None,
 ):
     """Resolve the attention impl *outside* the cache boundary so a
     set_attention_impl() flip between calls maps to a different cache key
@@ -70,11 +71,18 @@ def make_generate_fn(
     streaming has nothing to bound there and its per-cell overhead is pure
     loss (measured: einsum decode 2160 vs kernel 1978 tok/s at B=8, 4091 vs
     2779 at B=32 on v5e). An explicit `attn_impl` forces both phases.
+
+    `kv_quant="int8"` stores the decode-time KV cache as int8 with per-slot
+    scales: prefill fills the normal bf16 cache, one pass quantizes it
+    (ops/quant.quantize_kv), and every decode step streams half the cache
+    bytes (decode is cache-streaming-bound at long context). Requires the
+    einsum decode impl (the auto default).
     """
     return _make_generate_fn(
         cfg, max_new, sampling, stop_ids, mesh,
         attn_impl or attention_impl(mesh),
         attn_impl or decode_attention_impl(mesh),
+        kv_quant,
     )
 
 
@@ -87,6 +95,7 @@ def _make_generate_fn(
     mesh,
     attn_impl: str,
     decode_impl: str,
+    kv_quant: Optional[str] = None,
 ):
     """Build + jit a generate function for a fixed decode-budget cap and sampler.
 
@@ -106,6 +115,13 @@ def _make_generate_fn(
     # resolved single-block impl — its T=1 queries have nothing to shard.
     sp = dict(mesh.shape).get("sp", 1) if mesh is not None else 1
     prefill_impl = "ring" if sp > 1 else impl
+    if kv_quant not in (None, "int8"):
+        raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
+    if kv_quant and decode_impl != "xla":
+        raise ValueError(
+            "kv_quant='int8' needs the einsum decode impl (the auto "
+            f"default); decode resolved to {decode_impl!r}"
+        )
 
     def gen(
         params: Params,
@@ -140,6 +156,18 @@ def _make_generate_fn(
         # token (split_blocks docstring). Only the unrolled decode branch
         # accepts pre-sliced params — a forced ring impl scans instead.
         dec_params = params if decode_impl == "ring" else split_blocks(params)
+
+        if kv_quant:
+            # One-pass cache quantization between prefill and decode: the
+            # loop carries int8 values + f32 per-slot scales and every step
+            # streams ~half the cache bytes (ops/quant.quantize_kv).
+            from ..ops.quant import quantize_kv
+
+            kq, vq = quantize_kv(cache["k"]), quantize_kv(cache["v"])
+            cache = {"k8": kq["q8"], "ks": kq["s"],
+                     "v8": vq["q8"], "vs": vq["s"]}
+            if mesh is not None:
+                cache = constrain_cache(cache, mesh)
 
         def cond(carry):
             out, cur, pos, done, cache, step = carry
@@ -189,9 +217,21 @@ class InferenceEngine:
         new_bucket: int = 64,
         speculative_draft: int = 0,
         speculative_ngram: int = 3,
+        kv_quant: Optional[str] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # "int8": decode streams an int8 KV cache (half the cache bytes;
+        # make_generate_fn docstring). Greedy/sampled both supported; the
+        # speculative path has no int8-KV variant, and silently dropping a
+        # requested memory/bandwidth mode would misattribute results — so
+        # the combination is rejected up front.
+        if kv_quant and speculative_draft:
+            raise ValueError(
+                "kv_quant and speculative_draft cannot combine: the "
+                "speculative verify loop streams the bf16 cache"
+            )
+        self.kv_quant = kv_quant
         # Prompt-lookup speculative decoding (engine/speculative.py): greedy
         # requests draft `speculative_draft` tokens per round by n-gram
         # lookup over prompt+history and verify them in one forward. 0
@@ -269,6 +309,7 @@ class InferenceEngine:
             self.last_spec_rounds = None  # this call ran no speculation
             fn = make_generate_fn(
                 self.cfg, cap, sampling, self.stop_ids, self.mesh,
+                kv_quant=self.kv_quant,
             )
             out, gen_lens = fn(
                 self.params, tokens, lengths, jnp.int32(max_new_tokens),

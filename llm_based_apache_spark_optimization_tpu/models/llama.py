@@ -40,7 +40,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.attention import attention_mask, gqa_attention
+from ..ops.attention import (
+    attention_mask,
+    gqa_attention,
+    gqa_attention_quantized,
+)
 from ..ops.norm import rms_norm
 from ..ops.pallas import flash_gqa_attention, sharded_flash_gqa_attention
 from ..ops.quant import mm
@@ -121,6 +125,24 @@ def _update_cache(cache: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray) -> j
     )(cache, new.transpose(0, 2, 1, 3), start.astype(jnp.int32))
 
 
+def _update_scale_layer(
+    scales: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray, layer: int
+) -> jnp.ndarray:
+    """Write per-slot quant scales `new` [B, T, K] into the stacked scale
+    tensor [L, B, K, S] at a static layer index and per-batch offsets (the
+    int8-KV companion of `_update_cache_layer`; same per-row static-index
+    DUS chain, same in-place reasoning)."""
+    b = new.shape[0]
+    upd = new.transpose(0, 2, 1)  # [B, K, T]
+    start = start.astype(jnp.int32)
+    for row in range(b):
+        scales = lax.dynamic_update_slice(
+            scales, upd[row][None, None].astype(scales.dtype),
+            (layer, row, 0, start[row]),
+        )
+    return scales
+
+
 def _update_cache_layer(
     cache: jnp.ndarray, new: jnp.ndarray, start: jnp.ndarray, layer: int
 ) -> jnp.ndarray:
@@ -180,8 +202,11 @@ def forward(
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     start = positions[:, 0]
 
+    quant_cache = cache is not None and "k8" in cache
     if cache is None:
         kv_size = t
+    elif quant_cache:
+        kv_size = cache["k8"].shape[3]
     else:
         kv_size = cache["k"].shape[3]
     # Default is the always-correct einsum path: a bare forward() cannot see
@@ -191,6 +216,13 @@ def forward(
     impl = attn_impl
     if impl == "ring" and mesh is None:
         raise ValueError('attn_impl="ring" requires a mesh with an "sp" axis')
+    if quant_cache and (impl != "xla" or t > _UNROLL_MAX_T):
+        raise ValueError(
+            "an int8 KV cache needs the einsum impl and the unrolled "
+            f"small-T path (T <= {_UNROLL_MAX_T}): the flash kernel and the "
+            "prefill scan stream bf16 caches (engine prefill fills bf16, "
+            "then quantizes once — engine/generate.py)"
+        )
     mask = (
         attention_mask(positions, kv_size, cfg.sliding_window)
         if impl == "xla"
@@ -232,6 +264,9 @@ def forward(
             )
         else:
             attn = gqa_attention(q, k_full, v_full, mask)
+        return post_attn(p, x, attn)
+
+    def post_attn(p, x, attn):
         x = x + mm(attn.reshape(b, t, nh * hd), p["wo"])
         h2 = rms_norm(x, p["ln_mlp"], cfg.norm_eps)
         gate = jax.nn.silu(mm(h2, p["wg"]).astype(jnp.float32)).astype(x.dtype)
@@ -282,17 +317,40 @@ def forward(
         # ms/step of repeated weight re-layout copies); pre-sliced params
         # anchor those conversions outside the loop, once per call.
         blocks = params["blocks"]
-        ck, cv = cache["k"], cache["v"]
+        new_cache = dict(cache)
         for l in range(cfg.num_layers):
-            if isinstance(blocks, (list, tuple)):
-                p = blocks[l]
-            else:
-                p = jax.tree.map(lambda a, _l=l: a[_l], blocks)
+            p = (blocks[l] if isinstance(blocks, (list, tuple))
+                 else jax.tree.map(lambda a, _l=l: a[_l], blocks))
             q, k, v = qkv(p, x)
-            ck = _update_cache_layer(ck, k, start, l)
-            cv = _update_cache_layer(cv, v, start, l)
-            x = attn_mlp(p, x, q, ck[l], cv[l], k, v)
-        new_cache = {"k": ck, "v": cv}
+            if quant_cache:
+                # int8 KV: quantize the fresh sliver (absmax over H), write
+                # value+scale with the same static-index DUS chains, attend
+                # with the int8-streaming einsum
+                # (ops/attention.gqa_attention_quantized).
+                from ..ops.quant import quantize_kv
+
+                kq = quantize_kv(k)  # values [B, T, K, H], scales [B, T, K]
+                vq = quantize_kv(v)
+                new_cache["k8"] = _update_cache_layer(
+                    new_cache["k8"], kq["q8"], start, l)
+                new_cache["ks"] = _update_scale_layer(
+                    new_cache["ks"], kq["s"], start, l)
+                new_cache["v8"] = _update_cache_layer(
+                    new_cache["v8"], vq["q8"], start, l)
+                new_cache["vs"] = _update_scale_layer(
+                    new_cache["vs"], vq["s"], start, l)
+                attn = gqa_attention_quantized(
+                    q, new_cache["k8"][l], new_cache["ks"][l],
+                    new_cache["v8"][l], new_cache["vs"][l], mask,
+                )
+                x = post_attn(p, x, attn)
+            else:
+                new_cache["k"] = _update_cache_layer(
+                    new_cache["k"], k, start, l)
+                new_cache["v"] = _update_cache_layer(
+                    new_cache["v"], v, start, l)
+                x = attn_mlp(p, x, q, new_cache["k"][l], new_cache["v"][l],
+                             k, v)
     else:
         x, (k_new, v_new) = lax.scan(
             block, x, (params["blocks"], cache["k"], cache["v"])

@@ -66,3 +66,38 @@ def gqa_attention(
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bksh->btkgh", probs.astype(v.dtype), v)
     return out.reshape(b, t, n, h)
+
+
+def gqa_attention_quantized(
+    q: jnp.ndarray,   # [B, T, N, H]
+    k8: jnp.ndarray,  # [B, K, S, H] int8
+    ks: jnp.ndarray,  # [B, K, S] f32 — per-slot K scales
+    v8: jnp.ndarray,  # [B, K, S, H] int8
+    vs: jnp.ndarray,  # [B, K, S] f32 — per-slot V scales
+    mask: jnp.ndarray,  # [B, T, S] bool
+) -> jnp.ndarray:
+    """`gqa_attention` over an int8 KV cache (ops/quant.quantize_kv).
+
+    Both contractions stream the int8 arrays DIRECTLY (the same
+    mixed-precision-dot rule as ops/quant.mm — an `astype` first would
+    materialize a bf16 copy): K's per-slot scales multiply the score
+    columns after the QK^T dot, and V's fold into the probabilities before
+    the PV dot. Numerically identical to dequantizing the cache and
+    calling `gqa_attention` (asserted in tests), at half the HBM traffic.
+    """
+    b, t, n, h = q.shape
+    kh, s = k8.shape[1], k8.shape[2]
+    g = n // kh
+    scale = h ** -0.5
+    q5 = q.reshape(b, t, kh, g, h)
+    scores = jnp.einsum(
+        "btkgh,bksh->bkgts", q5, k8, preferred_element_type=jnp.float32
+    ) * (ks.astype(jnp.float32)[:, :, None, None, :] * scale)
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    pv = probs * vs.astype(jnp.float32)[:, :, None, None, :]
+    out = jnp.einsum(
+        "bkgts,bksh->btkgh", pv.astype(q.dtype), v8,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype).reshape(b, t, n, h)

@@ -117,20 +117,25 @@ def _flash_block_update(
 
 def _flash_kernel(
     kvlen_ref,  # [B] i32 SMEM (scalar prefetch) — valid KV slots per row
-    qpos_ref,  # [1, 1, GT] i32   (positions tiled over the G query groups)
-    q_ref,     # [1, 1, GT, H]
+    qpos_ref,  # [1, 1, QB] i32   (this q-block's positions)
+    q_ref,     # [1, 1, QB, H]
     k_ref,     # [1, 1, BLK, H]
     v_ref,     # [1, 1, BLK, H]
-    o_ref,     # [1, 1, GT, H]
-    m_ref,     # [GT, LANES] f32 scratch — running row max (lane-broadcast)
-    l_ref,     # [GT, LANES] f32 scratch — running denominator
-    acc_ref,   # [GT, H] f32 scratch — running weighted V sum
+    o_ref,     # [1, 1, QB, H]
+    m_ref,     # [QB, LANES] f32 scratch — running row max (lane-broadcast)
+    l_ref,     # [QB, LANES] f32 scratch — running denominator
+    acc_ref,   # [QB, H] f32 scratch — running weighted V sum
     *,
     scale: float,
     sliding_window: Optional[int],
     kv_len: int,
 ):
-    s_idx = pl.program_id(2)
+    """Grid = (B, K, Q_blocks, S_blocks): the G·T query-row axis tiles into
+    QB-row blocks so VMEM scratch stays bounded at long prompts (an untiled
+    T=1024 GQA prefill needs ~27 MB of scratch against the ~16 MB/core
+    limit). S-blocks run innermost, so each q-block's online-softmax
+    accumulators live across its S sweep and re-init at the next q-block."""
+    s_idx = pl.program_id(3)
     blk = k_ref.shape[2]
     kvl = kvlen_ref[pl.program_id(0)]
 
@@ -140,15 +145,16 @@ def _flash_kernel(
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    qp_row = qpos_ref[0, 0]       # [GT]
+    qp_row = qpos_ref[0, 0]       # [QB]
 
     # Causal block skip: a KV block whose first slot already exceeds every
-    # query position — or this row's live KV length — contributes nothing:
-    # skip its matmuls entirely. For a from-zero prefill this halves average
-    # work (the classic upper-triangle saving of causal flash attention);
-    # for a kv_len=0 row (parked scheduler slot) nothing runs at all. The
-    # grid step still executes (Pallas can't skip grid cells), but its K/V
-    # DMA was elided by the clamped index map and the MXU does nothing.
+    # query position in THIS q-block — or this row's live KV length —
+    # contributes nothing: skip its matmuls entirely. For a from-zero
+    # prefill this halves average work (the classic upper-triangle saving
+    # of causal flash attention); for a kv_len=0 row (parked scheduler
+    # slot) nothing runs at all. The grid step still executes (Pallas can't
+    # skip grid cells), but its K/V DMA was elided by the clamped index map
+    # and the MXU does nothing.
     @pl.when((s_idx * blk <= jnp.max(qp_row)) & (s_idx * blk < kvl))
     def _compute():
         m_new, l_new, acc_new = _flash_block_update(
@@ -160,7 +166,7 @@ def _flash_kernel(
         m_ref[:] = jnp.broadcast_to(m_new[0], m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new[0], l_ref.shape)
 
-    @pl.when(s_idx == pl.num_programs(2) - 1)
+    @pl.when(s_idx == pl.num_programs(3) - 1)
     def _finalize():
         l = l_ref[:, :1]
         out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
@@ -325,9 +331,20 @@ def flash_gqa_attention(
         )(kv_lens, qpos, q5, k, v)
         return out.reshape(b, kh, g, t, h).transpose(0, 3, 1, 2, 4).reshape(b, t, n, h)
 
-    grid = (b, kh, pl.cdiv(s, blk))
+    # Q-tiling bounds the per-cell scratch (kernel docstring). A tile must
+    # satisfy Mosaic's block constraints where it appears: qblk is the LANE
+    # dim of the qpos block (multiple of 128, or the full GT axis) and the
+    # sublane dim of the q/o blocks (covered by any 128 multiple). Fall
+    # back to untiled when GT has no 128-multiple factor — small GT is
+    # exactly where scratch fits anyway.
+    qblk = gt
+    for cand in (512, 256, 128):
+        if gt % cand == 0:
+            qblk = cand
+            break
+    grid = (b, kh, gt // qblk, pl.cdiv(s, blk))
 
-    def kv_map(bi, ki, si, kvl):
+    def kv_map(bi, ki, qb, si, kvl):
         # Same clamp as kv_map1, per (row, kv-head) cell.
         last = jnp.maximum((kvl[bi] + blk - 1) // blk - 1, 0)
         return (bi, ki, jnp.minimum(si, last), 0)
@@ -336,18 +353,20 @@ def flash_gqa_attention(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, gt), lambda bi, ki, si, kvl: (bi, 0, 0)),
-            pl.BlockSpec((1, 1, gt, h), lambda bi, ki, si, kvl: (bi, ki, 0, 0)),
+            pl.BlockSpec((1, 1, qblk), lambda bi, ki, qb, si, kvl: (bi, 0, qb)),
+            pl.BlockSpec(
+                (1, 1, qblk, h), lambda bi, ki, qb, si, kvl: (bi, ki, qb, 0)
+            ),
             pl.BlockSpec((1, 1, blk, h), kv_map),
             pl.BlockSpec((1, 1, blk, h), kv_map),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, gt, h), lambda bi, ki, si, kvl: (bi, ki, 0, 0)
+            (1, 1, qblk, h), lambda bi, ki, qb, si, kvl: (bi, ki, qb, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((gt, _LANES), jnp.float32),
-            pltpu.VMEM((gt, _LANES), jnp.float32),
-            pltpu.VMEM((gt, h), jnp.float32),
+            pltpu.VMEM((qblk, _LANES), jnp.float32),
+            pltpu.VMEM((qblk, _LANES), jnp.float32),
+            pltpu.VMEM((qblk, h), jnp.float32),
         ],
     )
     out = pl.pallas_call(
@@ -357,11 +376,13 @@ def flash_gqa_attention(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, gt, h), q.dtype),
-        # batch and KV-head cells are independent -> megacore can split them;
-        # the S axis carries the online-softmax accumulators and must run
-        # in order on one core.
+        # batch and KV-head cells are independent -> megacore can split
+        # them; the q-block axis reuses the scratch accumulators (marked
+        # arbitrary so one core sweeps a q-block's S-blocks in order), and
+        # the S axis carries the online-softmax state.
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=("parallel", "parallel", "arbitrary",
+                                 "arbitrary"),
         ),
         interpret=interpret,
     )(kv_lens, qpos, q5, k, v)

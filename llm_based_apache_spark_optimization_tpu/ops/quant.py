@@ -65,6 +65,23 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def quantize_kv(x: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+    """Quantize K or V cache tensors [..., S, H] to int8 with one f32 scale
+    per slot (absmax over the head dim).
+
+    The TPU counterpart of llama.cpp's q8_0 KV-cache type: decode attention
+    is cache-streaming-bound at long context, and int8 storage halves that
+    traffic. Per-slot scaling keeps the error local to a token — attention
+    applies K scales to the score row and folds V scales into the
+    probabilities, so both dots stream int8 directly (ops/attention.
+    gqa_attention_quantized)."""
+    x32 = x.astype(jnp.float32)
+    s = jnp.max(jnp.abs(x32), axis=-1) / 127.0      # [..., S]
+    s = jnp.where(s == 0.0, 1.0, s)
+    q8 = jnp.clip(jnp.round(x32 / s[..., None]), -127, 127).astype(jnp.int8)
+    return {"q8": q8, "s": s}
+
+
 def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """x @ w for a plain array or a QTensor (dequant fused into the matmul).
 

@@ -104,10 +104,13 @@ def shard_batch(tokens: Pytree, mesh: Mesh) -> Pytree:
 
 
 def constrain_cache(cache: Pytree, mesh: Mesh) -> Pytree:
-    """Pin the in-program KV cache layout (called inside jit)."""
-    return jax.tree.map(
-        lambda x: jax.lax.with_sharding_constraint(
-            x, NamedSharding(mesh, cache_spec())
-        ),
-        cache,
-    )
+    """Pin the in-program KV cache layout (called inside jit).
+
+    Handles both cache forms: bf16 {"k","v"} [L, B, K, S, H] and int8
+    {"k8","ks","v8","vs"} — the [L, B, K, S] scale tensors drop the head
+    axis from the spec but keep batch-over-dp / heads-over-tp."""
+    def pin(x):
+        spec = cache_spec() if x.ndim == 5 else P(None, "dp", "tp", None)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(pin, cache)
