@@ -164,6 +164,11 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
             scheduler_meshes = [mesh]
 
+    kv_quant = "int8" if getattr(args, "kv_int8", False) else None
+    if kv_quant and getattr(args, "speculative", 0) > 0 and not args.scheduler:
+        sys.exit("--kv-int8 cannot combine with --speculative: the "
+                 "speculative verify loop streams the bf16 cache")
+
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
         if path.endswith(".gguf") and tok_dir is None:
@@ -174,7 +179,8 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             if len(scheduler_meshes) == 1:
                 common = dict(mesh=scheduler_meshes[0],
                               max_new_tokens=max_new_tokens,
-                              add_bos=add_bos, num_slots=args.slots)
+                              add_bos=add_bos, num_slots=args.slots,
+                              kv_quant=kv_quant)
                 if path.endswith(".gguf"):
                     return SchedulerBackend.from_gguf(path, tok, **common)
                 return SchedulerBackend.from_hf_checkpoint(
@@ -203,6 +209,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 ContinuousBatchingScheduler(
                     cfg, params, num_slots=args.slots,
                     stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
+                    kv_quant=kv_quant,
                 )
                 for m in scheduler_meshes
             ]
@@ -214,11 +221,13 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             return EngineBackend.from_gguf(
                 path, tok, mesh=mesh, max_new_tokens=max_new_tokens,
                 add_bos=add_bos, speculative_draft=getattr(args, "speculative", 0),
+                kv_quant=kv_quant,
             )
         return EngineBackend.from_hf_checkpoint(
             path, tok, mesh=mesh, quantize_int8=args.int8,
             max_new_tokens=max_new_tokens, add_bos=add_bos,
             speculative_draft=getattr(args, "speculative", 0),
+            kv_quant=kv_quant,
         )
 
     from ..serve.factory import assemble_reference_service
@@ -249,6 +258,10 @@ def main(argv=None) -> None:
                          "per round for greedy requests (engine backends "
                          "with --no-scheduler; copy-heavy NL→SQL "
                          "workloads on real checkpoints benefit most)")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="int8 KV cache with per-slot scales: halves the "
+                         "serving window's HBM footprint and decode cache "
+                         "streaming (scheduler and engine backends)")
     ap.add_argument("--int8", action="store_true",
                     help="int8 weight-only quantization (HF checkpoints)")
     ap.add_argument("--scheduler", action=argparse.BooleanOptionalAction,

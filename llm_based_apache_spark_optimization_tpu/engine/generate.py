@@ -161,11 +161,9 @@ def _make_generate_fn(
             # One-pass cache quantization between prefill and decode: the
             # loop carries int8 values + f32 per-slot scales and every step
             # streams ~half the cache bytes (ops/quant.quantize_kv).
-            from ..ops.quant import quantize_kv
+            from ..ops.quant import quantize_cache
 
-            kq, vq = quantize_kv(cache["k"]), quantize_kv(cache["v"])
-            cache = {"k8": kq["q8"], "ks": kq["s"],
-                     "v8": vq["q8"], "vs": vq["s"]}
+            cache = quantize_cache(cache["k"], cache["v"])
             if mesh is not None:
                 cache = constrain_cache(cache, mesh)
 

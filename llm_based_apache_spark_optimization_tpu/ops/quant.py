@@ -82,6 +82,17 @@ def quantize_kv(x: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     return {"q8": q8, "s": s}
 
 
+def quantize_cache(
+    k: jnp.ndarray, v: jnp.ndarray
+) -> Dict[str, jnp.ndarray]:
+    """Quantize a K/V cache pair into the canonical int8-cache dict layout
+    {"k8", "ks", "v8", "vs"} that models/llama.forward and the scheduler's
+    cache-tuple threading consume (one definition of the layout; see also
+    serve/scheduler._cache_dict)."""
+    kq, vq = quantize_kv(k), quantize_kv(v)
+    return {"k8": kq["q8"], "ks": kq["s"], "v8": vq["q8"], "vs": vq["s"]}
+
+
 def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
     """x @ w for a plain array or a QTensor (dequant fused into the matmul).
 

@@ -82,6 +82,7 @@ class EngineBackend:
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
         speculative_draft: int = 0,
+        kv_quant=None,
         **kwargs,
     ) -> "EngineBackend":
         """Stand up a backend straight from an HF-format checkpoint directory
@@ -117,7 +118,7 @@ class EngineBackend:
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
-            speculative_draft=speculative_draft,
+            speculative_draft=speculative_draft, kv_quant=kv_quant,
         )
         return cls(engine, tokenizer, **kwargs)
 
@@ -132,6 +133,7 @@ class EngineBackend:
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
         speculative_draft: int = 0,
+        kv_quant=None,
         **kwargs,
     ) -> "EngineBackend":
         """Stand up a backend from a GGUF blob — the exact file format the
@@ -144,7 +146,7 @@ class EngineBackend:
         )
         engine = InferenceEngine(
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
-            speculative_draft=speculative_draft,
+            speculative_draft=speculative_draft, kv_quant=kv_quant,
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
         )

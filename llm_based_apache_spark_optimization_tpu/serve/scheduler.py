@@ -96,6 +96,19 @@ from ..ops.sampling import SamplingParams, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
 
 
+def _cache_dict(arrs: Sequence[jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Tuple-of-arrays cache -> the dict form models/llama.forward takes."""
+    if len(arrs) == 2:
+        return {"k": arrs[0], "v": arrs[1]}
+    return {"k8": arrs[0], "ks": arrs[1], "v8": arrs[2], "vs": arrs[3]}
+
+
+def _cache_tuple(d: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, ...]:
+    if "k8" in d:
+        return (d["k8"], d["ks"], d["v8"], d["vs"])
+    return (d["k"], d["v"])
+
+
 @dataclasses.dataclass
 class _Request:
     ids: List[int]
@@ -131,6 +144,7 @@ class ContinuousBatchingScheduler:
         stop_ids: Optional[Sequence[int]] = None,
         mesh=None,
         prefix_cache_blocks: int = 64,
+        kv_quant: Optional[str] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -152,27 +166,52 @@ class ContinuousBatchingScheduler:
         self._impl = attention_impl(mesh)
 
         dtype = jax.tree.leaves(params)[0].dtype
+        self._dtype = dtype
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
+        self.kv_quant = kv_quant
         # Decode impl is cost-aware: the flash kernel's per-row kv_lens
         # bounding (parked slots stream nothing) only beats the einsum
         # path's zero-overhead full-cache read once the persistent
         # [slots, max_seq] cache is large per device — see
-        # ops.pallas.decode_attention_impl for the measured crossover.
+        # ops.pallas.decode_attention_impl for the measured crossover. An
+        # int8 KV cache decodes through the einsum path exclusively (the
+        # quantized attention of ops/attention.py), which also halves the
+        # full-read penalty the kernel would have amortized.
         from ..engine.kvcache import cache_bytes as _cache_bytes
 
         tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
-        self._decode_impl = decode_attention_impl(
+        self._decode_impl = "xla" if kv_quant else decode_attention_impl(
             mesh,
             _cache_bytes(cfg, num_slots, self.max_seq, dtype.itemsize) // tp,
         )
         cache = init_cache(cfg, num_slots, self.max_seq, dtype=dtype)
+        # The persistent cache is a TUPLE of arrays threaded through every
+        # jitted op: (k, v) in bf16 mode, (k8, ks, v8, vs) with int8 KV
+        # (values + per-slot scales, ops/quant.quantize_kv).
+        if kv_quant:
+            from ..ops.quant import quantize_cache
+
+            arrs = _cache_tuple(quantize_cache(cache["k"], cache["v"]))
+        else:
+            arrs = (cache["k"], cache["v"])
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            spec = P(None, None, "tp", None, None)  # slots unsharded, KV heads on tp
-            cache = jax.tree.map(
-                lambda x: jax.device_put(x, NamedSharding(mesh, spec)), cache
+            # Slots unsharded, KV heads on tp; scale tensors drop the head
+            # axis from the spec.
+            arrs = tuple(
+                jax.device_put(
+                    x,
+                    NamedSharding(
+                        mesh,
+                        P(None, None, "tp", None, None) if x.ndim == 5
+                        else P(None, None, "tp", None),
+                    ),
+                )
+                for x in arrs
             )
-        self._ck, self._cv = cache["k"], cache["v"]
+        self._cache = arrs
 
         # Per-slot state lives ON DEVICE and chains between rounds: decode
         # rounds and admission scatters are issued asynchronously and the
@@ -235,10 +274,12 @@ class ContinuousBatchingScheduler:
 
         # Prefix cache: block size = the smallest bucket, so chunk boundaries
         # always land on block boundaries. OrderedDict as LRU of
-        # content-keyed K/V blocks ([L, 1, K, pblock, H] device arrays).
+        # content-keyed cache-block tuples (one entry per cache array:
+        # [L, 1, K, pblock, H] values, plus [L, 1, K, pblock] scales under
+        # kv_quant).
         self._pblock = self._buckets[0]
         self._prefix_cache_blocks = max(0, prefix_cache_blocks)
-        self._prefix_cache: "OrderedDict[Tuple[int, ...], Tuple[jax.Array, jax.Array]]" = (
+        self._prefix_cache: "OrderedDict[Tuple[int, ...], Tuple[jax.Array, ...]]" = (
             OrderedDict()
         )
         # Publish gate: a block is copied out of the cache only once its
@@ -314,34 +355,48 @@ class ContinuousBatchingScheduler:
     def _build_block_ops(self):
         """Jitted device-to-device prefix-block copy ops.
 
-        slice:   cache [L, B, K, S, H] -> block [L, 1, K, pblock, H]
-        restore: write a block back into a slot row at a block-aligned start.
+        slice:   each cache array [L, B, K, S(, H)] -> block [L, 1, K,
+                 pblock(, H)] (values and, under kv_quant, their scales)
+        restore: write the blocks back into a slot row at a block-aligned
+                 start.
         Both are pure data movement — no compute — so a cache hit costs HBM
         copies instead of a transformer forward."""
         L, K, H = self.cfg.num_layers, self.cfg.num_kv_heads, self.cfg.head_dim
         pb = self._pblock
+        nc = len(self._cache)
+
+        def _sizes(arr):
+            return (L, 1, K, pb, H) if arr.ndim == 5 else (L, 1, K, pb)
+
+        def _idx(arr, slot, start):
+            return ((0, slot, 0, start, 0) if arr.ndim == 5
+                    else (0, slot, 0, start))
 
         @jax.jit
-        def slice_block(ck, cv, slot, start):
-            sizes = (L, 1, K, pb, H)
-            bk = lax.dynamic_slice(ck, (0, slot, 0, start, 0), sizes)
-            bv = lax.dynamic_slice(cv, (0, slot, 0, start, 0), sizes)
-            return bk, bv
+        def slice_block(*args):
+            cache, (slot, start) = args[:nc], args[nc:]
+            return tuple(
+                lax.dynamic_slice(c, _idx(c, slot, start), _sizes(c))
+                for c in cache
+            )
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def restore_block(ck, cv, bk, bv, slot, start):
-            ck = lax.dynamic_update_slice(ck, bk, (0, slot, 0, start, 0))
-            cv = lax.dynamic_update_slice(cv, bv, (0, slot, 0, start, 0))
-            return ck, cv
+        @partial(jax.jit, donate_argnums=tuple(range(nc)))
+        def restore_block(*args):
+            cache, blocks, (slot, start) = args[:nc], args[nc:2 * nc], args[2 * nc:]
+            return tuple(
+                lax.dynamic_update_slice(c, b, _idx(c, slot, start))
+                for c, b in zip(cache, blocks)
+            )
 
         return slice_block, restore_block
 
     def _build_prefill(self, t_bucket: int, k: int):
         cfg, impl, mesh = self.cfg, self._impl, self.mesh
+        quant, dtype = self.kv_quant, self._dtype
+        nc = len(self._cache)
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def prefill(params, ck, cv, tokens, lengths, slots, starts, temps,
-                    topps, topks, seeds):
+        @partial(jax.jit, donate_argnums=tuple(range(1, 1 + nc)))
+        def prefill(params, *args):
             """One prompt chunk for EACH of k slots in one forward — prefill
             is MXU-bound and weight streaming amortizes across the batch
             (admission bursts would otherwise pay a full weight pass per
@@ -353,23 +408,48 @@ class ContinuousBatchingScheduler:
             Padding rows carry slot index num_slots (out of bounds): the
             gather clamps harmlessly and the scatter DROPS their cache
             writes (jax scatter OOB semantics), so a partially filled
-            k-batch is safe without duplicate-slot scatters."""
-            rows_k = ck[:, slots]  # [L, k, K, S, H] gather
-            rows_v = cv[:, slots]
+            k-batch is safe without duplicate-slot scatters.
+
+            With kv_quant, the gathered rows dequantize to the compute
+            dtype for the chunk forward and the updated rows requantize
+            before the scatter. Absmax-int8 requantization is idempotent
+            up to the bf16 rounding of the dequantized values, so earlier
+            chunks' entries drift by at most their own quantization noise.
+            """
+            cache = args[:nc]
+            (tokens, lengths, slots, starts, temps, topps, topks,
+             seeds) = args[nc:]
+            rows = [c[:, slots] for c in cache]  # [L, k, K, S(, H)] gathers
+            if quant:
+                row_cache = {
+                    "k": (rows[0].astype(dtype)
+                          * rows[1][..., None].astype(dtype)),
+                    "v": (rows[2].astype(dtype)
+                          * rows[3][..., None].astype(dtype)),
+                }
+            else:
+                row_cache = {"k": rows[0], "v": rows[1]}
             positions = (
                 starts[:, None] + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
             )
             logits, new = forward(
-                cfg, params, tokens, positions, {"k": rows_k, "v": rows_v},
+                cfg, params, tokens, positions, row_cache,
                 logit_indices=lengths - 1, attn_impl=impl, mesh=mesh,
             )
-            ck = ck.at[:, slots].set(new["k"])
-            cv = cv.at[:, slots].set(new["v"])
+            if quant:
+                from ..ops.quant import quantize_cache
+
+                new_rows = _cache_tuple(quantize_cache(new["k"], new["v"]))
+            else:
+                new_rows = (new["k"], new["v"])
+            cache = tuple(
+                c.at[:, slots].set(n) for c, n in zip(cache, new_rows)
+            )
             keys = jax.vmap(
                 lambda s: jax.random.fold_in(jax.random.key(s), 0)
             )(seeds)
             toks = sample_runtime(logits[:, 0], temps, topps, topks, keys)
-            return ck, cv, toks
+            return (*cache, toks)
 
         return prefill
 
@@ -377,19 +457,23 @@ class ContinuousBatchingScheduler:
         cfg, impl, chunk = self.cfg, self._decode_impl, self.decode_chunk
         mesh = self.mesh
         pad_id = cfg.pad_id
+        nc = len(self._cache)
 
-        @partial(jax.jit, donate_argnums=(1, 2, 3, 4, 10))
-        def decode(params, ck, cv, cur, pos, active, temps, topps, topks,
-                   seeds, counts):
+        @partial(jax.jit,
+                 donate_argnums=tuple(range(1, 3 + nc)) + (8 + nc,))
+        def decode(params, *args):
+            cache = args[:nc]
+            (cur, pos, active, temps, topps, topks, seeds,
+             counts) = args[nc:]
             # Per-layer slices outside the chunk scan: decode-matmul layout
             # conversions run once per round, not per token (split_blocks).
             params = split_blocks(params)
 
             def step(carry, i):
-                ck, cv, cur, pos = carry
-                logits, cache = forward(
+                cache, cur, pos = carry
+                logits, new_cache = forward(
                     cfg, params, cur[:, None], pos[:, None],
-                    {"k": ck, "v": cv}, attn_impl=impl, mesh=mesh,
+                    _cache_dict(cache), attn_impl=impl, mesh=mesh,
                     # Parked slots (decoding garbage at the park position)
                     # stream ZERO KV blocks; live slots stream only up to
                     # their own position — without this every decode step
@@ -405,15 +489,15 @@ class ContinuousBatchingScheduler:
                 nxt = sample_runtime(logits[:, 0], temps, topps, topks, keys)
                 nxt = jnp.where(active, nxt, pad_id)
                 pos = jnp.where(active, pos + 1, pos)
-                return (cache["k"], cache["v"], nxt, pos), nxt
+                return (_cache_tuple(new_cache), nxt, pos), nxt
 
-            (ck, cv, cur, pos), toks = lax.scan(
-                step, (ck, cv, cur, pos), jnp.arange(chunk)
+            (cache, cur, pos), toks = lax.scan(
+                step, (cache, cur, pos), jnp.arange(chunk)
             )
             # RNG stream bookkeeping advances on device too: every active
             # slot consumed `chunk` samples.
             counts = jnp.where(active, counts + chunk, counts)
-            return ck, cv, cur, pos, counts, toks.T  # toks: [num_slots, chunk]
+            return (*cache, cur, pos, counts, toks.T)  # toks: [slots, chunk]
 
         return decode
 
@@ -433,8 +517,8 @@ class ContinuousBatchingScheduler:
         for kb in self._kbuckets:
             if (t, kb) not in self._prefill_fns:
                 self._prefill_fns[(t, kb)] = self._build_prefill(t, kb)
-            self._ck, self._cv, _ = self._prefill_fns[(t, kb)](
-                self.params, self._ck, self._cv,
+            out = self._prefill_fns[(t, kb)](
+                self.params, *self._cache,
                 jnp.full((kb, t), pad, jnp.int32),
                 jnp.ones(kb, jnp.int32),
                 jnp.full((kb,), self.num_slots, jnp.int32),  # all OOB
@@ -444,6 +528,7 @@ class ContinuousBatchingScheduler:
                 jnp.zeros(kb, jnp.int32),
                 jnp.zeros(kb, jnp.uint32),
             )
+            self._cache = out[:-1]
 
     def start(self) -> "ContinuousBatchingScheduler":
         if self._thread is None:
@@ -568,10 +653,10 @@ class ContinuousBatchingScheduler:
                 n += 1
             for j in range(n):
                 key = tuple(req.ids[: (j + 1) * pb])
-                bk, bv = self._prefix_cache[key]
+                blocks = self._prefix_cache[key]
                 self._prefix_cache.move_to_end(key)  # LRU touch
-                self._ck, self._cv = self._restore_block_fn(
-                    self._ck, self._cv, bk, bv, jnp.int32(slot),
+                self._cache = self._restore_block_fn(
+                    *self._cache, *blocks, jnp.int32(slot),
                     jnp.int32(j * pb),
                 )
             if n:
@@ -638,13 +723,14 @@ class ContinuousBatchingScheduler:
             topks.append(0)
             seeds.append(0)
 
-        self._ck, self._cv, toks = self._prefill_fns[(t, kb)](
-            self.params, self._ck, self._cv,
+        out = self._prefill_fns[(t, kb)](
+            self.params, *self._cache,
             jnp.asarray(tokens, jnp.int32), jnp.asarray(lengths, jnp.int32),
             jnp.asarray(slots, jnp.int32), jnp.asarray(starts, jnp.int32),
             jnp.asarray(temps, jnp.float32), jnp.asarray(topps, jnp.float32),
             jnp.asarray(topks, jnp.int32), jnp.asarray(seeds, jnp.uint32),
         )
+        self._cache, toks = out[:-1], out[-1]
 
         for i, (slot, req) in enumerate(group):
             chunk_start = req.prefilled
@@ -688,10 +774,9 @@ class ContinuousBatchingScheduler:
                 while len(self._prefix_seen) > 4 * self._prefix_cache_blocks:
                     self._prefix_seen.popitem(last=False)
                 continue
-            bk, bv = self._slice_block_fn(
-                self._ck, self._cv, jnp.int32(slot), jnp.int32(b0 * pb)
+            self._prefix_cache[key] = self._slice_block_fn(
+                *self._cache, jnp.int32(slot), jnp.int32(b0 * pb)
             )
-            self._prefix_cache[key] = (bk, bv)
             while len(self._prefix_cache) > self._prefix_cache_blocks:
                 self._prefix_cache.popitem(last=False)
 
@@ -706,12 +791,14 @@ class ContinuousBatchingScheduler:
             self._slot_req[i] if active[i] else None
             for i in range(self.num_slots)
         ]
-        (self._ck, self._cv, self._cur, self._pos, self._counts,
-         toks) = self._decode_fn(
-            self.params, self._ck, self._cv, self._cur, self._pos,
+        nc = len(self._cache)
+        out = self._decode_fn(
+            self.params, *self._cache, self._cur, self._pos,
             jnp.asarray(active), self._temps, self._topps, self._topks,
             self._seeds, self._counts,
         )
+        self._cache = out[:nc]
+        self._cur, self._pos, self._counts, toks = out[nc:]
         self._pending.append((issue_reqs, toks, self._first_pending))
         self._first_pending = []
 
@@ -988,6 +1075,7 @@ class SchedulerBackend:
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
+        kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         **kwargs,
@@ -995,8 +1083,10 @@ class SchedulerBackend:
         """Deployment path for concurrent serving: HF checkpoint straight
         into a continuous-batching scheduler (the product's `--scheduler`
         flag, app/__main__.py). Mirrors `EngineBackend.from_hf_checkpoint`
-        incl. int8 weight-only quantization; the mesh (if any) must be
-        dp=1 — request parallelism comes from slots."""
+        incl. int8 weight-only quantization (and `kv_quant="int8"` for the
+        persistent KV cache — halves the serving window's HBM footprint
+        and decode streaming); the mesh (if any) must be dp=1 — request
+        parallelism comes from slots."""
         import jax.numpy as jnp
 
         from ..checkpoint import load_hf_checkpoint
@@ -1021,7 +1111,7 @@ class SchedulerBackend:
             decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
-            mesh=sched_mesh,
+            mesh=sched_mesh, kv_quant=kv_quant,
         )
         return cls(sched, tokenizer, **kwargs)
 
@@ -1036,6 +1126,7 @@ class SchedulerBackend:
         num_slots: int = 8,
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
+        kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         **kwargs,
@@ -1053,7 +1144,7 @@ class SchedulerBackend:
             decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
-            mesh=mesh,
+            mesh=mesh, kv_quant=kv_quant,
         )
         return cls(sched, tokenizer, **kwargs)
 

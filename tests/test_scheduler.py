@@ -399,10 +399,10 @@ def test_warmup_compiles_all_kbuckets_without_state_change(tiny_model_module):
 
     cfg, params = tiny_model_module
     sched = make_sched(cfg, params, num_slots=2)
-    before_k = np.asarray(sched._ck)
+    before_k = np.asarray(sched._cache[0])
     sched.warmup()
     assert {kb for (_, kb) in sched._prefill_fns} == set(sched._kbuckets)
-    np.testing.assert_array_equal(np.asarray(sched._ck), before_k)
+    np.testing.assert_array_equal(np.asarray(sched._cache[0]), before_k)
     golden = engine_golden(cfg, params, PROMPTS[:2], max_new=4)
     with sched:
         assert sched.generate(PROMPTS[:2], max_new_tokens=4) == golden
