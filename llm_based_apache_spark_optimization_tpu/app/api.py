@@ -65,6 +65,65 @@ def create_api_app(
             "output_file": result.output_file,
         })
 
+    @app.route("/api/generate", methods=("POST",))
+    def api_generate(req: Request) -> Response:
+        """Direct generation endpoint, Ollama wire shape: body
+        `{"model", "prompt", "system"?, "stream"?, "max_new_tokens"?}`.
+        stream=false (default) returns `{"model", "response", "done": true}`
+        in one JSON object; stream=true returns NDJSON lines
+        `{"model", "response": <chunk>, "done": false}` flushed per chunk,
+        terminated by `{"model", "done": true}` — tokens arrive live from
+        the continuous-batching scheduler. The reference app only ever
+        called the blocking form (`FastAPI/app.py:85-90`)."""
+        try:
+            data = req.json()
+        except Exception:
+            return Response.json({"error": "invalid JSON body"}, status=400)
+        model = data.get("model", "")
+        prompt = data.get("prompt", "")
+        if not model or not prompt:
+            return Response.json(
+                {"error": "both 'model' and 'prompt' are required"},
+                status=400,
+            )
+        system = data.get("system", "")
+        max_new = data.get("max_new_tokens")
+        # Resolve the model BEFORE streaming: once the NDJSON generator is
+        # returned, 200 headers are already on the wire and a late KeyError
+        # could only abort the body — the 404 must fire here.
+        if model not in service.models():
+            return Response.json(
+                {"error": f"model {model!r} is not registered; "
+                          f"available: {service.models()}"},
+                status=404,
+            )
+        try:
+            if not data.get("stream", False):
+                res = service.generate(
+                    model, prompt, system=system, max_new_tokens=max_new
+                )
+                return Response.json({
+                    "model": model, "response": res.response, "done": True,
+                })
+
+            def chunks():
+                try:
+                    for piece in service.generate_stream(
+                        model, prompt, system=system, max_new_tokens=max_new
+                    ):
+                        yield {"model": model, "response": piece,
+                               "done": False}
+                except Exception as e:  # mid-stream failure: headers are
+                    # already sent, so surface the error as a final line
+                    # instead of severing the connection silently.
+                    yield {"model": model, "error": str(e), "done": True}
+                    return
+                yield {"model": model, "done": True}
+
+            return Response.ndjson_stream(chunks())
+        except KeyError as e:
+            return Response.json({"error": str(e)}, status=404)
+
     @app.route("/models")
     def models(req: Request) -> Response:
         return Response.json({

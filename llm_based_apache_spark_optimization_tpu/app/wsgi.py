@@ -113,6 +113,10 @@ class Response:
     body: bytes = b""
     status: int = 200
     headers: List[Tuple[str, str]] = field(default_factory=list)
+    # Streaming body: an iterable of byte chunks written (and flushed by the
+    # WSGI server) as they are produced. Mutually exclusive with `body`; no
+    # Content-Length is set, so the connection delivers chunks live.
+    stream: Any = None
 
     @classmethod
     def json(cls, obj: Any, status: int = 200) -> "Response":
@@ -120,6 +124,19 @@ class Response:
             body=jsonlib.dumps(obj).encode(),
             status=status,
             headers=[("Content-Type", "application/json")],
+        )
+
+    @classmethod
+    def ndjson_stream(cls, chunks) -> "Response":
+        """Newline-delimited JSON streaming (the Ollama wire shape): each
+        element of `chunks` is dumped as one line and flushed immediately."""
+        def gen():
+            for obj in chunks:
+                yield (jsonlib.dumps(obj) + "\n").encode()
+
+        return cls(
+            stream=gen(),
+            headers=[("Content-Type", "application/x-ndjson")],
         )
 
     @classmethod
@@ -215,6 +232,13 @@ class App:
                  f"{self.SESSION_COOKIE}={self._codec.encode(req.session)}; "
                  f"Path=/; HttpOnly")
             )
+        if resp.stream is not None:
+            # Streaming responses carry no Content-Length; the WSGI server
+            # writes/flushes each yielded chunk (wsgiref flushes per write).
+            start_response(
+                _STATUS.get(resp.status, f"{resp.status} Unknown"), headers
+            )
+            return resp.stream
         headers.append(("Content-Length", str(len(resp.body))))
         start_response(_STATUS.get(resp.status, f"{resp.status} Unknown"), headers)
         return [resp.body]

@@ -81,7 +81,7 @@ import threading
 from collections import OrderedDict, deque
 from concurrent.futures import Future
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -118,12 +118,24 @@ class _Request:
     top_k: int
     seed: int
     future: Future
+    # Streaming: called from the worker thread with each ACCEPTED token id,
+    # in order, before the future resolves (overshoot/stop tokens never
+    # reach it). Must be fast and non-blocking; exceptions are swallowed so
+    # a broken consumer cannot kill the serving loop.
+    on_token: Optional[Callable[[int], None]] = None
     # live state (set at admission)
     generated: List[int] = dataclasses.field(default_factory=list)
     # chunked-prefill progress: prompt tokens already written to the cache.
     # A slot is decode-eligible only once the whole prompt is in (`ready`).
     prefilled: int = 0
     ready: bool = False
+
+    def emit(self, tok: int) -> None:
+        if self.on_token is not None:
+            try:
+                self.on_token(tok)
+            except Exception:  # noqa: BLE001 — consumer bugs must not kill serving
+                self.on_token = None
 
 
 class ContinuousBatchingScheduler:
@@ -567,6 +579,9 @@ class ContinuousBatchingScheduler:
         # differs from InferenceEngine's shared-batch keys, so scheduler and
         # engine agree token-for-token on greedy but not on sampled runs.)
         seed: int = 0,
+        # Streaming consumer: called with each accepted token id in order
+        # from the worker thread (see _Request.on_token).
+        on_token: Optional[Callable[[int], None]] = None,
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
@@ -586,7 +601,7 @@ class ContinuousBatchingScheduler:
             ids=list(ids), max_new=max_new_tokens,
             temperature=sampling.temperature, top_p=sampling.top_p,
             top_k=sampling.top_k, seed=seed,
-            future=Future(),
+            future=Future(), on_token=on_token,
         )
         with self._submit_lock:
             if self._closed:
@@ -823,6 +838,7 @@ class ContinuousBatchingScheduler:
             self._retire(slot, req, [])
             return
         req.generated.append(first)
+        req.emit(first)
         if len(req.generated) >= req.max_new:
             self._retire(slot, req, req.generated)
 
@@ -849,6 +865,7 @@ class ContinuousBatchingScheduler:
                     done = True
                     break
                 req.generated.append(tok)
+                req.emit(tok)
                 if len(req.generated) >= req.max_new:
                     done = True
                     break
@@ -994,7 +1011,8 @@ class SchedulerPool:
         self.shutdown()
 
     def submit(self, ids, max_new_tokens: int = 256,
-               sampling: SamplingParams = SamplingParams(), seed: int = 0):
+               sampling: SamplingParams = SamplingParams(), seed: int = 0,
+               on_token=None):
         # Skip replicas whose event loop has crashed: a dead scheduler must
         # not keep failing its round-robin share while healthy ones idle.
         # The try/except covers the race where a replica dies between the
@@ -1008,7 +1026,7 @@ class SchedulerPool:
             try:
                 return sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
-                    seed=seed,
+                    seed=seed, on_token=on_token,
                 )
             except ValueError:
                 # Request-shape rejection (oversize prompt): identical on
@@ -1160,6 +1178,65 @@ class SchedulerBackend:
                 f"{sched.max_seq}-token scheduler window of {sched.cfg.name}"
             )
         return min(max_new_tokens or self.max_new_tokens, room)
+
+    def complete_stream(self, prompt: str,
+                        max_new_tokens: Optional[int] = None,
+                        sampling: Optional[SamplingParams] = None,
+                        seed: int = 0):
+        """Stream the completion as text chunks while it decodes — the
+        capability Ollama's `stream=true` API exposes and the reference
+        never used. Token ids arrive from the scheduler's per-request
+        callback; text is re-decoded incrementally and emitted as clean
+        deltas (a chunk is held back while the byte-level decode of a
+        partial multi-byte sequence would surface U+FFFD, and the last
+        `longest stop text - 1` chars stay held so a stop spanning chunk
+        boundaries never leaks — streamed text equals blocking text).
+
+        Each token re-decodes the accumulated ids (O(n^2) over the
+        completion) ON PURPOSE: prefix-decode is not compositional for
+        BPE/sentencepiece boundaries, the cost is host-side microseconds
+        per token against human-reading-rate output, and exactness vs the
+        blocking path is the contract the tests pin."""
+        from .backends import trim_stop_texts
+
+        ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+        toks: "queue.Queue[int]" = queue.Queue()
+        fut = self.scheduler.submit(
+            ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
+            sampling=sampling or self.sampling, seed=seed,
+            on_token=toks.put,
+        )
+        out_ids: List[int] = []
+        emitted = ""
+        hold = max((len(s) for s in self.stop_texts), default=1) - 1
+
+        done = False
+        while not done:
+            try:
+                out_ids.append(toks.get(timeout=0.05))
+            except queue.Empty:
+                done = fut.done()
+                continue
+            text = self.tokenizer.decode(out_ids)
+            trimmed = trim_stop_texts(text, self.stop_texts)
+            if trimmed != text:  # a stop text landed: flush to it and end
+                if len(trimmed) > len(emitted):
+                    yield trimmed[len(emitted):]
+                fut.result()  # surface scheduler errors before return
+                return
+            # Emit up to the holdback horizon, minus any trailing partial
+            # multi-byte replacement char.
+            safe = text[: len(text) - hold if hold else len(text)]
+            delta = safe[len(emitted):]
+            if delta and not delta.endswith("�"):
+                emitted += delta
+                yield delta
+        fut.result()  # propagate errors; also syncs the final token list
+        while not toks.empty():
+            out_ids.append(toks.get_nowait())
+        text = trim_stop_texts(self.tokenizer.decode(out_ids), self.stop_texts)
+        if len(text) > len(emitted):
+            yield text[len(emitted):]
 
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0):

@@ -116,6 +116,70 @@ class GenerationService:
             output_tokens=completion.output_tokens,
         )
 
+    def generate_stream(
+        self,
+        model: str,
+        prompt: str,
+        system: str = "",
+        max_new_tokens: Optional[int] = None,
+        sampling: Optional[SamplingParams] = None,
+        seed: int = 0,
+    ):
+        """Yield the completion as text chunks while it decodes (Ollama's
+        `stream=true` surface). Backends without a `complete_stream` seam
+        (the one-XLA-program engine, fakes) degrade to a single chunk.
+        Metrics record the request exactly like generate()."""
+        entry = self._models.get(model)
+        if entry is None:
+            raise KeyError(
+                f"model {model!r} is not registered; available: {self.models()}"
+            )
+        rendered = entry.template(system, prompt)
+        t0 = time.perf_counter()
+        out_tokens = prompt_tokens = 0
+        try:
+            streamer = getattr(entry.backend, "complete_stream", None)
+            if streamer is None:
+                completion = entry.backend.complete(
+                    rendered, max_new_tokens=max_new_tokens, sampling=sampling,
+                    seed=seed,
+                )
+                out_tokens, prompt_tokens = (completion.output_tokens,
+                                             completion.prompt_tokens)
+                if completion.text:
+                    yield completion.text
+            else:
+                tok = getattr(entry.backend, "tokenizer", None)
+                if tok is not None:
+                    prompt_tokens = len(tok.encode(
+                        rendered,
+                        add_bos=getattr(entry.backend, "add_bos", True),
+                    ))
+                with trace_capture(f"generate-{model}"):
+                    for chunk in streamer(
+                        rendered, max_new_tokens=max_new_tokens,
+                        sampling=sampling, seed=seed,
+                    ):
+                        out_tokens += 1  # ~1 chunk/token (held-back merges)
+                        yield chunk
+        finally:
+            # Record even when the client disconnects mid-stream (the WSGI
+            # server close()s the generator -> GeneratorExit lands here):
+            # disconnect-heavy streaming must not vanish from the serving
+            # metrics.
+            latency = time.perf_counter() - t0
+            with self._lock:
+                s = self.stats[model]
+                s["requests"] += 1
+                s["total_latency_s"] += latency
+                s["total_tokens"] += out_tokens
+            self.metrics.record(RequestMetrics(
+                model=model,
+                prompt_tokens=prompt_tokens,
+                output_tokens=out_tokens,
+                latency_s=latency,
+            ))
+
     def generate_batch(
         self,
         model: str,

@@ -1,0 +1,139 @@
+"""Streaming generation: scheduler on_token -> backend complete_stream ->
+service generate_stream -> /api/generate NDJSON (the Ollama `stream=true`
+surface the reference never used)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    SchedulerBackend,
+)
+from llm_based_apache_spark_optimization_tpu.serve.service import GenerationService
+from llm_based_apache_spark_optimization_tpu.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TINY
+    params = init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    return cfg, params
+
+
+def test_scheduler_on_token_streams_accepted_tokens(tiny):
+    cfg, params = tiny
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+        stop_ids=(-1,),
+    )
+    seen = []
+    with sched:
+        out = sched.submit([1, 5, 9], max_new_tokens=7,
+                           on_token=seen.append).result()
+    assert seen == out and len(out) == 7
+
+
+def test_scheduler_on_token_callback_errors_do_not_kill_serving(tiny):
+    cfg, params = tiny
+
+    def boom(tok):
+        raise RuntimeError("consumer bug")
+
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+        stop_ids=(-1,),
+    )
+    with sched:
+        out = sched.submit([1, 5], max_new_tokens=5, on_token=boom).result()
+        again = sched.submit([1, 5], max_new_tokens=5).result()
+    assert len(out) == 5 and out == again
+
+
+def test_backend_complete_stream_matches_blocking(tiny):
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, decode_chunk=4, prompt_bucket=16,
+        stop_ids=(cfg.eos_id,),
+    )
+    backend = SchedulerBackend(sched, tok, max_new_tokens=12)
+    try:
+        blocking = backend.complete("hello world").text
+        streamed = "".join(backend.complete_stream("hello world"))
+        assert streamed == blocking
+    finally:
+        backend.shutdown()
+
+
+def test_backend_complete_stream_stop_text_spanning_chunks(tiny):
+    """A stop text that arrives one character per token must not leak its
+    prefix into the stream: streamed output equals the blocking path's
+    trimmed output exactly."""
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, decode_chunk=4, prompt_bucket=16,
+        stop_ids=(-1,),
+    )
+    probe = SchedulerBackend(sched, tok, max_new_tokens=10)
+    full = probe.complete("abc").text
+    if len(full) < 5:
+        pytest.skip("probe output too short to derive a stop text")
+    stop = full[3:5]  # lands mid-stream, token by token
+    backend = SchedulerBackend(sched, tok, max_new_tokens=10,
+                               stop_texts=(stop,))
+    try:
+        blocking = backend.complete("abc").text
+        streamed = "".join(backend.complete_stream("abc"))
+        assert streamed == blocking == full[:full.find(stop)]
+    finally:
+        backend.shutdown()
+
+
+def test_service_generate_stream_fake_backend_single_chunk():
+    from llm_based_apache_spark_optimization_tpu.serve import FakeBackend
+
+    svc = GenerationService()
+    svc.register("m", FakeBackend(lambda p: "SELECT 1"))
+    chunks = list(svc.generate_stream("m", "question"))
+    assert chunks == ["SELECT 1"]
+    assert svc.stats["m"]["requests"] == 1
+
+
+def test_api_generate_endpoint_blocking_and_streaming(tmp_path):
+    from llm_based_apache_spark_optimization_tpu.app.api import create_api_app
+    from llm_based_apache_spark_optimization_tpu.app.config import AppConfig
+    from llm_based_apache_spark_optimization_tpu.serve import FakeBackend
+    from llm_based_apache_spark_optimization_tpu.sql.sqlite_backend import (
+        SQLiteBackend,
+    )
+
+    svc = GenerationService()
+    svc.register("duckdb-nsql", FakeBackend(lambda p: "SELECT 42"))
+    cfg = AppConfig(input_dir=str(tmp_path / "in"),
+                    output_dir=str(tmp_path / "out"),
+                    history_db=str(tmp_path / "h.db"))
+    app = create_api_app(svc, SQLiteBackend(), None, cfg)
+    client = app.test_client()
+
+    r = client.post_json("/api/generate",
+                         {"model": "duckdb-nsql", "prompt": "q"})
+    assert r.status == 200 and r.json()["response"] == "SELECT 42"
+    assert r.json()["done"] is True
+
+    r = client.post_json("/api/generate",
+                         {"model": "duckdb-nsql", "prompt": "q",
+                          "stream": True})
+    assert r.status == 200
+    lines = [json.loads(ln) for ln in r.body.decode().splitlines()]
+    assert lines[-1] == {"model": "duckdb-nsql", "done": True}
+    assert "".join(l.get("response", "") for l in lines[:-1]) == "SELECT 42"
+
+    r = client.post_json("/api/generate", {"model": "nope", "prompt": "q"})
+    assert r.status == 404
+    r = client.post_json("/api/generate", {"prompt": "q"})
+    assert r.status == 400
