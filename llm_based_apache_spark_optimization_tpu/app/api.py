@@ -88,6 +88,16 @@ def create_api_app(
             )
         system = data.get("system", "")
         max_new = data.get("max_new_tokens")
+        # Client input errors must be 400s, not 500s (or mid-stream error
+        # lines): validate before any generation starts.
+        if max_new is not None and (
+            not isinstance(max_new, int) or isinstance(max_new, bool)
+            or max_new < 1
+        ):
+            return Response.json(
+                {"error": "'max_new_tokens' must be a positive integer"},
+                status=400,
+            )
         # Resolve the model BEFORE streaming: once the NDJSON generator is
         # returned, 200 headers are already on the wire and a late KeyError
         # could only abort the body — the 404 must fire here.
@@ -123,6 +133,10 @@ def create_api_app(
             return Response.ndjson_stream(chunks())
         except KeyError as e:
             return Response.json({"error": str(e)}, status=404)
+        except ValueError as e:
+            # Request-shape rejections (e.g. a prompt that leaves no decode
+            # room in the serving window) are the client's error.
+            return Response.json({"error": str(e)}, status=400)
 
     @app.route("/models")
     def models(req: Request) -> Response:

@@ -177,10 +177,11 @@ def build_service(args, log=print):
             from .ops.quant import quantize_params
 
             params = quantize_params(params)
+        kv_quant = "int8" if getattr(args, "kv_int8", False) else None
         if args.scheduler:
             sched = ContinuousBatchingScheduler(
                 cfg, params, num_slots=args.slots, stop_ids=stop_ids,
-                mesh=mesh,
+                mesh=mesh, kv_quant=kv_quant,
             )
             return SchedulerBackend(
                 sched, tok, max_new_tokens=args.max_new_tokens,
@@ -188,7 +189,8 @@ def build_service(args, log=print):
             )
         from .engine import InferenceEngine
 
-        eng = InferenceEngine(cfg, params, stop_ids=stop_ids, mesh=mesh)
+        eng = InferenceEngine(cfg, params, stop_ids=stop_ids, mesh=mesh,
+                              kv_quant=kv_quant)
         return EngineBackend(
             eng, tok, max_new_tokens=args.max_new_tokens, add_bos=add_bos
         )
@@ -217,6 +219,9 @@ def main(argv=None) -> None:
                     help="orbax native-cache root (convert once, restore after)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="int8 KV cache (per-slot scales): halves the "
+                         "serving window's HBM footprint and cache traffic")
     ap.add_argument("--scheduler", action=argparse.BooleanOptionalAction,
                     default=True)
     ap.add_argument("--slots", type=int, default=8)

@@ -123,6 +123,11 @@ class _Request:
     # reach it). Must be fast and non-blocking; exceptions are swallowed so
     # a broken consumer cannot kill the serving loop.
     on_token: Optional[Callable[[int], None]] = None
+    # Cooperative cancellation (set via Scheduler.cancel): the worker
+    # retires the request at its next harvest instead of decoding the rest
+    # of the budget into an abandoned consumer (client disconnects must not
+    # pin slots).
+    cancelled: bool = False
     # live state (set at admission)
     generated: List[int] = dataclasses.field(default_factory=list)
     # chunked-prefill progress: prompt tokens already written to the cache.
@@ -603,6 +608,7 @@ class ContinuousBatchingScheduler:
             top_k=sampling.top_k, seed=seed,
             future=Future(), on_token=on_token,
         )
+        req.future._lsot_request = req  # cancel() handle
         with self._submit_lock:
             if self._closed:
                 if self._crash is not None:
@@ -630,6 +636,16 @@ class ContinuousBatchingScheduler:
         ]
         return [f.result() for f in futs]
 
+    @staticmethod
+    def cancel(future: "Future[List[int]]") -> None:
+        """Cooperatively cancel a submitted request: the worker retires it
+        (resolving the future with whatever was generated) at its next
+        harvest instead of decoding the remaining budget for an abandoned
+        consumer. Safe on finished/foreign futures (no-op)."""
+        req = getattr(future, "_lsot_request", None)
+        if req is not None:
+            req.cancelled = True
+
     @property
     def prefix_stats(self) -> Dict[str, int]:
         """Prefix-cache observability: requests that reused any blocks, total
@@ -649,6 +665,9 @@ class ContinuousBatchingScheduler:
     def _admit(self, slot: int, req: _Request) -> None:
         """Reserve `slot` and queue the prompt for chunked prefill, reusing
         any cached prefix blocks first (device-to-device copy, no forward)."""
+        if req.cancelled:  # cancelled while queued: never occupy a slot
+            req.future.set_result(req.generated)
+            return
         self._slot_req[slot] = req
         # Park the slot's decode writes before its prompt starts streaming in
         # (it may still be frozen at the previous occupant's position).
@@ -834,6 +853,9 @@ class ContinuousBatchingScheduler:
         overshoot bound)."""
         if req is not self._slot_req[slot]:
             return  # cleared by shutdown/crash path meanwhile
+        if req.cancelled:
+            self._retire(slot, req, req.generated)
+            return
         if first in self.stop_ids or req.max_new < 1:
             self._retire(slot, req, [])
             return
@@ -858,6 +880,9 @@ class ContinuousBatchingScheduler:
         for i, req in enumerate(issue_reqs):
             if req is None or req is not self._slot_req[i]:
                 continue  # inactive at issue, or already retired
+            if req.cancelled:
+                self._retire(i, req, req.generated)
+                continue
             done = False
             for tok in toks[i]:
                 tok = int(tok)
@@ -1042,6 +1067,8 @@ class SchedulerPool:
                 continue
         raise RuntimeError("all scheduler replicas have crashed")
 
+    cancel = staticmethod(ContinuousBatchingScheduler.cancel)
+
     def generate(self, prompts, max_new_tokens: int = 256,
                  sampling: SamplingParams = SamplingParams(), seed: int = 0):
         futs = [
@@ -1182,7 +1209,8 @@ class SchedulerBackend:
     def complete_stream(self, prompt: str,
                         max_new_tokens: Optional[int] = None,
                         sampling: Optional[SamplingParams] = None,
-                        seed: int = 0):
+                        seed: int = 0,
+                        stats_out: Optional[dict] = None):
         """Stream the completion as text chunks while it decodes — the
         capability Ollama's `stream=true` API exposes and the reference
         never used. Token ids arrive from the scheduler's per-request
@@ -1200,6 +1228,11 @@ class SchedulerBackend:
         from .backends import trim_stop_texts
 
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+        if stats_out is not None:
+            # Accounting seam for GenerationService.generate_stream: the
+            # prompt is tokenized here anyway, and chunk counts are not
+            # token counts (holdbacks merge many tokens into one chunk).
+            stats_out["prompt_tokens"] = len(ids)
         toks: "queue.Queue[int]" = queue.Queue()
         fut = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
@@ -1210,33 +1243,43 @@ class SchedulerBackend:
         emitted = ""
         hold = max((len(s) for s in self.stop_texts), default=1) - 1
 
-        done = False
-        while not done:
-            try:
-                out_ids.append(toks.get(timeout=0.05))
-            except queue.Empty:
-                done = fut.done()
-                continue
-            text = self.tokenizer.decode(out_ids)
-            trimmed = trim_stop_texts(text, self.stop_texts)
-            if trimmed != text:  # a stop text landed: flush to it and end
-                if len(trimmed) > len(emitted):
-                    yield trimmed[len(emitted):]
-                fut.result()  # surface scheduler errors before return
-                return
-            # Emit up to the holdback horizon, minus any trailing partial
-            # multi-byte replacement char.
-            safe = text[: len(text) - hold if hold else len(text)]
-            delta = safe[len(emitted):]
-            if delta and not delta.endswith("�"):
-                emitted += delta
-                yield delta
-        fut.result()  # propagate errors; also syncs the final token list
-        while not toks.empty():
-            out_ids.append(toks.get_nowait())
-        text = trim_stop_texts(self.tokenizer.decode(out_ids), self.stop_texts)
-        if len(text) > len(emitted):
-            yield text[len(emitted):]
+        try:
+            done = False
+            while not done:
+                try:
+                    out_ids.append(toks.get(timeout=0.05))
+                except queue.Empty:
+                    done = fut.done()
+                    continue
+                text = self.tokenizer.decode(out_ids)
+                trimmed = trim_stop_texts(text, self.stop_texts)
+                if trimmed != text:  # a stop text landed: flush and end
+                    if len(trimmed) > len(emitted):
+                        yield trimmed[len(emitted):]
+                    fut.result()  # surface scheduler errors before return
+                    return
+                # Emit up to the holdback horizon, minus any trailing
+                # partial multi-byte replacement char.
+                safe = text[: len(text) - hold if hold else len(text)]
+                delta = safe[len(emitted):]
+                if delta and not delta.endswith("�"):
+                    emitted += delta
+                    yield delta
+            fut.result()  # propagate errors; also syncs the token list
+            while not toks.empty():
+                out_ids.append(toks.get_nowait())
+            text = trim_stop_texts(
+                self.tokenizer.decode(out_ids), self.stop_texts
+            )
+            if len(text) > len(emitted):
+                yield text[len(emitted):]
+        finally:
+            # Consumer gone mid-stream (GeneratorExit lands on a yield):
+            # cancel so the slot stops decoding an abandoned request.
+            if not fut.done():
+                self.scheduler.cancel(fut)
+            if stats_out is not None:
+                stats_out["output_tokens"] = len(out_ids)
 
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0):

@@ -137,6 +137,7 @@ class GenerationService:
         rendered = entry.template(system, prompt)
         t0 = time.perf_counter()
         out_tokens = prompt_tokens = 0
+        stream_stats: dict = {}
         try:
             streamer = getattr(entry.backend, "complete_stream", None)
             if streamer is None:
@@ -149,24 +150,31 @@ class GenerationService:
                 if completion.text:
                     yield completion.text
             else:
-                tok = getattr(entry.backend, "tokenizer", None)
-                if tok is not None:
-                    prompt_tokens = len(tok.encode(
-                        rendered,
-                        add_bos=getattr(entry.backend, "add_bos", True),
-                    ))
-                with trace_capture(f"generate-{model}"):
-                    for chunk in streamer(
-                        rendered, max_new_tokens=max_new_tokens,
-                        sampling=sampling, seed=seed,
-                    ):
-                        out_tokens += 1  # ~1 chunk/token (held-back merges)
-                        yield chunk
+                # The backend fills real token counts through stats_out
+                # (chunk counts are not token counts; re-encoding here
+                # would tokenize the prompt twice).
+                inner = streamer(
+                    rendered, max_new_tokens=max_new_tokens,
+                    sampling=sampling, seed=seed, stats_out=stream_stats,
+                )
+                try:
+                    with trace_capture(f"generate-{model}"):
+                        for chunk in inner:
+                            yield chunk
+                finally:
+                    # Deterministically unwind the backend generator (its
+                    # finally cancels the scheduler request and fills
+                    # stats_out) BEFORE the accounting below reads it — a
+                    # disconnect would otherwise leave it to the GC.
+                    inner.close()
         finally:
             # Record even when the client disconnects mid-stream (the WSGI
             # server close()s the generator -> GeneratorExit lands here):
             # disconnect-heavy streaming must not vanish from the serving
-            # metrics.
+            # metrics. The backend's own finally has filled stats_out by
+            # the time the generator unwinds.
+            out_tokens = stream_stats.get("output_tokens", out_tokens)
+            prompt_tokens = stream_stats.get("prompt_tokens", prompt_tokens)
             latency = time.perf_counter() - t0
             with self._lock:
                 s = self.stats[model]

@@ -94,6 +94,42 @@ def test_backend_complete_stream_stop_text_spanning_chunks(tiny):
         backend.shutdown()
 
 
+def test_stream_close_cancels_scheduler_request(tiny):
+    """A consumer abandoning the stream (generator close) must free the
+    slot instead of decoding the full budget for nobody."""
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=1, decode_chunk=2, prompt_bucket=8,
+        stop_ids=(-1,), max_seq=128,
+    )
+    backend = SchedulerBackend(sched, tok, max_new_tokens=90)
+    try:
+        gen = backend.complete_stream("ab")
+        next(gen)       # stream started, request in flight
+        gen.close()     # client disconnect
+        # The single slot must come free again: a fresh request completes.
+        out = backend.complete("cd", max_new_tokens=4)
+        assert out.output_tokens == 4
+        assert all(r is None for r in sched._slot_req)
+    finally:
+        backend.shutdown()
+
+
+def test_cancel_queued_request_never_occupies_slot(tiny):
+    cfg, params = tiny
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=1, decode_chunk=2, prompt_bucket=8,
+        stop_ids=(-1,), max_seq=128,
+    )
+    with sched:
+        busy = sched.submit([1, 2], max_new_tokens=60)
+        queued = sched.submit([1, 3], max_new_tokens=60)
+        sched.cancel(queued)
+        assert queued.result(timeout=60) is not None  # resolves, not hangs
+        assert len(busy.result(timeout=60)) == 60
+
+
 def test_service_generate_stream_fake_backend_single_chunk():
     from llm_based_apache_spark_optimization_tpu.serve import FakeBackend
 
@@ -135,5 +171,12 @@ def test_api_generate_endpoint_blocking_and_streaming(tmp_path):
 
     r = client.post_json("/api/generate", {"model": "nope", "prompt": "q"})
     assert r.status == 404
+    r = client.post_json("/api/generate",
+                         {"model": "nope", "prompt": "q", "stream": True})
+    assert r.status == 404  # resolved before any stream headers
     r = client.post_json("/api/generate", {"prompt": "q"})
+    assert r.status == 400
+    r = client.post_json("/api/generate",
+                         {"model": "duckdb-nsql", "prompt": "q",
+                          "max_new_tokens": "100"})
     assert r.status == 400
