@@ -55,6 +55,16 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   `SchedulerPool` that round-robins admissions. That matches the workload:
   serving throughput scales with independent replicas; there is no gradient
   all-reduce to motivate a fused dp program (inference-only framework).
+- **int8 KV cache** (`kv_quant="int8"`): the persistent window stores int8
+  values + per-slot f32 scales (ops/quant.quantize_kv) — half the HBM
+  footprint and decode streaming. Decode runs the int8-streaming einsum
+  attention; chunked prefill dequantizes the gathered rows for the chunk
+  forward and requantizes on scatter-back.
+- **Streaming + cancellation**: `submit(on_token=...)` delivers accepted
+  tokens in order from the worker thread (SchedulerBackend.complete_stream
+  turns them into clean text deltas, byte-identical to the blocking path);
+  `cancel(future)` retires an abandoned request at its next harvest so
+  disconnected clients do not pin slots.
 
 - **Async issue/harvest pipeline**: decode rounds, prompt chunks and
   admission scatters dispatch without waiting; per-slot state (cur/pos/
