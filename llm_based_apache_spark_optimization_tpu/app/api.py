@@ -116,6 +116,14 @@ def create_api_app(
                     "model": model, "response": res.response, "done": True,
                 })
 
+            # Pre-validate the request shape (oversize prompt / no decode
+            # room) while a 400 is still possible: the generator below runs
+            # AFTER 200 headers are sent, where the identical ValueError
+            # could only become a mid-stream error line — and the blocking
+            # branch of this same endpoint answers 400.
+            service.validate(model, prompt, system=system,
+                             max_new_tokens=max_new)
+
             def chunks():
                 try:
                     for piece in service.generate_stream(

@@ -152,6 +152,25 @@ class EngineBackend:
         )
         return cls(engine, tokenizer, **kwargs)
 
+    def check_budget(self, prompt: str,
+                     max_new_tokens: Optional[int] = None) -> None:
+        """Raise ValueError if `prompt` leaves no decode room — the same
+        rejection complete() would make, runnable BEFORE any response
+        bytes go on the wire (streaming handlers must turn request-shape
+        errors into 400s, which is impossible once 200 headers are sent)."""
+        ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+        self._room(len(ids))
+
+    def _room(self, n_prompt_tokens: int) -> int:
+        cfg = self.engine.cfg
+        room = cfg.max_seq_len - self.engine.padded_prompt_len(n_prompt_tokens)
+        if room < 1:
+            raise ValueError(
+                f"prompt ({n_prompt_tokens} tokens) leaves no room in the "
+                f"{cfg.max_seq_len}-token context of {cfg.name}"
+            )
+        return room
+
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
@@ -159,13 +178,7 @@ class EngineBackend:
         # bucketed (and sp-padded, on a sequence-parallel mesh) prompt: a
         # serving backend degrades to a shorter completion instead of
         # erroring (the engine itself raises on overflow).
-        cfg = self.engine.cfg
-        room = cfg.max_seq_len - self.engine.padded_prompt_len(len(ids))
-        if room < 1:
-            raise ValueError(
-                f"prompt ({len(ids)} tokens) leaves no room in the "
-                f"{cfg.max_seq_len}-token context of {cfg.name}"
-            )
+        room = self._room(len(ids))
         budget = min(max_new_tokens or self.max_new_tokens, room)
         with self._lock:
             out = self.engine.generate(

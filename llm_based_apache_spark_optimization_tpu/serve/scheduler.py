@@ -438,10 +438,14 @@ class ContinuousBatchingScheduler:
             k-batch is safe without duplicate-slot scatters.
 
             With kv_quant, the gathered rows dequantize to the compute
-            dtype for the chunk forward and the updated rows requantize
-            before the scatter. Absmax-int8 requantization is idempotent
-            up to the bf16 rounding of the dequantized values, so earlier
-            chunks' entries drift by at most their own quantization noise.
+            dtype for the chunk forward, but only THIS chunk's window
+            [start, start+t) requantizes and scatters back: a full-row
+            scatter would round-trip earlier chunks' entries
+            int8→bf16→int8 once per subsequent chunk, and bf16 rounding
+            of q8·s can flip int8 LSBs each pass — drift would accumulate
+            over a long multi-chunk prompt. Windowed, every entry is
+            quantized exactly once (scales are per-position, so the
+            window owns its scales too).
             """
             cache = args[:nc]
             (tokens, lengths, slots, starts, temps, topps, topks,
@@ -466,12 +470,36 @@ class ContinuousBatchingScheduler:
             if quant:
                 from ..ops.quant import quantize_cache
 
-                new_rows = _cache_tuple(quantize_cache(new["k"], new["v"]))
+                # Window gather BY THE SAME pos_idx the scatter uses — not a
+                # dynamic_slice, whose clamped *start* would shift the whole
+                # window when a prefix-cache-misaligned final chunk runs
+                # past S (start + t_bucket > S): gather clamps and scatter
+                # drops PER ELEMENT, so every in-bounds position j still
+                # maps new[start+j] -> cache[start+j] and only the
+                # past-the-end tail (whose writes the old full-row scatter
+                # also never materialized) degenerates.
+                pos_idx = (
+                    starts[:, None]
+                    + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
+                )
+                row_ar = jnp.arange(pos_idx.shape[0], dtype=jnp.int32)
+                # Advanced indices at non-adjacent dims broadcast to the
+                # FRONT: windows come out [k, t, L, K(, H)] — exactly the
+                # layout the scatter below expects.
+                wk = new["k"][:, row_ar[:, None], :, pos_idx]
+                wv = new["v"][:, row_ar[:, None], :, pos_idx]
+                wins = _cache_tuple(quantize_cache(wk, wv))
+                cache = tuple(
+                    # OOB padding slots / past-the-end positions drop their
+                    # writes (jax scatter OOB semantics), as before.
+                    c.at[:, slots[:, None], :, pos_idx].set(w)
+                    for c, w in zip(cache, wins)
+                )
             else:
-                new_rows = (new["k"], new["v"])
-            cache = tuple(
-                c.at[:, slots].set(n) for c, n in zip(cache, new_rows)
-            )
+                cache = tuple(
+                    c.at[:, slots].set(n)
+                    for c, n in zip(cache, (new["k"], new["v"]))
+                )
             keys = jax.vmap(
                 lambda s: jax.random.fold_in(jax.random.key(s), 0)
             )(seeds)
@@ -695,6 +723,18 @@ class ContinuousBatchingScheduler:
                 if tuple(req.ids[: (n + 1) * pb]) not in self._prefix_cache:
                     break
                 n += 1
+            # Cap reuse so the chunk envelope stays inside the cache: the
+            # un-reused chunking ends at bucket_len(P) <= max_seq-1, but a
+            # block-aligned (not bucket-aligned) reuse offset R shifts every
+            # chunk start, and the final chunk (whose BUCKET can exceed the
+            # tokens left) can then end past the cache. forward's cache
+            # write is a dynamic_update_slice whose clamped START would
+            # silently shift the whole chunk's KV — so shrink the reuse
+            # until the exact envelope fits (n=0 restores the proven-safe
+            # un-reused geometry).
+            s_cache = self._cache[0].shape[3]
+            while n and self._chunk_end(n * pb, len(req.ids)) > s_cache:
+                n -= 1
             for j in range(n):
                 key = tuple(req.ids[: (j + 1) * pb])
                 blocks = self._prefix_cache[key]
@@ -714,6 +754,21 @@ class ContinuousBatchingScheduler:
         return next(
             (b for b in self._buckets if b >= remaining), self.prompt_bucket
         )
+
+    def _chunk_end(self, start: int, total: int) -> int:
+        """Highest cache position (exclusive) the chunked prefill of tokens
+        [start, total) will WRITE — the final chunk writes its whole bucket,
+        which can exceed the tokens left. Mirrors _next_bucket's chunking."""
+        end = start
+        while start < total:
+            remaining = total - start
+            t = next(
+                (b for b in self._buckets if b >= remaining),
+                self.prompt_bucket,
+            )
+            end = start + t
+            start += min(t, remaining)
+        return end
 
     def _prefill_step(self) -> None:
         """Run ONE prompt chunk for up to `_prefill_kmax` waiting requests
@@ -1203,6 +1258,15 @@ class SchedulerBackend:
         )
         return cls(sched, tokenizer, **kwargs)
 
+    def check_budget(self, prompt: str,
+                     max_new_tokens: Optional[int] = None) -> None:
+        """Raise ValueError if `prompt` leaves no decode room in the serving
+        window — the same rejection complete()/complete_stream() would make,
+        runnable BEFORE a streaming handler puts 200 headers on the wire
+        (after which a request-shape error can only be a mid-stream line)."""
+        ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+        self._budget(len(ids), max_new_tokens)
+
     def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
         sched = self.scheduler
         overshoot = (sched._harvest_lag + 1) * sched.decode_chunk
@@ -1266,7 +1330,15 @@ class SchedulerBackend:
                 if trimmed != text:  # a stop text landed: flush and end
                     if len(trimmed) > len(emitted):
                         yield trimmed[len(emitted):]
-                    fut.result()  # surface scheduler errors before return
+                    # Stop texts are host-side only (the scheduler knows stop
+                    # IDS, not stop strings): without a cancel the slot keeps
+                    # decoding the full remaining budget for text that is
+                    # already final, delaying the terminal chunk and the
+                    # slot's release. Cancel retires it at the next harvest;
+                    # the future then resolves with what was generated, so
+                    # result() still surfaces scheduler errors.
+                    self.scheduler.cancel(fut)
+                    fut.result()
                     return
                 # Emit up to the holdback horizon, minus any trailing
                 # partial multi-byte replacement char.

@@ -63,6 +63,14 @@ class GenerationService:
     def models(self):
         return sorted(self._models)
 
+    def _entry(self, model: str) -> ModelEntry:
+        entry = self._models.get(model)
+        if entry is None:
+            raise KeyError(
+                f"model {model!r} is not registered; available: {self.models()}"
+            )
+        return entry
+
     def close(self) -> None:
         """Shut down owned backend resources (scheduler threads, slot-pool
         caches). Idempotent; shared backends (one scheduler behind two
@@ -85,11 +93,7 @@ class GenerationService:
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
     ) -> GenerateResult:
-        entry = self._models.get(model)
-        if entry is None:
-            raise KeyError(
-                f"model {model!r} is not registered; available: {self.models()}"
-            )
+        entry = self._entry(model)
         rendered = entry.template(system, prompt)
         t0 = time.perf_counter()
         with trace_capture(f"generate-{model}"):
@@ -116,6 +120,30 @@ class GenerationService:
             output_tokens=completion.output_tokens,
         )
 
+    def validate(
+        self,
+        model: str,
+        prompt: str,
+        system: str = "",
+        max_new_tokens: Optional[int] = None,
+    ) -> None:
+        """Raise the same KeyError/ValueError generate() would raise for a
+        bad model name or an oversize prompt — WITHOUT generating. Streaming
+        handlers call this before sending response headers: a request-shape
+        error must become a 400/404 status, which is impossible once the
+        NDJSON stream's 200 is on the wire. Backends without a budget seam
+        (fakes) validate trivially.
+
+        The check tokenizes the rendered prompt a second time (the
+        generate call re-encodes it); that is host-side microseconds per
+        kilotoken against a device TTFT of tens of milliseconds, and
+        keeping validate() stateless beats threading encoded ids through
+        the service/backend seam."""
+        entry = self._entry(model)
+        check = getattr(entry.backend, "check_budget", None)
+        if check is not None:
+            check(entry.template(system, prompt), max_new_tokens)
+
     def generate_stream(
         self,
         model: str,
@@ -129,11 +157,7 @@ class GenerationService:
         `stream=true` surface). Backends without a `complete_stream` seam
         (the one-XLA-program engine, fakes) degrade to a single chunk.
         Metrics record the request exactly like generate()."""
-        entry = self._models.get(model)
-        if entry is None:
-            raise KeyError(
-                f"model {model!r} is not registered; available: {self.models()}"
-            )
+        entry = self._entry(model)
         rendered = entry.template(system, prompt)
         t0 = time.perf_counter()
         out_tokens = prompt_tokens = 0
@@ -203,11 +227,7 @@ class GenerationService:
         request's latency when submitted together); tok/s aggregates across
         the batch in the metrics registry.
         """
-        entry = self._models.get(model)
-        if entry is None:
-            raise KeyError(
-                f"model {model!r} is not registered; available: {self.models()}"
-            )
+        entry = self._entry(model)
         rendered = [entry.template(system, p) for p in prompts]
         t0 = time.perf_counter()
         with trace_capture(f"generate-batch-{model}"):

@@ -162,6 +162,65 @@ def test_scheduler_kv_quant_multichunk_and_prefix_cache(tiny):
     assert sched.prefix_stats["blocks_reused"] > 0
 
 
+def test_kv_quant_windowed_scatter_survives_prefix_misalignment(tiny):
+    """Regression: prefix-cache reuse offsets chunk starts by BLOCK (16)
+    rather than bucket multiples, so a final chunk can have
+    start + bucket > max_seq. The windowed int8 requant must gather and
+    scatter per element (gather clamps, scatter drops) — a dynamic_slice
+    whose clamped *start* shifted the whole window would write position
+    start+j the KV of position start+j-shift, silently corrupting the tail
+    of a real prompt."""
+    import dataclasses
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg0, params = tiny
+    cfg = dataclasses.replace(cfg0, name="tiny-long", max_seq_len=512)
+    rng = np.random.default_rng(7)
+    shared = [1] + [int(x) for x in rng.integers(3, 300, size=15)]
+    x_ids = shared + [int(v) for v in rng.integers(3, 300, size=111)]
+    y_ids = shared + [int(v) for v in rng.integers(3, 300, size=111)]
+    assert len(x_ids) == len(y_ids) == 127
+
+    def run(prefix_blocks, max_seq):
+        sched = ContinuousBatchingScheduler(
+            cfg, params, num_slots=1, decode_chunk=2, prompt_bucket=64,
+            stop_ids=(-1,), max_seq=max_seq, kv_quant="int8",
+            prefix_cache_blocks=prefix_blocks,
+        )
+        with sched:
+            if prefix_blocks:
+                sched.submit(x_ids, max_new_tokens=2).result()  # seen
+                sched.submit(x_ids, max_new_tokens=2).result()  # published
+            sched.submit(y_ids, max_new_tokens=2).result()
+        k8, ks = jax.device_get((sched._cache[0], sched._cache[1]))
+        # Dequantized K for slot 0, prompt positions [16, 127).
+        deq = (k8[:, 0, :, 16:127].astype(np.float32)
+               * ks[:, 0, :, 16:127, None])
+        return deq, sched.prefix_stats["blocks_reused"]
+
+    # max_seq=144: Y reuses the shared 16-token block and chunks as
+    # [16,80) then start=80, t=64 — ending exactly at the cache edge.
+    ref, _ = run(0, 144)
+    reused, n_blocks = run(8, 144)
+    assert n_blocks >= 1
+    # Chunk boundaries differ between the runs, so values drift by chained
+    # quantization noise — but a shifted window would leave the tail
+    # positions essentially uncorrelated with the reference.
+    err = np.linalg.norm(reused - ref) / np.linalg.norm(ref)
+    assert err < 0.2, f"relative error {err:.3f}: window misaligned"
+
+    # max_seq=136: the same reuse would chunk [80,144) PAST the cache,
+    # where forward's dynamic_update_slice would clamp the start and shift
+    # the whole chunk's KV — admission must cap the reuse instead.
+    ref136, _ = run(0, 136)
+    capped, _ = run(8, 136)
+    err = np.linalg.norm(capped - ref136) / np.linalg.norm(ref136)
+    assert err < 0.2, f"relative error {err:.3f}: overflow chunk formed"
+
+
 def test_kv_quant_rejects_non_einsum_decode(tiny):
     cfg, params = tiny
     from llm_based_apache_spark_optimization_tpu.engine import make_generate_fn
