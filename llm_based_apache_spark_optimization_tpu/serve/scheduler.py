@@ -470,18 +470,16 @@ class ContinuousBatchingScheduler:
             if quant:
                 from ..ops.quant import quantize_cache
 
-                # Window gather BY THE SAME pos_idx the scatter uses — not a
-                # dynamic_slice, whose clamped *start* would shift the whole
-                # window when a prefix-cache-misaligned final chunk runs
-                # past S (start + t_bucket > S): gather clamps and scatter
-                # drops PER ELEMENT, so every in-bounds position j still
-                # maps new[start+j] -> cache[start+j] and only the
-                # past-the-end tail (whose writes the old full-row scatter
-                # also never materialized) degenerates.
-                pos_idx = (
-                    starts[:, None]
-                    + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
-                )
+                # Window gather BY THE SAME positions the forward wrote and
+                # the scatter below targets — not a dynamic_slice, whose
+                # clamped *start* would shift the whole window when a
+                # prefix-cache-misaligned final chunk runs past S
+                # (start + t_bucket > S): gather clamps and scatter drops
+                # PER ELEMENT, so every in-bounds position j still maps
+                # new[start+j] -> cache[start+j] and only the past-the-end
+                # tail (whose writes the old full-row scatter also never
+                # materialized) degenerates.
+                pos_idx = positions  # [k, t] = starts[:, None] + arange(t)
                 row_ar = jnp.arange(pos_idx.shape[0], dtype=jnp.int32)
                 # Advanced indices at non-adjacent dims broadcast to the
                 # FRONT: windows come out [k, t, L, K(, H)] — exactly the

@@ -248,6 +248,7 @@ def test_pipeline_runs_are_isolated_per_backend_factory(cfg, tmp_path):
     assert len(calls) == 2
 
 
+@pytest.mark.slow
 def test_checkpoint_backend_cli_wiring(tiny_model, tmp_path):
     """--backend checkpoint: HF dir + tokenizer.json -> live service."""
     import argparse
@@ -287,6 +288,7 @@ def test_checkpoint_backend_cli_wiring(tiny_model, tmp_path):
     assert out.output_tokens >= 1
 
 
+@pytest.mark.slow
 def test_checkpoint_backend_cli_scheduler_default(tiny_model, tmp_path):
     """The product default (--scheduler): checkpoint models served through
     continuous-batching schedulers, concurrent requests sharing one decode
@@ -344,6 +346,7 @@ def test_checkpoint_backend_cli_scheduler_default(tiny_model, tmp_path):
         sql.scheduler.shutdown()
 
 
+@pytest.mark.slow
 def test_checkpoint_backend_cli_scheduler_pool_dp2(tiny_model, tmp_path):
     """--scheduler --dp 2 --tp 2: each dp replica owns a tp=2 submesh and a
     slot pool; requests round-robin through one SchedulerPool backend and

@@ -52,6 +52,7 @@ def _tiny_hf_model(tmp_path, tie=False, kv_heads=2):
 
 
 @pytest.mark.parametrize("tie", [False, True])
+@pytest.mark.slow
 def test_hf_logits_parity(tmp_path, tie):
     hf_model, ckpt_dir = _tiny_hf_model(tmp_path, tie=tie)
     cfg, params = load_hf_checkpoint(ckpt_dir, dtype=jnp.float32)

@@ -1,5 +1,7 @@
 """Eval-harness tier: metrics math + suite scoring against fake models."""
 
+import pytest
+
 from llm_based_apache_spark_optimization_tpu.evalh import (
     FOUR_QUERY_SUITE,
     TAXI_DDL_SYSTEM,
@@ -251,6 +253,7 @@ def test_load_spider_real_format(tmp_path):
     assert ec.nl == c0.nl and ec.expected_sql == c0.expected_sql
 
 
+@pytest.mark.slow
 def test_run_config_mesh_honesty():
     """Config rows must state the mesh that actually ran: with a factory and
     8 CPU virtual devices the tp=4 config builds a real tp=4 mesh; without a

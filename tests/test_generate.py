@@ -1,5 +1,7 @@
 """Engine tests: generate loop, stop tokens, sampling, batching raggedness."""
 
+import pytest  # noqa: F401
+
 import json
 from pathlib import Path
 
@@ -14,6 +16,7 @@ from llm_based_apache_spark_optimization_tpu.ops import SamplingParams
 from llm_based_apache_spark_optimization_tpu.ops.sampling import sample
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_manual_loop(tiny_model):
     """The jitted while_loop decode must equal a hand-rolled argmax loop."""
     cfg, params = tiny_model
@@ -128,6 +131,7 @@ def test_golden_decode_pinned_tokens(tiny_model):
     )
 
 
+@pytest.mark.slow
 def test_sample_runtime_fused_cutoffs():
     """The single-sort top-k∩top-p cutoff restricts support exactly: k=2
     draws stay in the top-2 set; p-only draws stay inside the nucleus."""
@@ -201,6 +205,7 @@ def test_engine_default_stop_ids_include_config_extras(tiny_model):
     assert eng.stop_ids == (chat_cfg.eos_id, 7, 9)
 
 
+@pytest.mark.slow
 def test_sliding_window_decode_crosses_boundary(tiny_model):
     """Mistral-style sliding-window attention: cached decode that crosses
     the window boundary must equal a full no-cache recompute at every step

@@ -24,6 +24,7 @@ from llm_based_apache_spark_optimization_tpu.ops.attention import (
 from llm_based_apache_spark_optimization_tpu.ops.quant import quantize_kv
 
 
+@pytest.mark.slow
 def test_quantize_kv_roundtrip_error_bounded():
     x = jax.random.normal(jax.random.key(0), (2, 3, 16, 8), jnp.float32)
     q = quantize_kv(x)
@@ -35,6 +36,7 @@ def test_quantize_kv_roundtrip_error_bounded():
     assert (err <= bound).all()
 
 
+@pytest.mark.slow
 def test_quantized_attention_matches_dequantized_reference():
     b, t, n, kh, s, h = 2, 1, 4, 2, 24, 16
     key = jax.random.key(1)
@@ -54,6 +56,7 @@ def test_quantized_attention_matches_dequantized_reference():
     )
 
 
+@pytest.mark.slow
 def test_quantized_attention_sliding_window():
     b, t, n, kh, s, h = 1, 1, 4, 2, 32, 8
     q = jax.random.normal(jax.random.key(4), (b, t, n, h), jnp.float32)
@@ -81,6 +84,7 @@ def tiny():
 PROMPTS = [[1, 5, 9, 5, 9, 3], [1, 7], [1, 3, 4, 8, 10, 2, 6]]
 
 
+@pytest.mark.slow
 def test_engine_kv_quant_outputs_track_bf16(tiny):
     """Random tiny weights: int8-KV greedy decode should agree with the
     full-precision engine on most tokens (quant noise may flip near-ties,
@@ -99,6 +103,7 @@ def test_engine_kv_quant_outputs_track_bf16(tiny):
     assert agree / total >= 0.7, f"only {agree}/{total} tokens agree"
 
 
+@pytest.mark.slow
 def test_engine_kv_quant_sampled_and_stops(tiny):
     cfg, params = tiny
     from llm_based_apache_spark_optimization_tpu.ops.sampling import SamplingParams
@@ -116,6 +121,7 @@ def test_engine_kv_quant_sampled_and_stops(tiny):
     assert out2 == [probe[0]]
 
 
+@pytest.mark.slow
 def test_scheduler_kv_quant_matches_engine_kv_quant(tiny):
     """Greedy parity: the scheduler's int8-KV serving path must reproduce
     the int8-KV engine exactly for single-chunk prompts (identical
@@ -141,6 +147,7 @@ def test_scheduler_kv_quant_matches_engine_kv_quant(tiny):
     assert out == golden
 
 
+@pytest.mark.slow
 def test_scheduler_kv_quant_multichunk_and_prefix_cache(tiny):
     """Multi-chunk prompts (chunked prefill requantization) and prefix-cache
     reuse both produce well-formed, repeatable completions under int8 KV."""
@@ -162,6 +169,7 @@ def test_scheduler_kv_quant_multichunk_and_prefix_cache(tiny):
     assert sched.prefix_stats["blocks_reused"] > 0
 
 
+@pytest.mark.slow
 def test_kv_quant_windowed_scatter_survives_prefix_misalignment(tiny):
     """Regression: prefix-cache reuse offsets chunk starts by BLOCK (16)
     rather than bucket multiples, so a final chunk can have

@@ -97,6 +97,7 @@ def test_forward_shapes_and_finite(tiny_model):
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
+@pytest.mark.slow
 def test_cached_incremental_forward_matches_full_forward(tiny_model):
     """Prefill+decode through the cache == one full no-cache forward."""
     cfg, params = tiny_model
@@ -138,6 +139,7 @@ def test_causality_future_tokens_do_not_affect_past_logits(tiny_model):
     )
 
 
+@pytest.mark.slow
 def test_untied_head_used_when_config_untied():
     from llm_based_apache_spark_optimization_tpu.models.configs import LlamaConfig
     import dataclasses

@@ -10,6 +10,8 @@ dp-sharded global batch from per-host rows — asserting the semantics
 (VERDICT r2 missing #5 / next #9).
 """
 
+import pytest  # noqa: F401
+
 import socket
 import subprocess
 import sys
@@ -62,6 +64,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_global_mesh_and_batch_assembly(tmp_path):
     child = tmp_path / "mh_child.py"
     child.write_text(_CHILD.format(repo=str(REPO)))

@@ -52,6 +52,7 @@ def test_native_bpe_roundtrip(trained):
         assert trained.decode(ids) == text
 
 
+@pytest.mark.slow
 def test_native_bpe_long_input(trained):
     text = " ".join(CORPUS) * 200  # ~10k chars: the hot-loop case
     py_tok = BPETokenizer(

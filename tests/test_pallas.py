@@ -48,11 +48,13 @@ def _ref_and_flash(b, t, s, n, kh, h, *, window=None, block_kv=512, seed=0):
         (2, 4, 20, 6, 3, 32),    # chunked prefill over longer cache
     ],
 )
+@pytest.mark.slow
 def test_flash_matches_einsum(b, t, s, n, kh, h):
     ref, out = _ref_and_flash(b, t, s, n, kh, h)
     np.testing.assert_allclose(ref, out, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_ragged_kv_blocks():
     # S=20 with block_kv=8 -> 3 blocks, last one ragged: out-of-range slots
     # must be masked, not read as garbage.
@@ -72,6 +74,7 @@ def test_flash_sliding_window():
 
 
 @pytest.mark.parametrize("dp,tp", [(1, 2), (2, 2), (2, 1)])
+@pytest.mark.slow
 def test_sharded_flash_matches_einsum(dp, tp):
     """shard_map-wrapped kernel under a dp×tp mesh == unsharded einsum.
 
@@ -93,6 +96,7 @@ def test_sharded_flash_matches_einsum(dp, tp):
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_generate_parity_sharded_pallas_vs_xla(tiny_model):
     """Whole generate loop on a dp×tp mesh: flash == einsum token-for-token."""
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
@@ -113,6 +117,7 @@ def test_generate_parity_sharded_pallas_vs_xla(tiny_model):
     assert ref == out
 
 
+@pytest.mark.slow
 def test_generate_parity_pallas_vs_xla(tiny_model):
     """Whole generate loop: flash path produces the same tokens as einsum."""
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
@@ -194,6 +199,7 @@ def test_flash_kv_lens_zero_parks_row():
     )
 
 
+@pytest.mark.slow
 def test_scheduler_parity_with_pallas_kv_lens(tiny_model):
     """End-to-end: the scheduler under attn impl 'pallas' (which now passes
     active-masked kv_lens) must still match the engine goldens exactly."""

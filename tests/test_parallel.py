@@ -69,6 +69,7 @@ def test_sharded_forward_matches_unsharded(tiny_model):
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_sharded_generate_matches_unsharded(tiny_model):
     cfg, params = tiny_model
     mesh = make_mesh(dp=4, tp=2)

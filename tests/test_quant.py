@@ -1,5 +1,7 @@
 """Int8 weight-only quantization: numerics, forward quality, TP composition."""
 
+import pytest  # noqa: F401
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -77,6 +79,7 @@ def test_quantized_generate_runs(tiny_model):
     assert len(out) == 2 and all(len(o) >= 1 for o in out)
 
 
+@pytest.mark.slow
 def test_quantized_tp_generate_matches_single_device(tiny_model):
     cfg, params = tiny_model
     qp = quantize_params(params)

@@ -40,6 +40,7 @@ def _dense_golden(q, k, v, positions, sliding_window=None):
     [(1, 8, 1), (2, 4, 1), (1, 4, 2), (2, 2, 2)],
     ids=["sp8", "dp2sp4", "sp4tp2", "dp2sp2tp2"],
 )
+@pytest.mark.slow
 def test_ring_matches_dense(dp, sp, tp):
     b, t, n, kh, h = 2 * dp, 8 * sp, 4, 2, 16
     q, k, v = _rand_qkv(jax.random.key(0), b, t, n, kh, h)

@@ -39,6 +39,7 @@ def fixture_ckpt(tmp_path_factory):
     return root
 
 
+@pytest.mark.slow
 def test_runbook_one_command_report_and_cache(fixture_ckpt, tmp_path, capsys):
     from llm_based_apache_spark_optimization_tpu import runbook
 

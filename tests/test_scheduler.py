@@ -64,6 +64,7 @@ def test_slot_reuse_more_requests_than_slots(tiny_model_module):
     assert out == golden
 
 
+@pytest.mark.slow
 def test_concurrent_submitters(tiny_model_module):
     cfg, params = tiny_model_module
     golden = engine_golden(cfg, params, PROMPTS, max_new=5)
@@ -80,6 +81,7 @@ def test_concurrent_submitters(tiny_model_module):
     assert [results[i] for i in range(len(PROMPTS))] == golden
 
 
+@pytest.mark.slow
 def test_stop_token_frees_slot(tiny_model_module):
     """Force a stop id that random weights hit, and check completions end there."""
     cfg, params = tiny_model_module
@@ -142,6 +144,7 @@ def test_top_k_sampling_supported(tiny_model_module):
     assert all(0 <= t < cfg.vocab_size for t in out_k5[0])
 
 
+@pytest.mark.slow
 def test_seed_reproducible_across_batch_composition(tiny_model_module):
     """A sampled request must reproduce its tokens for the same seed no
     matter what other traffic shares the batch, and differ across seeds."""
@@ -166,6 +169,7 @@ def test_seed_reproducible_across_batch_composition(tiny_model_module):
     assert alone != other_seed  # overwhelmingly, in 6 tokens at T=0.9
 
 
+@pytest.mark.slow
 def test_multibucket_prefill(tiny_model_module):
     """Short prompts use a small prefill bucket; a long prompt still streams
     through chunked prefill — outputs stay engine-exact either way."""
@@ -184,6 +188,7 @@ def test_multibucket_prefill(tiny_model_module):
         )
 
 
+@pytest.mark.slow
 def test_scheduler_pool_round_robin(tiny_model_module):
     """SchedulerPool (the dp>1 story): replicas serve engine-exact greedy."""
     from llm_based_apache_spark_optimization_tpu.serve import SchedulerPool
@@ -215,6 +220,7 @@ def test_scheduler_backend_seam(tiny_model_module):
         sched.shutdown()
 
 
+@pytest.mark.slow
 def test_tp_sharded_scheduler(tiny_model_module):
     """TP over the virtual CPU mesh: outputs match the unsharded golden."""
     import jax
@@ -233,6 +239,7 @@ def test_tp_sharded_scheduler(tiny_model_module):
         ContinuousBatchingScheduler(cfg, params, mesh=dp_mesh)
 
 
+@pytest.mark.slow
 def test_tp_sharded_scheduler_pallas(tiny_model_module):
     """TP mesh + flash kernel (the BASELINE 4/5 serving stack): the scheduler
     must route its forward() calls through the shard_map pallas wrapper and
@@ -254,6 +261,7 @@ def test_tp_sharded_scheduler_pallas(tiny_model_module):
     assert out == golden
 
 
+@pytest.mark.slow
 def test_scheduler_pool_skips_crashed_replica(tiny_model_module):
     """A crashed replica must not keep eating its round-robin share."""
     from llm_based_apache_spark_optimization_tpu.serve import SchedulerPool
@@ -273,6 +281,7 @@ def test_scheduler_pool_skips_crashed_replica(tiny_model_module):
             s._crash = None  # let shutdown() join cleanly
 
 
+@pytest.mark.slow
 def test_prefix_cache_parity_and_hits(tiny_model_module):
     """Requests sharing a schema-style prefix reuse cached K/V blocks
     (skipping that prefill work) and still match the engine token-for-token."""
@@ -298,6 +307,7 @@ def test_prefix_cache_parity_and_hits(tiny_model_module):
     assert stats["cached_blocks"] > 0
 
 
+@pytest.mark.slow
 def test_prefix_cache_lru_capacity(tiny_model_module):
     cfg, params = tiny_model_module
     prompts = [[1] + list(range(3 + 30 * i, 3 + 30 * i + 30)) for i in range(3)]
@@ -309,6 +319,7 @@ def test_prefix_cache_lru_capacity(tiny_model_module):
     assert sched.prefix_stats["cached_blocks"] <= 2
 
 
+@pytest.mark.slow
 def test_prefix_cache_disabled(tiny_model_module):
     cfg, params = tiny_model_module
     golden = engine_golden(cfg, params, PROMPTS[:2], max_new=4)
@@ -319,6 +330,7 @@ def test_prefix_cache_disabled(tiny_model_module):
                                   "cached_blocks": 0}
 
 
+@pytest.mark.slow
 def test_prefix_cache_under_tp_mesh(tiny_model_module):
     """Sharded cache blocks restore correctly on a tp mesh."""
     import jax
@@ -341,6 +353,7 @@ def test_prefix_cache_under_tp_mesh(tiny_model_module):
     assert sched.prefix_stats["blocks_reused"] >= 3
 
 
+@pytest.mark.slow
 def test_scheduler_backend_complete_batch(tiny_model_module):
     """complete_batch submits the whole batch through the slot pool and the
     greedy results match per-request engine goldens."""
@@ -363,6 +376,7 @@ def test_scheduler_backend_complete_batch(tiny_model_module):
         sched.shutdown()
 
 
+@pytest.mark.slow
 def test_scheduler_backend_from_hf_checkpoint(tiny_model_module, tmp_path):
     """The deployment factory: HF dir -> scheduler backend, greedy parity
     with the engine path on the same checkpoint."""
@@ -391,6 +405,7 @@ def test_scheduler_backend_from_hf_checkpoint(tiny_model_module, tmp_path):
         backend.scheduler.shutdown()
 
 
+@pytest.mark.slow
 def test_warmup_compiles_all_kbuckets_without_state_change(tiny_model_module):
     """warmup() builds every (bucket, k-bucket) prefill variant and runs
     them against the OOB padding slot — no slot/cache state changes, and

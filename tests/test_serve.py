@@ -86,6 +86,7 @@ def test_engine_backend_end_to_end_text(tiny_model):
     assert res2.response == res.response
 
 
+@pytest.mark.slow
 def test_tiny_service_serves_three_reference_models():
     """The demo service carries the reference's full comparison set —
     duckdb-nsql, llama3.2, mistral (Model_Evaluation_&_Comparision.py:69,83)

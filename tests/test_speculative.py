@@ -59,6 +59,7 @@ PROMPTS = [
 
 
 @pytest.mark.parametrize("draft_len,ngram", [(4, 2), (8, 3), (2, 2)])
+@pytest.mark.slow
 def test_speculative_matches_vanilla_greedy(tiny, draft_len, ngram):
     cfg, params = tiny
     ref = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
@@ -72,6 +73,7 @@ def test_speculative_matches_vanilla_greedy(tiny, draft_len, ngram):
     assert spec.last_spec_rounds is not None and spec.last_spec_rounds >= 1
 
 
+@pytest.mark.slow
 def test_speculative_respects_stop_ids(tiny):
     cfg, params = tiny
     # Discover what vanilla greedy emits, then declare its 3rd token a stop

@@ -116,6 +116,7 @@ def test_stream_close_cancels_scheduler_request(tiny):
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_stream_stop_text_cancels_remaining_budget(tiny):
     """Stop texts are host-side only (the scheduler knows stop ids, not
     strings): once one lands, the stream must cancel the request so the
@@ -192,6 +193,7 @@ def test_api_stream_oversize_prompt_is_400(tiny, tmp_path):
         backend.shutdown()
 
 
+@pytest.mark.slow
 def test_cancel_queued_request_never_occupies_slot(tiny):
     cfg, params = tiny
     sched = ContinuousBatchingScheduler(
