@@ -34,6 +34,9 @@ class Completion:
     text: str
     output_tokens: int
     prompt_tokens: int = 0
+    # Time to first token, when the backend has a first-token seam (the
+    # continuous-batching scheduler); 0.0 = not measured.
+    ttft_s: float = 0.0
 
 
 def trim_stop_texts(text: str, stop_texts: Sequence[str]) -> str:

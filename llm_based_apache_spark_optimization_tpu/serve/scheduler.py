@@ -88,6 +88,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from collections import OrderedDict, deque
 from concurrent.futures import Future
 from functools import partial
@@ -104,6 +105,22 @@ from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
 from ..ops.sampling import SamplingParams, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
+
+
+def _first_token_timer(then: Optional[Callable[[int], None]] = None):
+    """(on_token, first_at) pair for TTFT measurement: on_token records the
+    worker-thread harvest time of the request's first ACCEPTED token — its
+    true time-to-first-token origin (queueing + prefill + first harvest
+    lag) — into the returned list, then forwards the token to `then`."""
+    first_at: List[float] = []
+
+    def on_tok(tok: int) -> None:
+        if not first_at:
+            first_at.append(time.perf_counter())
+        if then is not None:
+            then(tok)
+
+    return on_tok, first_at
 
 
 def _cache_dict(arrs: Sequence[jnp.ndarray]) -> Dict[str, jnp.ndarray]:
@@ -1306,10 +1323,12 @@ class SchedulerBackend:
             # token counts (holdbacks merge many tokens into one chunk).
             stats_out["prompt_tokens"] = len(ids)
         toks: "queue.Queue[int]" = queue.Queue()
+        t_submit = time.perf_counter()
+        on_tok, first_at = _first_token_timer(toks.put)
         fut = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed,
-            on_token=toks.put,
+            on_token=on_tok,
         )
         out_ids: List[int] = []
         emitted = ""
@@ -1360,19 +1379,24 @@ class SchedulerBackend:
                 self.scheduler.cancel(fut)
             if stats_out is not None:
                 stats_out["output_tokens"] = len(out_ids)
+                if first_at:
+                    stats_out["ttft_s"] = first_at[0] - t_submit
 
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0):
         from .backends import Completion, trim_stop_texts
 
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+        t_submit = time.perf_counter()
+        on_tok, first_at = _first_token_timer()
         out = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
-            sampling=sampling or self.sampling, seed=seed,
+            sampling=sampling or self.sampling, seed=seed, on_token=on_tok,
         ).result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
         return Completion(text=text, output_tokens=len(out),
-                          prompt_tokens=len(ids))
+                          prompt_tokens=len(ids),
+                          ttft_s=(first_at[0] - t_submit) if first_at else 0.0)
 
     def complete_batch(
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
@@ -1387,18 +1411,23 @@ class SchedulerBackend:
         ids_list = [
             self.tokenizer.encode(p, add_bos=self.add_bos) for p in prompts
         ]
+        t_submit = time.perf_counter()
+        timers = [_first_token_timer() for _ in ids_list]
         futs = [
             self.scheduler.submit(
                 ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
                 sampling=sampling or self.sampling, seed=seed,
+                on_token=on_tok,
             )
-            for ids in ids_list
+            for ids, (on_tok, _) in zip(ids_list, timers)
         ]
+        firsts = [fl for _, fl in timers]
         completions = []
-        for ids, fut in zip(ids_list, futs):
+        for ids, fut, fl in zip(ids_list, futs, firsts):
             out = fut.result()
             text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
             completions.append(Completion(
-                text=text, output_tokens=len(out), prompt_tokens=len(ids)
+                text=text, output_tokens=len(out), prompt_tokens=len(ids),
+                ttft_s=(fl[0] - t_submit) if fl else 0.0,
             ))
         return completions

@@ -112,6 +112,7 @@ class GenerationService:
             prompt_tokens=completion.prompt_tokens,
             output_tokens=completion.output_tokens,
             latency_s=latency,
+            ttft_s=getattr(completion, "ttft_s", 0.0),
         ))
         return GenerateResult(
             response=completion.text,
@@ -210,6 +211,7 @@ class GenerationService:
                 prompt_tokens=prompt_tokens,
                 output_tokens=out_tokens,
                 latency_s=latency,
+                ttft_s=stream_stats.get("ttft_s", 0.0),
             ))
 
     def generate_batch(
@@ -249,6 +251,7 @@ class GenerationService:
                 model=model, prompt_tokens=c.prompt_tokens,
                 output_tokens=c.output_tokens, latency_s=latency,
                 wall_share_s=latency / len(completions),
+                ttft_s=getattr(c, "ttft_s", 0.0),
             ))
         return [
             GenerateResult(

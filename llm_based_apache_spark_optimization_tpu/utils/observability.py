@@ -72,6 +72,10 @@ class RequestMetrics:
     # evalh.ModelReport.wall_clock_s). 0.0 means "same as latency_s"
     # (sequential request).
     wall_share_s: float = 0.0
+    # Time to first token (submit -> first accepted token harvested), the
+    # metric streaming exists for. 0.0 = not measured (backends without a
+    # first-token seam: the one-XLA-program engine, fakes).
+    ttft_s: float = 0.0
     stages: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
@@ -85,7 +89,7 @@ class RequestMetrics:
         return self.output_tokens / span if span > 0 else 0.0
 
     def to_dict(self) -> Dict:
-        return {
+        out = {
             "model": self.model,
             "prompt_tokens": self.prompt_tokens,
             "output_tokens": self.output_tokens,
@@ -93,6 +97,9 @@ class RequestMetrics:
             "decode_tok_s": round(self.decode_tok_s, 2),
             "stages": {k: round(v, 4) for k, v in self.stages.items()},
         }
+        if self.ttft_s:
+            out["ttft_s"] = round(self.ttft_s, 4)
+        return out
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -145,6 +152,13 @@ class MetricsRegistry:
                     "p95_latency_s": round(_percentile(lats, 0.95), 4),
                     "avg_decode_tok_s": round(toks / span, 2) if span else 0.0,
                 }
+                # TTFT percentiles over the requests that measured one
+                # (scheduler-path requests; the single-program engine has
+                # no first-token seam and reports none).
+                ttfts = sorted(r.ttft_s for r in recent if r.ttft_s)
+                if ttfts:
+                    out[model]["ttft_p50_s"] = round(_percentile(ttfts, 0.50), 4)
+                    out[model]["ttft_p95_s"] = round(_percentile(ttfts, 0.95), 4)
             return out
 
 

@@ -208,6 +208,34 @@ def test_cancel_queued_request_never_occupies_slot(tiny):
         assert len(busy.result(timeout=60)) == 60
 
 
+@pytest.mark.slow
+def test_ttft_measured_through_service_and_metrics(tiny):
+    """SchedulerBackend measures time-to-first-token (the metric streaming
+    exists for) on both the blocking and streaming paths, and the service
+    surfaces ttft_p50/p95 in its /metrics snapshot."""
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, decode_chunk=2, prompt_bucket=8,
+        stop_ids=(-1,), max_seq=64,
+    )
+    backend = SchedulerBackend(sched, tok, max_new_tokens=6)
+    svc = GenerationService()
+    svc.register("m", backend)
+    try:
+        res = backend.complete("ab")
+        assert 0 < res.ttft_s <= 60
+        svc.generate("m", "ab")
+        list(svc.generate_stream("m", "cd"))
+        batch = backend.complete_batch(["ab", "cd"])
+        assert all(0 < c.ttft_s <= 60 for c in batch)
+        snap = svc.metrics.snapshot()["m"]
+        assert 0 < snap["ttft_p50_s"] <= snap["ttft_p95_s"] <= 60
+        assert snap["ttft_p50_s"] <= snap["p95_latency_s"] + 1e-9
+    finally:
+        backend.shutdown()
+
+
 def test_service_generate_stream_fake_backend_single_chunk():
     from llm_based_apache_spark_optimization_tpu.serve import FakeBackend
 
