@@ -117,6 +117,34 @@ def make_fake_service() -> GenerationService:
     return svc
 
 
+def make_oracle_service() -> GenerationService:
+    """Canned service that answers every known eval case with its EXPECTED
+    SQL (keyed by the NL question embedded in the rendered prompt).
+
+    This is the instrument's self-proof: an eval run over it must read
+    100% exact match AND 100% execution match, demonstrating end-to-end
+    that the scorer can score a hit (VERDICT r3 weak #1: with only
+    random-weight runs committed, `execution_match` had never returned 1
+    in an artifact — an instrument that has only ever read 0 is
+    unproven). Any number below 100 on this backend is a harness bug,
+    never a model property."""
+    from ..evalh.configs import sql_case_base
+
+    cases = sql_case_base()
+
+    def oracle(prompt: str) -> str:
+        for case in cases:
+            if case.nl and case.nl in prompt:
+                return case.expected_sql
+        return "SELECT * FROM temp_view LIMIT 10"
+
+    svc = GenerationService()
+    svc.register("duckdb-nsql", FakeBackend(oracle))
+    svc.register("llama3.2", FakeBackend(oracle))
+    svc.register("mistral", FakeBackend(oracle), template="mistral-instruct")
+    return svc
+
+
 def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     """Real deployment: load duckdb-nsql (NL→SQL) and llama3.2 (error
     analysis) from HF directories or GGUF blobs onto one mesh.

@@ -20,7 +20,8 @@ import sys
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="evalh")
-    ap.add_argument("--backend", choices=("tiny", "fake"), default="fake")
+    ap.add_argument("--backend", choices=("tiny", "fake", "oracle"),
+                    default="fake")
     ap.add_argument("--configs", nargs="*", metavar="KEY",
                     help="run BASELINE configs (all when no KEY given)")
     ap.add_argument("--spider", metavar="DEV_JSON",
@@ -34,13 +35,20 @@ def main(argv=None) -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
-    from ..app.__main__ import make_fake_service, make_tiny_service
+    from ..app.__main__ import (
+        make_fake_service,
+        make_oracle_service,
+        make_tiny_service,
+    )
     from .configs import CONFIGS, run_config
     from .fixtures import FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM
     from .harness import evaluate_models, format_summary
 
-    service = (make_tiny_service(args.max_new_tokens)
-               if args.backend == "tiny" else make_fake_service())
+    service = {
+        "tiny": lambda: make_tiny_service(args.max_new_tokens),
+        "fake": make_fake_service,
+        "oracle": make_oracle_service,
+    }[args.backend]()
 
     if args.configs is not None:
         keys = args.configs or list(CONFIGS)
@@ -61,6 +69,13 @@ def main(argv=None) -> None:
         return
 
     if args.spider:
+        if args.backend == "oracle":
+            # The oracle only indexes the in-tree suites; on external
+            # Spider data every answer would be the fallback and the
+            # ~0% result would be indistinguishable from a harness bug.
+            sys.exit("--backend oracle is the in-tree-suite instrument "
+                     "self-proof; it does not know external --spider "
+                     "cases — use --backend tiny/fake there")
         from .spider import load_spider
 
         cases = [c.as_eval_case() for c in load_spider(args.spider, limit=100)]

@@ -82,8 +82,15 @@ CONFIGS: Dict[str, BenchConfig] = {
 }
 
 
+def sql_case_base():
+    """The canonical SQL-workload case list every benchmark config draws
+    from (and the oracle backend indexes — a drift between the two would
+    falsely fail the instrument self-proof)."""
+    return [c.as_eval_case() for c in SPIDER_SMOKE] + list(FOUR_QUERY_SUITE)
+
+
 def _sql_cases(n: int):
-    base = [c.as_eval_case() for c in SPIDER_SMOKE] + list(FOUR_QUERY_SUITE)
+    base = sql_case_base()
     return [base[i % len(base)] for i in range(n)]
 
 

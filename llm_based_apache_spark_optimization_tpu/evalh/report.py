@@ -218,7 +218,8 @@ def generate(
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="evalh.report")
-    ap.add_argument("--backend", choices=("tiny", "fake"), default="tiny")
+    ap.add_argument("--backend", choices=("tiny", "fake", "oracle"),
+                    default="tiny")
     ap.add_argument("--scheduler", action="store_true",
                     help="serve the tiny models through continuous-batching "
                          "schedulers (config 5 then batches concurrent "
@@ -233,7 +234,11 @@ def main(argv=None) -> None:
 
         jax.config.update("jax_platforms", "cpu")
 
-    from ..app.__main__ import make_fake_service, make_tiny_service
+    from ..app.__main__ import (
+        make_fake_service,
+        make_oracle_service,
+        make_tiny_service,
+    )
 
     factory = None
     if args.backend == "tiny":
@@ -245,14 +250,25 @@ def main(argv=None) -> None:
         def factory(tp):
             return make_tiny_service(args.max_new_tokens,
                                      scheduler=args.scheduler, tp=tp)
+    elif args.backend == "oracle":
+        service = make_oracle_service()
+        desc = ("oracle canned backend (answers every SQL case with its "
+                "expected SQL — instrument self-proof: anything below "
+                "100% exact/execution match on the suite tables is a "
+                "harness bug)")
     else:
         service = make_fake_service()
         desc = "fake canned backend (contract smoke)"
     text = generate(
         service, backend_desc=desc, max_new_tokens=args.max_new_tokens,
-        quality_meaningful=False,
+        quality_meaningful=args.backend == "oracle",
         timestamp=datetime.datetime.now().strftime("%Y-%m-%d %H:%M"),
         service_factory=factory,
+        # Config rows 2/3 are error-analysis workloads with no expected
+        # SQL; on the oracle backend they'd read 0% right under a banner
+        # saying below-100 means a harness bug. The self-proof is the
+        # suite tables; skip the config table there.
+        with_configs=args.backend != "oracle",
     )
     if args.out == "-":
         sys.stdout.write(text)

@@ -18,24 +18,29 @@ from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
 tokenizers = pytest.importorskip("tokenizers")
 
 
-@pytest.fixture(scope="module")
-def fixture_ckpt(tmp_path_factory):
-    root = tmp_path_factory.mktemp("runbook_ckpt")
-    params = init_params(TINY, jax.random.key(3), dtype=jnp.float32)
-    save_hf_checkpoint(TINY, params, root)
-
+def _write_word_tokenizer(ckpt_dir, words: str) -> None:
+    """Minimal real tokenizer.json (WordLevel + whitespace) beside a
+    checkpoint, with the special ids runbook/serving expect."""
     from tokenizers import Tokenizer
     from tokenizers.models import WordLevel
     from tokenizers.pre_tokenizers import Whitespace
 
     vocab = {"<s>": 1, "</s>": 2, "[UNK]": 0}
-    for i, w in enumerate(
-        "select from where count sum vendor fare table schema".split()
-    ):
+    for i, w in enumerate(words.split()):
         vocab[w] = 3 + i
     tok = Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
     tok.pre_tokenizer = Whitespace()
-    tok.save(str(root / "tokenizer.json"))
+    tok.save(str(ckpt_dir / "tokenizer.json"))
+
+
+@pytest.fixture(scope="module")
+def fixture_ckpt(tmp_path_factory):
+    root = tmp_path_factory.mktemp("runbook_ckpt")
+    params = init_params(TINY, jax.random.key(3), dtype=jnp.float32)
+    save_hf_checkpoint(TINY, params, root)
+    _write_word_tokenizer(
+        root, "select from where count sum vendor fare table schema"
+    )
     return root
 
 
@@ -84,6 +89,45 @@ def test_runbook_one_command_report_and_cache(fixture_ckpt, tmp_path, capsys):
     argv3 = [a if a != str(out) else str(out3) for a in argv]
     runbook.main(argv3)
     assert "converted + cached" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+def test_runbook_over_transformers_written_checkpoint(tmp_path):
+    """Weights-in -> report-out over a checkpoint written by HF
+    `transformers` itself (save_pretrained) — not the in-tree writer, so a
+    shared convention bug cannot cancel out. This is the full operator
+    path (convert -> orbax cache -> scheduler serve -> eval -> report) on
+    external weights (VERDICT r3 next #2b)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from llm_based_apache_spark_optimization_tpu import runbook
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0,
+        bos_token_id=1, eos_token_id=2, pad_token_id=0,
+    )
+    model = transformers.LlamaForCausalLM(hf_cfg).eval().float()
+    ckpt = tmp_path / "hf"
+    model.save_pretrained(ckpt, safe_serialization=True)
+    _write_word_tokenizer(ckpt, "select from where count sum vendor fare")
+
+    out = tmp_path / "EVAL.md"
+    runbook.main([
+        "--sql-model", str(ckpt),
+        "--cache-dir", str(tmp_path / "cache"),
+        "--max-new-tokens", "8",
+        "--max-seq", "2048",
+        "--slots", "2",
+        "-o", str(out),
+        "--cpu",
+    ])
+    text = out.read_text()
+    assert "Four-query suite — per query" in text
+    assert "## BASELINE configs" in text
 
 
 def test_runbook_cfg_json_roundtrip():
