@@ -51,6 +51,12 @@ def main(argv=None) -> None:
     }[args.backend]()
 
     if args.configs is not None:
+        if args.backend == "oracle":
+            # Error-analysis configs (2/3) have no expected SQL; the oracle
+            # would read 0% there under a banner that says below-100 means
+            # a harness bug (same ambiguous-zero as --spider below).
+            sys.exit("--backend oracle proves the instrument on the SQL "
+                     "suites only; run it without --configs")
         keys = args.configs or list(CONFIGS)
         for key in keys:
             if key not in CONFIGS:
