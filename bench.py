@@ -449,7 +449,7 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch) -> dict:
     )
     # Derive the admissible budget from the scheduler's OWN bound (its
     # resolved prompt_bucket and harvest lag), not a hand-mirrored copy.
-    overshoot = (sched._harvest_lag + 1) * sched.decode_chunk
+    overshoot = sched.overshoot
     max_new = min(
         max_new,
         sched.max_seq - 1 - overshoot - bucket_len(prompt_len,

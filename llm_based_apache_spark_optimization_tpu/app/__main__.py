@@ -159,11 +159,6 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     from ..serve.scheduler import SchedulerBackend
     from ..tokenizer import HFTokenizer
 
-    if args.scheduler and getattr(args, "speculative", 0) > 0:
-        sys.exit("--speculative needs the engine serving path: the "
-                 "continuous-batching scheduler decodes per-slot chunks and "
-                 "does not speculate — pass --no-scheduler with "
-                 "--speculative")
     mesh = None
     scheduler_meshes = [None]
     if args.dp * args.sp * args.tp > 1:
@@ -209,6 +204,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                               max_new_tokens=max_new_tokens,
                               add_bos=add_bos, num_slots=args.slots,
                               kv_quant=kv_quant)
+                common["speculative_draft"] = getattr(args, "speculative", 0)
                 if path.endswith(".gguf"):
                     return SchedulerBackend.from_gguf(path, tok, **common)
                 return SchedulerBackend.from_hf_checkpoint(
@@ -238,6 +234,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                     cfg, params, num_slots=args.slots,
                     stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
                     kv_quant=kv_quant,
+                    speculative_draft=getattr(args, "speculative", 0),
                 )
                 for m in scheduler_meshes
             ]
@@ -283,9 +280,10 @@ def main(argv=None) -> None:
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--speculative", type=int, default=0, metavar="N",
                     help="prompt-lookup speculative decoding: draft N tokens "
-                         "per round for greedy requests (engine backends "
-                         "with --no-scheduler; copy-heavy NL→SQL "
-                         "workloads on real checkpoints benefit most)")
+                         "per round for greedy requests, on both the "
+                         "scheduler (default) and engine serving paths — "
+                         "copy-heavy NL→SQL workloads on real checkpoints "
+                         "benefit most")
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8 KV cache with per-slot scales: halves the "
                          "serving window's HBM footprint and decode cache "

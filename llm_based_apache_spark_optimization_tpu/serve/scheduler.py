@@ -65,6 +65,16 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   turns them into clean text deltas, byte-identical to the blocking path);
   `cancel(future)` retires an abandoned request at its next harvest so
   disconnected clients do not pin slots.
+- **Speculative decoding** (`speculative_draft=D`): decode rounds become
+  draft+verify rounds — each slot drafts D tokens by prompt lookup over an
+  on-device token history (prompt tokens scattered in by the prefill fn,
+  emits appended by the round itself) and one T=D+1 forward verifies the
+  whole batch. Greedy slots emit their accepted chain (1..D+1 tokens per
+  round, exactly vanilla-greedy output); temperature>0 slots emit 1
+  sampled token from the window's first logits. The verify window runs
+  the unrolled small-T einsum path, which also composes with the int8 KV
+  cache. Prefix-cache reuse is disabled in this mode (reused tokens never
+  reach the draft history).
 
 - **Async issue/harvest pipeline**: decode rounds, prompt chunks and
   admission scatters dispatch without waiting; per-slot state (cur/pos/
@@ -76,9 +86,10 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   bottleneck was sync latency, not device FLOPs) and costs one chunk of
   retirement/admission latency.
 
-Bounds: a request needs `bucket_len(prompt) + max_new +
-(harvest_lag+1)*decode_chunk <= S_max` — the overshoot term because the
-device can run past a budget or a stop token for up to that many steps
+Bounds: a request needs `bucket_len(prompt) + max_new + overshoot <= S_max`
+(see the `overshoot` property: (harvest_lag+1) rounds of decode_chunk — or
+of D+1 plus a verify window's write lookahead under speculation) — the
+device can run past a budget or a stop token for up to that many positions
 before the host notices (those tokens are discarded; their cache writes are
 garbage covered by the invariant above).
 """
@@ -189,6 +200,8 @@ class ContinuousBatchingScheduler:
         mesh=None,
         prefix_cache_blocks: int = 64,
         kv_quant: Optional[str] = None,
+        speculative_draft: int = 0,
+        spec_ngram: int = 3,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -316,6 +329,40 @@ class ContinuousBatchingScheduler:
             kb *= 2
         self._kbuckets = kbuckets + [self._prefill_kmax]
 
+        # Speculative decoding (prompt-lookup, engine/speculative.py): when
+        # speculative_draft=D > 0, decode rounds draft D tokens per slot
+        # from an ON-DEVICE token history and verify them with one T=D+1
+        # forward — greedy slots emit 1..D+1 tokens per round (exact greedy
+        # chain), sampled slots emit exactly 1 (sampled from the window's
+        # first logits; rejection-sampling drafts would be needed to emit
+        # more unbiasedly). The verify window takes the unrolled small-T
+        # einsum path, which also composes with the int8 KV cache.
+        self._spec_draft = int(speculative_draft or 0)
+        self._spec_ngram = spec_ngram
+        if self._spec_draft:
+            from ..models.llama import _UNROLL_MAX_T
+
+            if not 1 <= self._spec_draft <= _UNROLL_MAX_T - 1:
+                raise ValueError(
+                    f"speculative_draft must be in [1, {_UNROLL_MAX_T - 1}]"
+                    f" (verify window T = draft+1 must take the unrolled "
+                    f"small-T path), got {self._spec_draft}"
+                )
+            # Prefix-cache reuse skips prefill forwards, so reused tokens
+            # would never reach the on-device draft history; disable reuse
+            # rather than draft from holes (both features target the same
+            # copy-heavy workload — pick speculation when it's on).
+            prefix_cache_blocks = 0
+            # History rows are max_seq + D+1 wide: the emit scatter writes a
+            # D+1 window at hlen (<= max_seq-1 by the submit bound), and the
+            # extra tail absorbs it without dynamic_update_slice clamping.
+            self._hist = jnp.full(
+                (num_slots, self.max_seq + self._spec_draft + 1),
+                cfg.pad_id, jnp.int32,
+            )
+            self._hlen = jnp.zeros(num_slots, jnp.int32)
+            self._spec_ready_fn = self._build_spec_ready()
+
         # Prefix cache: block size = the smallest bucket, so chunk boundaries
         # always land on block boundaries. OrderedDict as LRU of
         # content-keyed cache-block tuples (one entry per cache array:
@@ -349,7 +396,8 @@ class ContinuousBatchingScheduler:
         self._submit_lock = threading.Lock()
         self._closed = False
         self._prefill_fns: Dict[Tuple[int, int], object] = {}
-        self._decode_fn = self._build_decode()
+        self._decode_fn = (self._build_spec_decode() if self._spec_draft
+                           else self._build_decode())
 
     # ---------------------------------------------------------------- jitted
 
@@ -438,8 +486,15 @@ class ContinuousBatchingScheduler:
         cfg, impl, mesh = self.cfg, self._impl, self.mesh
         quant, dtype = self.kv_quant, self._dtype
         nc = len(self._cache)
+        spec = bool(self._spec_draft)
 
-        @partial(jax.jit, donate_argnums=tuple(range(1, 1 + nc)))
+        # Speculative mode appends the on-device draft history as one more
+        # donated arg: the chunk's tokens scatter into hist rows at the
+        # same positions their K/V land at (drafting needs the prompt text,
+        # and it is already on device for the forward anyway).
+        donate = tuple(range(1, 1 + nc)) + ((9 + nc,) if spec else ())
+
+        @partial(jax.jit, donate_argnums=donate)
         def prefill(params, *args):
             """One prompt chunk for EACH of k slots in one forward — prefill
             is MXU-bound and weight streaming amortizes across the batch
@@ -466,7 +521,8 @@ class ContinuousBatchingScheduler:
             """
             cache = args[:nc]
             (tokens, lengths, slots, starts, temps, topps, topks,
-             seeds) = args[nc:]
+             seeds) = args[nc:nc + 8]
+            hist = args[nc + 8] if spec else None
             rows = [c[:, slots] for c in cache]  # [L, k, K, S(, H)] gathers
             if quant:
                 row_cache = {
@@ -519,6 +575,10 @@ class ContinuousBatchingScheduler:
                 lambda s: jax.random.fold_in(jax.random.key(s), 0)
             )(seeds)
             toks = sample_runtime(logits[:, 0], temps, topps, topks, keys)
+            if spec:
+                # OOB padding slots drop their history writes too.
+                hist = hist.at[slots[:, None], positions].set(tokens)
+                return (*cache, hist, toks)
             return (*cache, toks)
 
         return prefill
@@ -571,6 +631,98 @@ class ContinuousBatchingScheduler:
 
         return decode
 
+    def _build_spec_ready(self):
+        """Jitted history arm for a freshly prefilled slot: the first
+        sampled token lands at position plen and the valid length becomes
+        plen + 1 (the prompt tokens themselves were scattered into the
+        history by the prefill fn, chunk by chunk)."""
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def spec_ready(hist, hlen, slot, tok, plen):
+            return hist.at[slot, plen].set(tok[0]), hlen.at[slot].set(plen + 1)
+
+        return spec_ready
+
+    def _build_spec_decode(self):
+        """One speculative round for the whole slot batch: draft D tokens
+        per slot by prompt lookup over the on-device history, verify with a
+        single T=D+1 forward, emit the accepted greedy chain (or 1 sampled
+        token for temperature>0 slots). Per-slot state — history, length,
+        position, RNG counts — advances on device; the host harvests
+        (emitted [slots, D+1], n_emit [slots]) a lag late, exactly like
+        vanilla rounds.
+
+        Attention runs the einsum impl: the verify window needs the
+        unrolled small-T path (which is also the only int8-KV path), and
+        the pallas decode kernel is a T=1 specialization. Parked slots
+        verify garbage at the parking position — their cache writes clamp
+        into their own row's tail, which the visibility invariant covers —
+        and emit nothing (n_emit=0); their history write is routed past
+        max_seq so a slot mid-chunked-prefill cannot have its freshly
+        scattered prompt history punched by pad writes at a stale hlen."""
+        from ..engine.speculative import ngram_draft
+
+        cfg, mesh = self.cfg, self.mesh
+        D, ngram = self._spec_draft, self._spec_ngram
+        d1 = D + 1
+        pad_id = cfg.pad_id
+        nc = len(self._cache)
+
+        @partial(jax.jit,
+                 donate_argnums=tuple(range(1, nc + 5)) + (nc + 10,))
+        def spec_decode(params, *args):
+            cache = args[:nc]
+            (hist, hlen, cur, pos, active, temps, topps, topks, seeds,
+             counts) = args[nc:]
+            params = split_blocks(params)
+            drafts = ngram_draft(hist, hlen, D, ngram)           # [S, D]
+            verify = jnp.concatenate([cur[:, None], drafts], 1)  # [S, D+1]
+            jd = jnp.arange(d1, dtype=jnp.int32)[None, :]
+            vpos = pos[:, None] + jd
+            logits, new_cache = forward(
+                cfg, params, verify, vpos, _cache_dict(cache),
+                attn_impl="xla", mesh=mesh,
+            )
+            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, D+1]
+            # preds[j] is the true greedy token after verify[j] iff every
+            # draft before j was accepted; accept the longest such chain.
+            eq = (drafts == preds[:, :D]).astype(jnp.int32)
+            acc = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)         # [S]
+            keys = jax.vmap(
+                lambda s, c: jax.random.fold_in(jax.random.key(s), c)
+            )(seeds, counts)
+            sampled0 = sample_runtime(logits[:, 0], temps, topps, topks, keys)
+            greedy = temps <= 0.0
+            n_emit = jnp.where(active, jnp.where(greedy, acc + 1, 1), 0)
+            emitted = jnp.where(
+                greedy[:, None], preds,
+                jnp.concatenate(
+                    [sampled0[:, None],
+                     jnp.full((preds.shape[0], D), pad_id, jnp.int32)], 1
+                ),
+            )
+            emitted = jnp.where(jd < n_emit[:, None], emitted, pad_id)
+            # Inactive rows write past max_seq (clamped into the history's
+            # spare tail), never at their stale hlen.
+            write_at = jnp.where(
+                active, hlen, jnp.int32(hist.shape[1])
+            )
+            hist = jax.vmap(
+                lambda h, e, s: lax.dynamic_update_slice(h, e, (s,))
+            )(hist, emitted, write_at)
+            cur = jax.vmap(
+                lambda e, n, c: jnp.where(n > 0, e[jnp.maximum(n - 1, 0)], c)
+            )(emitted, n_emit, cur)
+            pos = pos + n_emit
+            hlen = hlen + n_emit
+            # Sampled slots consumed one stream index; greedy argmax
+            # consumed none.
+            counts = counts + jnp.where(active & ~greedy, 1, 0)
+            return (*_cache_tuple(new_cache), hist, hlen, cur, pos, counts,
+                    emitted, n_emit)
+
+        return spec_decode
+
     # ------------------------------------------------------------- lifecycle
 
     def warmup(self, prompt_len: Optional[int] = None) -> None:
@@ -587,8 +739,7 @@ class ContinuousBatchingScheduler:
         for kb in self._kbuckets:
             if (t, kb) not in self._prefill_fns:
                 self._prefill_fns[(t, kb)] = self._build_prefill(t, kb)
-            out = self._prefill_fns[(t, kb)](
-                self.params, *self._cache,
+            args = [
                 jnp.full((kb, t), pad, jnp.int32),
                 jnp.ones(kb, jnp.int32),
                 jnp.full((kb,), self.num_slots, jnp.int32),  # all OOB
@@ -597,8 +748,14 @@ class ContinuousBatchingScheduler:
                 jnp.ones(kb, jnp.float32),
                 jnp.zeros(kb, jnp.int32),
                 jnp.zeros(kb, jnp.uint32),
-            )
-            self._cache = out[:-1]
+            ]
+            if self._spec_draft:
+                args.append(self._hist)
+            out = self._prefill_fns[(t, kb)](self.params, *self._cache, *args)
+            nc = len(self._cache)
+            self._cache = out[:nc]
+            if self._spec_draft:
+                self._hist = out[nc]
 
     def start(self) -> "ContinuousBatchingScheduler":
         if self._thread is None:
@@ -643,11 +800,11 @@ class ContinuousBatchingScheduler:
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
-        # Overshoot bound: the device can run (harvest_lag + 1) chunks past
+        # Overshoot bound: the device can run (harvest_lag + 1) rounds past
         # a budget or stop token before the host notices (rounds are
         # harvested one lag late); those tokens are discarded but their
         # cache writes must stay inside the window.
-        overshoot = (self._harvest_lag + 1) * self.decode_chunk
+        overshoot = self.overshoot
         need = bucket_len(len(ids), self.prompt_bucket) + max_new_tokens + overshoot
         if need > self.max_seq - 1:  # the last cache slot is the parking spot
             raise ValueError(
@@ -698,6 +855,17 @@ class ContinuousBatchingScheduler:
         req = getattr(future, "_lsot_request", None)
         if req is not None:
             req.cancelled = True
+
+    @property
+    def overshoot(self) -> int:
+        """Max tokens/positions the device can run past a budget or stop
+        before the host notices: pending rounds × max tokens per round,
+        plus (speculatively) one verify window of cache-write lookahead
+        beyond the last emitted position."""
+        if self._spec_draft:
+            d1 = self._spec_draft + 1
+            return (self._harvest_lag + 1) * d1 + self._spec_draft
+        return (self._harvest_lag + 1) * self.decode_chunk
 
     @property
     def prefix_stats(self) -> Dict[str, int]:
@@ -837,14 +1005,19 @@ class ContinuousBatchingScheduler:
             topks.append(0)
             seeds.append(0)
 
-        out = self._prefill_fns[(t, kb)](
-            self.params, *self._cache,
+        call_args = [
             jnp.asarray(tokens, jnp.int32), jnp.asarray(lengths, jnp.int32),
             jnp.asarray(slots, jnp.int32), jnp.asarray(starts, jnp.int32),
             jnp.asarray(temps, jnp.float32), jnp.asarray(topps, jnp.float32),
             jnp.asarray(topks, jnp.int32), jnp.asarray(seeds, jnp.uint32),
-        )
-        self._cache, toks = out[:-1], out[-1]
+        ]
+        if self._spec_draft:
+            call_args.append(self._hist)
+        out = self._prefill_fns[(t, kb)](self.params, *self._cache, *call_args)
+        nc = len(self._cache)
+        self._cache, toks = out[:nc], out[-1]
+        if self._spec_draft:
+            self._hist = out[nc]
 
         for i, (slot, req) in enumerate(group):
             chunk_start = req.prefilled
@@ -870,6 +1043,11 @@ class ContinuousBatchingScheduler:
                 jnp.float32(req.temperature), jnp.float32(req.top_p),
                 jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
             )
+            if self._spec_draft:
+                self._hist, self._hlen = self._spec_ready_fn(
+                    self._hist, self._hlen, jnp.int32(slot), tok,
+                    jnp.int32(len(req.ids)),
+                )
             self._first_pending.append((slot, req, tok))
 
     def _publish_blocks(self, slot: int, req: _Request, chunk_start: int) -> None:
@@ -906,14 +1084,25 @@ class ContinuousBatchingScheduler:
             for i in range(self.num_slots)
         ]
         nc = len(self._cache)
-        out = self._decode_fn(
-            self.params, *self._cache, self._cur, self._pos,
-            jnp.asarray(active), self._temps, self._topps, self._topks,
-            self._seeds, self._counts,
-        )
-        self._cache = out[:nc]
-        self._cur, self._pos, self._counts, toks = out[nc:]
-        self._pending.append((issue_reqs, toks, self._first_pending))
+        if self._spec_draft:
+            out = self._decode_fn(
+                self.params, *self._cache, self._hist, self._hlen,
+                self._cur, self._pos, jnp.asarray(active), self._temps,
+                self._topps, self._topks, self._seeds, self._counts,
+            )
+            self._cache = out[:nc]
+            (self._hist, self._hlen, self._cur, self._pos, self._counts,
+             toks, n_emit) = out[nc:]
+        else:
+            out = self._decode_fn(
+                self.params, *self._cache, self._cur, self._pos,
+                jnp.asarray(active), self._temps, self._topps, self._topks,
+                self._seeds, self._counts,
+            )
+            self._cache = out[:nc]
+            self._cur, self._pos, self._counts, toks = out[nc:]
+            n_emit = None
+        self._pending.append((issue_reqs, toks, n_emit, self._first_pending))
         self._first_pending = []
 
     def _retire(self, slot: int, req: _Request, result: List[int]) -> None:
@@ -948,9 +1137,9 @@ class ContinuousBatchingScheduler:
         """Sync the OLDEST in-flight round: one device_get brings down its
         chunk tokens plus any prefill first-tokens attached to it; retire
         finished requests and free their slots."""
-        issue_reqs, toks_dev, firsts = self._pending.popleft()
-        toks, first_vals = jax.device_get(
-            (toks_dev, [t for (_, _, t) in firsts])
+        issue_reqs, toks_dev, n_emit_dev, firsts = self._pending.popleft()
+        toks, n_emit, first_vals = jax.device_get(
+            (toks_dev, n_emit_dev, [t for (_, _, t) in firsts])
         )
         toks = np.asarray(toks)
         # Firsts precede the round's chunk tokens in every stream: their
@@ -963,8 +1152,11 @@ class ContinuousBatchingScheduler:
             if req.cancelled:
                 self._retire(i, req, req.generated)
                 continue
+            # Speculative rounds emit a variable number of accepted tokens
+            # per slot; vanilla rounds emit the whole chunk row.
+            row = toks[i] if n_emit is None else toks[i][: int(n_emit[i])]
             done = False
-            for tok in toks[i]:
+            for tok in row:
                 tok = int(tok)
                 if tok in self.stop_ids:
                     done = True
@@ -1096,6 +1288,10 @@ class SchedulerPool:
     def _harvest_lag(self) -> int:
         return self.schedulers[0]._harvest_lag
 
+    @property
+    def overshoot(self) -> int:
+        return self.schedulers[0].overshoot
+
     def warmup(self, prompt_len=None) -> None:
         for s in self.schedulers:
             s.warmup(prompt_len)
@@ -1203,6 +1399,7 @@ class SchedulerBackend:
         kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
+        speculative_draft: int = 0,
         **kwargs,
     ) -> "SchedulerBackend":
         """Deployment path for concurrent serving: HF checkpoint straight
@@ -1237,6 +1434,7 @@ class SchedulerBackend:
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
             mesh=sched_mesh, kv_quant=kv_quant,
+            speculative_draft=speculative_draft,
         )
         return cls(sched, tokenizer, **kwargs)
 
@@ -1254,6 +1452,7 @@ class SchedulerBackend:
         kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
+        speculative_draft: int = 0,
         **kwargs,
     ) -> "SchedulerBackend":
         """GGUF blob -> continuous-batching scheduler (C++ parse + dequant,
@@ -1270,6 +1469,7 @@ class SchedulerBackend:
             stop_ids=stop_ids if stop_ids is not None
             else resolve_stop_ids(cfg, tokenizer),
             mesh=mesh, kv_quant=kv_quant,
+            speculative_draft=speculative_draft,
         )
         return cls(sched, tokenizer, **kwargs)
 
@@ -1284,7 +1484,7 @@ class SchedulerBackend:
 
     def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
         sched = self.scheduler
-        overshoot = (sched._harvest_lag + 1) * sched.decode_chunk
+        overshoot = sched.overshoot
         room = sched.max_seq - 1 - overshoot - bucket_len(
             n_prompt_tokens, sched.prompt_bucket
         )

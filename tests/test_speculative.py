@@ -147,3 +147,126 @@ def test_speculative_fn_rounds_bounded(tiny):
     assert out.shape == (1, 8)
     assert int(lens[0]) == 8
     assert 1 <= int(rounds) <= 8
+
+
+# ---------------------------------------------------------------------------
+# Scheduler speculation (serve/scheduler.py speculative_draft): the serving
+# path the real SQL checkpoints run on.
+
+@pytest.mark.slow
+def test_scheduler_speculative_matches_engine_greedy(tiny):
+    """Exactness contract under continuous batching: whatever the drafts,
+    the speculative scheduler's greedy output equals the vanilla engine's,
+    token for token."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    golden = [
+        InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
+        .generate([p], max_new_tokens=10)[0]
+        for p in PROMPTS
+    ]
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+        speculative_draft=4,
+    )
+    with sched:
+        out = sched.generate(PROMPTS, max_new_tokens=10)
+    assert out == golden
+
+
+@pytest.mark.slow
+def test_scheduler_speculative_mixed_sampling_and_reproducible(tiny):
+    """Sampled slots ride the same verify round (emitting 1 token each)
+    and stay reproducible per (prompt, seed); greedy slots in the same
+    batch keep engine parity."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    greedy_p, sampled_p = PROMPTS[0], PROMPTS[2]
+    golden = InferenceEngine(
+        cfg, params, stop_ids=(-1,), prompt_bucket=8
+    ).generate([greedy_p], max_new_tokens=8)[0]
+    sp = SamplingParams(temperature=0.8, top_p=0.9)
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=3, prompt_bucket=8, stop_ids=(-1,),
+        speculative_draft=4,
+    )
+    with sched:
+        g = sched.submit(greedy_p, max_new_tokens=8)
+        s1 = sched.submit(sampled_p, max_new_tokens=8, sampling=sp, seed=5)
+        s2 = sched.submit(sampled_p, max_new_tokens=8, sampling=sp, seed=5)
+        s3 = sched.submit(sampled_p, max_new_tokens=8, sampling=sp, seed=6)
+        outs = [f.result() for f in (g, s1, s2, s3)]
+    assert outs[0] == golden
+    assert outs[1] == outs[2]           # same seed -> same completion
+    assert all(len(o) == 8 for o in outs)
+
+
+@pytest.mark.slow
+def test_scheduler_speculative_stop_and_budget(tiny):
+    """Stops cut the accepted chain at harvest exactly like vanilla rounds,
+    and budgets never over-emit even when a chain crosses them."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    probe = InferenceEngine(
+        cfg, params, stop_ids=(-1,), prompt_bucket=8
+    ).generate([PROMPTS[0]], max_new_tokens=8)[0]
+    stop = probe[3]  # 4th greedy token becomes the stop id
+    golden = InferenceEngine(
+        cfg, params, stop_ids=(stop,), prompt_bucket=8
+    ).generate([PROMPTS[0]], max_new_tokens=8)[0]
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(stop,),
+        speculative_draft=4,
+    )
+    with sched:
+        out = sched.submit(PROMPTS[0], max_new_tokens=8).result()
+        short = sched.submit(PROMPTS[2], max_new_tokens=3).result()
+    # Engine includes the stop token then ends; scheduler strips it.
+    assert out == [t for t in golden if t != stop]
+    assert len(short) == 3
+
+
+@pytest.mark.slow
+def test_scheduler_speculative_with_int8_kv(tiny):
+    """The verify window's unrolled einsum path is also the int8-KV path:
+    speculation and the quantized persistent cache compose, with greedy
+    parity against the non-speculative int8-KV scheduler."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    vanilla = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+        kv_quant="int8",
+    )
+    with vanilla:
+        golden = vanilla.generate(PROMPTS, max_new_tokens=8)
+    spec = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+        kv_quant="int8", speculative_draft=4,
+    )
+    with spec:
+        out = spec.generate(PROMPTS, max_new_tokens=8)
+    assert out == golden
+
+
+def test_scheduler_speculative_rejects_bad_draft(tiny):
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    with pytest.raises(ValueError, match="speculative_draft"):
+        ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, stop_ids=(-1,), speculative_draft=99,
+        )
