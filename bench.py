@@ -30,9 +30,14 @@ two sub-benchmarks fold into the same JSON line:
   "long_context": B=16 prompt=1024 — the shape where KV-cache bytes rival
                   weight bytes — stacking int8 weights and the int8 KV
                   cache
-(BENCH_INT8=0 / BENCH_SCHED=0 / BENCH_LONG=0 skip them; they default off on
-the CPU fallback, where their compile+run time would blow the watchdog
-budget.)
+  "7b":           the FLAGSHIP shape — duckdb-nsql-7b (Llama-2-7B arch),
+                  int8 weights + int8 KV on one chip (bf16 7B does not
+                  leave serving headroom on a 16 GB v5e), B=8 and B=32:
+                  the BASELINE north star is denominated in this model
+                  class
+(BENCH_INT8=0 / BENCH_SCHED=0 / BENCH_LONG=0 / BENCH_7B=0 skip them; they
+default off on the CPU fallback, where their compile+run time would blow
+the watchdog budget.)
 
 Baseline derivation (BASELINE.md): the reference's best model (DuckDB-NSQL via
 Ollama) averages 8.05 s per NL→SQL query over its four-query suite for
@@ -83,12 +88,12 @@ def outer() -> int:
     """Run the inner bench under a hard timeout; retry accel, fall back to CPU."""
     force_cpu = os.environ.get("BENCH_FORCE_CPU") == "1"
     # Budgets: a healthy TPU run is compiles (primary + int8 engines +
-    # scheduler prefill/decode variants + 3 long-context engines, ~4-6 min
-    # total) + a minute of measuring; 1100s/attempt absorbs that plus a
-    # slow tunnel bring-up. Worst case (tunnel dead, 2 accel attempts +
-    # backoff + CPU fallback) stays under ~60 min so the driver's
-    # end-of-round bench never sees a hung process.
-    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1100"))
+    # scheduler prefill/decode variants + 3 long-context engines + the two
+    # 7B flagship programs, ~8-12 min total) + minutes of measuring;
+    # 1600s/attempt absorbs that plus a slow tunnel bring-up. Worst case
+    # (tunnel dead, 2 accel attempts + backoff + CPU fallback) stays under
+    # ~80 min so the driver's end-of-round bench never sees a hung process.
+    tpu_timeout = int(os.environ.get("BENCH_TPU_TIMEOUT", "1600"))
     cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1200"))
     tpu_retries = int(os.environ.get("BENCH_TPU_RETRIES", "2"))
 
@@ -260,6 +265,8 @@ def inner() -> int:
             params, quant, device_kind,
         ))
 
+    with_7b = os.environ.get("BENCH_7B", sub_default) == "1"
+
     if with_int8 and quant != "int8":
         result["int8"] = _bench_int8(
             cfg, params, prompt_len, max_new, batch, best_tok_s, device_kind,
@@ -271,8 +278,108 @@ def inner() -> int:
     if with_long:
         result["long_context"] = _bench_long(cfg, params)
 
+    if with_7b:
+        # Free the primary engine first: the flagship tree needs the HBM.
+        del eng, params
+        result["7b"] = _bench_7b(device_kind, dev)
+
     _emit(result)
     return 0
+
+
+def _bench_7b(device_kind, dev) -> dict:
+    """Flagship-shape leg: duckdb-nsql-7b (the Llama-2-7B architecture the
+    reference's headline model fine-tunes — BASELINE.md north star) on ONE
+    chip, int8 weights + int8 KV cache. bf16 7B is 13.5 GB of weights
+    alone; on a 16 GB v5e the serving configuration IS the quantized one,
+    so that is what this measures: decode tok/s at B=8 and B=32, the HBM
+    roofline position, compile time, and the resident HBM footprint.
+    Weights are random int8 (ops/quant.init_params_quantized — built
+    directly at final size; no 13.5 GB intermediate): throughput is
+    shape/byte-bound, not value-bound."""
+    import time as _t
+
+    import jax
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.engine.kvcache import (
+        cache_bytes,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import REGISTRY
+    from llm_based_apache_spark_optimization_tpu.ops.quant import (
+        init_params_quantized,
+    )
+
+    cfg = REGISTRY[os.environ.get("BENCH_7B_CONFIG", "duckdb-nsql-7b")]
+    batch = int(os.environ.get("BENCH_7B_BATCH", "8"))
+    prompt_len = min(int(os.environ.get("BENCH_7B_PROMPT", "128")),
+                     cfg.max_seq_len // 2)
+    max_new = min(int(os.environ.get("BENCH_7B_NEW", "64")),
+                  cfg.max_seq_len - prompt_len)
+    out: dict = {"config": cfg.name, "quant": "int8+kv8",
+                 "prompt": prompt_len, "new": max_new}
+
+    params = init_params_quantized(cfg, jax.random.key(0))
+    out["param_bytes"] = _param_bytes(params)
+    rng = np.random.default_rng(3)
+
+    def prompts_for(b):
+        return [
+            [int(x) for x in rng.integers(3, cfg.vocab_size, size=prompt_len)]
+            for _ in range(b)
+        ]
+
+    eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=prompt_len,
+                          kv_quant="int8")
+    peak_flops, peak_bw = _peak_for(device_kind, "int8")
+
+    def measure(b):
+        ps = prompts_for(b)
+        t0 = _t.perf_counter()
+        eng.generate(ps, max_new_tokens=max_new)  # warmup+compile
+        compile_s = _t.perf_counter() - t0
+        best = 0.0
+        for _ in range(2):
+            t0 = _t.perf_counter()
+            res = eng.generate(ps, max_new_tokens=max_new)
+            best = max(best, sum(len(o) for o in res)
+                       / (_t.perf_counter() - t0))
+        # Prefill probe for the decode-only split.
+        eng.generate(ps, max_new_tokens=1)
+        t_pre = float("inf")
+        for _ in range(2):
+            t0 = _t.perf_counter()
+            eng.generate(ps, max_new_tokens=1)
+            t_pre = min(t_pre, _t.perf_counter() - t0)
+        decode_dt = max(b * max_new / best - t_pre, 1e-9)
+        decode_tok_s = b * (max_new - 1) / decode_dt
+        block = {"tok_s": round(best, 1), "compile_s": round(compile_s, 1),
+                 "decode_tok_s": round(decode_tok_s, 1),
+                 "prefill_s": round(t_pre, 4)}
+        if peak_bw:
+            s_avg = prompt_len + max_new // 2
+            # int8 KV values + f32 per-position scales (1 + 4/head_dim
+            # bytes per element).
+            kv = cache_bytes(cfg, b, s_avg, 1)
+            kv += cache_bytes(cfg, b, s_avg, 4) // cfg.head_dim
+            bytes_per_step = out["param_bytes"] + kv
+            block["decode_hbm_util"] = round(
+                bytes_per_step * (decode_tok_s / b) / peak_bw, 4
+            )
+        return block
+
+    out[f"b{batch}"] = measure(batch)
+    b2 = int(os.environ.get("BENCH_7B_BATCH2", "32"))
+    if b2 and b2 != batch:
+        out[f"b{b2}"] = measure(b2)
+    # Resident HBM with the flagship engine live (weights + caches +
+    # programs). bytes_in_use, not the allocator's process-lifetime peak —
+    # the peak would report whatever the earlier legs high-watered.
+    ms = dev.memory_stats() or {}
+    if "bytes_in_use" in ms:
+        out["hbm_resident_gb"] = round(ms["bytes_in_use"] / 1e9, 2)
+    return out
 
 
 def _bench_long(cfg, params) -> dict:

@@ -65,6 +65,61 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def init_params_quantized(cfg, key, dtype=jnp.bfloat16) -> Dict[str, Any]:
+    """Random int8 param tree built DIRECTLY at its final size — no
+    full-precision intermediate.
+
+    Purpose: benchmarking big shapes on one chip. A 7B bf16 tree is
+    13.5 GB; `init_params` + `quantize_params` would peak near 20 GB on a
+    16 GB v5e before the bf16 tree is freed. Here the seven block matmuls
+    are sampled straight as int8 (uniform over the full range — decode
+    streams the same bytes real quantized weights would) with constant
+    per-channel scales matching init_params' 1/sqrt(fan_in) magnitude, so
+    logits stay finite and sampling behaves. Embeddings/unembed/norms
+    follow quantize_params' split and stay in `dtype`.
+    """
+    import jax
+
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    nh, kh, hd, L = (cfg.num_heads, cfg.num_kv_heads, cfg.head_dim,
+                     cfg.num_layers)
+    keys = jax.random.split(key, 10)
+    shapes = {
+        "wq": (L, d, nh * hd), "wk": (L, d, kh * hd), "wv": (L, d, kh * hd),
+        "wo": (L, nh * hd, d), "wg": (L, d, f), "wu": (L, d, f),
+        "wd": (L, f, d),
+    }
+    blocks: Dict[str, Any] = {}
+    for i, (name, shape) in enumerate(shapes.items()):
+        fan_in = shape[-2]
+        # jit so the PRNG runs on-device at int8 width; int8 absmax 127
+        # with scale fan_in^-0.5/127 reproduces init_params' row scale.
+        q8 = jax.jit(
+            lambda k, s=shape: jax.random.randint(k, s, -127, 128, jnp.int8)
+        )(keys[i])
+        s = jnp.full(shape[:-2] + shape[-1:], fan_in ** -0.5 / 127.0,
+                     jnp.float32)
+        blocks[name] = {"q8": q8, "s": s}
+    blocks["ln_attn"] = jnp.ones((L, d), dtype)
+    blocks["ln_mlp"] = jnp.ones((L, d), dtype)
+
+    def emb(k):
+        return jax.jit(
+            lambda kk: (jax.random.normal(kk, (cfg.vocab_size, d),
+                                          jnp.float32) * d ** -0.5)
+            .astype(dtype)
+        )(k)
+
+    params: Dict[str, Any] = {
+        "embed": emb(keys[7]),
+        "blocks": blocks,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = emb(keys[8])
+    return params
+
+
 def quantize_kv(x: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     """Quantize K or V cache tensors [..., S, H] to int8 with one f32 scale
     per slot (absmax over the head dim).

@@ -97,3 +97,30 @@ def test_quantized_tp_generate_matches_single_device(tiny_model):
     wq = sharded.params["blocks"]["wq"]
     assert wq["q8"].addressable_shards[0].data.shape[-1] == wq["q8"].shape[-1] // 2
     assert wq["s"].addressable_shards[0].data.shape[-1] == wq["s"].shape[-1] // 2
+
+
+def test_init_params_quantized_structure_and_engine():
+    """The direct-at-final-size int8 init (the 7B bench leg's tree) must
+    match quantize_params(init_params(...))'s tree structure exactly and
+    drive the int8 engine end-to-end."""
+    import jax
+
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+    from llm_based_apache_spark_optimization_tpu.ops.quant import (
+        init_params_quantized,
+        quantize_params,
+    )
+
+    ref = quantize_params(init_params(TINY, jax.random.key(0),
+                                      dtype=jnp.float32))
+    got = init_params_quantized(TINY, jax.random.key(1), dtype=jnp.float32)
+    assert jax.tree.structure(ref) == jax.tree.structure(got)
+    for r, g in zip(jax.tree.leaves(ref), jax.tree.leaves(got)):
+        assert r.shape == g.shape and r.dtype == g.dtype, (r.shape, g.shape)
+
+    eng = InferenceEngine(TINY, got, stop_ids=(-1,), prompt_bucket=8,
+                          kv_quant="int8")
+    out = eng.generate([[1, 5, 9], [1, 7]], max_new_tokens=6)
+    assert all(len(o) == 6 for o in out)
+    assert all(0 <= t < TINY.vocab_size for o in out for t in o)
