@@ -1,5 +1,7 @@
 """Metrics/timing observability: registry aggregates and endpoint surface."""
 
+import pytest
+
 import json
 
 from llm_based_apache_spark_optimization_tpu.utils.observability import (
@@ -88,3 +90,35 @@ def test_metrics_endpoint():
     svc.generate("duckdb-nsql", "q")
     res = client.request("GET", "/metrics")
     assert json.loads(res.body)["duckdb-nsql"]["requests"] == 1
+
+
+@pytest.mark.slow
+def test_device_trace_captures_real_op_time():
+    """traceprof parses jax.profiler's chrome trace into device-op time:
+    a matmul loop's device_time_s must be positive, bounded by wall, and
+    the hot op list non-empty."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.utils.traceprof import (
+        device_trace,
+    )
+
+    x = jnp.ones((512, 512), jnp.float32)
+
+    @jax.jit
+    def step(a):
+        for _ in range(8):
+            a = a @ a / 512.0
+        return a
+
+    step(x).block_until_ready()  # compile outside the trace
+    t0 = time.perf_counter()
+    with device_trace() as tr:
+        step(x).block_until_ready()
+    wall = time.perf_counter() - t0
+    assert tr.op_time_s() > 0.0
+    assert 0.0 < tr.device_time_s() <= wall + 0.5
+    assert tr.top_ops(3) and tr.top_ops(3)[0][1] > 0.0
