@@ -153,6 +153,14 @@ def build_service(args, log=print):
     from .serve.scheduler import ContinuousBatchingScheduler, SchedulerBackend
     from .tokenizer import HFTokenizer
 
+    if (getattr(args, "kv_int8", False) and getattr(args, "speculative", 0)
+            and not args.scheduler):
+        # Same up-front guard as the app CLI: the ENGINE's speculative
+        # verify loop streams a bf16 cache; only the scheduler path
+        # composes speculation with the int8 KV cache.
+        sys.exit("runbook: --kv-int8 cannot combine with --speculative on "
+                 "--no-scheduler (the engine's verify loop streams the "
+                 "bf16 cache); drop one, or use the scheduler path")
     mesh = None
     if args.tp > 1:
         from .parallel import make_mesh
@@ -178,10 +186,11 @@ def build_service(args, log=print):
 
             params = quantize_params(params)
         kv_quant = "int8" if getattr(args, "kv_int8", False) else None
+        spec = getattr(args, "speculative", 0)
         if args.scheduler:
             sched = ContinuousBatchingScheduler(
                 cfg, params, num_slots=args.slots, stop_ids=stop_ids,
-                mesh=mesh, kv_quant=kv_quant,
+                mesh=mesh, kv_quant=kv_quant, speculative_draft=spec,
             )
             return SchedulerBackend(
                 sched, tok, max_new_tokens=args.max_new_tokens,
@@ -190,7 +199,7 @@ def build_service(args, log=print):
         from .engine import InferenceEngine
 
         eng = InferenceEngine(cfg, params, stop_ids=stop_ids, mesh=mesh,
-                              kv_quant=kv_quant)
+                              kv_quant=kv_quant, speculative_draft=spec)
         return EngineBackend(
             eng, tok, max_new_tokens=args.max_new_tokens, add_bos=add_bos
         )
@@ -222,6 +231,10 @@ def main(argv=None) -> None:
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8 KV cache (per-slot scales): halves the "
                          "serving window's HBM footprint and cache traffic")
+    ap.add_argument("--speculative", type=int, default=0, metavar="N",
+                    help="prompt-lookup speculative decoding, draft N "
+                         "tokens/round (greedy requests; NL→SQL's "
+                         "copy-heavy completions are the sweet spot)")
     ap.add_argument("--scheduler", action=argparse.BooleanOptionalAction,
                     default=True)
     ap.add_argument("--slots", type=int, default=8)

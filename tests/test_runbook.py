@@ -149,3 +149,45 @@ def test_runbook_cfg_json_roundtrip():
         TINY, rope_scaling=RopeFreqFactors((1.0, 2.0, 4.0, 8.0))
     )
     assert _cfg_load(_cfg_dump(cfg2)) == cfg2
+
+
+@pytest.mark.slow
+def test_runbook_speculative_flag(fixture_ckpt, tmp_path):
+    """--speculative N flows through to the scheduler backends and the
+    report still generates (greedy output unchanged by construction)."""
+    from llm_based_apache_spark_optimization_tpu import runbook
+
+    out = tmp_path / "EVAL_SPEC.md"
+    runbook.main([
+        "--sql-model", str(fixture_ckpt),
+        "--cache-dir", str(tmp_path / "cache"),
+        "--max-new-tokens", "8",
+        "--max-seq", "2048",
+        "--slots", "2",
+        "--speculative", "4",
+        "-o", str(out),
+        "--cpu",
+    ])
+    assert "Four-query suite — per query" in out.read_text()
+
+    # Engine path (--no-scheduler) takes the same flag...
+    out2 = tmp_path / "EVAL_SPEC_ENG.md"
+    runbook.main([
+        "--sql-model", str(fixture_ckpt),
+        "--cache-dir", str(tmp_path / "cache"),
+        "--max-new-tokens", "8",
+        "--max-seq", "2048",
+        "--no-scheduler",
+        "--speculative", "4",
+        "-o", str(out2),
+        "--cpu",
+    ])
+    assert "Four-query suite — per query" in out2.read_text()
+    # ...but rejects the bf16-verify-loop/int8-cache combination cleanly.
+    with pytest.raises(SystemExit, match="kv-int8"):
+        runbook.main([
+            "--sql-model", str(fixture_ckpt),
+            "--cache-dir", str(tmp_path / "cache"),
+            "--no-scheduler", "--speculative", "4", "--kv-int8",
+            "-o", str(tmp_path / "x.md"), "--cpu",
+        ])
