@@ -221,6 +221,7 @@ def inner() -> int:
     with_int8 = os.environ.get("BENCH_INT8", sub_default) == "1"
     with_sched = os.environ.get("BENCH_SCHED", sub_default) == "1"
     with_long = os.environ.get("BENCH_LONG", sub_default) == "1"
+    with_7b = os.environ.get("BENCH_7B", sub_default) == "1"
 
     dev = jax.devices()[0]
     platform, device_kind = dev.platform, dev.device_kind
@@ -235,6 +236,22 @@ def inner() -> int:
         params = quantize_params(params)
     # stop_ids=(-1,): never stops — random weights would otherwise emit eos at
     # arbitrary points and under-count the decode work.
+    # BENCH_FUSE=1: fused wqkv/wgu matmuls (models/llama.fuse_blocks) for
+    # prefill A/B runs. Fuse the tree HERE and drop the unfused leaves —
+    # letting the engine fuse would keep both full copies resident for the
+    # whole run (an OOM at exactly the sizes where prefill MFU matters).
+    fuse = os.environ.get("BENCH_FUSE", "0") == "1"
+    if fuse:
+        from llm_based_apache_spark_optimization_tpu.models.llama import (
+            fuse_blocks,
+        )
+
+        params = fuse_blocks(params)
+        # Focused A/B: the sub-benchmarks quantize/reshard the primary
+        # tree by its UNFUSED names and must not silently run on a fused
+        # one (quantize_params would skip wqkv and the int8 leg would
+        # measure bf16).
+        with_int8 = with_sched = with_long = with_7b = False
     eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=prompt_len)
     rng = __import__("numpy").random.default_rng(0)
     prompts = _mk_prompts(cfg, batch, prompt_len, rng)
@@ -265,14 +282,14 @@ def inner() -> int:
         "device_kind": device_kind,
         "compile_s": round(compile_s, 1),
     }
+    if fuse:
+        result["fused_matmuls"] = True
 
     if detail:
         result.update(_detail(
             cfg, eng, prompts, prompt_len, max_new, batch, best_dt,
             params, quant, device_kind,
         ))
-
-    with_7b = os.environ.get("BENCH_7B", sub_default) == "1"
 
     if with_int8 and quant != "int8":
         result["int8"] = _bench_int8(

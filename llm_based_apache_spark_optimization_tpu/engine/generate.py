@@ -216,9 +216,22 @@ class InferenceEngine:
         speculative_draft: int = 0,
         speculative_ngram: int = 3,
         kv_quant: Optional[str] = None,
+        fuse_matmuls: bool = False,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # Fused wqkv/wgu matmuls (models/llama.fuse_blocks): fewer, wider
+        # MXU calls — a prefill-throughput lever. Single-device only: the
+        # TP sharding specs name the unfused weights.
+        if fuse_matmuls:
+            if mesh is not None:
+                raise ValueError(
+                    "fuse_matmuls is single-device: TP sharding specs "
+                    "shard wq/wk/wv/wg/wu individually"
+                )
+            from ..models.llama import fuse_blocks
+
+            params = fuse_blocks(params)
         # "int8": decode streams an int8 KV cache (half the cache bytes;
         # make_generate_fn docstring). Greedy/sampled both supported; the
         # speculative path has no int8-KV variant, and silently dropping a

@@ -47,7 +47,7 @@ from ..ops.attention import (
 )
 from ..ops.norm import rms_norm
 from ..ops.pallas import flash_gqa_attention, sharded_flash_gqa_attention
-from ..ops.quant import mm
+from ..ops.quant import is_qtensor, mm
 from ..ops.ring_attention import ring_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
 from .configs import LlamaConfig
@@ -92,6 +92,40 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     if not cfg.tie_embeddings:
         params["lm_head"] = w(keys[8], (cfg.vocab_size, d), d)
     return params
+
+
+def fuse_blocks(params: Params) -> Params:
+    """A params variant with the per-projection matmuls concatenated:
+    wq|wk|wv -> "wqkv" and wg|wu -> "wgu" (out axes stacked).
+
+    Prefill runs 7 medium matmuls per layer; fusing the three QKV
+    projections (one shared input h) and the two MLP up-projections (one
+    shared input h2) into single wider matmuls halves kernel count and
+    widens the MXU N dimension — one of the prefill-MFU levers (the
+    output columns are unchanged dot products, so results are exact:
+    asserted in tests/test_model.py).
+
+    Works on bf16 trees and int8 QTensor trees (per-output-channel scales
+    concatenate with their columns). Single-device only: the TP sharding
+    specs (parallel/sharding.py) name the unfused weights — engines guard
+    fuse_matmuls against a mesh.
+    """
+    blocks = dict(params["blocks"])
+
+    def cat(names):
+        ws = [blocks.pop(n) for n in names]
+        if is_qtensor(ws[0]):
+            return {
+                "q8": jnp.concatenate([w["q8"] for w in ws], axis=-1),
+                "s": jnp.concatenate([w["s"] for w in ws], axis=-1),
+            }
+        return jnp.concatenate(ws, axis=-1)
+
+    blocks["wqkv"] = cat(("wq", "wk", "wv"))
+    blocks["wgu"] = cat(("wg", "wu"))
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
 
 
 def split_blocks(params: Params) -> Params:
@@ -234,9 +268,16 @@ def forward(
     def qkv(p, x):
         h = rms_norm(x, p["ln_attn"], cfg.norm_eps)
         # mm() transparently handles int8 QTensors (ops/quant.py).
-        q = mm(h, p["wq"]).reshape(b, t, nh, hd)
-        k = mm(h, p["wk"]).reshape(b, t, kh, hd)
-        v = mm(h, p["wv"]).reshape(b, t, kh, hd)
+        if "wqkv" in p:  # fused tree (fuse_blocks): one wide matmul
+            qc, kc = nh * hd, kh * hd
+            fused = mm(h, p["wqkv"])
+            q = fused[..., :qc].reshape(b, t, nh, hd)
+            k = fused[..., qc:qc + kc].reshape(b, t, kh, hd)
+            v = fused[..., qc + kc:].reshape(b, t, kh, hd)
+        else:
+            q = mm(h, p["wq"]).reshape(b, t, nh, hd)
+            k = mm(h, p["wk"]).reshape(b, t, kh, hd)
+            v = mm(h, p["wv"]).reshape(b, t, kh, hd)
         return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
     def attn_mlp(p, x, q, k_full, v_full, k_fresh, v_fresh):
@@ -269,8 +310,14 @@ def forward(
     def post_attn(p, x, attn):
         x = x + mm(attn.reshape(b, t, nh * hd), p["wo"])
         h2 = rms_norm(x, p["ln_mlp"], cfg.norm_eps)
-        gate = jax.nn.silu(mm(h2, p["wg"]).astype(jnp.float32)).astype(x.dtype)
-        x = x + mm(gate * mm(h2, p["wu"]), p["wd"])
+        if "wgu" in p:  # fused tree: gate|up in one matmul
+            f = cfg.intermediate_size
+            gu = mm(h2, p["wgu"])
+            g_out, u_out = gu[..., :f], gu[..., f:]
+        else:
+            g_out, u_out = mm(h2, p["wg"]), mm(h2, p["wu"])
+        gate = jax.nn.silu(g_out.astype(jnp.float32)).astype(x.dtype)
+        x = x + mm(gate * u_out, p["wd"])
         return x
 
     def block(x, layer_in):

@@ -154,3 +154,29 @@ def test_untied_head_used_when_config_untied():
     pos = jnp.arange(3, dtype=jnp.int32)[None]
     logits, _ = forward(cfg, params, tokens, pos, None)
     assert logits.shape == (1, 3, 64)
+
+
+@pytest.mark.slow
+def test_fused_matmuls_exact_parity(tiny_model):
+    """fuse_blocks concatenates the QKV and gate/up projections into wide
+    matmuls; each output column is the same dot product, so generation must
+    be EXACTLY vanilla — bf16/f32 and int8 trees alike."""
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.ops.quant import (
+        quantize_params,
+    )
+
+    cfg, params = tiny_model
+    prompts = [[1, 5, 9, 5, 9, 3], [1, 7], [1, 3, 4, 8, 10, 2, 6]]
+    for tree in (params, quantize_params(params)):
+        ref = InferenceEngine(cfg, tree, stop_ids=(-1,), prompt_bucket=8)
+        fused = InferenceEngine(cfg, tree, stop_ids=(-1,), prompt_bucket=8,
+                                fuse_matmuls=True)
+        assert (ref.generate(prompts, max_new_tokens=8)
+                == fused.generate(prompts, max_new_tokens=8))
+
+    from llm_based_apache_spark_optimization_tpu.parallel import make_mesh
+
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="single-device"):
+        InferenceEngine(cfg, params, mesh=mesh, fuse_matmuls=True)
