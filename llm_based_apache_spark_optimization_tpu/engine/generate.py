@@ -221,17 +221,11 @@ class InferenceEngine:
         self.cfg = cfg
         self.mesh = mesh
         # Fused wqkv/wgu matmuls (models/llama.fuse_blocks): fewer, wider
-        # MXU calls — a prefill-throughput lever. Single-device only: the
-        # TP sharding specs name the unfused weights.
+        # MXU calls — a prefill-throughput lever.
         if fuse_matmuls:
-            if mesh is not None:
-                raise ValueError(
-                    "fuse_matmuls is single-device: TP sharding specs "
-                    "shard wq/wk/wv/wg/wu individually"
-                )
-            from ..models.llama import fuse_blocks
+            from ..models.llama import maybe_fuse
 
-            params = fuse_blocks(params)
+            params = maybe_fuse(params, mesh)
         # "int8": decode streams an int8 KV cache (half the cache bytes;
         # make_generate_fn docstring). Greedy/sampled both supported; the
         # speculative path has no int8-KV variant, and silently dropping a

@@ -128,6 +128,18 @@ def fuse_blocks(params: Params) -> Params:
     return out
 
 
+def maybe_fuse(params: Params, mesh) -> Params:
+    """The engines' shared fuse_matmuls entry: fuse, or reject on a mesh
+    (TP sharding specs shard wq/wk/wv/wg/wu individually — one place to
+    lift that restriction if fused specs ever land)."""
+    if mesh is not None:
+        raise ValueError(
+            "fuse_matmuls is single-device: TP sharding specs shard "
+            "wq/wk/wv/wg/wu individually"
+        )
+    return fuse_blocks(params)
+
+
 def split_blocks(params: Params) -> Params:
     """A params variant whose "blocks" is a per-layer LIST of trees (static
     slices of the stacked [L, ...] weights).

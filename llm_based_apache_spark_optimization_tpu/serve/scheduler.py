@@ -202,9 +202,16 @@ class ContinuousBatchingScheduler:
         kv_quant: Optional[str] = None,
         speculative_draft: int = 0,
         spec_ngram: int = 3,
+        fuse_matmuls: bool = False,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        if fuse_matmuls:
+            # Fewer, wider MXU matmuls for admission prefill (the phase
+            # that stalls decode rounds under load).
+            from ..models.llama import maybe_fuse
+
+            params = maybe_fuse(params, mesh)
         if mesh is not None:
             if dict(mesh.shape).get("dp", 1) != 1:
                 raise ValueError(

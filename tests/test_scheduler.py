@@ -446,3 +446,25 @@ def test_shutdown_with_in_flight_rounds_fails_futures(tiny_model_module):
     # And the scheduler rejects new work after shutdown.
     with pytest.raises(RuntimeError):
         sched.submit([1, 2], max_new_tokens=4)
+
+
+@pytest.mark.slow
+def test_scheduler_fused_matmuls_parity(tiny_model_module):
+    """fuse_matmuls under the scheduler: greedy output must be exactly the
+    unfused scheduler's (same dot products, wider matmuls), including with
+    speculation on."""
+    cfg, params = tiny_model_module
+    prompts = [[1, 5, 9, 5, 9, 3], [1, 7, 2, 4]]
+    ref = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+    )
+    with ref:
+        golden = ref.generate(prompts, max_new_tokens=8)
+    for spec in (0, 4):
+        fused = ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+            fuse_matmuls=True, speculative_draft=spec,
+        )
+        with fused:
+            out = fused.generate(prompts, max_new_tokens=8)
+        assert out == golden, f"spec={spec}"
