@@ -89,9 +89,17 @@ def main(argv=None) -> None:
     else:
         cases, system = FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM
 
+    # Execution-match scoring rides along on the taxi suite (its fixture
+    # table is in-tree); external Spider cases have no loaded database to
+    # judge against, so they score string metrics only.
+    exec_backend = None
+    if not args.spider:
+        from .report import make_taxi_exec_backend
+
+        exec_backend = make_taxi_exec_backend()
     reports = evaluate_models(
         service, service.models(), cases, system,
-        max_new_tokens=args.max_new_tokens,
+        max_new_tokens=args.max_new_tokens, exec_backend=exec_backend,
     )
     print(format_summary(reports))
 

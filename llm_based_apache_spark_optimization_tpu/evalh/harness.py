@@ -165,6 +165,10 @@ def format_summary(reports: Dict[str, ModelReport]) -> str:
             f"Average Edit Distance: {rep.avg_edit_distance:.2f}",
             f"Average Latency: {rep.avg_latency_s:.4f} sec",
             f"Aggregate Throughput: {rep.aggregate_tok_per_s:.1f} tok/s",
-            "=" * 72,
         ]
+        if rep.execution_match_rate is not None:
+            lines.append(
+                f"Execution Match Rate: {rep.execution_match_rate:.2f}%"
+            )
+        lines.append("=" * 72)
     return "\n".join(lines)
