@@ -75,8 +75,9 @@ def make_generate_fn(
     `kv_quant="int8"` stores the decode-time KV cache as int8 with per-slot
     scales: prefill fills the normal bf16 cache, one pass quantizes it
     (ops/quant.quantize_kv), and every decode step streams half the cache
-    bytes (decode is cache-streaming-bound at long context). Requires the
-    einsum decode impl (the auto default).
+    bytes (decode is cache-streaming-bound at long context). Decodes via
+    the einsum impl (auto default) or, when forced, the int8-streaming
+    flash kernel (flash_gqa_attention_quantized).
     """
     return _make_generate_fn(
         cfg, max_new, sampling, stop_ids, mesh,
@@ -117,10 +118,15 @@ def _make_generate_fn(
     prefill_impl = "ring" if sp > 1 else impl
     if kv_quant not in (None, "int8"):
         raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
-    if kv_quant and decode_impl != "xla":
+    if kv_quant and decode_impl not in ("xla", "pallas"):
+        # "xla" is the auto default (uniform engine caches are mostly live
+        # — ops.pallas.decode_attention_impl); a forced "pallas" runs the
+        # int8-streaming flash decode kernel
+        # (flash_gqa_attention_quantized). Ring has no quantized path.
         raise ValueError(
-            "kv_quant='int8' needs the einsum decode impl (the auto "
-            f"default); decode resolved to {decode_impl!r}"
+            "kv_quant='int8' decodes through the einsum impl (auto "
+            f"default) or the pallas flash kernel; resolved to "
+            f"{decode_impl!r}"
         )
 
     def gen(

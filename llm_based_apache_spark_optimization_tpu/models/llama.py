@@ -46,7 +46,12 @@ from ..ops.attention import (
     gqa_attention_quantized,
 )
 from ..ops.norm import rms_norm
-from ..ops.pallas import flash_gqa_attention, sharded_flash_gqa_attention
+from ..ops.pallas import (
+    flash_gqa_attention,
+    flash_gqa_attention_quantized,
+    sharded_flash_gqa_attention,
+    sharded_flash_gqa_attention_quantized,
+)
 from ..ops.quant import is_qtensor, mm
 from ..ops.ring_attention import ring_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
@@ -262,12 +267,18 @@ def forward(
     impl = attn_impl
     if impl == "ring" and mesh is None:
         raise ValueError('attn_impl="ring" requires a mesh with an "sp" axis')
-    if quant_cache and (impl != "xla" or t > _UNROLL_MAX_T):
+    # int8 KV cache: einsum path for any small-T window; the pallas flash
+    # kernel additionally supports T=1 decode (flash_gqa_attention_quantized
+    # — int8 streaming AND per-row kv_lens bounding stacked).
+    if quant_cache and not (
+        (impl == "xla" and t <= _UNROLL_MAX_T)
+        or (impl == "pallas" and t == 1)
+    ):
         raise ValueError(
             "an int8 KV cache needs the einsum impl and the unrolled "
-            f"small-T path (T <= {_UNROLL_MAX_T}): the flash kernel and the "
-            "prefill scan stream bf16 caches (engine prefill fills bf16, "
-            "then quantizes once — engine/generate.py)"
+            f"small-T path (T <= {_UNROLL_MAX_T}), or the pallas impl at "
+            "T=1 (decode): the prefill scan streams bf16 caches (engine "
+            "prefill fills bf16, then quantizes once — engine/generate.py)"
         )
     mask = (
         attention_mask(positions, kv_size, cfg.sliding_window)
@@ -398,10 +409,21 @@ def forward(
                     new_cache["v8"], vq["q8"], start, l)
                 new_cache["vs"] = _update_scale_layer(
                     new_cache["vs"], vq["s"], start, l)
-                attn = gqa_attention_quantized(
-                    q, new_cache["k8"][l], new_cache["ks"][l],
-                    new_cache["v8"][l], new_cache["vs"][l], mask,
-                )
+                if impl == "pallas":  # T == 1 (validated above)
+                    fn = (sharded_flash_gqa_attention_quantized
+                          if mesh is not None
+                          else flash_gqa_attention_quantized)
+                    args = (mesh,) if mesh is not None else ()
+                    attn = fn(
+                        *args, q, new_cache["k8"][l], new_cache["ks"][l],
+                        new_cache["v8"][l], new_cache["vs"][l], positions,
+                        cfg.sliding_window, kv_lens,
+                    )
+                else:
+                    attn = gqa_attention_quantized(
+                        q, new_cache["k8"][l], new_cache["ks"][l],
+                        new_cache["v8"][l], new_cache["vs"][l], mask,
+                    )
                 x = post_attn(p, x, attn)
             else:
                 new_cache["k"] = _update_cache_layer(

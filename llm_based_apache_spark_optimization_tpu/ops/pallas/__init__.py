@@ -10,7 +10,12 @@ reference stack (reference `Flask/app.py:102-107` delegates inference to
 Ollama/llama.cpp, whose C++/CUDA kernels are the analogous hot loop).
 """
 
-from .attention import flash_gqa_attention, sharded_flash_gqa_attention  # noqa: F401
+from .attention import (  # noqa: F401
+    flash_gqa_attention,
+    flash_gqa_attention_quantized,
+    sharded_flash_gqa_attention,
+    sharded_flash_gqa_attention_quantized,
+)
 from .dispatch import (  # noqa: F401
     attention_impl,
     decode_attention_impl,

@@ -71,13 +71,14 @@ def _flash_block_update(
     prefill kernel passes Kc=1 views, the decode kernel the full K. Inputs:
     q [Kc, GT, H], k/v [Kc, BLK, H], m/l [Kc, GT, 1], acc [Kc, GT, H].
     Returns (m_new, l_new, acc_new)."""
-    # A ragged final block reads past S: those rows are padding garbage
-    # (possibly NaN), and 0 * NaN = NaN would leak through the p @ v
-    # matmul even with p zeroed — zero the rows themselves.
+    # A ragged final block reads past S, and rows past this row's LIVE
+    # length kvl can be garbage too (an int8 cache dequantizes
+    # uninitialized scales): either way 0 * NaN = NaN would leak through
+    # the p @ v matmul even with p zeroed — zero the rows themselves.
     row_pos = s_idx * blk + jax.lax.broadcasted_iota(
         jnp.int32, v.shape, dimension=1
     )
-    v_z = jnp.where(row_pos < kv_len, v, 0)
+    v_z = jnp.where(row_pos < jnp.minimum(kv_len, kvl), v, 0)
 
     scores = jax.lax.dot_general(
         q, k,
@@ -173,59 +174,150 @@ def _flash_kernel(
         o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
-def _flash_decode_kernel(
-    kvlen_ref,  # [B] i32 SMEM (scalar prefetch) — valid KV slots per row
-    qpos_ref,  # [1, 1, GT] i32
-    q_ref,     # [1, K, GT, H] — ALL KV heads of one batch row
-    k_ref,     # [1, K, BLK, H]
-    v_ref,     # [1, K, BLK, H]
-    o_ref,     # [1, K, GT, H]
-    m_ref,     # [K, GT, LANES] f32 scratch — running row max (lane-broadcast)
-    l_ref,     # [K, GT, LANES] f32 scratch — running denominator
-    acc_ref,   # [K, GT, H] f32 scratch — running weighted V sum
-    *,
-    scale: float,
-    sliding_window: Optional[int],
-    kv_len: int,
-):
-    """Folded-K variant for T == 1: same online-softmax math as
-    `_flash_kernel` (shared `_flash_block_update`), with the KV-head axis
-    inside the cell as the batch dim of batched `dot_general`s.
-    Grid = (B, S_blocks)."""
-    s_idx = pl.program_id(1)
-    blk = k_ref.shape[2]
-    kvl = kvlen_ref[pl.program_id(0)]
+def _make_decode_kernel(dequant):
+    """Folded-K decode kernel factory (T == 1, grid = (B, S_blocks)): same
+    online-softmax math as `_flash_kernel` (shared `_flash_block_update`)
+    with the KV-head axis inside the cell as the batch dim of batched
+    `dot_general`s. `dequant(stream_refs, dtype) -> (k, v)` turns the
+    streamed KV blocks into compute blocks — identity for bf16 caches,
+    VMEM dequantization for int8+scales — so the init/gate/finalize
+    skeleton exists exactly once."""
 
-    @pl.when(s_idx == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+    def kernel(kvlen_ref, qpos_ref, q_ref, *rest,
+               scale, sliding_window, kv_len):
+        *stream_refs, o_ref, m_ref, l_ref, acc_ref = rest
+        s_idx = pl.program_id(1)
+        blk = stream_refs[0].shape[2]
+        kvl = kvlen_ref[pl.program_id(0)]
 
-    qp_row = qpos_ref[0, 0]       # [GT]
+        @pl.when(s_idx == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    @pl.when((s_idx * blk <= jnp.max(qp_row)) & (s_idx * blk < kvl))
-    def _compute():
-        m_new, l_new, acc_new = _flash_block_update(
-            q_ref[0], k_ref[0], v_ref[0], qp_row, kvl, s_idx, blk,
-            m_ref[:, :, :1], l_ref[:, :, :1], acc_ref[...],
-            scale=scale, sliding_window=sliding_window, kv_len=kv_len,
-        )
-        acc_ref[:] = acc_new
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        qp_row = qpos_ref[0, 0]       # [GT]
 
-    @pl.when(s_idx == pl.num_programs(1) - 1)
-    def _finalize():
-        l = l_ref[:, :, :1]
-        out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = out.astype(o_ref.dtype)
+        @pl.when((s_idx * blk <= jnp.max(qp_row)) & (s_idx * blk < kvl))
+        def _compute():
+            k, v = dequant(stream_refs, q_ref.dtype)
+            m_new, l_new, acc_new = _flash_block_update(
+                q_ref[0], k, v, qp_row, kvl, s_idx, blk,
+                m_ref[:, :, :1], l_ref[:, :, :1], acc_ref[...],
+                scale=scale, sliding_window=sliding_window, kv_len=kv_len,
+            )
+            acc_ref[:] = acc_new
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(s_idx == pl.num_programs(1) - 1)
+        def _finalize():
+            l = l_ref[:, :, :1]
+            out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = out.astype(o_ref.dtype)
+
+    return kernel
+
+
+# bf16 cache: streams are (k, v), used as-is.
+_flash_decode_kernel = _make_decode_kernel(
+    lambda refs, dt: (refs[0][0], refs[1][0])
+)
+
+
+def _dequant_streams(refs, dt):
+    """(k8, ks, v8, vs) int8+scale blocks -> bf16 compute blocks. HBM
+    streamed HALF the bytes of a bf16 cache; the dequant runs on VMEM
+    blocks only. Scaling V's rows by vs before the PV dot equals scaling
+    the probabilities (p·diag(vs)·V8 = p·(vs⊙V8))."""
+    k8, ks, v8, vs = refs
+    k = (k8[0].astype(jnp.float32) * ks[0].astype(jnp.float32)).astype(dt)
+    v = (v8[0].astype(jnp.float32) * vs[0].astype(jnp.float32)).astype(dt)
+    return k, v
+
+
+# int8 cache: streams are (k8 [1,K,BLK,H], ks [1,K,BLK,1], v8, vs).
+_flash_decode_kernel_q8 = _make_decode_kernel(_dequant_streams)
 
 
 # K-folded decode blocks keep K·BLK·H·itemsize under this budget (K and V
 # each, double-buffered by the pipeline): large-K models shrink BLK instead
 # of blowing the ~16 MB/core VMEM.
 _DECODE_KV_BLOCK_BYTES = 2 * 1024 * 1024
+
+
+def _run_decode_grid(kernel, q, streams, q_positions, kv_lens,
+                     sliding_window, blk, interpret):
+    """The K-folded decode pipeline shared by the bf16 and int8-KV
+    kernels: grid (B, S_blocks), per-block DMA of every `streams` array
+    through the kv_lens-clamped index map, online-softmax scratch, and
+    the head-fold/unfold reshapes. `streams` is a list of
+    (array [B, K, S, ...tail], tail_block_shape) pairs — (h,) for K/V
+    values, (1,) for scale columns.
+
+    Block-size rule: blk is the SUBLANE dim of every stream block (the
+    tail is the lane dim), so shrinking keeps it a multiple of 8; the
+    VMEM budget counts actual itemsizes, so int8 streams halve the
+    pressure and keep bigger blocks."""
+    b, t, n, h = q.shape
+    kh, s = streams[0][0].shape[1], streams[0][0].shape[2]
+    g = n // kh
+    gt = g * t
+    import math
+
+    per_slot_bytes = sum(
+        math.prod(tail) * arr.dtype.itemsize for arr, tail in streams
+    ) // 2  # K-side vs V-side stream in parallel; budget is per stream
+    while blk > 8 and kh * blk * per_slot_bytes > _DECODE_KV_BLOCK_BYTES:
+        blk = max(8, (blk // 2) // 8 * 8)
+    grid = (b, pl.cdiv(s, blk))
+
+    kv_lens = jnp.clip(kv_lens.astype(jnp.int32), 0, s)
+    q5 = q.reshape(b, t, kh, g, h).transpose(0, 2, 3, 1, 4).reshape(b, kh, gt, h)
+    qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))[:, None, :]
+
+    def kv_map1(bi, si, kvl):
+        # Clamp at the row's last live block: grid steps past it revisit
+        # the same block, and Pallas elides the DMA when the index
+        # repeats — that's what turns the causal/live-length skip from a
+        # compute saving into the bandwidth saving decode actually needs.
+        last = jnp.maximum((kvl[bi] + blk - 1) // blk - 1, 0)
+        return (bi, 0, jnp.minimum(si, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, gt), lambda bi, si, kvl: (bi, 0, 0)),
+            pl.BlockSpec((1, kh, gt, h), lambda bi, si, kvl: (bi, 0, 0, 0)),
+        ] + [
+            pl.BlockSpec((1, kh, blk) + tail, kv_map1)
+            for _, tail in streams
+        ],
+        out_specs=pl.BlockSpec(
+            (1, kh, gt, h), lambda bi, si, kvl: (bi, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((kh, gt, _LANES), jnp.float32),
+            pltpu.VMEM((kh, gt, _LANES), jnp.float32),
+            pltpu.VMEM((kh, gt, h), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            kernel, scale=h**-0.5, sliding_window=sliding_window, kv_len=s,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, gt, h), q.dtype),
+        # Batch cells are independent -> megacore can split them; the S
+        # axis carries the online-softmax accumulators and must run in
+        # order on one core.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(kv_lens, qpos, q5, *[arr for arr, _ in streams])
+    return out.reshape(b, kh, g, t, h).transpose(0, 3, 1, 2, 4).reshape(b, t, n, h)
 
 
 @functools.partial(
@@ -269,8 +361,17 @@ def flash_gqa_attention(
 
     if kv_lens is None:
         kv_lens = jnp.max(q_positions, axis=1) + 1
-    kv_lens = jnp.clip(kv_lens.astype(jnp.int32), 0, s)
 
+    if t == 1:
+        # Decode: fold the KV-head axis into the cell (see module docstring)
+        # and run the shared K-folded pipeline (which owns the clip / head
+        # fold / qpos tiling for the decode grid).
+        return _run_decode_grid(
+            _flash_decode_kernel, q, [(k, (h,)), (v, (h,))],
+            q_positions, kv_lens, sliding_window, blk, interpret,
+        )
+
+    kv_lens = jnp.clip(kv_lens.astype(jnp.int32), 0, s)
     # [B, T, N, H] -> [B, K, G*T, H]: fold query groups into rows per KV head.
     q5 = q.reshape(b, t, kh, g, h).transpose(0, 2, 3, 1, 4).reshape(b, kh, gt, h)
     # Row r = g*T + t attends from position q_positions[b, r % T]. The
@@ -278,58 +379,6 @@ def flash_gqa_attention(
     # the array dims — the TPU lowering requires (8, 128)-divisible or
     # full-dim blocks, and a (1, GT) block over [B, GT] violates that.
     qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))[:, None, :]  # [B, 1, GT]
-
-    if t == 1:
-        # Decode: fold the KV-head axis into the cell (see module docstring).
-        # Halving must keep blk sublane-aligned (multiple of 8): S is only
-        # guaranteed a multiple of 8, so e.g. blk=328 would halve to an
-        # unlowerable 164 — round down to the alignment each halving.
-        while blk > 8 and kh * blk * h * k.dtype.itemsize > _DECODE_KV_BLOCK_BYTES:
-            blk = max(8, (blk // 2) // 8 * 8)
-        grid = (b, pl.cdiv(s, blk))
-
-        def kv_map1(bi, si, kvl):
-            # Clamp at the row's last live block: grid steps past it revisit
-            # the same block, and Pallas elides the DMA when the index
-            # repeats — that's what turns the causal/live-length skip from a
-            # compute saving into the bandwidth saving decode actually needs.
-            last = jnp.maximum((kvl[bi] + blk - 1) // blk - 1, 0)
-            return (bi, 0, jnp.minimum(si, last), 0)
-
-        grid_spec = pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, 1, gt), lambda bi, si, kvl: (bi, 0, 0)),
-                pl.BlockSpec((1, kh, gt, h), lambda bi, si, kvl: (bi, 0, 0, 0)),
-                pl.BlockSpec((1, kh, blk, h), kv_map1),
-                pl.BlockSpec((1, kh, blk, h), kv_map1),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, kh, gt, h), lambda bi, si, kvl: (bi, 0, 0, 0)
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((kh, gt, _LANES), jnp.float32),
-                pltpu.VMEM((kh, gt, _LANES), jnp.float32),
-                pltpu.VMEM((kh, gt, h), jnp.float32),
-            ],
-        )
-        out = pl.pallas_call(
-            functools.partial(
-                _flash_decode_kernel, scale=h**-0.5,
-                sliding_window=sliding_window, kv_len=s,
-            ),
-            grid_spec=grid_spec,
-            out_shape=jax.ShapeDtypeStruct((b, kh, gt, h), q.dtype),
-            # Batch cells are independent -> megacore can split them; the S
-            # axis carries the online-softmax accumulators and must run in
-            # order on one core.
-            compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel", "arbitrary"),
-            ),
-            interpret=interpret,
-        )(kv_lens, qpos, q5, k, v)
-        return out.reshape(b, kh, g, t, h).transpose(0, 3, 1, 2, 4).reshape(b, t, n, h)
 
     # Q-tiling bounds the per-cell scratch (kernel docstring). A tile must
     # satisfy Mosaic's block constraints where it appears: qblk is the LANE
@@ -389,6 +438,88 @@ def flash_gqa_attention(
 
     # [B, K, G*T, H] -> [B, T, N, H]
     return out.reshape(b, kh, g, t, h).transpose(0, 3, 1, 2, 4).reshape(b, t, n, h)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sliding_window", "block_kv", "interpret")
+)
+def flash_gqa_attention_quantized(
+    q: jnp.ndarray,            # [B, 1, N, H] — decode only (T == 1)
+    k8: jnp.ndarray,           # [B, K, S, H] int8
+    ks: jnp.ndarray,           # [B, K, S] f32 — per-slot K scales
+    v8: jnp.ndarray,           # [B, K, S, H] int8
+    vs: jnp.ndarray,           # [B, K, S] f32 — per-slot V scales
+    q_positions: jnp.ndarray,  # [B, 1] i32
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,  # [B] i32 — live KV slots per row
+    *,
+    block_kv: int = 512,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Decode flash attention over the int8 KV cache: the bounded-streaming
+    win of `flash_gqa_attention` (per-row kv_lens, parked slots stream
+    nothing) STACKED with the byte win of `ops.attention.
+    gqa_attention_quantized` (int8 cache = half the HBM traffic) — the two
+    levers the continuous-batching scheduler's decode otherwise has to
+    choose between. T=1 only (the einsum path keeps verify windows and
+    CPU/odd shapes)."""
+    b, t, n, h = q.shape
+    if t != 1:
+        raise ValueError(f"quantized flash kernel is decode-only (T=1), got T={t}")
+    kh, s = k8.shape[1], k8.shape[2]
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if not interpret and s % 8:
+        raise ValueError(
+            f"flash kernel needs sublane-aligned S (multiple of 8) on TPU, "
+            f"got {s}"
+        )
+    if kv_lens is None:
+        kv_lens = jnp.max(q_positions, axis=1) + 1
+    ks4 = ks.astype(jnp.float32)[..., None]  # [B, K, S, 1]
+    vs4 = vs.astype(jnp.float32)[..., None]
+    return _run_decode_grid(
+        _flash_decode_kernel_q8, q,
+        [(k8, (h,)), (ks4, (1,)), (v8, (h,)), (vs4, (1,))],
+        q_positions, kv_lens, sliding_window, min(block_kv, s), interpret,
+    )
+
+
+def sharded_flash_gqa_attention_quantized(
+    mesh,
+    q, k8, ks, v8, vs, q_positions,
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,
+    *,
+    block_kv: int = 512,
+    interpret: Optional[bool] = None,
+):
+    """`flash_gqa_attention_quantized` under a dp×tp mesh (same reasoning
+    as `sharded_flash_gqa_attention`: heads and batch are the sharded
+    axes and the kernel needs no collectives; scales shard with their
+    KV-head axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    q_spec = P("dp", None, "tp", None)
+    kv_spec = P("dp", "tp", None, None)
+    sc_spec = P("dp", "tp", None)
+    body = functools.partial(
+        flash_gqa_attention_quantized,
+        sliding_window=sliding_window, block_kv=block_kv, interpret=interpret,
+    )
+    if kv_lens is None:
+        kv_lens = jnp.max(q_positions.astype(jnp.int32), axis=1) + 1
+    return jax.shard_map(
+        lambda q_, k_, ks_, v_, vs_, p_, l_: body(
+            q_, k_, ks_, v_, vs_, p_, kv_lens=l_
+        ),
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, sc_spec, kv_spec, sc_spec, P("dp", None),
+                  P("dp")),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k8, ks, v8, vs, q_positions, kv_lens)
 
 
 def sharded_flash_gqa_attention(

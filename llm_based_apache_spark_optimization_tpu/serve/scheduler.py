@@ -58,8 +58,11 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
 - **int8 KV cache** (`kv_quant="int8"`): the persistent window stores int8
   values + per-slot f32 scales (ops/quant.quantize_kv) — half the HBM
   footprint and decode streaming. Decode runs the int8-streaming einsum
-  attention; chunked prefill dequantizes the gathered rows for the chunk
-  forward and requantizes on scatter-back.
+  attention, or — past the cost crossover where a large mostly-dead
+  window pays for per-row bounded streaming — the quantized flash kernel
+  (ops.pallas.flash_gqa_attention_quantized: int8 bytes AND kv_lens
+  bounding stacked). Chunked prefill dequantizes the gathered rows for
+  the chunk forward and requantizes only its own window on scatter-back.
 - **Streaming + cancellation**: `submit(on_token=...)` delivers accepted
   tokens in order from the worker thread (SchedulerBackend.complete_stream
   turns them into clean text deltas, byte-identical to the blocking path);
@@ -238,17 +241,21 @@ class ContinuousBatchingScheduler:
         # bounding (parked slots stream nothing) only beats the einsum
         # path's zero-overhead full-cache read once the persistent
         # [slots, max_seq] cache is large per device — see
-        # ops.pallas.decode_attention_impl for the measured crossover. An
-        # int8 KV cache decodes through the einsum path exclusively (the
-        # quantized attention of ops/attention.py), which also halves the
-        # full-read penalty the kernel would have amortized.
+        # ops.pallas.decode_attention_impl for the measured crossover.
+        # With the int8 KV cache the streamed bytes HALVE (which also
+        # halves the full-read penalty the kernel amortizes), so the
+        # crossover is fed the quantized byte count; past it, decode runs
+        # flash_gqa_attention_quantized — int8 streaming and bounded
+        # streaming stacked.
         from ..engine.kvcache import cache_bytes as _cache_bytes
 
         tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
-        self._decode_impl = "xla" if kv_quant else decode_attention_impl(
-            mesh,
-            _cache_bytes(cfg, num_slots, self.max_seq, dtype.itemsize) // tp,
-        )
+        cache_dev_bytes = _cache_bytes(
+            cfg, num_slots, self.max_seq, dtype.itemsize
+        ) // tp
+        if kv_quant:
+            cache_dev_bytes //= 2
+        self._decode_impl = decode_attention_impl(mesh, cache_dev_bytes)
         cache = init_cache(cfg, num_slots, self.max_seq, dtype=dtype)
         # The persistent cache is a TUPLE of arrays threaded through every
         # jitted op: (k, v) in bf16 mode, (k8, ks, v8, vs) with int8 KV

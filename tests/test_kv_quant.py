@@ -229,11 +229,32 @@ def test_kv_quant_windowed_scatter_survives_prefix_misalignment(tiny):
     assert err < 0.2, f"relative error {err:.3f}: overflow chunk formed"
 
 
-def test_kv_quant_rejects_non_einsum_decode(tiny):
+def test_kv_quant_decode_impls(tiny):
+    """int8 KV decodes through einsum (auto) or the quantized flash kernel
+    (forced pallas) — with greedy parity between the two — and still
+    rejects impls with no quantized path (ring)."""
     cfg, params = tiny
     from llm_based_apache_spark_optimization_tpu.engine import make_generate_fn
-    from llm_based_apache_spark_optimization_tpu.ops.sampling import SamplingParams
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
 
-    with pytest.raises(ValueError, match="einsum decode impl"):
+    with pytest.raises(ValueError, match="einsum impl"):
         make_generate_fn(cfg, 8, SamplingParams(), (-1,), None,
-                         attn_impl="pallas", kv_quant="int8")
+                         attn_impl="ring", kv_quant="int8")
+
+    from llm_based_apache_spark_optimization_tpu.ops.pallas import (
+        set_attention_impl,
+    )
+
+    golden = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                             kv_quant="int8").generate(PROMPTS,
+                                                       max_new_tokens=8)
+    try:
+        set_attention_impl("pallas")
+        eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                              kv_quant="int8")
+        out = eng.generate(PROMPTS, max_new_tokens=8)
+    finally:
+        set_attention_impl("auto")
+    assert out == golden
