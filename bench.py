@@ -51,8 +51,10 @@ thing the loaded model would.
 
 Knobs (env): BENCH_CONFIG (model registry name, default bench-1b), BENCH_BATCH,
 BENCH_PROMPT, BENCH_NEW (auto-clamped to the config's max_seq_len),
-BENCH_QUANT=int8, BENCH_REPS, BENCH_DETAIL=1, BENCH_FORCE_CPU=1,
-BENCH_TPU_TIMEOUT / BENCH_CPU_TIMEOUT (s), BENCH_TPU_RETRIES.
+BENCH_QUANT=int8|int4 (int4: packed-nibble weights through the pallas
+int4 matmul kernel), BENCH_FUSE=1 (fused wqkv/wgu A/B), BENCH_7B_BITS=4|8,
+BENCH_REPS, BENCH_DETAIL=1, BENCH_FORCE_CPU=1, BENCH_TPU_TIMEOUT /
+BENCH_CPU_TIMEOUT (s), BENCH_TPU_RETRIES.
 """
 
 from __future__ import annotations
@@ -234,6 +236,17 @@ def inner() -> int:
         from llm_based_apache_spark_optimization_tpu.ops import quantize_params
 
         params = quantize_params(params)
+    elif quant == "int4":
+        from llm_based_apache_spark_optimization_tpu.ops import (
+            quantize_params_int4,
+        )
+
+        params = quantize_params_int4(params)
+        # The sub-benchmarks (re)quantize the primary tree by its int8/bf16
+        # leaf shapes; an int4 tree would crash quantize_params mid-run.
+        # BENCH_QUANT=int4 is a focused primary measurement (the 7b leg has
+        # its own BENCH_7B_BITS=4 path).
+        with_int8 = with_sched = with_long = with_7b = False
     # stop_ids=(-1,): never stops — random weights would otherwise emit eos at
     # arbitrary points and under-count the decode work.
     # BENCH_FUSE=1: fused wqkv/wgu matmuls (models/llama.fuse_blocks) for
@@ -273,7 +286,7 @@ def inner() -> int:
 
     result = {
         "metric": f"aggregate greedy decode throughput ({cfg_name}"
-                  f"{'-int8' if quant == 'int8' else ''}, B={batch}, "
+                  f"{'-' + quant if quant else ''}, B={batch}, "
                   f"prompt={prompt_len}, new={max_new})",
         "value": round(best_tok_s, 1),
         "unit": "output tok/s",
@@ -336,15 +349,16 @@ def _bench_7b(device_kind, dev) -> dict:
     )
 
     cfg = REGISTRY[os.environ.get("BENCH_7B_CONFIG", "duckdb-nsql-7b")]
+    bits = int(os.environ.get("BENCH_7B_BITS", "8"))
     batch = int(os.environ.get("BENCH_7B_BATCH", "8"))
     prompt_len = min(int(os.environ.get("BENCH_7B_PROMPT", "128")),
                      cfg.max_seq_len // 2)
     max_new = min(int(os.environ.get("BENCH_7B_NEW", "64")),
                   cfg.max_seq_len - prompt_len)
-    out: dict = {"config": cfg.name, "quant": "int8+kv8",
+    out: dict = {"config": cfg.name, "quant": f"int{bits}+kv8",
                  "prompt": prompt_len, "new": max_new}
 
-    params = init_params_quantized(cfg, jax.random.key(0))
+    params = init_params_quantized(cfg, jax.random.key(0), bits=bits)
     out["param_bytes"] = _param_bytes(params)
     rng = np.random.default_rng(3)
 

@@ -124,6 +124,13 @@ def fuse_blocks(params: Params) -> Params:
                 "q8": jnp.concatenate([w["q8"] for w in ws], axis=-1),
                 "s": jnp.concatenate([w["s"] for w in ws], axis=-1),
             }
+        if isinstance(ws[0], dict) and "q4" in ws[0]:
+            # int4 packs along the contraction axis; out axes concat
+            # directly (scales ride their out columns).
+            return {
+                "q4": jnp.concatenate([w["q4"] for w in ws], axis=-1),
+                "s4": jnp.concatenate([w["s4"] for w in ws], axis=-1),
+            }
         return jnp.concatenate(ws, axis=-1)
 
     blocks["wqkv"] = cat(("wq", "wk", "wv"))

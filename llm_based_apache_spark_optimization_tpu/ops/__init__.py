@@ -2,7 +2,16 @@
 
 from .attention import attention_mask, gqa_attention  # noqa: F401
 from .norm import rms_norm  # noqa: F401
-from .quant import dequantize_weight, is_qtensor, quantize_params, quantize_weight  # noqa: F401
+from .quant import (  # noqa: F401
+    dequantize_weight,
+    dequantize_weight_int4,
+    is_q4tensor,
+    is_qtensor,
+    quantize_params,
+    quantize_params_int4,
+    quantize_weight,
+    quantize_weight_int4,
+)
 from .ring_attention import ring_gqa_attention  # noqa: F401
 from .rope import apply_rope, rope_cos_sin  # noqa: F401
 from .sampling import SamplingParams, greedy, sample  # noqa: F401

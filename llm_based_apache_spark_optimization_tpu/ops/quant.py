@@ -42,6 +42,60 @@ def is_qtensor(w: Any) -> bool:
     return isinstance(w, dict) and "q8" in w
 
 
+def is_q4tensor(w: Any) -> bool:
+    return isinstance(w, dict) and "q4" in w
+
+
+def quantize_weight_int4(w: jnp.ndarray, group: int = 128) -> Dict[str, jnp.ndarray]:
+    """[..., in, out] float -> {"q4": uint8 [..., in/2, out] packed nibbles,
+    "s4": f32 [..., in/group, out]} — symmetric absmax int4 with one scale
+    per (contraction group, out channel), the storage llama.cpp's Q4 blobs
+    get at (the reference's models ship 4-bit; this is the TPU-native
+    equivalent at one QUARTER of bf16's weight bytes).
+
+    Byte b of q4 packs contraction rows 2b (LOW nibble) and 2b+1 (HIGH),
+    biased by +8 into [0, 15] (value = nibble - 8). Packed uint8 on
+    purpose: the jnp.int4 dtype crashes the axon TPU client on device_put.
+    """
+    n_in = w.shape[-2]
+    group = min(group, n_in)
+    if n_in % group or group % 2:
+        raise ValueError(f"in dim {n_in} must be a multiple of even group "
+                         f"{group}")
+    w32 = w.astype(jnp.float32)
+    grouped = w32.reshape(*w.shape[:-2], n_in // group, group, w.shape[-1])
+    s = jnp.max(jnp.abs(grouped), axis=-2) / 7.0   # [..., groups, out]
+    s = jnp.where(s == 0.0, 1.0, s)
+    q = jnp.clip(jnp.round(grouped / s[..., None, :]), -8, 7)
+    q = q.reshape(*w.shape[:-2], n_in, w.shape[-1])
+    nib = (q + 8).astype(jnp.uint8)
+    pairs = nib.reshape(*w.shape[:-2], n_in // 2, 2, w.shape[-1])
+    q4 = pairs[..., 0, :] | jnp.left_shift(pairs[..., 1, :], jnp.uint8(4))
+    return {"q4": q4, "s4": s}
+
+
+def dequantize_weight_int4(w: Dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.ndarray:
+    from .pallas.int4mm import unpack_nibbles
+
+    q = unpack_nibbles(w["q4"]).astype(jnp.float32)  # [..., in, out]
+    n_in = q.shape[-2]
+    groups = w["s4"].shape[-2]
+    grouped = q.reshape(*q.shape[:-2], groups, n_in // groups, q.shape[-1])
+    deq = grouped * w["s4"][..., None, :]
+    return deq.reshape(q.shape).astype(dtype)
+
+
+def quantize_params_int4(params: Dict[str, Any], group: int = 128) -> Dict[str, Any]:
+    """int4-quantize the block matmul weights (same split as
+    quantize_params: embeddings/unembed/norms stay high-precision)."""
+    out = dict(params)
+    out["blocks"] = {
+        k: quantize_weight_int4(v, group) if k in QUANT_KEYS else v
+        for k, v in params["blocks"].items()
+    }
+    return out
+
+
 def quantize_weight(w: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     """[..., in, out] float -> {"q8": int8, "s": f32 [..., out]}."""
     w32 = w.astype(jnp.float32)
@@ -65,7 +119,7 @@ def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
-def init_params_quantized(cfg, key, dtype=jnp.bfloat16) -> Dict[str, Any]:
+def init_params_quantized(cfg, key, dtype=jnp.bfloat16, bits: int = 8) -> Dict[str, Any]:
     """Random int8 param tree built DIRECTLY at its final size — no
     full-precision intermediate.
 
@@ -89,17 +143,35 @@ def init_params_quantized(cfg, key, dtype=jnp.bfloat16) -> Dict[str, Any]:
         "wo": (L, nh * hd, d), "wg": (L, d, f), "wu": (L, d, f),
         "wd": (L, f, d),
     }
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
     blocks: Dict[str, Any] = {}
     for i, (name, shape) in enumerate(shapes.items()):
         fan_in = shape[-2]
-        # jit so the PRNG runs on-device at int8 width; int8 absmax 127
-        # with scale fan_in^-0.5/127 reproduces init_params' row scale.
-        q8 = jax.jit(
-            lambda k, s=shape: jax.random.randint(k, s, -127, 128, jnp.int8)
-        )(keys[i])
-        s = jnp.full(shape[:-2] + shape[-1:], fan_in ** -0.5 / 127.0,
-                     jnp.float32)
-        blocks[name] = {"q8": q8, "s": s}
+        if bits == 8:
+            # jit so the PRNG runs on-device at int8 width; int8 absmax
+            # 127 with scale fan_in^-0.5/127 reproduces init_params' row
+            # scale.
+            q8 = jax.jit(
+                lambda k, s=shape: jax.random.randint(k, s, -127, 128,
+                                                      jnp.int8)
+            )(keys[i])
+            s = jnp.full(shape[:-2] + shape[-1:], fan_in ** -0.5 / 127.0,
+                         jnp.float32)
+            blocks[name] = {"q8": q8, "s": s}
+        else:
+            # Packed random nibbles at final size (quantize_weight_int4
+            # layout), absmax 7 scaling; group = min(128, fan_in).
+            group = min(128, fan_in)
+            pshape = shape[:-2] + (fan_in // 2, shape[-1])
+            q4 = jax.jit(
+                lambda k, s=pshape: jax.random.randint(
+                    k, s, 0, 256, jnp.int32
+                ).astype(jnp.uint8)
+            )(keys[i])
+            s4 = jnp.full(shape[:-2] + (fan_in // group, shape[-1]),
+                          fan_in ** -0.5 / 7.0, jnp.float32)
+            blocks[name] = {"q4": q4, "s4": s4}
     blocks["ln_attn"] = jnp.ones((L, d), dtype)
     blocks["ln_mlp"] = jnp.ones((L, d), dtype)
 
@@ -162,4 +234,13 @@ def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
             preferred_element_type=jnp.float32,
         )
         return (acc * w["s"]).astype(x.dtype)
+    if is_q4tensor(w):
+        from .pallas.int4mm import int4_matmul
+
+        lead = x.shape[:-1]
+        rows = 1
+        for d in lead:
+            rows *= d
+        out = int4_matmul(x.reshape(rows, x.shape[-1]), w["q4"], w["s4"])
+        return out.reshape(*lead, out.shape[-1])
     return x @ w

@@ -86,9 +86,15 @@ def batch_spec(ndim: int = 2) -> P:
 
 def shard_params(params: Pytree, cfg: LlamaConfig, mesh: Mesh) -> Pytree:
     """Place a (host or single-device) param tree onto the mesh."""
-    from ..ops.quant import is_qtensor
+    from ..ops.quant import is_q4tensor, is_qtensor
 
     validate_tp(cfg, mesh.shape["tp"])
+    if is_q4tensor(params["blocks"]["wq"]):
+        raise NotImplementedError(
+            "int4 trees are single-device for now: the pallas int4 matmul "
+            "inside mm() would need a shard_map wrapper per weight before "
+            "it can run on GSPMD-sharded operands"
+        )
     specs = param_specs(cfg, quantized=is_qtensor(params["blocks"]["wq"]))
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
