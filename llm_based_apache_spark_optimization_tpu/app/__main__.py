@@ -191,6 +191,12 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     if kv_quant and getattr(args, "speculative", 0) > 0 and not args.scheduler:
         sys.exit("--kv-int8 cannot combine with --speculative: the "
                  "speculative verify loop streams the bf16 cache")
+    int4 = getattr(args, "int4", False)
+    if int4 and args.int8:
+        sys.exit("pick one of --int8 / --int4")
+    if int4 and args.dp * args.sp * args.tp > 1:
+        sys.exit("--int4 is single-device for now: the pallas int4 matmul "
+                 "needs a shard_map wrapper before it can run sharded")
 
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
@@ -205,10 +211,12 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                               add_bos=add_bos, num_slots=args.slots,
                               kv_quant=kv_quant)
                 common["speculative_draft"] = getattr(args, "speculative", 0)
+                common["quantize_int8"] = args.int8
+                common["quantize_int4"] = int4
                 if path.endswith(".gguf"):
                     return SchedulerBackend.from_gguf(path, tok, **common)
                 return SchedulerBackend.from_hf_checkpoint(
-                    path, tok, quantize_int8=args.int8, **common
+                    path, tok, **common
                 )
             # dp replicas: load the checkpoint ONCE host-side (and quantize
             # host-side, so only the int8 tree ever ships — the same order
@@ -246,10 +254,12 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             return EngineBackend.from_gguf(
                 path, tok, mesh=mesh, max_new_tokens=max_new_tokens,
                 add_bos=add_bos, speculative_draft=getattr(args, "speculative", 0),
-                kv_quant=kv_quant,
+                kv_quant=kv_quant, quantize_int8=args.int8,
+                quantize_int4=int4,
             )
         return EngineBackend.from_hf_checkpoint(
             path, tok, mesh=mesh, quantize_int8=args.int8,
+            quantize_int4=int4,
             max_new_tokens=max_new_tokens, add_bos=add_bos,
             speculative_draft=getattr(args, "speculative", 0),
             kv_quant=kv_quant,
@@ -288,6 +298,10 @@ def main(argv=None) -> None:
                     help="int8 KV cache with per-slot scales: halves the "
                          "serving window's HBM footprint and decode cache "
                          "streaming (scheduler and engine backends)")
+    ap.add_argument("--int4", action="store_true",
+                    help="pack block weights to 4-bit nibbles served by the "
+                         "pallas int4 matmul kernel (quarter of bf16's "
+                         "weight bytes; single-device)")
     ap.add_argument("--int8", action="store_true",
                     help="int8 weight-only quantization (HF checkpoints)")
     ap.add_argument("--scheduler", action=argparse.BooleanOptionalAction,

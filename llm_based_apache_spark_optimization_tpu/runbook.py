@@ -153,6 +153,12 @@ def build_service(args, log=print):
     from .serve.scheduler import ContinuousBatchingScheduler, SchedulerBackend
     from .tokenizer import HFTokenizer
 
+    if getattr(args, "int4", False):
+        if args.int8:
+            sys.exit("runbook: pick one of --int8 / --int4")
+        if args.tp > 1:
+            sys.exit("runbook: --int4 is single-device for now (the pallas "
+                     "int4 matmul needs a shard_map wrapper to run sharded)")
     if (getattr(args, "kv_int8", False) and getattr(args, "speculative", 0)
             and not args.scheduler):
         # Same up-front guard as the app CLI: the ENGINE's speculative
@@ -185,6 +191,10 @@ def build_service(args, log=print):
             from .ops.quant import quantize_params
 
             params = quantize_params(params)
+        elif getattr(args, "int4", False):
+            from .ops.quant import quantize_params_int4
+
+            params = quantize_params_int4(params)
         kv_quant = "int8" if getattr(args, "kv_int8", False) else None
         spec = getattr(args, "speculative", 0)
         if args.scheduler:
@@ -228,6 +238,9 @@ def main(argv=None) -> None:
                     help="orbax native-cache root (convert once, restore after)")
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--int8", action="store_true")
+    ap.add_argument("--int4", action="store_true",
+                    help="4-bit packed weights via the pallas int4 matmul "
+                         "kernel (single-device; pick one of --int8/--int4)")
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8 KV cache (per-slot scales): halves the "
                          "serving window's HBM footprint and cache traffic")

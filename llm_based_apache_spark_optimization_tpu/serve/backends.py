@@ -84,6 +84,7 @@ class EngineBackend:
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
+        quantize_int4: bool = False,
         speculative_draft: int = 0,
         kv_quant=None,
         **kwargs,
@@ -93,24 +94,29 @@ class EngineBackend:
 
         `quantize_int8=True` converts the block matmul weights to int8
         QTensors before placement (ops/quant.py) — halves weight HBM
-        traffic for bandwidth-bound decode. `speculative_draft=N` turns on
-        prompt-lookup speculative decoding for greedy requests
-        (engine/speculative.py — the NL→SQL copy-heavy workload is its
-        sweet spot)."""
+        traffic for bandwidth-bound decode; `quantize_int4=True` packs
+        them to 4-bit nibbles served by the pallas int4 matmul kernel
+        (one quarter of bf16's weight bytes; single-device).
+        `speculative_draft=N` turns on prompt-lookup speculative decoding
+        for greedy requests (engine/speculative.py — the NL→SQL
+        copy-heavy workload is its sweet spot)."""
         import jax.numpy as jnp
 
         from ..checkpoint import load_hf_checkpoint
 
-        if quantize_int8:
-            from ..ops.quant import quantize_params
+        if quantize_int8 and quantize_int4:
+            raise ValueError("pick one of quantize_int8 / quantize_int4")
+        if quantize_int8 or quantize_int4:
+            from ..ops.quant import quantize_params, quantize_params_int4
             from ..parallel.sharding import shard_params
 
-            # Load host-side, quantize, then place: the int8 tree is what
-            # ships to devices, not the full-precision one.
+            # Load host-side, quantize, then place: the quantized tree is
+            # what ships to devices, not the full-precision one.
             cfg, params = load_hf_checkpoint(
                 ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=None
             )
-            params = quantize_params(params)
+            params = (quantize_params_int4(params) if quantize_int4
+                      else quantize_params(params))
             if mesh is not None:
                 params = shard_params(params, cfg, mesh)
         else:
@@ -135,18 +141,37 @@ class EngineBackend:
         dtype=None,
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
+        quantize_int8: bool = False,
+        quantize_int4: bool = False,
         speculative_draft: int = 0,
         kv_quant=None,
         **kwargs,
     ) -> "EngineBackend":
         """Stand up a backend from a GGUF blob — the exact file format the
         reference's Ollama models ship as (parsed + dequantized by the
-        in-tree C++ core, native/src/gguf.cpp)."""
+        in-tree C++ core, native/src/gguf.cpp). The loader dequantizes the
+        blob's own quantization to the compute dtype; `quantize_int8` /
+        `quantize_int4` then re-quantize into the in-tree serving formats
+        (a Q4 blob served with quantize_int4 stays 4-bit end to end)."""
         from ..checkpoint import load_gguf_checkpoint
 
-        cfg, params = load_gguf_checkpoint(
-            gguf_path, cfg=cfg, dtype=dtype, mesh=mesh
-        )
+        if quantize_int8 and quantize_int4:
+            raise ValueError("pick one of quantize_int8 / quantize_int4")
+        if quantize_int8 or quantize_int4:
+            from ..ops.quant import quantize_params, quantize_params_int4
+            from ..parallel.sharding import shard_params
+
+            cfg, params = load_gguf_checkpoint(
+                gguf_path, cfg=cfg, dtype=dtype, mesh=None
+            )
+            params = (quantize_params_int4(params) if quantize_int4
+                      else quantize_params(params))
+            if mesh is not None:
+                params = shard_params(params, cfg, mesh)
+        else:
+            cfg, params = load_gguf_checkpoint(
+                gguf_path, cfg=cfg, dtype=dtype, mesh=mesh
+            )
         engine = InferenceEngine(
             cfg, params, mesh=mesh, prompt_bucket=prompt_bucket,
             speculative_draft=speculative_draft, kv_quant=kv_quant,

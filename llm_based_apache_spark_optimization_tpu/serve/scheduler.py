@@ -1410,6 +1410,7 @@ class SchedulerBackend:
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
+        quantize_int4: bool = False,
         kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
@@ -1428,13 +1429,16 @@ class SchedulerBackend:
         from ..checkpoint import load_hf_checkpoint
         from .backends import resolve_stop_ids
 
-        if quantize_int8:
-            from ..ops.quant import quantize_params
+        if quantize_int8 and quantize_int4:
+            raise ValueError("pick one of quantize_int8 / quantize_int4")
+        if quantize_int8 or quantize_int4:
+            from ..ops.quant import quantize_params, quantize_params_int4
 
             cfg, params = load_hf_checkpoint(
                 ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=None
             )
-            params = quantize_params(params)
+            params = (quantize_params_int4(params) if quantize_int4
+                      else quantize_params(params))
             # Placement happens in the scheduler __init__ (shard_params).
             sched_mesh = mesh
         else:
@@ -1463,6 +1467,8 @@ class SchedulerBackend:
         num_slots: int = 8,
         prompt_bucket: int = 128,
         stop_ids: Optional[Sequence[int]] = None,
+        quantize_int8: bool = False,
+        quantize_int4: bool = False,
         kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
@@ -1470,13 +1476,27 @@ class SchedulerBackend:
         **kwargs,
     ) -> "SchedulerBackend":
         """GGUF blob -> continuous-batching scheduler (C++ parse + dequant,
-        native/src/gguf.cpp)."""
+        native/src/gguf.cpp). `quantize_int8`/`quantize_int4` re-quantize
+        the dequantized blob into the in-tree serving formats (a Q4 blob
+        served with quantize_int4 stays 4-bit end to end)."""
         from ..checkpoint import load_gguf_checkpoint
         from .backends import resolve_stop_ids
 
-        cfg, params = load_gguf_checkpoint(
-            gguf_path, cfg=cfg, dtype=dtype, mesh=mesh
-        )
+        if quantize_int8 and quantize_int4:
+            raise ValueError("pick one of quantize_int8 / quantize_int4")
+        if quantize_int8 or quantize_int4:
+            from ..ops.quant import quantize_params, quantize_params_int4
+
+            cfg, params = load_gguf_checkpoint(
+                gguf_path, cfg=cfg, dtype=dtype, mesh=None
+            )
+            params = (quantize_params_int4(params) if quantize_int4
+                      else quantize_params(params))
+            # Placement happens in the scheduler __init__ (shard_params).
+        else:
+            cfg, params = load_gguf_checkpoint(
+                gguf_path, cfg=cfg, dtype=dtype, mesh=mesh
+            )
         sched = ContinuousBatchingScheduler(
             cfg, params, num_slots=num_slots, max_seq=max_seq,
             decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,

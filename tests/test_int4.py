@@ -179,3 +179,37 @@ def test_init_params_quantized_int4_structure(tiny_model):
     eng = InferenceEngine(TINY, got, stop_ids=(-1,), prompt_bucket=8)
     out = eng.generate([[1, 5, 9], [1, 7]], max_new_tokens=6)
     assert all(len(o) == 6 for o in out)
+
+
+@pytest.mark.slow
+def test_int4_checkpoint_serving_path(tmp_path):
+    """quantize_int4 through the deployment classmethod: HF checkpoint ->
+    int4 tree -> scheduler backend -> completion."""
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        save_hf_checkpoint,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+    from llm_based_apache_spark_optimization_tpu.ops.quant import is_q4tensor
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        SchedulerBackend,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import ByteTokenizer
+
+    params = init_params(TINY, jax.random.key(3), dtype=jnp.float32)
+    save_hf_checkpoint(TINY, params, tmp_path)
+    backend = SchedulerBackend.from_hf_checkpoint(
+        str(tmp_path), ByteTokenizer(), quantize_int4=True,
+        max_new_tokens=6, num_slots=2, dtype=jnp.float32,
+    )
+    try:
+        assert is_q4tensor(backend.scheduler.params["blocks"]["wq"])
+        out = backend.complete("ab")
+        assert out.output_tokens >= 1
+    finally:
+        backend.shutdown()
+
+    with pytest.raises(ValueError, match="pick one"):
+        SchedulerBackend.from_hf_checkpoint(
+            str(tmp_path), ByteTokenizer(), quantize_int4=True,
+            quantize_int8=True,
+        )
