@@ -213,3 +213,30 @@ def test_int4_checkpoint_serving_path(tmp_path):
             str(tmp_path), ByteTokenizer(), quantize_int4=True,
             quantize_int8=True,
         )
+
+
+@pytest.mark.slow
+def test_int4_weights_with_int8_kv_scheduler(tiny_model):
+    """Max-compression serving config: 4-bit weights (pallas matmul) +
+    int8 KV cache, under the scheduler, greedy parity with the engine on
+    the same tree."""
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny_model
+    params4 = quantize_params_int4(params, group=32)
+    prompts = [[1, 5, 9], [1, 7, 2, 4]]
+    golden = [
+        InferenceEngine(cfg, params4, stop_ids=(-1,), prompt_bucket=8,
+                        kv_quant="int8").generate([p], max_new_tokens=6)[0]
+        for p in prompts
+    ]
+    sched = ContinuousBatchingScheduler(
+        cfg, params4, num_slots=2, decode_chunk=4, prompt_bucket=8,
+        stop_ids=(-1,), kv_quant="int8",
+    )
+    with sched:
+        out = sched.generate(prompts, max_new_tokens=6)
+    assert out == golden
