@@ -247,6 +247,17 @@ def inner() -> int:
         # BENCH_QUANT=int4 is a focused primary measurement (the 7b leg has
         # its own BENCH_7B_BITS=4 path).
         with_int8 = with_sched = with_long = with_7b = False
+    unembed8 = os.environ.get("BENCH_UNEMBED8", "0") == "1"
+    if unembed8:
+        # Per-row int8 embed/unembed tables: after int4 blocks the bf16
+        # unembed is the largest remaining decode stream. Focused A/B:
+        # the sub-benchmarks would otherwise silently run on the
+        # ue8-quantized tree under their own labels.
+        from llm_based_apache_spark_optimization_tpu.ops import quantize_unembed
+
+        params = quantize_unembed(params)
+        quant = (quant + "+ue8") if quant else "ue8"
+        with_int8 = with_sched = with_long = with_7b = False
     # stop_ids=(-1,): never stops — random weights would otherwise emit eos at
     # arbitrary points and under-count the decode work.
     # BENCH_FUSE=1: fused wqkv/wgu matmuls (models/llama.fuse_blocks) for
@@ -359,6 +370,13 @@ def _bench_7b(device_kind, dev) -> dict:
                  "prompt": prompt_len, "new": max_new}
 
     params = init_params_quantized(cfg, jax.random.key(0), bits=bits)
+    if os.environ.get("BENCH_7B_UNEMBED8", "0") == "1":
+        from llm_based_apache_spark_optimization_tpu.ops.quant import (
+            quantize_unembed,
+        )
+
+        params = quantize_unembed(params)
+        out["quant"] += "+ue8"
     out["param_bytes"] = _param_bytes(params)
     rng = np.random.default_rng(3)
 

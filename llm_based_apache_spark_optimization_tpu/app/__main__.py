@@ -213,6 +213,8 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 common["speculative_draft"] = getattr(args, "speculative", 0)
                 common["quantize_int8"] = args.int8
                 common["quantize_int4"] = int4
+                common["quantize_unembed8"] = getattr(args, "int8_unembed",
+                                                      False)
                 if path.endswith(".gguf"):
                     return SchedulerBackend.from_gguf(path, tok, **common)
                 return SchedulerBackend.from_hf_checkpoint(
@@ -256,10 +258,12 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 add_bos=add_bos, speculative_draft=getattr(args, "speculative", 0),
                 kv_quant=kv_quant, quantize_int8=args.int8,
                 quantize_int4=int4,
+                quantize_unembed8=getattr(args, "int8_unembed", False),
             )
         return EngineBackend.from_hf_checkpoint(
             path, tok, mesh=mesh, quantize_int8=args.int8,
             quantize_int4=int4,
+            quantize_unembed8=getattr(args, "int8_unembed", False),
             max_new_tokens=max_new_tokens, add_bos=add_bos,
             speculative_draft=getattr(args, "speculative", 0),
             kv_quant=kv_quant,
@@ -298,6 +302,10 @@ def main(argv=None) -> None:
                     help="int8 KV cache with per-slot scales: halves the "
                          "serving window's HBM footprint and decode cache "
                          "streaming (scheduler and engine backends)")
+    ap.add_argument("--int8-unembed", action="store_true",
+                    help="per-row int8 embedding/unembedding tables — the "
+                         "largest remaining bf16 decode stream after block "
+                         "quantization (composes with --int8/--int4)")
     ap.add_argument("--int4", action="store_true",
                     help="pack block weights to 4-bit nibbles served by the "
                          "pallas int4 matmul kernel (quarter of bf16's "

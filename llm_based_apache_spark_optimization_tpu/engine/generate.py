@@ -142,7 +142,7 @@ def _make_generate_fn(
         # clamp (InferenceEngine always passes budget <= cap, but this fn is
         # exported for direct use).
         budget = jnp.minimum(budget, max_new)
-        cache = init_cache(cfg, b, t + max_new, dtype=params["embed"].dtype)
+        cache = init_cache(cfg, b, t + max_new, dtype=params["final_norm"].dtype)
         if mesh is not None:
             cache = constrain_cache(cache, mesh)
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))

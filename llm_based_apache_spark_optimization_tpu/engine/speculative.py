@@ -158,7 +158,7 @@ def _make_speculative_generate_fn(
         budget = jnp.minimum(budget, max_new)
         lengths = lengths.astype(jnp.int32)
         # Cache spans prompt + completion + one verify window of overshoot.
-        cache = init_cache(cfg, b, t + max_new + d1, dtype=params["embed"].dtype)
+        cache = init_cache(cfg, b, t + max_new + d1, dtype=params["final_norm"].dtype)
         if mesh is not None:
             cache = constrain_cache(cache, mesh)
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))

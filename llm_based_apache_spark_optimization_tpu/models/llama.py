@@ -256,7 +256,14 @@ def forward(
     cost at long T).
     """
     b, t = tokens.shape
-    x = jnp.take(params["embed"], tokens, axis=0)  # [B, T, D]
+    emb = params["embed"]
+    if is_qtensor(emb):  # ops/quant.quantize_unembed: per-row int8 table
+        rows = jnp.take(emb["q8"], tokens, axis=0).astype(jnp.float32)
+        x = (rows * jnp.take(emb["s"], tokens, axis=0)[..., None]).astype(
+            params["final_norm"].dtype
+        )
+    else:
+        x = jnp.take(emb, tokens, axis=0)  # [B, T, D]
     cos, sin = rope_cos_sin(positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     start = positions[:, 0]
 
@@ -451,5 +458,15 @@ def forward(
             x, logit_indices.astype(jnp.int32)[:, None, None], axis=1
         )  # [B, 1, D]
     unembed = params["embed"] if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("btd,vd->btv", x, unembed, preferred_element_type=jnp.float32)
+    if is_qtensor(unembed):
+        # int8 streams straight into the dot (never .astype the table —
+        # ops/quant.py's measured rule); per-row scales rescale the logit
+        # columns in the f32 epilogue.
+        logits = jnp.einsum(
+            "btd,vd->btv", x, unembed["q8"],
+            preferred_element_type=jnp.float32,
+        ) * unembed["s"][None, None, :]
+    else:
+        logits = jnp.einsum("btd,vd->btv", x, unembed,
+                            preferred_element_type=jnp.float32)
     return logits, new_cache

@@ -9,6 +9,7 @@ from .quant import (  # noqa: F401
     is_qtensor,
     quantize_params,
     quantize_params_int4,
+    quantize_unembed,
     quantize_weight,
     quantize_weight_int4,
 )

@@ -192,6 +192,34 @@ def init_params_quantized(cfg, key, dtype=jnp.bfloat16, bits: int = 8) -> Dict[s
     return params
 
 
+def quantize_unembed(params: Dict[str, Any]) -> Dict[str, Any]:
+    """int8-quantize the embedding/unembedding tables (per-ROW scales:
+    absmax over the hidden axis, one scale per vocab entry).
+
+    The block quantizers deliberately leave these in bf16, but at decode
+    the unembed matmul streams the whole [V, D] table every step — after
+    int4 blocks it is the largest remaining bf16 stream (~22% of 7B-int4
+    decode bytes). llama.cpp's presets quantize output/token_embd too
+    (Q6/Q8); this is the same split at int8. The embedding GATHER
+    dequantizes only the looked-up rows (exact per row, negligible cost);
+    the unembed feeds int8 straight into the logits einsum with the scale
+    applied per vocab column after (ops/quant.mm's direct-dot rule).
+    """
+    def q(t: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        t32 = t.astype(jnp.float32)
+        s = jnp.max(jnp.abs(t32), axis=-1) / 127.0      # [V]
+        s = jnp.where(s == 0.0, 1.0, s)
+        q8 = jnp.clip(jnp.round(t32 / s[:, None]), -127, 127).astype(jnp.int8)
+        return {"q8": q8, "s": s}
+
+    out = dict(params)
+    out["embed"] = q(params["embed"]) if not is_qtensor(params["embed"]) \
+        else params["embed"]
+    if "lm_head" in params and not is_qtensor(params["lm_head"]):
+        out["lm_head"] = q(params["lm_head"])
+    return out
+
+
 def quantize_kv(x: jnp.ndarray) -> Dict[str, jnp.ndarray]:
     """Quantize K or V cache tensors [..., S, H] to int8 with one f32 scale
     per slot (absmax over the head dim).

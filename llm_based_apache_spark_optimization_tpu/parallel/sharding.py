@@ -42,7 +42,8 @@ def validate_tp(cfg: LlamaConfig, tp: int) -> None:
         )
 
 
-def param_specs(cfg: LlamaConfig, quantized: bool = False) -> Dict[str, Any]:
+def param_specs(cfg: LlamaConfig, quantized: bool = False,
+                q_unembed: bool = False) -> Dict[str, Any]:
     """PartitionSpec tree matching models.llama.init_params exactly.
 
     With `quantized=True` the seven matmul weights become QTensor dicts
@@ -50,12 +51,18 @@ def param_specs(cfg: LlamaConfig, quantized: bool = False) -> Dict[str, Any]:
     per-output-channel scale keeps only the out axis, so it shards over tp
     for column-parallel weights and replicates for row-parallel ones (the
     scale multiply happens after GSPMD's all-reduce of the partial sums).
+    `q_unembed` mirrors quantize_unembed's {"q8","s"} embed/lm_head dicts
+    (replicated, like the bf16 tables).
     """
     def w(spec: P) -> Any:
         return {"q8": spec, "s": P(spec[0], spec[2])} if quantized else spec
 
+    def table() -> Any:
+        return ({"q8": P(None, None), "s": P(None)} if q_unembed
+                else P(None, None))
+
     specs: Dict[str, Any] = {
-        "embed": P(None, None),
+        "embed": table(),
         "blocks": {
             "wq": w(P(None, None, "tp")),
             "wk": w(P(None, None, "tp")),
@@ -70,7 +77,7 @@ def param_specs(cfg: LlamaConfig, quantized: bool = False) -> Dict[str, Any]:
         "final_norm": P(None),
     }
     if not cfg.tie_embeddings:
-        specs["lm_head"] = P(None, None)
+        specs["lm_head"] = table()
     return specs
 
 
@@ -95,7 +102,11 @@ def shard_params(params: Pytree, cfg: LlamaConfig, mesh: Mesh) -> Pytree:
             "inside mm() would need a shard_map wrapper per weight before "
             "it can run on GSPMD-sharded operands"
         )
-    specs = param_specs(cfg, quantized=is_qtensor(params["blocks"]["wq"]))
+    specs = param_specs(
+        cfg,
+        quantized=is_qtensor(params["blocks"]["wq"]),
+        q_unembed=is_qtensor(params["embed"]),
+    )
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
         is_leaf=lambda x: isinstance(x, P),

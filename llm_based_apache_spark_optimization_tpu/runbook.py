@@ -195,6 +195,10 @@ def build_service(args, log=print):
             from .ops.quant import quantize_params_int4
 
             params = quantize_params_int4(params)
+        if getattr(args, "int8_unembed", False):
+            from .ops.quant import quantize_unembed
+
+            params = quantize_unembed(params)
         kv_quant = "int8" if getattr(args, "kv_int8", False) else None
         spec = getattr(args, "speculative", 0)
         if args.scheduler:
@@ -241,6 +245,9 @@ def main(argv=None) -> None:
     ap.add_argument("--int4", action="store_true",
                     help="4-bit packed weights via the pallas int4 matmul "
                          "kernel (single-device; pick one of --int8/--int4)")
+    ap.add_argument("--int8-unembed", action="store_true",
+                    help="per-row int8 embed/unembed tables (composes with "
+                         "--int8/--int4)")
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8 KV cache (per-slot scales): halves the "
                          "serving window's HBM footprint and cache traffic")

@@ -85,6 +85,7 @@ class EngineBackend:
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
         quantize_int4: bool = False,
+        quantize_unembed8: bool = False,
         speculative_draft: int = 0,
         kv_quant=None,
         **kwargs,
@@ -106,8 +107,12 @@ class EngineBackend:
 
         if quantize_int8 and quantize_int4:
             raise ValueError("pick one of quantize_int8 / quantize_int4")
-        if quantize_int8 or quantize_int4:
-            from ..ops.quant import quantize_params, quantize_params_int4
+        if quantize_int8 or quantize_int4 or quantize_unembed8:
+            from ..ops.quant import (
+                quantize_params,
+                quantize_params_int4,
+                quantize_unembed,
+            )
             from ..parallel.sharding import shard_params
 
             # Load host-side, quantize, then place: the quantized tree is
@@ -115,8 +120,14 @@ class EngineBackend:
             cfg, params = load_hf_checkpoint(
                 ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=None
             )
-            params = (quantize_params_int4(params) if quantize_int4
-                      else quantize_params(params))
+            if quantize_int4:
+                params = quantize_params_int4(params)
+            elif quantize_int8:
+                params = quantize_params(params)
+            if quantize_unembed8:
+                # Per-row int8 embed/unembed tables (composes with either
+                # block quantization — or none).
+                params = quantize_unembed(params)
             if mesh is not None:
                 params = shard_params(params, cfg, mesh)
         else:
@@ -143,6 +154,7 @@ class EngineBackend:
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
         quantize_int4: bool = False,
+        quantize_unembed8: bool = False,
         speculative_draft: int = 0,
         kv_quant=None,
         **kwargs,
@@ -157,15 +169,23 @@ class EngineBackend:
 
         if quantize_int8 and quantize_int4:
             raise ValueError("pick one of quantize_int8 / quantize_int4")
-        if quantize_int8 or quantize_int4:
-            from ..ops.quant import quantize_params, quantize_params_int4
+        if quantize_int8 or quantize_int4 or quantize_unembed8:
+            from ..ops.quant import (
+                quantize_params,
+                quantize_params_int4,
+                quantize_unembed,
+            )
             from ..parallel.sharding import shard_params
 
             cfg, params = load_gguf_checkpoint(
                 gguf_path, cfg=cfg, dtype=dtype, mesh=None
             )
-            params = (quantize_params_int4(params) if quantize_int4
-                      else quantize_params(params))
+            if quantize_int4:
+                params = quantize_params_int4(params)
+            elif quantize_int8:
+                params = quantize_params(params)
+            if quantize_unembed8:
+                params = quantize_unembed(params)
             if mesh is not None:
                 params = shard_params(params, cfg, mesh)
         else:

@@ -1411,6 +1411,7 @@ class SchedulerBackend:
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
         quantize_int4: bool = False,
+        quantize_unembed8: bool = False,
         kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
@@ -1431,14 +1432,22 @@ class SchedulerBackend:
 
         if quantize_int8 and quantize_int4:
             raise ValueError("pick one of quantize_int8 / quantize_int4")
-        if quantize_int8 or quantize_int4:
-            from ..ops.quant import quantize_params, quantize_params_int4
+        if quantize_int8 or quantize_int4 or quantize_unembed8:
+            from ..ops.quant import (
+                quantize_params,
+                quantize_params_int4,
+                quantize_unembed,
+            )
 
             cfg, params = load_hf_checkpoint(
                 ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=None
             )
-            params = (quantize_params_int4(params) if quantize_int4
-                      else quantize_params(params))
+            if quantize_int4:
+                params = quantize_params_int4(params)
+            elif quantize_int8:
+                params = quantize_params(params)
+            if quantize_unembed8:
+                params = quantize_unembed(params)
             # Placement happens in the scheduler __init__ (shard_params).
             sched_mesh = mesh
         else:
@@ -1469,6 +1478,7 @@ class SchedulerBackend:
         stop_ids: Optional[Sequence[int]] = None,
         quantize_int8: bool = False,
         quantize_int4: bool = False,
+        quantize_unembed8: bool = False,
         kv_quant: Optional[str] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
@@ -1484,14 +1494,22 @@ class SchedulerBackend:
 
         if quantize_int8 and quantize_int4:
             raise ValueError("pick one of quantize_int8 / quantize_int4")
-        if quantize_int8 or quantize_int4:
-            from ..ops.quant import quantize_params, quantize_params_int4
+        if quantize_int8 or quantize_int4 or quantize_unembed8:
+            from ..ops.quant import (
+                quantize_params,
+                quantize_params_int4,
+                quantize_unembed,
+            )
 
             cfg, params = load_gguf_checkpoint(
                 gguf_path, cfg=cfg, dtype=dtype, mesh=None
             )
-            params = (quantize_params_int4(params) if quantize_int4
-                      else quantize_params(params))
+            if quantize_int4:
+                params = quantize_params_int4(params)
+            elif quantize_int8:
+                params = quantize_params(params)
+            if quantize_unembed8:
+                params = quantize_unembed(params)
             # Placement happens in the scheduler __init__ (shard_params).
         else:
             cfg, params = load_gguf_checkpoint(

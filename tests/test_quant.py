@@ -124,3 +124,93 @@ def test_init_params_quantized_structure_and_engine():
     out = eng.generate([[1, 5, 9], [1, 7]], max_new_tokens=6)
     assert all(len(o) == 6 for o in out)
     assert all(0 <= t < TINY.vocab_size for o in out for t in o)
+
+
+@pytest.mark.slow
+def test_quantized_unembed_tracks_dequantized(tiny_model):
+    """quantize_unembed (per-row int8 embed/unembed tables): the engine on
+    the quantized tables must track an engine running the SAME values
+    dequantized — tied and untied head alike — and compose with int8
+    blocks under TP."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.models import init_params
+    from llm_based_apache_spark_optimization_tpu.ops.quant import (
+        is_qtensor,
+        quantize_params,
+        quantize_unembed,
+    )
+
+    def deq_table(t):
+        return (t["q8"].astype(jnp.float32) * t["s"][:, None])
+
+    prompts = [[1, 5, 9, 5, 9, 3], [1, 7]]
+    cfg_tied, params = tiny_model
+    cfg_untied = dataclasses.replace(cfg_tied, name="tiny-untied",
+                                     tie_embeddings=False)
+    params_untied = init_params(cfg_untied, jax.random.key(5),
+                                dtype=jnp.float32)
+    for cfg, tree in ((cfg_tied, params), (cfg_untied, params_untied)):
+        q = quantize_unembed(tree)
+        assert is_qtensor(q["embed"])
+        deq = dict(q)
+        deq["embed"] = deq_table(q["embed"])
+        if "lm_head" in q:
+            assert is_qtensor(q["lm_head"])
+            deq["lm_head"] = deq_table(q["lm_head"])
+        ref = InferenceEngine(cfg, deq, stop_ids=(-1,), prompt_bucket=8)
+        eng = InferenceEngine(cfg, q, stop_ids=(-1,), prompt_bucket=8)
+        golden = ref.generate(prompts, max_new_tokens=8)
+        out = eng.generate(prompts, max_new_tokens=8)
+        agree = sum(a == b for go, oo in zip(golden, out)
+                    for a, b in zip(go, oo))
+        assert agree / 16 >= 0.9, f"{cfg.name}: {agree}/16"
+
+    # TP: int8 blocks + quantized unembed shard and match single-device.
+    from llm_based_apache_spark_optimization_tpu.parallel import make_mesh
+
+    tree = quantize_unembed(quantize_params(params))
+    single = InferenceEngine(cfg_tied, tree, stop_ids=(-1,),
+                             prompt_bucket=8).generate(prompts,
+                                                       max_new_tokens=6)
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    sharded = InferenceEngine(cfg_tied, tree, stop_ids=(-1,),
+                              prompt_bucket=8, mesh=mesh)
+    assert sharded.generate(prompts, max_new_tokens=6) == single
+
+
+@pytest.mark.slow
+def test_unembed8_checkpoint_serving_path(tmp_path):
+    """quantize_unembed8 through the deployment classmethod, composed with
+    int8 blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.checkpoint import (
+        save_hf_checkpoint,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+    from llm_based_apache_spark_optimization_tpu.ops.quant import is_qtensor
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        SchedulerBackend,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import ByteTokenizer
+
+    params = init_params(TINY, jax.random.key(3), dtype=jnp.float32)
+    save_hf_checkpoint(TINY, params, tmp_path)
+    backend = SchedulerBackend.from_hf_checkpoint(
+        str(tmp_path), ByteTokenizer(), quantize_int8=True,
+        quantize_unembed8=True, max_new_tokens=6, num_slots=2,
+        dtype=jnp.float32,
+    )
+    try:
+        tree = backend.scheduler.params
+        assert is_qtensor(tree["blocks"]["wq"]) and is_qtensor(tree["embed"])
+        out = backend.complete("ab")
+        assert out.output_tokens >= 1
+    finally:
+        backend.shutdown()
