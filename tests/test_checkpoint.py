@@ -69,6 +69,7 @@ def test_hf_logits_parity(tmp_path, tie):
     np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_hf_greedy_decode_parity(tmp_path):
     """Token-level parity over a short greedy continuation (cache path too)."""
     hf_model, ckpt_dir = _tiny_hf_model(tmp_path)

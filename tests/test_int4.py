@@ -162,6 +162,7 @@ def test_int4_rejects_mesh(tiny_model):
         InferenceEngine(cfg, params4, mesh=mesh)
 
 
+@pytest.mark.slow
 def test_init_params_quantized_int4_structure(tiny_model):
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
     from llm_based_apache_spark_optimization_tpu.models import TINY

@@ -229,6 +229,7 @@ def test_kv_quant_windowed_scatter_survives_prefix_misalignment(tiny):
     assert err < 0.2, f"relative error {err:.3f}: overflow chunk formed"
 
 
+@pytest.mark.slow
 def test_kv_quant_decode_impls(tiny):
     """int8 KV decodes through einsum (auto) or the quantized flash kernel
     (forced pallas) — with greedy parity between the two — and still

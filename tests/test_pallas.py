@@ -68,6 +68,7 @@ def test_flash_multiblock_online_softmax():
     np.testing.assert_allclose(ref, out, rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_sliding_window():
     ref, out = _ref_and_flash(2, 4, 32, 4, 2, 16, window=8, block_kv=8)
     np.testing.assert_allclose(ref, out, rtol=2e-5, atol=2e-5)

@@ -99,6 +99,7 @@ def test_quantized_tp_generate_matches_single_device(tiny_model):
     assert wq["s"].addressable_shards[0].data.shape[-1] == wq["s"].shape[-1] // 2
 
 
+@pytest.mark.slow
 def test_init_params_quantized_structure_and_engine():
     """The direct-at-final-size int8 init (the 7B bench leg's tree) must
     match quantize_params(init_params(...))'s tree structure exactly and

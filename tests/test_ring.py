@@ -91,6 +91,7 @@ def test_ring_under_jit_bf16():
     )
 
 
+@pytest.mark.slow
 def test_sp_generate_matches_unsharded(tiny_model):
     """Full generate with ring prefill on a dp×sp×tp mesh == unsharded greedy."""
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine

@@ -90,6 +90,7 @@ def test_speculative_respects_stop_ids(tiny):
     )
 
 
+@pytest.mark.slow
 def test_speculative_budget_edges(tiny):
     cfg, params = tiny
     ref = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
