@@ -194,9 +194,6 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     int4 = getattr(args, "int4", False)
     if int4 and args.int8:
         sys.exit("pick one of --int8 / --int4")
-    if int4 and args.dp * args.sp * args.tp > 1:
-        sys.exit("--int4 is single-device for now: the pallas int4 matmul "
-                 "needs a shard_map wrapper before it can run sharded")
 
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)

@@ -52,7 +52,7 @@ from ..ops.pallas import (
     sharded_flash_gqa_attention,
     sharded_flash_gqa_attention_quantized,
 )
-from ..ops.quant import is_qtensor, mm
+from ..ops.quant import is_qtensor, mm, mm_stacked
 from ..ops.ring_attention import ring_gqa_attention
 from ..ops.rope import apply_rope, rope_cos_sin
 from .configs import LlamaConfig
@@ -100,55 +100,64 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def fuse_blocks(params: Params) -> Params:
-    """A params variant with the per-projection matmuls concatenated:
-    wq|wk|wv -> "wqkv" and wg|wu -> "wgu" (out axes stacked).
+    """A params variant with same-input projections fused into one matmul:
+    wq|wk|wv -> "wqkv" (MHA, equal shapes) or wk|wv -> "wkv" (GQA, where
+    wq's out dim differs), and wg|wu -> "wgu".
 
-    Prefill runs 7 medium matmuls per layer; fusing the three QKV
-    projections (one shared input h) and the two MLP up-projections (one
-    shared input h2) into single wider matmuls halves kernel count and
-    widens the MXU N dimension — one of the prefill-MFU levers (the
-    output columns are unchanged dot products, so results are exact:
-    asserted in tests/test_model.py).
+    Prefill runs 7 medium matmuls per layer; fusing projections that share
+    an input (h for QKV, h2 for gate/up) cuts kernel count and widens the
+    MXU N dimension — one of the prefill-MFU levers (each output column is
+    the same dot product, so results are exact: tests/test_model.py).
 
-    Works on bf16 trees and int8 QTensor trees (per-output-channel scales
-    concatenate with their columns). Single-device only: the TP sharding
-    specs (parallel/sharding.py) name the unfused weights — engines guard
-    fuse_matmuls against a mesh.
+    Layout: the fused weight STACKS the projections on a new axis -2 —
+    [L, D, C, O] — instead of concatenating out axes. Stacking is what
+    makes the fusion tensor-parallel: the O axis shards over tp exactly
+    like the unfused weights (parallel/sharding.param_specs) and the C
+    split in forward is a device-local index, where a concatenated
+    [L, D, C*O] axis would put projection boundaries mid-shard and force a
+    reshard at every split. Works on bf16 trees, int8 QTensor trees
+    (per-out-channel scales stack to [L, C, O]) and int4 packed trees
+    (q4 [L, D/2, C, O] — the kernel flattens the contiguous (C, O) tail).
     """
     blocks = dict(params["blocks"])
 
-    def cat(names):
+    def out_dim(w):
+        if is_qtensor(w):
+            return w["q8"].shape[-1]
+        if isinstance(w, dict) and "q4" in w:
+            return w["q4"].shape[-1]
+        return w.shape[-1]
+
+    def stack(names):
         ws = [blocks.pop(n) for n in names]
         if is_qtensor(ws[0]):
             return {
-                "q8": jnp.concatenate([w["q8"] for w in ws], axis=-1),
-                "s": jnp.concatenate([w["s"] for w in ws], axis=-1),
+                "q8": jnp.stack([w["q8"] for w in ws], axis=-2),
+                "s": jnp.stack([w["s"] for w in ws], axis=-2),
             }
         if isinstance(ws[0], dict) and "q4" in ws[0]:
-            # int4 packs along the contraction axis; out axes concat
-            # directly (scales ride their out columns).
             return {
-                "q4": jnp.concatenate([w["q4"] for w in ws], axis=-1),
-                "s4": jnp.concatenate([w["s4"] for w in ws], axis=-1),
+                "q4": jnp.stack([w["q4"] for w in ws], axis=-2),
+                "s4": jnp.stack([w["s4"] for w in ws], axis=-2),
             }
-        return jnp.concatenate(ws, axis=-1)
+        return jnp.stack(ws, axis=-2)
 
-    blocks["wqkv"] = cat(("wq", "wk", "wv"))
-    blocks["wgu"] = cat(("wg", "wu"))
+    if out_dim(blocks["wq"]) == out_dim(blocks["wk"]):  # MHA: one 3-stack
+        blocks["wqkv"] = stack(("wq", "wk", "wv"))
+    else:  # GQA: K/V share a shape, Q stays its own (wider) matmul
+        blocks["wkv"] = stack(("wk", "wv"))
+    blocks["wgu"] = stack(("wg", "wu"))
     out = dict(params)
     out["blocks"] = blocks
     return out
 
 
 def maybe_fuse(params: Params, mesh) -> Params:
-    """The engines' shared fuse_matmuls entry: fuse, or reject on a mesh
-    (TP sharding specs shard wq/wk/wv/wg/wu individually — one place to
-    lift that restriction if fused specs ever land)."""
-    if mesh is not None:
-        raise ValueError(
-            "fuse_matmuls is single-device: TP sharding specs shard "
-            "wq/wk/wv/wg/wu individually"
-        )
+    """The engines' shared fuse_matmuls entry. The mesh argument is kept
+    for call-site symmetry but no longer gates anything: the stacked fused
+    layout TP-shards on its out axis (fuse_blocks docstring), so fusion
+    composes with every mesh topology."""
+    del mesh
     return fuse_blocks(params)
 
 
@@ -304,17 +313,23 @@ def forward(
 
     def qkv(p, x):
         h = rms_norm(x, p["ln_attn"], cfg.norm_eps)
-        # mm() transparently handles int8 QTensors (ops/quant.py).
-        if "wqkv" in p:  # fused tree (fuse_blocks): one wide matmul
-            qc, kc = nh * hd, kh * hd
-            fused = mm(h, p["wqkv"])
-            q = fused[..., :qc].reshape(b, t, nh, hd)
-            k = fused[..., qc:qc + kc].reshape(b, t, kh, hd)
-            v = fused[..., qc + kc:].reshape(b, t, kh, hd)
+        # mm()/mm_stacked() transparently handle int8 QTensors and int4
+        # packed trees (ops/quant.py); mesh routes int4 through its
+        # shard_map wrapper with the weight's Megatron partition.
+        if "wqkv" in p:  # fused MHA tree: one stacked [D, 3, O] matmul
+            fused = mm_stacked(h, p["wqkv"], mesh)  # [B, T, 3, O]
+            q = fused[..., 0, :].reshape(b, t, nh, hd)
+            k = fused[..., 1, :].reshape(b, t, kh, hd)
+            v = fused[..., 2, :].reshape(b, t, kh, hd)
+        elif "wkv" in p:  # fused GQA tree: Q alone + stacked [D, 2, KO]
+            q = mm(h, p["wq"], mesh).reshape(b, t, nh, hd)
+            kv = mm_stacked(h, p["wkv"], mesh)  # [B, T, 2, KO]
+            k = kv[..., 0, :].reshape(b, t, kh, hd)
+            v = kv[..., 1, :].reshape(b, t, kh, hd)
         else:
-            q = mm(h, p["wq"]).reshape(b, t, nh, hd)
-            k = mm(h, p["wk"]).reshape(b, t, kh, hd)
-            v = mm(h, p["wv"]).reshape(b, t, kh, hd)
+            q = mm(h, p["wq"], mesh).reshape(b, t, nh, hd)
+            k = mm(h, p["wk"], mesh).reshape(b, t, kh, hd)
+            v = mm(h, p["wv"], mesh).reshape(b, t, kh, hd)
         return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
 
     def attn_mlp(p, x, q, k_full, v_full, k_fresh, v_fresh):
@@ -345,16 +360,15 @@ def forward(
         return post_attn(p, x, attn)
 
     def post_attn(p, x, attn):
-        x = x + mm(attn.reshape(b, t, nh * hd), p["wo"])
+        x = x + mm(attn.reshape(b, t, nh * hd), p["wo"], mesh, "row")
         h2 = rms_norm(x, p["ln_mlp"], cfg.norm_eps)
-        if "wgu" in p:  # fused tree: gate|up in one matmul
-            f = cfg.intermediate_size
-            gu = mm(h2, p["wgu"])
-            g_out, u_out = gu[..., :f], gu[..., f:]
+        if "wgu" in p:  # fused tree: gate|up stacked in one matmul
+            gu = mm_stacked(h2, p["wgu"], mesh)  # [B, T, 2, F]
+            g_out, u_out = gu[..., 0, :], gu[..., 1, :]
         else:
-            g_out, u_out = mm(h2, p["wg"]), mm(h2, p["wu"])
+            g_out, u_out = mm(h2, p["wg"], mesh), mm(h2, p["wu"], mesh)
         gate = jax.nn.silu(g_out.astype(jnp.float32)).astype(x.dtype)
-        x = x + mm(gate * u_out, p["wd"])
+        x = x + mm(gate * u_out, p["wd"], mesh, "row")
         return x
 
     def block(x, layer_in):

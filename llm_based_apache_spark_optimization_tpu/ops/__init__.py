@@ -7,11 +7,14 @@ from .quant import (  # noqa: F401
     dequantize_weight_int4,
     is_q4tensor,
     is_qtensor,
+    mm,
+    mm_stacked,
     quantize_params,
     quantize_params_int4,
     quantize_unembed,
     quantize_weight,
     quantize_weight_int4,
+    tp_safe_group,
 )
 from .ring_attention import ring_gqa_attention  # noqa: F401
 from .rope import apply_rope, rope_cos_sin  # noqa: F401

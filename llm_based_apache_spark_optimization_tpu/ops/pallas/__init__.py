@@ -21,3 +21,4 @@ from .dispatch import (  # noqa: F401
     decode_attention_impl,
     set_attention_impl,
 )
+from .int4mm import int4_matmul, sharded_int4_matmul  # noqa: F401

@@ -110,6 +110,15 @@ def int4_matmul(
     at 512/256/128 lanes or runs whole when smaller. Returns [R, OUT] in
     x.dtype.
     """
+    if q4.ndim == 3:
+        # Stacked fused weight [IN/2, C, OUT] (models/llama.fuse_blocks):
+        # the (C, OUT) tail is contiguous row-major, so flattening it to one
+        # out axis is free and the kernel runs unchanged; the caller's
+        # [R, C, OUT] view is the same bytes back.
+        d2, c, o = q4.shape
+        out = int4_matmul(x, q4.reshape(d2, c * o),
+                          s4.reshape(s4.shape[0], c * o), interpret=interpret)
+        return out.reshape(out.shape[0], c, o)
     r, n_in = x.shape
     n_out = q4.shape[1]
     n_groups = s4.shape[0]
@@ -131,14 +140,25 @@ def int4_matmul(
     # Row tiling bounds the f32 scratch and x/out blocks for prefill-shaped
     # calls (rows = batch*seq can be thousands, and an untiled scratch
     # would blow the ~16 MB/core VMEM); decode-small row counts run whole.
-    rb = next((c for c in (256, 128) if r % c == 0), r)
-    grid = (r // rb, n_out // ob, n_in_blocks)
+    # Rows that don't divide 128 pad up to the next 128 multiple (output
+    # sliced back) — falling back to rb=r would rebuild exactly the untiled
+    # scratch the tiling exists to bound (advisor r4 finding).
+    rows = r
+    rb = next((c for c in (256, 128) if r % c == 0), None)
+    if rb is None:
+        if r <= 256:
+            rb = r
+        else:
+            rows = -(-r // 128) * 128
+            x = jnp.pad(x, ((0, rows - r), (0, 0)))
+            rb = 256 if rows % 256 == 0 else 128
+    grid = (rows // rb, n_out // ob, n_in_blocks)
 
     # Even/odd contraction planes (module docstring): plane p holds
     # original rows 2b+p, aligned with byte b's low/high nibble. Group g's
     # even rows are CONTIGUOUS in the plane ([g*group/2, (g+1)*group/2)),
     # which is what lets the kernel scale by group with a pure reshape.
-    x3 = x.reshape(r, n_in // 2, 2)
+    x3 = x.reshape(rows, n_in // 2, 2)
     xe, xo = x3[:, :, 0], x3[:, :, 1]   # each [R, IN/2]
 
     out = pl.pallas_call(
@@ -152,7 +172,7 @@ def int4_matmul(
             pl.BlockSpec((k_groups, ob), lambda ri, oi, ii: (ii, oi)),
         ],
         out_specs=pl.BlockSpec((rb, ob), lambda ri, oi, ii: (ri, oi)),
-        out_shape=jax.ShapeDtypeStruct((r, n_out), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((rows, n_out), x.dtype),
         scratch_shapes=[pltpu.VMEM((rb, ob), jnp.float32)],
         # Row/out-blocks are independent (megacore splits them); the
         # in-block axis accumulates through scratch and must run in order.
@@ -161,4 +181,64 @@ def int4_matmul(
         ),
         interpret=interpret,
     )(xe, xo, q4, s4)
-    return out
+    return out[:r] if rows != r else out
+
+
+def sharded_int4_matmul(
+    mesh,
+    x: jnp.ndarray,    # [R, IN] — rows dp-sharded (engine batch layout)
+    q4: jnp.ndarray,   # [IN/2, OUT] or stacked [IN/2, C, OUT]
+    s4: jnp.ndarray,   # [IN/GROUP, OUT] or [IN/GROUP, C, OUT]
+    *,
+    partition: str = "col",
+) -> jnp.ndarray:
+    """The int4 kernel under a dp×tp mesh, via `jax.shard_map`.
+
+    A pallas_call cannot run on GSPMD-sharded operands, so each Megatron
+    partition gets an explicit per-device body (the same split
+    parallel/sharding.param_specs encodes for the int8/bf16 dots, where
+    GSPMD does this implicitly):
+
+    - "col" (wq/wk/wv/wg/wu and the stacked fused trees): the weight's out
+      axis is tp-sharded; every device runs the kernel on its own column
+      shard of replicated-activation rows — no collective. Stacked [.., C,
+      OUT] weights shard the OUT axis and keep the C split device-local.
+    - "row" (wo/wd): the CONTRACTION axis is tp-sharded — the packed-nibble
+      axis splits at even byte boundaries and whole quant groups (tp divides
+      the group count: group=128 and the head/ffn dims are multiples of
+      128·tp for every supported config), each device contracts its own
+      slice, and a `psum` over "tp" reduces the partial products. The group
+      scales apply INSIDE the kernel, before the psum — correct because a
+      group's scale multiplies only that group's products, all of which
+      live on one device.
+
+    The "sp" mesh axis is unmentioned (replicated): activations outside
+    ring attention keep the sequence axis whole. check_vma=False for the
+    same reason as the sharded flash kernels — the replication checker
+    can't see through pallas_call.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if partition == "col":
+        wspec = P(None, "tp") if q4.ndim == 2 else P(None, None, "tp")
+        out_spec = P("dp", "tp") if q4.ndim == 2 else P("dp", None, "tp")
+        return jax.shard_map(
+            lambda x_, q_, s_: int4_matmul(x_, q_, s_),
+            mesh=mesh,
+            in_specs=(P("dp", None), wspec, wspec),
+            out_specs=out_spec,
+            check_vma=False,
+        )(x, q4, s4)
+    if partition != "row":
+        raise ValueError(f"partition must be 'col' or 'row', got {partition!r}")
+
+    def row_body(x_, q_, s_):
+        return jax.lax.psum(int4_matmul(x_, q_, s_), "tp")
+
+    return jax.shard_map(
+        row_body,
+        mesh=mesh,
+        in_specs=(P("dp", "tp"), P("tp", None), P("tp", None)),
+        out_specs=P("dp", None),
+        check_vma=False,
+    )(x, q4, s4)

@@ -46,6 +46,29 @@ def is_q4tensor(w: Any) -> bool:
     return isinstance(w, dict) and "q4" in w
 
 
+def tp_safe_group(n_in: int, group: int = 128) -> int:
+    """Largest even quant-group <= `group` that keeps WHOLE groups inside
+    every tensor-parallel shard of the contraction axis, for any tp in
+    {1, 2, 4, 8} (the BASELINE topologies) that evenly shards the axis at
+    even-group granularity. (If n_in/8 is odd, no even group can satisfy
+    tp=8 — but such an axis cannot shard 8 ways at nibble-pair granularity
+    in the first place; specs_for_params still re-checks alignment at the
+    actual mesh width and fails loudly.)
+
+    Row-parallel int4 weights (wo/wd) shard the contraction axis; the
+    sharded kernel applies group scales before the tp psum
+    (ops/pallas/int4mm.sharded_int4_matmul), which is only correct when no
+    group straddles a shard boundary. Most dims are multiples of 128*8 and
+    keep group=128; Llama-2-7B's ffn dim 11008 drops to 86 (the largest
+    even divisor of 11008/8 = 1376 below 128).
+    """
+    base = n_in // 8 if n_in % 8 == 0 else n_in
+    g = min(group, base, n_in)
+    while g > 2 and (base % g or g % 2):
+        g -= 1
+    return max(g, 2)
+
+
 def quantize_weight_int4(w: jnp.ndarray, group: int = 128) -> Dict[str, jnp.ndarray]:
     """[..., in, out] float -> {"q4": uint8 [..., in/2, out] packed nibbles,
     "s4": f32 [..., in/group, out]} — symmetric absmax int4 with one scale
@@ -87,10 +110,14 @@ def dequantize_weight_int4(w: Dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.
 
 def quantize_params_int4(params: Dict[str, Any], group: int = 128) -> Dict[str, Any]:
     """int4-quantize the block matmul weights (same split as
-    quantize_params: embeddings/unembed/norms stay high-precision)."""
+    quantize_params: embeddings/unembed/norms stay high-precision).
+
+    The per-weight group is clamped tp-safe (`tp_safe_group`) so the tree
+    can later shard onto any BASELINE tensor-parallel mesh."""
     out = dict(params)
     out["blocks"] = {
-        k: quantize_weight_int4(v, group) if k in QUANT_KEYS else v
+        k: quantize_weight_int4(v, tp_safe_group(v.shape[-2], group))
+        if k in QUANT_KEYS else v
         for k, v in params["blocks"].items()
     }
     return out
@@ -161,8 +188,9 @@ def init_params_quantized(cfg, key, dtype=jnp.bfloat16, bits: int = 8) -> Dict[s
             blocks[name] = {"q8": q8, "s": s}
         else:
             # Packed random nibbles at final size (quantize_weight_int4
-            # layout), absmax 7 scaling; group = min(128, fan_in).
-            group = min(128, fan_in)
+            # layout), absmax 7 scaling; tp-safe group like the real
+            # quantizer so sharded benches see the same byte layout.
+            group = tp_safe_group(fan_in)
             pshape = shape[:-2] + (fan_in // 2, shape[-1])
             q4 = jax.jit(
                 lambda k, s=pshape: jax.random.randint(
@@ -248,13 +276,20 @@ def quantize_cache(
     return {"k8": kq["q8"], "ks": kq["s"], "v8": vq["q8"], "vs": vq["s"]}
 
 
-def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
+def mm(x: jnp.ndarray, w: Any, mesh=None, partition: str = "col") -> jnp.ndarray:
     """x @ w for a plain array or a QTensor (dequant fused into the matmul).
 
     QTensor path: the int8 array goes straight into `dot_general` — never
     `.astype` the weight first (a standalone convert materializes VPU work
     XLA otherwise hides inside the matmul; see module docstring for the
-    measured cost). f32 accumulation, rescale in the epilogue."""
+    measured cost). f32 accumulation, rescale in the epilogue.
+
+    `mesh`/`partition` matter only for int4 trees: the pallas kernel can't
+    run on GSPMD-sharded operands, so under a mesh it routes through the
+    explicit shard_map wrapper with the weight's Megatron partition ("col"
+    for wq/wk/wv/wg/wu, "row" for wo/wd — the same split
+    parallel/sharding.param_specs encodes). bf16/int8 dots ignore both:
+    GSPMD partitions them from the operand shardings alone."""
     if is_qtensor(w):
         acc = lax.dot_general(
             x, w["q8"],
@@ -263,12 +298,45 @@ def mm(x: jnp.ndarray, w: Any) -> jnp.ndarray:
         )
         return (acc * w["s"]).astype(x.dtype)
     if is_q4tensor(w):
-        from .pallas.int4mm import int4_matmul
-
-        lead = x.shape[:-1]
-        rows = 1
-        for d in lead:
-            rows *= d
-        out = int4_matmul(x.reshape(rows, x.shape[-1]), w["q4"], w["s4"])
-        return out.reshape(*lead, out.shape[-1])
+        return _q4_mm(x, w, mesh, partition)
     return x @ w
+
+
+def _q4_mm(x: jnp.ndarray, w: Dict[str, jnp.ndarray], mesh,
+           partition: str) -> jnp.ndarray:
+    """Shared int4 route for mm/mm_stacked: flatten leading axes to kernel
+    rows, pick the shard_map wrapper under a mesh, restore the lead."""
+    from .pallas.int4mm import int4_matmul, sharded_int4_matmul
+
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    x2 = x.reshape(rows, x.shape[-1])
+    if mesh is not None:
+        out = sharded_int4_matmul(mesh, x2, w["q4"], w["s4"],
+                                  partition=partition)
+    else:
+        out = int4_matmul(x2, w["q4"], w["s4"])
+    return out.reshape(*lead, *out.shape[1:])
+
+
+def mm_stacked(x: jnp.ndarray, w: Any, mesh=None) -> jnp.ndarray:
+    """x[..., D] @ stacked fused weight [D, C, O] -> [..., C, O].
+
+    The fused-matmul layout (models/llama.fuse_blocks) STACKS same-shaped
+    projections on a new axis instead of concatenating their out axes: the
+    O axis tensor-parallel-shards exactly like the unfused weights and the
+    C split is a device-local index — a concatenated out axis would put
+    q/k/v boundaries mid-shard and force GSPMD to reshard every split.
+    Always column-parallel. Handles bf16, int8 QTensor (s is [C, O]) and
+    int4 stacked trees (q4 [D/2, C, O] — the kernel flattens the
+    contiguous (C, O) tail; ops/pallas/int4mm)."""
+    dn = (((x.ndim - 1,), (0,)), ((), ()))
+    if is_qtensor(w):
+        acc = lax.dot_general(x, w["q8"], dimension_numbers=dn,
+                              preferred_element_type=jnp.float32)
+        return (acc * w["s"]).astype(x.dtype)
+    if is_q4tensor(w):
+        return _q4_mm(x, w, mesh, "col")  # stacked trees are always col
+    return lax.dot_general(x, w, dimension_numbers=dn)

@@ -13,6 +13,7 @@ from .sharding import (  # noqa: F401
     constrain_cache,
     param_specs,
     shard_batch,
+    specs_for_params,
     shard_params,
     validate_tp,
 )

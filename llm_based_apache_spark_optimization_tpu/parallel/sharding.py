@@ -53,6 +53,11 @@ def param_specs(cfg: LlamaConfig, quantized: bool = False,
     scale multiply happens after GSPMD's all-reduce of the partial sums).
     `q_unembed` mirrors quantize_unembed's {"q8","s"} embed/lm_head dicts
     (replicated, like the bf16 tables).
+
+    This flag form covers UNFUSED trees only (it is the shape-contract the
+    checkpoint loaders pre-declare shardings from, before any tree exists);
+    `specs_for_params` derives specs from an actual tree and additionally
+    handles int4 and fused layouts.
     """
     def w(spec: P) -> Any:
         return {"q8": spec, "s": P(spec[0], spec[2])} if quantized else spec
@@ -81,6 +86,79 @@ def param_specs(cfg: LlamaConfig, quantized: bool = False,
     return specs
 
 
+# Row-parallel block weights shard their CONTRACTION axis; everything else
+# named here is column-parallel (out axis over tp).
+_ROW_PARALLEL = ("wo", "wd")
+
+
+def specs_for_params(params: Pytree, tp: int = 1) -> Pytree:
+    """PartitionSpec tree derived leaf-by-leaf from an ACTUAL params tree —
+    bf16 / int8 QTensor / int4 packed-nibble weights, fused (stacked
+    wqkv/wkv/wgu, [L, D, C, O]) and unfused layouts alike.
+
+    Rules (Megatron split, module docstring):
+    - column-parallel weights shard the out (last) axis over tp; their
+      per-out-channel / per-(group, out) scales shard with it;
+    - row-parallel weights (wo/wd) shard the contraction axis; int8 scales
+      replicate (applied after GSPMD's psum), int4 group scales shard WITH
+      their groups (applied inside the kernel, before the explicit psum —
+      ops/pallas/int4mm.sharded_int4_matmul);
+    - stacked fused weights are always column-parallel: out axis over tp,
+      the C (projection) axis replicated — the device-local split is the
+      point of the stacked layout (models/llama.fuse_blocks);
+    - embeddings/norms replicate.
+
+    `tp` is used only for the int4 row-parallel group-alignment check: a
+    shard must hold whole quant groups (quantize_params_int4 defaults to
+    tp-safe groups; a hand-built tree with misaligned groups fails here
+    with a clear error instead of silently wrong math).
+    """
+    from ..ops.quant import is_q4tensor, is_qtensor
+
+    def wspec(name: str, w: Any) -> Any:
+        row = name in _ROW_PARALLEL
+        if is_qtensor(w):
+            if w["q8"].ndim == 4:  # stacked fused [L, D, C, O]
+                return {"q8": P(None, None, None, "tp"),
+                        "s": P(None, None, "tp")}
+            return ({"q8": P(None, "tp", None), "s": P(None, None)} if row
+                    else {"q8": P(None, None, "tp"), "s": P(None, "tp")})
+        if is_q4tensor(w):
+            if w["q4"].ndim == 4:  # stacked fused [L, D/2, C, O]
+                return {"q4": P(None, None, None, "tp"),
+                        "s4": P(None, None, None, "tp")}
+            if row:
+                n_groups = w["s4"].shape[-2]
+                if n_groups % tp:
+                    raise ValueError(
+                        f"int4 {name}: tp={tp} does not divide the "
+                        f"{n_groups} quant groups — a tensor-parallel "
+                        f"shard would split a group (requantize with "
+                        f"ops.quant.tp_safe_group)"
+                    )
+                return {"q4": P(None, "tp", None), "s4": P(None, "tp", None)}
+            return {"q4": P(None, None, "tp"), "s4": P(None, None, "tp")}
+        if w.ndim == 4:  # stacked fused bf16 [L, D, C, O]
+            return P(None, None, None, "tp")
+        return P(None, "tp", None) if row else P(None, None, "tp")
+
+    def table(t: Any) -> Any:
+        return {"q8": P(None, None), "s": P(None)} if is_qtensor(t) \
+            else P(None, None)
+
+    specs: Dict[str, Any] = {
+        "embed": table(params["embed"]),
+        "blocks": {
+            k: (P(None, None) if k.startswith("ln_") else wspec(k, v))
+            for k, v in params["blocks"].items()
+        },
+        "final_norm": P(None),
+    }
+    if "lm_head" in params:
+        specs["lm_head"] = table(params["lm_head"])
+    return specs
+
+
 def cache_spec() -> P:
     """[L, B, K, S, H]: batch over dp, KV heads over tp."""
     return P(None, "dp", "tp", None, None)
@@ -92,21 +170,11 @@ def batch_spec(ndim: int = 2) -> P:
 
 
 def shard_params(params: Pytree, cfg: LlamaConfig, mesh: Mesh) -> Pytree:
-    """Place a (host or single-device) param tree onto the mesh."""
-    from ..ops.quant import is_q4tensor, is_qtensor
-
+    """Place a (host or single-device) param tree onto the mesh. Specs are
+    derived from the tree itself (specs_for_params), so every layout the
+    model layer produces — quantized, int4, fused — shards here."""
     validate_tp(cfg, mesh.shape["tp"])
-    if is_q4tensor(params["blocks"]["wq"]):
-        raise NotImplementedError(
-            "int4 trees are single-device for now: the pallas int4 matmul "
-            "inside mm() would need a shard_map wrapper per weight before "
-            "it can run on GSPMD-sharded operands"
-        )
-    specs = param_specs(
-        cfg,
-        quantized=is_qtensor(params["blocks"]["wq"]),
-        q_unembed=is_qtensor(params["embed"]),
-    )
+    specs = specs_for_params(params, tp=mesh.shape["tp"])
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs,
         is_leaf=lambda x: isinstance(x, P),

@@ -97,7 +97,8 @@ class EngineBackend:
         QTensors before placement (ops/quant.py) — halves weight HBM
         traffic for bandwidth-bound decode; `quantize_int4=True` packs
         them to 4-bit nibbles served by the pallas int4 matmul kernel
-        (one quarter of bf16's weight bytes; single-device).
+        (one quarter of bf16's weight bytes; TP-shards like the other
+        quantized layouts — parallel/sharding.specs_for_params).
         `speculative_draft=N` turns on prompt-lookup speculative decoding
         for greedy requests (engine/speculative.py — the NL→SQL
         copy-heavy workload is its sweet spot)."""

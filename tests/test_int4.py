@@ -151,15 +151,74 @@ def test_int4_fused_matmuls_parity(tiny_model):
             == fused.generate(prompts, max_new_tokens=8))
 
 
-def test_int4_rejects_mesh(tiny_model):
+def test_int4_matmul_pads_ragged_large_rows():
+    """Prefill-shaped row counts that don't divide 128 take the pad-and-
+    slice path (advisor r4: the old rb=r fallback rebuilt the untiled VMEM
+    scratch the tiling exists to bound)."""
+    r = 300  # > 256 and not a multiple of 128
+    x = jax.random.normal(jax.random.key(5), (r, 256), jnp.float32)
+    w = jax.random.normal(jax.random.key(6), (256, 128), jnp.float32)
+    q = quantize_weight_int4(w, group=64)
+    out = int4_matmul(x, q["q4"], q["s4"])
+    assert out.shape == (r, 128)
+    ref = x @ dequantize_weight_int4(q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_tp_safe_group():
+    from llm_based_apache_spark_optimization_tpu.ops.quant import tp_safe_group
+
+    # Multiples of 128*8 keep the full group.
+    assert tp_safe_group(4096) == 128
+    assert tp_safe_group(8192) == 128
+    # Llama-2-7B ffn: 128 doesn't divide 11008/8 = 1376, so the group
+    # drops to the largest even divisor (86 = 1376/16).
+    g7b = tp_safe_group(11008)
+    assert g7b < 128 and g7b % 2 == 0 and 1376 % g7b == 0
+    # Tiny dims degrade gracefully to an even divisor.
+    g = tp_safe_group(16, 32)
+    assert g % 2 == 0 and 16 % g == 0
+
+
+@pytest.mark.slow
+def test_int4_engine_tp_matches_single_device(tiny_model):
+    """int4 under tensor parallelism (VERDICT r4 next #2): the shard_map
+    int4 kernel wrappers (column-parallel wq/wk/wv/wg/wu, row-parallel
+    wo/wd with in-kernel group scales before the tp psum) must reproduce
+    the single-device int4 engine token for token."""
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
     from llm_based_apache_spark_optimization_tpu.parallel import make_mesh
 
     cfg, params = tiny_model
     params4 = quantize_params_int4(params, group=32)
+    prompts = [[1, 5, 9], [1, 7, 2, 4]]
+    golden = InferenceEngine(cfg, params4, stop_ids=(-1,), prompt_bucket=8) \
+        .generate(prompts, max_new_tokens=6)
     mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
-    with pytest.raises(NotImplementedError, match="int4"):
-        InferenceEngine(cfg, params4, mesh=mesh)
+    eng = InferenceEngine(cfg, params4, stop_ids=(-1,), prompt_bucket=8,
+                          mesh=mesh)
+    assert eng.generate(prompts, max_new_tokens=6) == golden
+
+
+@pytest.mark.slow
+def test_int4_fused_engine_tp_matches_single_device(tiny_model):
+    """The max-compression serving combo under TP: int4 stacked fused
+    trees (wkv/wgu column shards, C split device-local) + the row-parallel
+    unfused wo/wd."""
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+    from llm_based_apache_spark_optimization_tpu.parallel import make_mesh
+
+    cfg, params = tiny_model
+    params4 = quantize_params_int4(params, group=32)
+    prompts = [[1, 5, 9], [1, 7, 2, 4]]
+    golden = InferenceEngine(cfg, params4, stop_ids=(-1,), prompt_bucket=8,
+                             fuse_matmuls=True).generate(prompts,
+                                                         max_new_tokens=6)
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    eng = InferenceEngine(cfg, params4, stop_ids=(-1,), prompt_bucket=8,
+                          mesh=mesh, fuse_matmuls=True)
+    assert eng.generate(prompts, max_new_tokens=6) == golden
 
 
 @pytest.mark.slow

@@ -158,9 +158,10 @@ def test_untied_head_used_when_config_untied():
 
 @pytest.mark.slow
 def test_fused_matmuls_exact_parity(tiny_model):
-    """fuse_blocks concatenates the QKV and gate/up projections into wide
-    matmuls; each output column is the same dot product, so generation must
-    be EXACTLY vanilla — bf16/f32 and int8 trees alike."""
+    """fuse_blocks stacks the K/V (GQA) or Q/K/V (MHA) and gate/up
+    projections into single matmuls; each output column is the same dot
+    product, so generation must be EXACTLY vanilla — bf16/f32 and int8
+    trees alike, single-device and TP-sharded."""
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
     from llm_based_apache_spark_optimization_tpu.ops.quant import (
         quantize_params,
@@ -175,8 +176,40 @@ def test_fused_matmuls_exact_parity(tiny_model):
         assert (ref.generate(prompts, max_new_tokens=8)
                 == fused.generate(prompts, max_new_tokens=8))
 
+    # Fused under TP (VERDICT r4 next #2): the stacked layout shards its
+    # out axis over tp; greedy output must match the fused single-device
+    # engine exactly.
     from llm_based_apache_spark_optimization_tpu.parallel import make_mesh
 
     mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
-    with pytest.raises(ValueError, match="single-device"):
-        InferenceEngine(cfg, params, mesh=mesh, fuse_matmuls=True)
+    fused1 = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                             fuse_matmuls=True)
+    fused_tp = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                               mesh=mesh, fuse_matmuls=True)
+    assert (fused_tp.generate(prompts, max_new_tokens=8)
+            == fused1.generate(prompts, max_new_tokens=8))
+
+
+@pytest.mark.slow
+def test_fused_matmuls_mha_stacks_qkv():
+    """An MHA config (num_heads == num_kv_heads) fuses all three of Q/K/V
+    into one stacked [L, D, 3, O] weight; GQA keeps Q separate ("wkv")."""
+    import dataclasses
+
+    from llm_based_apache_spark_optimization_tpu.models import TINY
+    from llm_based_apache_spark_optimization_tpu.models.llama import fuse_blocks
+
+    cfg = dataclasses.replace(TINY, name="tiny-mha", num_heads=2,
+                              num_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    fused = fuse_blocks(params)
+    assert "wqkv" in fused["blocks"] and "wkv" not in fused["blocks"]
+    d = cfg.hidden_size
+    assert fused["blocks"]["wqkv"].shape == (
+        cfg.num_layers, d, 3, cfg.num_heads * cfg.head_dim
+    )
+    tokens = jnp.asarray([[1, 2, 3]], jnp.int32)
+    pos = jnp.arange(3, dtype=jnp.int32)[None]
+    ref, _ = forward(cfg, params, tokens, pos, None)
+    got, _ = forward(cfg, fused, tokens, pos, None)
+    assert jnp.allclose(ref, got, atol=1e-5)
