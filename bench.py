@@ -113,7 +113,7 @@ def _last_json(text: str) -> dict | None:
 # slow tunnel bring-up; a dead tunnel burns one slice, not the round.
 _LEGS = (
     ("int8", "int8", "BENCH_INT8", 360),
-    ("sched", "scheduler", "BENCH_SCHED", 360),
+    ("sched", "scheduler", "BENCH_SCHED", 480),
     ("long", "long_context", "BENCH_LONG", 420),
     ("7b", "7b", "BENCH_7B", 780),
     ("7b_sched", "7b_sched", "BENCH_7B_SCHED", 780),
@@ -548,7 +548,7 @@ def _bench_7b_sched(device_kind) -> dict:
     params = init_params_quantized(cfg, jax.random.key(0), bits=8)
     out = _bench_scheduler(
         cfg, params, prompt_len, max_new, batch=slots // 2,
-        kv_quant="int8", reps=1, n_req=2 * slots,
+        kv_quant="int8", reps=1, n_req=2 * slots, spec_draft=0,
     )
     out["config"] = cfg.name
     out["quant"] = "int8+kv8"
@@ -723,11 +723,19 @@ def _bench_int8(cfg, params, prompt_len, max_new, batch, bf16_tok_s,
 
 
 def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
-                     kv_quant=None, reps=None, n_req=None) -> dict:
+                     kv_quant=None, reps=None, n_req=None,
+                     spec_draft=None) -> dict:
     """Continuous-batching scheduler throughput: n_req requests from
     concurrent submitter threads share one persistent-cache decode batch —
     the number BENCH_r02 never recorded (VERDICT r2 missing #4). Also the
-    shared engine for the 7b_sched leg (kv_quant/reps/n_req kwargs)."""
+    shared engine for the 7b_sched leg (kv_quant/reps/n_req kwargs).
+
+    A second pass with speculative_draft=BENCH_SCHED_SPEC (default 4, 0
+    disables) reruns the same greedy workload on a speculative scheduler
+    and records tok/s plus the acceptance counters (VERDICT r4 next #5) —
+    random-weight prompts accept ~nothing, so the committed number is the
+    instrument proof and the overhead floor; real SQL checkpoints are
+    where tokens_per_round > 1.6 should appear."""
     import time as _t
     from concurrent.futures import ThreadPoolExecutor
 
@@ -840,6 +848,41 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
     if best_ttfts:
         out["ttft_p50_s"] = pctile(best_ttfts, 0.5)
         out["ttft_p95_s"] = pctile(best_ttfts, 0.95)
+
+    draft = (int(os.environ.get("BENCH_SCHED_SPEC", "4"))
+             if spec_draft is None else spec_draft)
+    if draft > 0:
+        spec_sched = ContinuousBatchingScheduler(
+            cfg, params, num_slots=slots, max_seq=max_seq,
+            prompt_bucket=prompt_len, stop_ids=(-1,),
+            decode_chunk=decode_chunk, kv_quant=kv_quant,
+            speculative_draft=draft,
+        )
+        spec_sched.warmup(prompt_len)
+        with spec_sched:
+            spec_sched.generate(reqs[:2], max_new_tokens=max_new)
+            # Snapshot the lifetime counters so the committed stats cover
+            # exactly the timed window (the warmup generate above also
+            # harvests verify rounds).
+            pre = dict(spec_sched.speculation_stats or {})
+            t0 = _t.perf_counter()
+            with ThreadPoolExecutor(max_workers=n_req) as pool:
+                futs = [pool.submit(spec_sched.submit, r,
+                                    max_new_tokens=max_new) for r in reqs]
+                stoks = sum(len(f.result().result()) for f in futs)
+            sdt = _t.perf_counter() - t0
+            post = dict(spec_sched.speculation_stats or {})
+        rounds = post.get("verify_rounds", 0) - pre.get("verify_rounds", 0)
+        toks_sp = post.get("tokens_emitted", 0) - pre.get("tokens_emitted", 0)
+        tpr = toks_sp / rounds if rounds else 0.0
+        out["speculative"] = {
+            "draft": draft,
+            "tok_s": round(stoks / sdt, 1),
+            "verify_rounds": rounds,
+            "tokens_emitted": toks_sp,
+            "tokens_per_round": round(tpr, 3),
+            "est_speedup_vs_vanilla": round(tpr / 1.6, 3),
+        }
     return out
 
 

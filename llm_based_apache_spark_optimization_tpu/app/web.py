@@ -107,8 +107,13 @@ def create_web_app(
 
     @app.route("/metrics")
     def metrics(req: Request) -> Response:
-        """Per-model serving aggregates (SURVEY.md §5 observability)."""
-        return Response.json(service.metrics.snapshot())
+        """Per-model serving aggregates (SURVEY.md §5 observability), plus
+        scheduler-layer stats (prefix-cache reuse, speculation acceptance)
+        for models served by backends that expose them."""
+        snap = service.metrics.snapshot()
+        for model, extra in service.backend_stats().items():
+            snap.setdefault(model, {})["serving"] = extra
+        return Response.json(snap)
 
     @app.route("/static/styles.css")
     def styles(req: Request) -> Response:

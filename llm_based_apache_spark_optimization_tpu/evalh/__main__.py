@@ -28,9 +28,16 @@ def main(argv=None) -> None:
                     help="evaluate on real Spider data at this path")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--virtual-devices", type=int, default=0, metavar="N",
+                    help="expose N virtual CPU devices (implies --cpu) so "
+                         "tp=4/tp=8 config rows run their named mesh")
     args = ap.parse_args(argv)
 
-    if args.cpu:
+    if args.virtual_devices:
+        from .report import force_virtual_devices
+
+        force_virtual_devices(args.virtual_devices)
+    elif args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
@@ -49,6 +56,13 @@ def main(argv=None) -> None:
         "fake": make_fake_service,
         "oracle": make_oracle_service,
     }[args.backend]()
+    # Mesh honesty (evalh/configs.run_config): configs naming tp=N get a
+    # factory that builds a tp-sharded tiny service when devices exist
+    # (with --virtual-devices, virtual CPU ones count).
+    factory = (
+        (lambda tp: make_tiny_service(args.max_new_tokens, tp=tp))
+        if args.backend == "tiny" else None
+    )
 
     if args.configs is not None:
         if args.backend == "oracle":
@@ -62,11 +76,13 @@ def main(argv=None) -> None:
             if key not in CONFIGS:
                 sys.exit(f"unknown config {key!r}; choices: {list(CONFIGS)}")
             cfg = CONFIGS[key]
-            rep = run_config(service, cfg, max_new_tokens=args.max_new_tokens)
+            rep = run_config(service, cfg, max_new_tokens=args.max_new_tokens,
+                             service_factory=factory)
             print(json.dumps({
                 "config": key,
                 "description": cfg.description,
                 "cases": len(rep.cases),
+                "mesh": rep.mesh,
                 "exact_match_rate": round(rep.exact_match_rate, 2),
                 "avg_edit_distance": round(rep.avg_edit_distance, 2),
                 "avg_latency_s": round(rep.avg_latency_s, 4),

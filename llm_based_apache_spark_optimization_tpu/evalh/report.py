@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import datetime
+import os
 import sys
 from typing import Dict, List, Optional, Sequence
 
@@ -216,6 +217,26 @@ def generate(
     )
 
 
+def force_virtual_devices(n: int) -> None:
+    """Expose n virtual CPU devices so BASELINE configs naming tp=4/tp=8
+    run on the mesh they name (VERDICT r4 next #4 — committed EVAL tables
+    had only ever shown the tp=1 fallback parenthetical).
+
+    Must run before the FIRST jax backend init — XLA flags are read when
+    the backend comes up, not at module import, so calling this from a CLI
+    main() after `import jax` is safe as long as no devices were touched.
+    Virtual host devices only exist on the CPU platform; the config-layer
+    update also defuses this container's sitecustomize axon override."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="evalh.report")
     ap.add_argument("--backend", choices=("tiny", "fake", "oracle"),
@@ -227,9 +248,14 @@ def main(argv=None) -> None:
     ap.add_argument("-o", "--out", default="-", help="output path (- = stdout)")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--virtual-devices", type=int, default=0, metavar="N",
+                    help="expose N virtual CPU devices (implies --cpu) so "
+                         "tp=4/tp=8 config rows run their named mesh")
     args = ap.parse_args(argv)
 
-    if args.cpu:
+    if args.virtual_devices:
+        force_virtual_devices(args.virtual_devices)
+    elif args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")

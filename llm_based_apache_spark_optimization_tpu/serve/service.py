@@ -71,6 +71,19 @@ class GenerationService:
             )
         return entry
 
+    def backend_stats(self) -> Dict[str, Dict]:
+        """Per-model serving-layer stats from backends exposing .stats()
+        (SchedulerBackend: prefix-cache reuse, speculation acceptance) —
+        the /metrics endpoint merges these beside the request aggregates."""
+        out: Dict[str, Dict] = {}
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            fn = getattr(e.backend, "stats", None)
+            if callable(fn):
+                out[e.name] = fn()
+        return out
+
     def close(self) -> None:
         """Shut down owned backend resources (scheduler threads, slot-pool
         caches). Idempotent; shared backends (one scheduler behind two

@@ -271,3 +271,92 @@ def test_scheduler_speculative_rejects_bad_draft(tiny):
         ContinuousBatchingScheduler(
             cfg, params, num_slots=2, stop_ids=(-1,), speculative_draft=99,
         )
+
+
+@pytest.mark.slow
+def test_speculation_stats_counted_and_surfaced(tiny):
+    """Acceptance accounting (VERDICT r4 next #5): greedy requests with a
+    self-repeating prompt accept drafts, the counters see every harvested
+    verify round, and tokens_per_round lands in [1, draft+1]. A repetitive
+    prompt guarantees n-gram lookup finds copyable continuations, so at
+    least SOME round must emit more than one token."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=16, stop_ids=(-1,),
+        speculative_draft=4,
+    )
+    assert sched.speculation_stats == {
+        "verify_rounds": 0, "tokens_emitted": 0, "tokens_per_round": 0.0,
+        "est_speedup_vs_vanilla": 0.0,
+    }
+    rep = [1, 5, 9, 5, 9, 5, 9, 5, 9, 5, 9]
+    with sched:
+        out = sched.generate([rep, [1, 7, 2]], max_new_tokens=12)
+    assert all(len(o) == 12 for o in out)
+    stats = sched.speculation_stats
+    assert stats["verify_rounds"] >= 1
+    assert stats["tokens_emitted"] >= 24  # every greedy token was counted
+    assert 1.0 <= stats["tokens_per_round"] <= 5.0
+
+
+@pytest.mark.slow
+def test_speculation_stats_in_metrics_endpoint(tiny):
+    """The /metrics payload must carry the scheduler-layer stats beside the
+    request aggregates (serving.speculation / serving.prefix_cache)."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerBackend,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.service import (
+        GenerationService,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import ByteTokenizer
+
+    cfg, params = tiny
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=16, stop_ids=(-1,),
+        speculative_draft=4,
+    )
+    svc = GenerationService()
+    svc.register("m", SchedulerBackend(sched, ByteTokenizer(),
+                                       max_new_tokens=8))
+    try:
+        svc.generate("m", "abcabcabc")
+        stats = svc.backend_stats()
+        assert "speculation" in stats["m"] and "prefix_cache" in stats["m"]
+        assert stats["m"]["speculation"]["verify_rounds"] >= 1
+    finally:
+        svc.close()
+
+
+def test_sampled_request_on_speculative_scheduler_warns(tiny, caplog):
+    """Advisor r4: a temperature>0 request on a speculative scheduler
+    regresses throughput — the first such admission must log a warning."""
+    import logging
+
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+        speculative_draft=2,
+    )
+    with caplog.at_level(logging.WARNING, logger="lsot.scheduler"), sched:
+        sched.generate([[1, 5, 9]], max_new_tokens=4,
+                       sampling=SamplingParams(temperature=0.8))
+        warned = [r for r in caplog.records if "speculative" in r.message]
+        assert len(warned) == 1
+        # Second sampled submit must NOT warn again (once per scheduler).
+        sched.generate([[1, 7]], max_new_tokens=4,
+                       sampling=SamplingParams(temperature=0.8))
+        assert len([r for r in caplog.records
+                    if "speculative" in r.message]) == 1
