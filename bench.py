@@ -858,30 +858,42 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
             decode_chunk=decode_chunk, kv_quant=kv_quant,
             speculative_draft=draft,
         )
+        from llm_based_apache_spark_optimization_tpu.engine.speculative import (
+            VERIFY_COST_RATIO,
+        )
+
         spec_sched.warmup(prompt_len)
+        spec_tok_s, rounds, toks_sp = 0.0, 0, 0
         with spec_sched:
             spec_sched.generate(reqs[:2], max_new_tokens=max_new)
-            # Snapshot the lifetime counters so the committed stats cover
-            # exactly the timed window (the warmup generate above also
-            # harvests verify rounds).
-            pre = dict(spec_sched.speculation_stats or {})
-            t0 = _t.perf_counter()
-            with ThreadPoolExecutor(max_workers=n_req) as pool:
-                futs = [pool.submit(spec_sched.submit, r,
-                                    max_new_tokens=max_new) for r in reqs]
-                stoks = sum(len(f.result().result()) for f in futs)
-            sdt = _t.perf_counter() - t0
-            post = dict(spec_sched.speculation_stats or {})
-        rounds = post.get("verify_rounds", 0) - pre.get("verify_rounds", 0)
-        toks_sp = post.get("tokens_emitted", 0) - pre.get("tokens_emitted", 0)
+            # Same best-of-reps protocol as the vanilla pass above — a
+            # single run on the tunneled transport would bias the
+            # spec-vs-vanilla comparison either way. Counter deltas bracket
+            # exactly the best rep's window (the warmup generate also
+            # harvests verify rounds, so lifetime totals would overcount).
+            for _ in range(reps):
+                pre = dict(spec_sched.speculation_stats or {})
+                t0 = _t.perf_counter()
+                with ThreadPoolExecutor(max_workers=n_req) as pool:
+                    futs = [pool.submit(spec_sched.submit, r,
+                                        max_new_tokens=max_new) for r in reqs]
+                    stoks = sum(len(f.result().result()) for f in futs)
+                sdt = _t.perf_counter() - t0
+                post = dict(spec_sched.speculation_stats or {})
+                if stoks / sdt > spec_tok_s:
+                    spec_tok_s = stoks / sdt
+                    rounds = (post.get("verify_rounds", 0)
+                              - pre.get("verify_rounds", 0))
+                    toks_sp = (post.get("tokens_emitted", 0)
+                               - pre.get("tokens_emitted", 0))
         tpr = toks_sp / rounds if rounds else 0.0
         out["speculative"] = {
             "draft": draft,
-            "tok_s": round(stoks / sdt, 1),
+            "tok_s": round(spec_tok_s, 1),
             "verify_rounds": rounds,
             "tokens_emitted": toks_sp,
             "tokens_per_round": round(tpr, 3),
-            "est_speedup_vs_vanilla": round(tpr / 1.6, 3),
+            "est_speedup_vs_vanilla": round(tpr / VERIFY_COST_RATIO, 3),
         }
     return out
 

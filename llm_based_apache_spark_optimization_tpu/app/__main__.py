@@ -294,13 +294,13 @@ def main(argv=None) -> None:
                          "per round for greedy requests, on both the "
                          "scheduler (default) and engine serving paths — "
                          "copy-heavy NL→SQL workloads on real checkpoints "
-                         "benefit most. NOTE: temperature>0 requests emit "
-                         "only 1 token per verify round under a speculative "
-                         "scheduler (a throughput regression vs vanilla "
-                         "rounds; the scheduler logs a warning) — keep "
-                         "sampled traffic off --speculative deployments. "
-                         "Acceptance is surfaced at /metrics "
-                         "(serving.speculation)")
+                         "benefit most. NOTE: temperature>0 requests emit 1 "
+                         "token per ~1.6x-cost verify round under a "
+                         "speculative scheduler (~1.6x device time per "
+                         "sampled token, with no draft upside; the "
+                         "scheduler logs a warning) — keep sampled traffic "
+                         "off --speculative deployments. Acceptance is "
+                         "surfaced at /metrics (serving.speculation)")
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8 KV cache with per-slot scales: halves the "
                          "serving window's HBM footprint and decode cache "

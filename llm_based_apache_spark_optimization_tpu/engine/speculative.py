@@ -53,6 +53,12 @@ from jax import lax
 from ..models.configs import LlamaConfig
 from ..models.llama import _UNROLL_MAX_T, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
+
+# Measured cost of one T=D+1 verify round relative to a T=1 decode step
+# (module docstring): the single source for every est_speedup_vs_vanilla
+# figure (scheduler speculation_stats, bench speculative block) — re-measure
+# here, and both surfaces move together.
+VERIFY_COST_RATIO = 1.6
 from ..parallel.sharding import constrain_cache
 from .kvcache import init_cache
 

@@ -74,7 +74,10 @@ def render_report(
     lines += ["## Four-query suite — per query (edit distance | latency)", ""]
     header = "| Query | " + " | ".join(models) + " |"
     lines += [header, "|" + "---|" * (len(models) + 1)]
-    for qi, case in enumerate(FOUR_QUERY_SUITE):
+    # Rows follow what actually RAN (generate's limit_cases smoke mode may
+    # have scored a prefix of the suite), not the full suite list.
+    n_ran = min(len(reports[m].cases) for m in models) if models else 0
+    for qi, case in enumerate(FOUR_QUERY_SUITE[:n_ran]):
         cells = []
         for m in models:
             c = reports[m].cases[qi]
@@ -184,13 +187,19 @@ def generate(
     service_factory=None,
     service_mesh: Optional[str] = None,
     exec_match: bool = True,
+    limit_cases: Optional[int] = None,
 ) -> str:
     import jax
 
     platform = jax.devices()[0].platform
     models = list(models or service.models())
+    # limit_cases = the runbook's smoke mode: score only the first N suite
+    # queries so the first run over a fresh checkpoint is one
+    # prefill+decode per model, not the whole report.
+    cases = (list(FOUR_QUERY_SUITE)[:limit_cases] if limit_cases
+             else FOUR_QUERY_SUITE)
     reports = evaluate_models(
-        service, models, FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM,
+        service, models, cases, TAXI_DDL_SYSTEM,
         max_new_tokens=max_new_tokens,
         exec_backend=make_taxi_exec_backend() if exec_match else None,
     )
@@ -227,11 +236,16 @@ def force_virtual_devices(n: int) -> None:
     main() after `import jax` is safe as long as no devices were touched.
     Virtual host devices only exist on the CPU platform; the config-layer
     update also defuses this container's sitecustomize axon override."""
+    import re
+
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
+    # Replace any pre-set count rather than skipping: silently keeping a
+    # smaller ambient value would bring jax up short and reintroduce the
+    # tp=1 fallback rows this flag exists to eliminate.
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    os.environ["XLA_FLAGS"] = (
+        flags.strip() + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

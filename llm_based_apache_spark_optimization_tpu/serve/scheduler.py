@@ -257,6 +257,14 @@ class ContinuousBatchingScheduler:
             cfg, num_slots, self.max_seq, dtype.itemsize
         ) // tp
         if kv_quant:
+            # Halving shifts the kernel/einsum crossover to the quantized
+            # byte count. NOTE (advisor r4): the crossover threshold itself
+            # was measured on the bf16 cache; quantization halves the
+            # kernel's streamed bytes and the einsum's full-read penalty
+            # roughly equally, so feeding the halved count to the bf16
+            # threshold is an extrapolation, not a re-measurement — if int8
+            # decode dispatch ever looks off, re-sweep the crossover with
+            # the int8 cache (ops/pallas/dispatch.py has the recipe).
             cache_dev_bytes //= 2
         self._decode_impl = decode_attention_impl(mesh, cache_dev_bytes)
         cache = init_cache(cfg, num_slots, self.max_seq, dtype=dtype)
@@ -840,16 +848,18 @@ class ContinuousBatchingScheduler:
         if self._spec_draft and sampling.temperature > 0.0 \
                 and not self._warned_sampled_spec:
             # Advisor r4: under speculation a sampled slot emits exactly 1
-            # token per T=D+1 verify round (vs decode_chunk per vanilla
-            # round) while still paying the wide forward — a throughput
-            # regression the submitter should know about once, loudly.
+            # token per T=D+1 verify round, and a verify round costs
+            # ~VERIFY_COST_RATIO of a vanilla decode step — so sampled
+            # traffic pays ~1.6x device time per token (and can never win
+            # anything back, since sampled slots accept no drafts). Warn
+            # once, loudly.
             self._warned_sampled_spec = True
             _log.warning(
                 "temperature>0 request admitted to a speculative scheduler "
-                "(draft=%d): sampled slots emit 1 token per verify round — "
-                "~%dx fewer than a vanilla decode round's chunk. Serve "
-                "sampled traffic on a non-speculative scheduler.",
-                self._spec_draft, self.decode_chunk,
+                "(draft=%d): sampled slots emit 1 token per verify round at "
+                "~1.6x a vanilla step's cost and never benefit from drafts. "
+                "Serve sampled traffic on a non-speculative scheduler.",
+                self._spec_draft,
             )
         req = _Request(
             ids=list(ids), max_new=max_new_tokens,
@@ -916,13 +926,16 @@ class ContinuousBatchingScheduler:
         go/no-go number for --speculative on a given workload."""
         if not self._spec_draft:
             return None
+        from ..engine.speculative import VERIFY_COST_RATIO
+
         rounds, toks = self._spec_rounds, self._spec_tokens
         tpr = toks / rounds if rounds else 0.0
         return {
             "verify_rounds": rounds,
             "tokens_emitted": toks,
             "tokens_per_round": round(tpr, 3),
-            "est_speedup_vs_vanilla": round(tpr / 1.6, 3) if rounds else 0.0,
+            "est_speedup_vs_vanilla":
+                round(tpr / VERIFY_COST_RATIO, 3) if rounds else 0.0,
         }
 
     @property
