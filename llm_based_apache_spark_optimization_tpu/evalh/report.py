@@ -195,7 +195,11 @@ def generate(
     models = list(models or service.models())
     # limit_cases = the runbook's smoke mode: score only the first N suite
     # queries so the first run over a fresh checkpoint is one
-    # prefill+decode per model, not the whole report.
+    # prefill+decode per model, not the whole report. Validated HERE so
+    # every caller inherits it: 0 would silently run the full suite
+    # (falsy = no limit) and a negative N would slice from the end.
+    if limit_cases is not None and limit_cases < 1:
+        raise ValueError(f"limit_cases must be >= 1, got {limit_cases}")
     cases = (list(FOUR_QUERY_SUITE)[:limit_cases] if limit_cases
              else FOUR_QUERY_SUITE)
     reports = evaluate_models(
