@@ -119,6 +119,7 @@ _LEGS = (
     ("int4", "int4", "BENCH_INT4", 420),
     ("7b4", "7b_int4", "BENCH_7B4", 600),
     ("7b_sched", "7b_sched", "BENCH_7B_SCHED", 780),
+    ("fuse", "fused", "BENCH_FUSED", 600),
 )
 
 
@@ -212,7 +213,8 @@ def outer() -> int:
                                        str(default_to)))
         print(f"bench[outer]: leg {leg} (timeout {timeout_s}s)",
               file=sys.stderr)
-        extra = {"BENCH_PRIMARY_TOKS": str(result.get("value", 0.0))}
+        extra = {"BENCH_PRIMARY_TOKS": str(result.get("value", 0.0)),
+                 "BENCH_PRIMARY_PREFILL": str(result.get("prefill_s", 0.0))}
         if on_cpu:
             extra["BENCH_FORCE_CPU"] = "1"
         t0 = time.time()
@@ -328,6 +330,9 @@ def inner_leg(leg: str) -> int:
     elif leg == "int4":
         _emit({"int4": _bench_int4(cfg, params, prompt_len, max_new, batch,
                                    primary or None, device_kind)})
+    elif leg == "fuse":
+        _emit({"fused": _bench_fused(cfg, params, prompt_len, max_new,
+                                     batch, primary or None)})
     else:
         print(f"bench: unknown BENCH_LEG={leg!r}", file=sys.stderr)
         return 2
@@ -653,7 +658,7 @@ def _bench_int8(cfg, params, prompt_len, max_new, batch, bf16_tok_s,
         out["speedup_vs_bf16"] = round(out[f"b{batch}_tok_s"] / bf16_tok_s, 2)
     out.update(_decode_split_and_util(
         eng8, cfg, batch, prompt_len, max_new, out[f"b{batch}_tok_s"],
-        pbytes8, device_kind, rng, "int8",
+        pbytes8, device_kind, rng,
     ))
     peak_flops, peak_bw = _peak_for(device_kind, "int8")
     bytes_per_step = _step_bytes(cfg, batch, prompt_len, max_new, pbytes8)
@@ -731,12 +736,14 @@ def _step_bytes(cfg, b, prompt_len, max_new, param_bytes,
 
 
 def _decode_split_and_util(eng, cfg, b, prompt_len, max_new, agg_tok_s,
-                           param_bytes, device_kind, rng, quant) -> dict:
+                           param_bytes, device_kind, rng) -> dict:
     """Decode-only split via the max_new=1 prefill probe, plus decode HBM
     util from DECODE-ONLY tok/s (one formula across the bf16/int8/int4
     legs — mixing aggregate- and decode-denominated utils would make the
-    cross-quant bandwidth comparison apples-to-oranges). Empty when
-    max_new is too small for the split to be signal."""
+    cross-quant bandwidth comparison apples-to-oranges). Bandwidth only:
+    this helper deliberately has no FLOPs/quant plumbing, so no caller
+    can silently compute MFU against the wrong peak (_detail owns MFU).
+    Empty when max_new is too small for the split to be signal."""
     import time as _t
 
     out: dict = {}
@@ -749,9 +756,10 @@ def _decode_split_and_util(eng, cfg, b, prompt_len, max_new, agg_tok_s,
         t0 = _t.perf_counter()
         eng.generate(ps, max_new_tokens=1)
         t_pre = min(t_pre, _t.perf_counter() - t0)
+    out["prefill_s"] = round(t_pre, 4)
     decode_dt = max(b * max_new / agg_tok_s - t_pre, 1e-9)
     out["decode_tok_s"] = round(b * (max_new - 1) / decode_dt, 1)
-    peak_flops, peak_bw = _peak_for(device_kind, quant)
+    _, peak_bw = _peak_for(device_kind, "")
     if peak_bw:
         bps = _step_bytes(cfg, b, prompt_len, max_new, param_bytes)
         out["decode_hbm_util"] = round(
@@ -817,8 +825,43 @@ def _bench_int4(cfg, params, prompt_len, max_new, batch, bf16_tok_s,
         out["speedup_vs_bf16"] = round(out[f"b{batch}_tok_s"] / bf16_tok_s, 2)
     out.update(_decode_split_and_util(
         eng4, cfg, batch, prompt_len, max_new, out[f"b{batch}_tok_s"],
-        pbytes4, device_kind, rng, "int8",
+        pbytes4, device_kind, rng,
     ))
+    return out
+
+
+def _bench_fused(cfg, params, prompt_len, max_new, batch,
+                 bf16_tok_s) -> dict:
+    """Fused-matmul A/B (stacked wkv/wqkv + wgu, models/llama.fuse_blocks):
+    the prefill-MFU lever, measured against the unfused primary. Reports
+    aggregate tok/s plus the prefill-only probe time — prefill is where
+    fewer, wider MXU matmuls should show (decode is weight-streaming-bound
+    and moves the same bytes either way). Passing BENCH_PRIMARY_PREFILL
+    (the core leg's prefill_s, handed through by the outer) turns the
+    probe into a committed speedup ratio."""
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
+
+    rng = np.random.default_rng(0)
+    eng = InferenceEngine(cfg, params, stop_ids=(-1,),
+                          prompt_bucket=prompt_len, fuse_matmuls=True)
+    out: dict = {"quant": "bf16+fused"}
+    out[f"b{batch}_tok_s"] = _measure_tok_s(eng, cfg, batch, prompt_len,
+                                            max_new, rng)
+    if bf16_tok_s:
+        out["speedup_vs_unfused"] = round(
+            out[f"b{batch}_tok_s"] / bf16_tok_s, 2
+        )
+    out.update(_decode_split_and_util(
+        eng, cfg, batch, prompt_len, max_new, out[f"b{batch}_tok_s"],
+        _param_bytes(params), "", rng,
+    ))
+    base_pre = float(os.environ.get("BENCH_PRIMARY_PREFILL", "0") or 0)
+    if base_pre > 0 and out.get("prefill_s"):
+        out["prefill_speedup_vs_unfused"] = round(
+            base_pre / out["prefill_s"], 2
+        )
     return out
 
 
