@@ -204,6 +204,14 @@ def outer() -> int:
     focused = (os.environ.get("BENCH_QUANT")
                or os.environ.get("BENCH_FUSE") == "1"
                or os.environ.get("BENCH_UNEMBED8") == "1")
+    if focused:
+        # Say so loudly: BENCH_FUSE=1 (focused primary) is one character
+        # from BENCH_FUSED=1 (the fused A/B leg) and silently skipping all
+        # legs would look like a bug to someone who meant the latter.
+        print("bench[outer]: focused primary mode "
+              "(BENCH_QUANT/BENCH_FUSE/BENCH_UNEMBED8) — optional legs "
+              "skipped; the fused A/B *leg* is BENCH_FUSED=1",
+              file=sys.stderr)
     legs_status = result.setdefault("legs", {})
     for leg, key, env_var, default_to in _LEGS:
         want = os.environ.get(env_var)
@@ -331,8 +339,17 @@ def inner_leg(leg: str) -> int:
         _emit({"int4": _bench_int4(cfg, params, prompt_len, max_new, batch,
                                    primary or None, device_kind)})
     elif leg == "fuse":
+        # Fuse HERE and rebind, dropping the unfused wq/wk/wv/wg/wu leaves
+        # before the engine builds — holding both copies would double
+        # weight residency (the OOM hazard inner_core's BENCH_FUSE path
+        # documents).
+        from llm_based_apache_spark_optimization_tpu.models.llama import (
+            fuse_blocks,
+        )
+
+        params = fuse_blocks(params)
         _emit({"fused": _bench_fused(cfg, params, prompt_len, max_new,
-                                     batch, primary or None)})
+                                     batch, primary or None, device_kind)})
     else:
         print(f"bench: unknown BENCH_LEG={leg!r}", file=sys.stderr)
         return 2
@@ -831,21 +848,22 @@ def _bench_int4(cfg, params, prompt_len, max_new, batch, bf16_tok_s,
 
 
 def _bench_fused(cfg, params, prompt_len, max_new, batch,
-                 bf16_tok_s) -> dict:
-    """Fused-matmul A/B (stacked wkv/wqkv + wgu, models/llama.fuse_blocks):
-    the prefill-MFU lever, measured against the unfused primary. Reports
-    aggregate tok/s plus the prefill-only probe time — prefill is where
-    fewer, wider MXU matmuls should show (decode is weight-streaming-bound
-    and moves the same bytes either way). Passing BENCH_PRIMARY_PREFILL
-    (the core leg's prefill_s, handed through by the outer) turns the
-    probe into a committed speedup ratio."""
+                 bf16_tok_s, device_kind) -> dict:
+    """Fused-matmul A/B (stacked wkv/wqkv + wgu, models/llama.fuse_blocks;
+    the caller passes an ALREADY-FUSED tree so the unfused leaves are
+    gone): the prefill-MFU lever, measured against the unfused primary.
+    Reports aggregate tok/s, the decode split/HBM util (expected ~flat:
+    decode moves the same bytes either way — the util number is here to
+    CONFIRM that), and the prefill probe, which BENCH_PRIMARY_PREFILL
+    (the core leg's prefill_s, handed through by the outer) turns into a
+    committed speedup ratio."""
     import numpy as np
 
     from llm_based_apache_spark_optimization_tpu.engine import InferenceEngine
 
     rng = np.random.default_rng(0)
     eng = InferenceEngine(cfg, params, stop_ids=(-1,),
-                          prompt_bucket=prompt_len, fuse_matmuls=True)
+                          prompt_bucket=prompt_len)
     out: dict = {"quant": "bf16+fused"}
     out[f"b{batch}_tok_s"] = _measure_tok_s(eng, cfg, batch, prompt_len,
                                             max_new, rng)
@@ -855,7 +873,7 @@ def _bench_fused(cfg, params, prompt_len, max_new, batch,
         )
     out.update(_decode_split_and_util(
         eng, cfg, batch, prompt_len, max_new, out[f"b{batch}_tok_s"],
-        _param_bytes(params), "", rng,
+        _param_bytes(params), device_kind, rng,
     ))
     base_pre = float(os.environ.get("BENCH_PRIMARY_PREFILL", "0") or 0)
     if base_pre > 0 and out.get("prefill_s"):

@@ -11,8 +11,17 @@ Megatron-style tensor parallelism over the "tp" axis:
   wo       [L, N*H, D]      row-parallel     -> shard first (contracted) dim
   wg/wu    [L, D, F]        column-parallel  -> shard last dim
   wd       [L, F, D]        row-parallel     -> shard contracted dim
-  embed / lm_head / norms   replicated (vocab matmul is negligible at decode;
-                            vocab-sharded unembed is a later optimization)
+  norms                     replicated
+  embed / lm_head           VOCAB-sharded over tp (specs_for_params): the
+                            unembed's FLOPs are negligible at decode but its
+                            table STREAMING is not (7B bf16: ~260 MB/step,
+                            ~4% of int8-quantized decode bytes; 22% of an
+                            int4 tree's) — row-sharding splits that across
+                            the mesh, the logits come out vocab-sharded with
+                            no collective, and sampling's argmax/top-k pulls
+                            a ~1 MB/step all-gather XLA inserts on its own.
+                            The embedding gather over the sharded table is
+                            a few rows of traffic either way.
 
 KV cache [L, B, S, K, H] shards batch over "dp" and KV heads over "tp" —
 each chip holds only its own heads' cache, which is what makes the 7B
@@ -52,19 +61,22 @@ def param_specs(cfg: LlamaConfig, quantized: bool = False,
     for column-parallel weights and replicates for row-parallel ones (the
     scale multiply happens after GSPMD's all-reduce of the partial sums).
     `q_unembed` mirrors quantize_unembed's {"q8","s"} embed/lm_head dicts
-    (replicated, like the bf16 tables).
+    (vocab-sharded like the bf16 tables — module docstring).
 
     This flag form covers UNFUSED trees only (it is the shape-contract the
     checkpoint loaders pre-declare shardings from, before any tree exists);
     `specs_for_params` derives specs from an actual tree and additionally
-    handles int4 and fused layouts.
+    handles int4 and fused layouts. The two MUST agree on placement for
+    shared layouts: the loaders stream weights straight to these specs,
+    and a disagreement would make every engine init reshuffle the tables
+    across the mesh.
     """
     def w(spec: P) -> Any:
         return {"q8": spec, "s": P(spec[0], spec[2])} if quantized else spec
 
     def table() -> Any:
-        return ({"q8": P(None, None), "s": P(None)} if q_unembed
-                else P(None, None))
+        return ({"q8": P("tp", None), "s": P("tp")} if q_unembed
+                else P("tp", None))
 
     specs: Dict[str, Any] = {
         "embed": table(),
@@ -106,7 +118,9 @@ def specs_for_params(params: Pytree, tp: int = 1) -> Pytree:
     - stacked fused weights are always column-parallel: out axis over tp,
       the C (projection) axis replicated — the device-local split is the
       point of the stacked layout (models/llama.fuse_blocks);
-    - embeddings/norms replicate.
+    - embed/lm_head tables shard their VOCAB axis over tp (splits the
+      unembed's per-step table streaming — module docstring); norms
+      replicate.
 
     `tp` is used only for the int4 row-parallel group-alignment check: a
     shard must hold whole quant groups (quantize_params_int4 defaults to
@@ -143,8 +157,11 @@ def specs_for_params(params: Pytree, tp: int = 1) -> Pytree:
         return P(None, "tp", None) if row else P(None, None, "tp")
 
     def table(t: Any) -> Any:
-        return {"q8": P(None, None), "s": P(None)} if is_qtensor(t) \
-            else P(None, None)
+        # Vocab axis over tp (module docstring): splits the unembed table's
+        # per-step HBM streaming across the mesh; int8 tables shard their
+        # per-row scales with their rows.
+        return {"q8": P("tp", None), "s": P("tp")} if is_qtensor(t) \
+            else P("tp", None)
 
     specs: Dict[str, Any] = {
         "embed": table(params["embed"]),

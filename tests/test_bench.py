@@ -1,0 +1,91 @@
+"""The bench harness itself (bench.py) — the driver's only measurement
+instrument, so its outage-proofing contract gets pinned here:
+
+- every emitted stdout line is a complete JSON artifact (the driver takes
+  the LAST line; a kill at any point must leave the richest finished one)
+- leg failures are recorded per-leg instead of nulling the run
+- the CPU fallback path produces the headline keys the judge reads
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+BENCH = str(Path(__file__).resolve().parent.parent / "bench.py")
+
+
+def _run_bench(extra_env, timeout=420):
+    env = dict(os.environ)
+    env.update({
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_CONFIG": "tiny",
+        "BENCH_BATCH": "2",
+        "BENCH_PROMPT": "32",
+        "BENCH_NEW": "16",
+        "BENCH_REPS": "1",
+        "BENCH_DETAIL": "0",
+    })
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=timeout, cwd=str(Path(BENCH).parent),
+    )
+
+
+def test_last_json_helper():
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+
+    assert bench._last_json("") is None
+    assert bench._last_json("noise\n{broken\n") is None
+    assert bench._last_json('{"a": 1}\n{"a": 2}\nnoise') == {"a": 2}
+    # A truncated final line must fall back to the previous complete one.
+    assert bench._last_json('{"a": 1}\n{"a": 2, "b"') == {"a": 1}
+
+
+@pytest.mark.slow
+def test_bench_cpu_fallback_emits_headline():
+    r = _run_bench({})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert lines, r.stderr[-2000:]
+    parsed = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "vs_baseline", "platform"):
+        assert key in parsed, parsed
+    assert parsed["platform"] == "cpu" and parsed["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_incremental_lines_and_leg_status():
+    """With one leg enabled, stdout carries >= 2 complete artifacts (core,
+    then core+leg) and the final line records the leg status — the
+    incremental-capture contract a driver kill relies on."""
+    r = _run_bench({"BENCH_INT8": "1", "BENCH_INT8_TRACE": "0"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) >= 2
+    assert "int8" not in lines[0]
+    final = lines[-1]
+    assert final["int8"]["quant"] == "int8"
+    assert final["legs"]["int8"].startswith("ok")
+    # Every line is a superset headline-wise.
+    for ln in lines:
+        assert ln["value"] == final["value"]
+
+
+@pytest.mark.slow
+def test_bench_leg_failure_recorded_not_fatal():
+    """A leg that dies must leave the core artifact intact with a per-leg
+    failure record (BENCH_r04's rc=124/parsed=null must stay impossible).
+    BENCH_7B_CONFIG=nonexistent makes the 7b leg crash on KeyError."""
+    r = _run_bench({"BENCH_7B": "1", "BENCH_7B_CONFIG": "nonexistent"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines() if ln.strip()]
+    final = lines[-1]
+    assert final["value"] > 0          # core survived
+    assert "7b" not in final           # failed leg contributed nothing
+    assert "7b" in final["legs"] and not final["legs"]["7b"].startswith("ok")

@@ -116,3 +116,30 @@ def test_multihost_single_process_degenerates():
     np.testing.assert_array_equal(np.asarray(arr), batch)
     with pytest.raises(ValueError):
         global_mesh(dp=3)
+
+
+@pytest.mark.slow
+def test_vocab_sharded_tables_parity(tiny_model):
+    """Embed/unembed tables shard their VOCAB axis over tp
+    (specs_for_params): the gather, the logits einsum and sampling must
+    agree token-for-token with the single-device engine — for the bf16
+    tables AND the int8 per-row quantize_unembed dicts."""
+    from llm_based_apache_spark_optimization_tpu.ops.quant import (
+        quantize_unembed,
+    )
+    from llm_based_apache_spark_optimization_tpu.parallel import (
+        specs_for_params,
+    )
+    from jax.sharding import PartitionSpec as P
+
+    cfg, params = tiny_model
+    specs = specs_for_params(params, tp=2)
+    assert specs["embed"] == P("tp", None)
+    prompts = [[1, 5, 9], [1, 7, 2, 4]]
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    for tree in (params, quantize_unembed(params)):
+        golden = InferenceEngine(cfg, tree, stop_ids=(-1,), prompt_bucket=8) \
+            .generate(prompts, max_new_tokens=6)
+        eng = InferenceEngine(cfg, tree, stop_ids=(-1,), prompt_bucket=8,
+                              mesh=mesh)
+        assert eng.generate(prompts, max_new_tokens=6) == golden
