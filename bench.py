@@ -209,9 +209,9 @@ def outer() -> int:
         # from BENCH_FUSED=1 (the fused A/B leg) and silently skipping all
         # legs would look like a bug to someone who meant the latter.
         print("bench[outer]: focused primary mode "
-              "(BENCH_QUANT/BENCH_FUSE/BENCH_UNEMBED8) — optional legs "
-              "skipped; the fused A/B *leg* is BENCH_FUSED=1",
-              file=sys.stderr)
+              "(BENCH_QUANT/BENCH_FUSE/BENCH_UNEMBED8) — default-on legs "
+              "skipped (explicitly enabled ones still run); the fused A/B "
+              "*leg* is BENCH_FUSED=1", file=sys.stderr)
     legs_status = result.setdefault("legs", {})
     for leg, key, env_var, default_to in _LEGS:
         want = os.environ.get(env_var)

@@ -312,7 +312,7 @@ def main(argv=None) -> None:
     ap.add_argument("--int4", action="store_true",
                     help="pack block weights to 4-bit nibbles served by the "
                          "pallas int4 matmul kernel (quarter of bf16's "
-                         "weight bytes; single-device)")
+                         "weight bytes; composes with --tp)")
     ap.add_argument("--int8", action="store_true",
                     help="int8 weight-only quantization (HF checkpoints)")
     ap.add_argument("--scheduler", action=argparse.BooleanOptionalAction,

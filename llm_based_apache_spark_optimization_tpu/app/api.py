@@ -156,7 +156,10 @@ def create_api_app(
     @app.route("/metrics")
     def metrics(req: Request) -> Response:
         """Per-model serving aggregates (p50/p95 latency, decode tok/s) —
-        the observability surface the reference never had (SURVEY.md §5)."""
-        return Response.json(service.metrics.snapshot())
+        the observability surface the reference never had (SURVEY.md §5) —
+        plus scheduler-layer stats (prefix-cache reuse, speculation
+        acceptance) for backends that expose them, mirroring the web app's
+        /metrics."""
+        return Response.json(service.metrics_snapshot())
 
     return app

@@ -110,10 +110,7 @@ def create_web_app(
         """Per-model serving aggregates (SURVEY.md §5 observability), plus
         scheduler-layer stats (prefix-cache reuse, speculation acceptance)
         for models served by backends that expose them."""
-        snap = service.metrics.snapshot()
-        for model, extra in service.backend_stats().items():
-            snap.setdefault(model, {})["serving"] = extra
-        return Response.json(snap)
+        return Response.json(service.metrics_snapshot())
 
     @app.route("/static/styles.css")
     def styles(req: Request) -> Response:

@@ -84,6 +84,15 @@ class GenerationService:
                 out[e.name] = fn()
         return out
 
+    def metrics_snapshot(self) -> Dict[str, Dict]:
+        """The /metrics payload: per-model request aggregates with each
+        model's serving-layer stats merged under "serving" — ONE
+        definition for the web and headless-API endpoints."""
+        snap = self.metrics.snapshot()
+        for model, extra in self.backend_stats().items():
+            snap.setdefault(model, {})["serving"] = extra
+        return snap
+
     def close(self) -> None:
         """Shut down owned backend resources (scheduler threads, slot-pool
         caches). Idempotent; shared backends (one scheduler behind two
