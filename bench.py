@@ -946,8 +946,37 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
                            f"max_seq={sched.max_seq}"}
     rng = np.random.default_rng(1)
     reqs = _mk_prompts(cfg, n_req, prompt_len, rng)
-    best_tok_s, best_dt, toks = 0.0, 0.0, 0
     reps = reps or int(os.environ.get("BENCH_SCHED_REPS", "2"))
+
+    def timed_wave(s, wave_reqs):
+        """One full-contention submit wave: (toks, wall_s, sorted lats,
+        sorted ttfts). ONE definition for the vanilla/speculative/prefix
+        passes — a measurement fix must apply to all three or their
+        cross-comparison skews."""
+        lats: list = []
+        ttfts: list = []
+
+        def one(r):
+            s0 = _t.perf_counter()
+            first: list = []
+
+            def on_tok(_tok):
+                if not first:
+                    first.append(_t.perf_counter())
+
+            res = s.submit(r, max_new_tokens=max_new,
+                           on_token=on_tok).result()
+            lats.append(_t.perf_counter() - s0)
+            if first:
+                ttfts.append(first[0] - s0)
+            return res
+
+        t0 = _t.perf_counter()
+        with ThreadPoolExecutor(max_workers=len(wave_reqs)) as pool:
+            toks = sum(len(r) for r in pool.map(one, wave_reqs))
+        return toks, _t.perf_counter() - t0, sorted(lats), sorted(ttfts)
+
+    best_tok_s, best_dt = 0.0, 0.0
     # Deterministically compile every (bucket, k-bucket) prefill variant the
     # timed run can form (admission bursts group up to kmax; retirement
     # waves re-admit in smaller groups) — warming through generate() races
@@ -959,32 +988,10 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
         best_lats: list = []
         best_ttfts: list = []
         for _ in range(reps):
-            lats = []
-            ttfts = []
-
-            def one(r):
-                s0 = _t.perf_counter()
-                first = []
-
-                def on_tok(_tok):
-                    if not first:
-                        first.append(_t.perf_counter())
-
-                out = sched.submit(r, max_new_tokens=max_new,
-                                   on_token=on_tok).result()
-                lats.append(_t.perf_counter() - s0)
-                if first:
-                    ttfts.append(first[0] - s0)
-                return out
-
-            t0 = _t.perf_counter()
-            with ThreadPoolExecutor(max_workers=n_req) as pool:
-                futs = [pool.submit(one, r) for r in reqs]
-                toks = sum(len(f.result()) for f in futs)
-            dt = _t.perf_counter() - t0
+            toks, dt, lats, ttfts = timed_wave(sched, reqs)
             if toks / dt > best_tok_s:
                 best_tok_s, best_dt = toks / dt, dt
-                best_lats, best_ttfts = sorted(lats), sorted(ttfts)
+                best_lats, best_ttfts = lats, ttfts
     # Per-request end-to-end latency under full contention (submit ->
     # result, queueing included): the metric BASELINE.json's north star is
     # denominated in alongside aggregate tok/s.
@@ -1034,12 +1041,7 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
             # harvests verify rounds, so lifetime totals would overcount).
             for _ in range(reps):
                 pre = dict(spec_sched.speculation_stats or {})
-                t0 = _t.perf_counter()
-                with ThreadPoolExecutor(max_workers=n_req) as pool:
-                    futs = [pool.submit(spec_sched.submit, r,
-                                        max_new_tokens=max_new) for r in reqs]
-                    stoks = sum(len(f.result().result()) for f in futs)
-                sdt = _t.perf_counter() - t0
+                stoks, sdt, _, _ = timed_wave(spec_sched, reqs)
                 post = dict(spec_sched.speculation_stats or {})
                 if stoks / sdt > spec_tok_s:
                     spec_tok_s = stoks / sdt
@@ -1055,6 +1057,54 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
             "tokens_emitted": toks_sp,
             "tokens_per_round": round(tpr, 3),
             "est_speedup_vs_vanilla": round(tpr / VERIFY_COST_RATIO, 3),
+        }
+
+    if os.environ.get("BENCH_SCHED_PREFIX", "1") == "1" and kv_quant is None:
+        # Warm-prefix pass: the reference's ACTUAL serving pattern is the
+        # same schema/system prompt on every request (SURVEY §2.2's
+        # NL→SQL contract), which is exactly what the prefix cache exists
+        # for — and it had no committed number. Requests share a
+        # block-aligned prefix with unique tails; within one wave the
+        # publish gate sees request 1, publishes on request 2, and 3..n
+        # skip their shared-prefix prefills. Reported against the cold
+        # main run's ttft/tok_s above. (Skipped under kv_quant only to
+        # keep the 7b_sched slice lean — the cache composes with int8 KV.)
+        psched = ContinuousBatchingScheduler(
+            cfg, params, num_slots=slots, max_seq=max_seq,
+            prompt_bucket=prompt_len, stop_ids=(-1,),
+            decode_chunk=decode_chunk, prefix_cache_blocks=256,
+        )
+        psched.warmup(prompt_len)
+        pblock = psched._pblock
+        shared_len = max(pblock, (prompt_len // 2) // pblock * pblock)
+        # Reused-prefix admissions prefill only the TAIL, whose smaller
+        # bucket has its own compiled variants — warm those too or the
+        # timed wave compiles mid-flight and reads slower than cold.
+        if prompt_len - shared_len > 0:
+            psched.warmup(prompt_len - shared_len)
+        rng2 = np.random.default_rng(9)
+        shared = _mk_prompts(cfg, 1, shared_len, rng2)[0]
+        tails = _mk_prompts(cfg, n_req, prompt_len - shared_len, rng2)
+        preqs = [shared + t for t in tails]
+        ptok_s, best_ttfts2 = 0.0, []
+        with psched:
+            psched.generate(preqs[:2], max_new_tokens=max_new)
+            # Best-of-reps like every other pass (one definition:
+            # timed_wave). The cache is warm from the generate above on —
+            # every rep measures the steady warm state.
+            for _ in range(reps):
+                ptoks, pdt, _, ttfts2 = timed_wave(psched, preqs)
+                if ptoks / pdt > ptok_s:
+                    ptok_s, best_ttfts2 = ptoks / pdt, ttfts2
+            stats = psched.prefix_stats
+        out["prefix_cache"] = {
+            "shared_prefix_tokens": shared_len,
+            "tok_s": round(ptok_s, 1),
+            **({"ttft_p50_s": pctile(best_ttfts2, 0.5),
+                "ttft_p95_s": pctile(best_ttfts2, 0.95)}
+               if best_ttfts2 else {}),
+            "hits": stats["hits"],
+            "blocks_reused": stats["blocks_reused"],
         }
     return out
 
