@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# One-command chip-window capture: run the full incremental bench on the
+# real TPU and save every artifact stage. Written for the axon-tunneled
+# v5e in this container, where chip windows are intermittent — when the
+# tunnel is up, this grabs everything the round needs in one shot.
+#
+#   bash scripts/chip_window.sh [OUTDIR]
+#
+# Produces in OUTDIR (default /tmp/chip_r05):
+#   bench_full.jsonl   — every incremental artifact line (last = richest)
+#   bench_full.err     — leg-by-leg stderr log
+#   BENCH_PREVIEW.json — the final merged artifact, pretty-printed
+#
+# The default legs already cover: core bf16 (+trace-parsed device MFU),
+# int8 (+B=8 per-op decode breakdown), scheduler (vanilla/speculative/
+# warm-prefix), long-context, 7B int8+kv8, compiled int4 (+kernel parity
+# err), 7B int4, 7B through the scheduler, fused-matmul A/B.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/chip_r05}"
+mkdir -p "$OUT"
+
+echo "chip_window: probing the tunnel (90s)..." >&2
+if ! timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" \
+    >/dev/null 2>&1; then
+  echo "chip_window: TPU backend unavailable — not starting" >&2
+  exit 1
+fi
+
+echo "chip_window: tunnel up; running the full bench (this can take ~30 min)" >&2
+python -u bench.py >"$OUT/bench_full.jsonl" 2>"$OUT/bench_full.err"
+rc=$?
+
+last=$(grep -E '^\{' "$OUT/bench_full.jsonl" | tail -1)
+if [ -n "$last" ]; then
+  printf '%s' "$last" | python -m json.tool >"$OUT/BENCH_PREVIEW.json"
+  echo "chip_window: wrote $OUT/BENCH_PREVIEW.json (bench rc=$rc)" >&2
+  python - "$OUT/BENCH_PREVIEW.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+print("legs:", d.get("legs"))
+print("headline:", d.get("value"), d.get("unit"), "on", d.get("device_kind"))
+EOF
+else
+  echo "chip_window: no artifact line captured (rc=$rc) — see bench_full.err" >&2
+  exit 1
+fi
