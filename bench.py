@@ -1096,8 +1096,10 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
 
         ptok_s, best_ttfts2 = 0.0, []
         best_stats = {"hits": 0, "blocks_reused": 0}
+        warm2 = [shared + t for t in
+                 _mk_prompts(cfg, 2, prompt_len - shared_len, rng2)]
         with psched:
-            psched.generate(fresh_wave()[:2], max_new_tokens=max_new)
+            psched.generate(warm2, max_new_tokens=max_new)
             # Best-of-reps like every other pass (one definition:
             # timed_wave); the shared prefix is published by the generate
             # above, so every rep measures the steady warm state. Counters

@@ -106,8 +106,10 @@ def _make_generate_fn(
     calls with the same signature reuse the compiled executable.
 
     With a `jax.sharding.Mesh`, the KV cache allocated inside the program is
-    pinned to the TP×DP layout (parallel/sharding.cache_spec); params/tokens
-    carry their own NamedShardings in, and GSPMD lays the collectives.
+    pinned to the TP×DP×SP layout (parallel/sharding.cache_spec — KV heads
+    over tp, batch over dp, cache SLOTS over sp, so an sp-way mesh fits
+    sp× the context); params/tokens carry their own NamedShardings in, and
+    GSPMD lays the collectives.
     """
     pad_id = cfg.pad_id
     impl = attn_impl
@@ -118,6 +120,17 @@ def _make_generate_fn(
     prefill_impl = "ring" if sp > 1 else impl
     if kv_quant not in (None, "int8"):
         raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
+    if sp > 1 and decode_impl == "pallas":
+        # The flash decode kernel's shard_map expects S-replicated K/V;
+        # against the sp-sharded cache (parallel/sharding.cache_spec) GSPMD
+        # would all-gather the whole cache every step — OOM at exactly the
+        # long-context sizes sp exists to serve. The einsum path IS the sp
+        # decode impl (flash-decoding-style partial combines).
+        raise ValueError(
+            "attn_impl='pallas' decode cannot run on an sp>1 mesh: the "
+            "sequence-sharded cache would be all-gathered every step; use "
+            "the auto/einsum decode impl"
+        )
     if kv_quant and decode_impl not in ("xla", "pallas"):
         # "xla" is the auto default (uniform engine caches are mostly live
         # — ops.pallas.decode_attention_impl); a forced "pallas" runs the

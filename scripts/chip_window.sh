@@ -41,6 +41,10 @@ d = json.load(open(sys.argv[1]))
 print("legs:", d.get("legs"))
 print("headline:", d.get("value"), d.get("unit"), "on", d.get("device_kind"))
 EOF
+  # A partial capture is still a capture, but automation must see that the
+  # run did not complete cleanly (e.g. tunnel dropped mid-legs) so the next
+  # window retries the lost legs.
+  exit "$rc"
 else
   echo "chip_window: no artifact line captured (rc=$rc) — see bench_full.err" >&2
   exit 1

@@ -236,3 +236,22 @@ def test_sliding_window_decode_crosses_boundary(tiny_model):
     free = InferenceEngine(cfg0, params, stop_ids=(-1,), prompt_bucket=8
                            ).generate([prompt], max_new_tokens=n_new)[0]
     assert free != got
+
+
+@pytest.mark.slow
+def test_pallas_decode_rejected_on_sp_mesh(tiny_model):
+    """Forced pallas decode on an sp>1 mesh would all-gather the
+    sequence-sharded cache every step — rejected up front."""
+    from llm_based_apache_spark_optimization_tpu.engine.generate import (
+        make_generate_fn,
+    )
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
+    from llm_based_apache_spark_optimization_tpu.parallel import make_mesh
+
+    cfg, _ = tiny_model
+    mesh = make_mesh(dp=1, sp=2, tp=2, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="sp>1"):
+        make_generate_fn(cfg, 8, SamplingParams(), (-1,), mesh,
+                         attn_impl="pallas")

@@ -143,3 +143,22 @@ def test_vocab_sharded_tables_parity(tiny_model):
         eng = InferenceEngine(cfg, tree, stop_ids=(-1,), prompt_bucket=8,
                               mesh=mesh)
         assert eng.generate(prompts, max_new_tokens=6) == golden
+
+
+@pytest.mark.slow
+def test_sp_sharded_decode_cache_parity(tiny_model):
+    """Sequence-parallel decode cache (cache_spec shards slots over sp):
+    the capacity lever for long context — an sp-way mesh holds sp x the
+    context one chip fits. Greedy output must match the single-device
+    engine exactly, bf16 AND int8-KV caches, through prefill (ring over
+    sp) and the unrolled decode's in-place sliver writes."""
+    cfg, params = tiny_model
+    prompts = [[1, 5, 9, 2, 8, 4], [1, 7, 3]]
+    mesh = make_mesh(dp=1, sp=2, tp=2, devices=jax.devices()[:4])
+    for kvq in (None, "int8"):
+        golden = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                                 kv_quant=kvq).generate(prompts,
+                                                        max_new_tokens=8)
+        eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                              mesh=mesh, kv_quant=kvq)
+        assert eng.generate(prompts, max_new_tokens=8) == golden, kvq
