@@ -155,6 +155,16 @@ def _make_speculative_generate_fn(
     d1 = draft_len + 1
     sp = dict(mesh.shape).get("sp", 1) if mesh is not None else 1
     pre_impl = "ring" if sp > 1 else prefill_impl
+    if sp > 1 and decode_impl == "pallas":
+        # Same hazard as generate.py's guard: the flash kernel's shard_map
+        # expects S-replicated K/V, and against the sp-sharded cache
+        # (parallel/sharding.cache_spec) every verify round would
+        # all-gather the whole cache.
+        raise ValueError(
+            "attn_impl='pallas' verify/decode cannot run on an sp>1 mesh: "
+            "the sequence-sharded cache would be all-gathered every round; "
+            "use the auto/einsum impl"
+        )
 
     def _is_stop(tok):
         return _is_stop_ids(tok, stop_ids)

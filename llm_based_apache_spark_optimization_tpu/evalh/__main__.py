@@ -20,8 +20,17 @@ import sys
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="evalh")
-    ap.add_argument("--backend", choices=("tiny", "fake", "oracle"),
+    ap.add_argument("--backend", choices=("tiny", "fake", "oracle", "ollama"),
                     default="fake")
+    ap.add_argument("--ollama-url", default="http://127.0.0.1:11434",
+                    metavar="URL",
+                    help="with --backend ollama: score a LIVE Ollama server "
+                         "(the reference's engine) under this instrument — "
+                         "the same tables, reference setup")
+    ap.add_argument("--models", nargs="+", metavar="NAME",
+                    help="restrict suite evaluation to these registered "
+                         "models (essential with --backend ollama: a "
+                         "daemon may host many unrelated local models)")
     ap.add_argument("--configs", nargs="*", metavar="KEY",
                     help="run BASELINE configs (all when no KEY given)")
     ap.add_argument("--spider", metavar="DEV_JSON",
@@ -51,11 +60,16 @@ def main(argv=None) -> None:
     from .fixtures import FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM
     from .harness import evaluate_models, format_summary
 
-    service = {
-        "tiny": lambda: make_tiny_service(args.max_new_tokens),
-        "fake": make_fake_service,
-        "oracle": make_oracle_service,
-    }[args.backend]()
+    if args.backend == "ollama":
+        from ..serve.ollama_client import OllamaClientService
+
+        service = OllamaClientService(args.ollama_url)
+    else:
+        service = {
+            "tiny": lambda: make_tiny_service(args.max_new_tokens),
+            "fake": make_fake_service,
+            "oracle": make_oracle_service,
+        }[args.backend]()
     # Mesh honesty (evalh/configs.run_config): configs naming tp=N get a
     # factory that builds a tp-sharded tiny service when devices exist
     # (with --virtual-devices, virtual CPU ones count).
@@ -113,8 +127,12 @@ def main(argv=None) -> None:
         from .report import make_taxi_exec_backend
 
         exec_backend = make_taxi_exec_backend()
+    models = args.models or service.models()
+    unknown = sorted(set(models) - set(service.models()))
+    if unknown:
+        sys.exit(f"unknown model(s) {unknown}; available: {service.models()}")
     reports = evaluate_models(
-        service, service.models(), cases, system,
+        service, models, cases, system,
         max_new_tokens=args.max_new_tokens, exec_backend=exec_backend,
     )
     print(format_summary(reports))

@@ -257,8 +257,17 @@ def force_virtual_devices(n: int) -> None:
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="evalh.report")
-    ap.add_argument("--backend", choices=("tiny", "fake", "oracle"),
+    ap.add_argument("--backend", choices=("tiny", "fake", "oracle", "ollama"),
                     default="tiny")
+    ap.add_argument("--ollama-url", default="http://127.0.0.1:11434",
+                    metavar="URL",
+                    help="with --backend ollama: report over a LIVE Ollama "
+                         "server — the reference's own engine in the same "
+                         "tables as the in-tree one")
+    ap.add_argument("--models", nargs="+", metavar="NAME",
+                    help="restrict the report to these models (essential "
+                         "with --backend ollama: a daemon may host many "
+                         "unrelated local models)")
     ap.add_argument("--scheduler", action="store_true",
                     help="serve the tiny models through continuous-batching "
                          "schedulers (config 5 then batches concurrent "
@@ -300,12 +309,19 @@ def main(argv=None) -> None:
                 "expected SQL — instrument self-proof: anything below "
                 "100% exact/execution match on the suite tables is a "
                 "harness bug)")
+    elif args.backend == "ollama":
+        from ..serve.ollama_client import OllamaClientService
+
+        service = OllamaClientService(args.ollama_url)
+        desc = (f"LIVE Ollama server at {args.ollama_url} — the reference's "
+                "own engine scored by the in-tree instrument")
     else:
         service = make_fake_service()
         desc = "fake canned backend (contract smoke)"
     text = generate(
         service, backend_desc=desc, max_new_tokens=args.max_new_tokens,
-        quality_meaningful=args.backend == "oracle",
+        models=args.models,
+        quality_meaningful=args.backend in ("oracle", "ollama"),
         timestamp=datetime.datetime.now().strftime("%Y-%m-%d %H:%M"),
         service_factory=factory,
         # Config rows 2/3 are error-analysis workloads with no expected

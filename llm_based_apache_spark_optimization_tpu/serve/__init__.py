@@ -1,6 +1,7 @@
 """Generation service: model registry, prompt templates, backends."""
 
 from .backends import Completion, EngineBackend, FakeBackend  # noqa: F401
+from .ollama_client import OllamaClientService  # noqa: F401
 from .scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     SchedulerBackend,

@@ -1,0 +1,159 @@
+"""Client adapter for a LIVE Ollama server — score the reference's own
+engine with the in-tree instrument.
+
+The reference measures its models by calling `ollama.generate(...)` against
+a local Ollama daemon (reference `Model_Evaluation_&_Comparision.py:69,83`;
+`Flask/app.py:102-107`). This adapter exposes that daemon through the same
+duck-typed service surface the eval harness and BASELINE configs consume
+(`generate` / `generate_batch` / `models` — serve/service.py), so an
+operator with the reference's exact setup can run
+
+    python -m llm_based_apache_spark_optimization_tpu.evalh \
+        --backend ollama --ollama-url http://127.0.0.1:11434
+
+and get the reference engine's quality/latency in the SAME report tables as
+the in-tree TPU engine — the apples-to-apples comparison the reference's
+DOCX tables could never offer its readers.
+
+Wire protocol (the subset ollama-python uses): POST /api/generate with
+{model, prompt, system, stream: false, options:{num_predict, temperature,
+top_p, top_k, seed}}; GET /api/tags for the model list. stdlib urllib only
+— no client library needed, and the in-tree WSGI fake in the tests speaks
+the same two routes.
+
+`generate_batch` loops sequentially on purpose: Ollama serializes requests
+(the reference's own serving behavior — `FastAPI/app.py:85-90` notes), and
+reporting a fake batched wall-clock would flatter it.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.request
+from typing import List, Optional
+
+from .service import GenerateResult
+
+
+class OllamaClientService:
+    """Duck-typed GenerationService over a live Ollama HTTP endpoint."""
+
+    def __init__(self, base_url: str = "http://127.0.0.1:11434",
+                 timeout_s: float = 300.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        # Kept for surface parity with GenerationService consumers that
+        # read .stats (the /models route); remote requests are accounted
+        # by the harness itself.
+        self.stats: dict = {}
+
+    # ----------------------------------------------------------- plumbing
+
+    def _open(self, req) -> dict:
+        # Surface the server's JSON error body ("model 'x' not found",
+        # overload, ...) instead of a bare HTTPError traceback that aborts
+        # a multi-model report with no explanation.
+        import urllib.error
+
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")[:500]
+            raise RuntimeError(
+                f"ollama server returned {e.code} for "
+                f"{getattr(req, 'full_url', req)}: {body}"
+            ) from e
+        except urllib.error.URLError as e:
+            raise RuntimeError(
+                f"cannot reach ollama at {self.base_url}: {e.reason} — is "
+                f"the daemon running (`ollama serve`)?"
+            ) from e
+
+    def _get(self, path: str) -> dict:
+        return self._open(self.base_url + path)
+
+    def _post(self, path: str, payload: dict) -> dict:
+        return self._open(urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        ))
+
+    # ------------------------------------------------------------ surface
+
+    def models(self) -> List[str]:
+        return sorted(m["name"] for m in self._get("/api/tags")
+                      .get("models", []))
+
+    def generate(
+        self,
+        model: str,
+        prompt: str,
+        system: str = "",
+        max_new_tokens: Optional[int] = None,
+        sampling=None,
+        seed: int = 0,
+    ) -> GenerateResult:
+        # sampling=None means GREEDY everywhere in-tree (SamplingParams
+        # defaults temperature=0) — send that explicitly: Ollama's own
+        # default is ~0.8, and letting it sample while the in-tree rows
+        # decode greedily would make the side-by-side table stochastic
+        # and skewed.
+        options: dict = {"seed": seed, "temperature": 0.0}
+        if max_new_tokens is not None:
+            options["num_predict"] = int(max_new_tokens)
+        if sampling is not None:
+            options["temperature"] = float(sampling.temperature)
+            options["top_p"] = float(sampling.top_p)
+            if sampling.top_k:
+                options["top_k"] = int(sampling.top_k)
+        t0 = time.perf_counter()
+        data = self._post("/api/generate", {
+            "model": model,
+            "prompt": prompt,
+            "system": system,
+            "stream": False,
+            "options": options,
+        })
+        latency = time.perf_counter() - t0
+        # eval_count is Ollama's own output-token count; fall back to a
+        # whitespace estimate for servers that omit it.
+        toks = int(data.get("eval_count") or
+                   max(1, len(str(data.get("response", "")).split())))
+        return GenerateResult(
+            response=str(data.get("response", "")),
+            model=model,
+            latency_s=latency,
+            output_tokens=toks,
+        )
+
+    def generate_batch(
+        self,
+        model: str,
+        prompts: List[str],
+        system: str = "",
+        max_new_tokens: Optional[int] = None,
+        sampling=None,
+        seed: int = 0,
+    ) -> List[GenerateResult]:
+        # Sequential on purpose (module docstring): the measured wall IS
+        # the reference engine's serialized serving behavior. Each result
+        # keeps its own latency; the harness sums the chunk wall from
+        # result[0], so stamp every result with the cumulative wall the
+        # way GenerationService's batch path reports the shared wall.
+        results = [
+            self.generate(model, p, system, max_new_tokens, sampling, seed)
+            for p in prompts
+        ]
+        wall = sum(r.latency_s for r in results)
+        return [
+            GenerateResult(response=r.response, model=r.model,
+                           latency_s=wall, output_tokens=r.output_tokens)
+            for r in results
+        ]
+
+    def close(self) -> None:  # surface parity; nothing to shut down
+        pass
