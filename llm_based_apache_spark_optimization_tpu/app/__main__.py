@@ -341,6 +341,14 @@ def main(argv=None) -> None:
         cfg = type(cfg)(**{**cfg.__dict__, "port": args.port})
     cfg.ensure_dirs()
 
+    if cfg.constrain_sql and getattr(args, "speculative", 0) > 0:
+        # Same startup-rejection policy as --kv-int8/--speculative: a
+        # speculative scheduler rejects every constrained submit, so this
+        # combination would turn EVERY CSV upload into a generate-time
+        # failure — fail at launch, not per request.
+        sys.exit("LSOT_CONSTRAIN_SQL cannot combine with --speculative: "
+                 "drafted tokens bypass the grammar mask")
+
     if args.backend == "checkpoint":
         if not args.sql_model_path:
             ap.error("--backend checkpoint requires --sql-model-path")

@@ -68,13 +68,20 @@ def create_api_app(
     @app.route("/api/generate", methods=("POST",))
     def api_generate(req: Request) -> Response:
         """Direct generation endpoint, Ollama wire shape: body
-        `{"model", "prompt", "system"?, "stream"?, "max_new_tokens"?}`.
+        `{"model", "prompt", "system"?, "stream"?, "max_new_tokens"?,
+        "constrain"?}`.
         stream=false (default) returns `{"model", "response", "done": true}`
         in one JSON object; stream=true returns NDJSON lines
         `{"model", "response": <chunk>, "done": false}` flushed per chunk,
         terminated by `{"model", "done": true}` — tokens arrive live from
         the continuous-batching scheduler. The reference app only ever
-        called the blocking form (`FastAPI/app.py:85-90`)."""
+        called the blocking form (`FastAPI/app.py:85-90`).
+
+        `constrain` opts into grammar-constrained decoding: the string
+        "spark_sql" (generic SELECT subset) or
+        `{"table": ..., "columns": [...]}` (schema-aware: the model cannot
+        emit identifiers outside the schema). The completion is then
+        guaranteed to parse under the in-tree grammar (constrain/)."""
         try:
             data = req.json()
         except Exception:
@@ -98,6 +105,33 @@ def create_api_app(
                 {"error": "'max_new_tokens' must be a positive integer"},
                 status=400,
             )
+        constrain = data.get("constrain")
+        if constrain is not None and not (
+            constrain == "spark_sql"
+            or (isinstance(constrain, dict)
+                # Exactly the documented keys, at least one present: a
+                # typo'd dict ({"Table": ...}) would otherwise pass on
+                # get() defaults and silently compile the GENERIC grammar
+                # while the client believes schema constraining is on.
+                and constrain
+                and set(constrain) <= {"table", "columns"}
+                and isinstance(constrain.get("table", ""), str)
+                and isinstance(constrain.get("columns", []), list)
+                # Present-but-empty columns would silently compile the
+                # GENERIC grammar while the client believes its schema is
+                # locked.
+                and constrain.get("columns", ["_"]) != []
+                # Every column must be a string: a non-string entry would
+                # only explode deep in grammar compilation as a 500 (or a
+                # mid-stream error line) instead of this 400.
+                and all(isinstance(c, str)
+                        for c in constrain.get("columns", [])))
+        ):
+            return Response.json(
+                {"error": "'constrain' must be \"spark_sql\" or "
+                          "{\"table\": ..., \"columns\": [...str...]}"},
+                status=400,
+            )
         # Resolve the model BEFORE streaming: once the NDJSON generator is
         # returned, 200 headers are already on the wire and a late KeyError
         # could only abort the body — the 404 must fire here.
@@ -110,24 +144,27 @@ def create_api_app(
         try:
             if not data.get("stream", False):
                 res = service.generate(
-                    model, prompt, system=system, max_new_tokens=max_new
+                    model, prompt, system=system, max_new_tokens=max_new,
+                    constrain=constrain,
                 )
                 return Response.json({
                     "model": model, "response": res.response, "done": True,
                 })
 
             # Pre-validate the request shape (oversize prompt / no decode
-            # room) while a 400 is still possible: the generator below runs
-            # AFTER 200 headers are sent, where the identical ValueError
-            # could only become a mid-stream error line — and the blocking
-            # branch of this same endpoint answers 400.
+            # room / unsupported-or-uncompilable constrain spec) while a
+            # 400 is still possible: the generator below runs AFTER 200
+            # headers are sent, where the identical ValueError could only
+            # become a mid-stream error line — and the blocking branch of
+            # this same endpoint answers 400.
             service.validate(model, prompt, system=system,
-                             max_new_tokens=max_new)
+                             max_new_tokens=max_new, constrain=constrain)
 
             def chunks():
                 try:
                     for piece in service.generate_stream(
-                        model, prompt, system=system, max_new_tokens=max_new
+                        model, prompt, system=system, max_new_tokens=max_new,
+                        constrain=constrain,
                     ):
                         yield {"model": model, "response": piece,
                                "done": False}

@@ -27,6 +27,12 @@ class AppConfig:
     host: str = "127.0.0.1"
     port: int = 8000
     max_new_tokens: int = 256
+    # Grammar-constrained NL→SQL (constrain/): the pipeline compiles the
+    # uploaded CSV's schema into the decoder's identifier grammar, so the
+    # SQL model cannot emit a column that is not in the table. Opt-in
+    # (LSOT_CONSTRAIN_SQL=1): only engine/scheduler backends support it —
+    # fake/demo backends would reject the request.
+    constrain_sql: bool = False
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":
@@ -36,7 +42,12 @@ class AppConfig:
             env = os.environ.get(f"LSOT_{name.upper()}")
             if env is not None:
                 default = getattr(cls, name)
-                kwargs[name] = type(default)(env)
+                if isinstance(default, bool):
+                    # bool("false") is True — parse flag strings properly.
+                    kwargs[name] = env.strip().lower() in ("1", "true",
+                                                           "yes", "on")
+                else:
+                    kwargs[name] = type(default)(env)
         kwargs.update(overrides)
         return cls(**kwargs)
 

@@ -101,6 +101,49 @@ class Pipeline:
         result.table_schema = schema.prompt_lines()
 
         status("processing", ST_GEN)
+        # Schema-aware constrained decoding (opt-in, constrain/): the SAME
+        # schema string that seeds the prompt is compiled into the
+        # decoder's identifier grammar — the model cannot hallucinate a
+        # column that is not in the uploaded table, and the L3
+        # error-diagnosis path stops being the only defense against
+        # unparseable SQL.
+        constrain = None
+        if cfg.constrain_sql:
+            from ..constrain.grammar import is_constrainable_identifier
+
+            # Only identifier-shaped headers can enter the grammar (a CSV
+            # column like "Trip Distance" is quoted by the SQL backend but
+            # cannot be emitted unambiguously by the decoder); with no
+            # usable column the run degrades to unconstrained rather than
+            # failing the request.
+            # The view name enters the grammar's table branch exactly like
+            # columns enter the identifier branch — same shape rule, same
+            # degrade-to-unconstrained policy (LSOT_VIEW_NAME is
+            # env-settable; a reserved or quoted-only name must not turn
+            # every upload into a deep compile error).
+            if not is_constrainable_identifier(cfg.view_name):
+                log.warning(
+                    "constrain_sql: view name %r is not identifier-shaped; "
+                    "generating unconstrained", cfg.view_name,
+                )
+            else:
+                cols = [c for c in schema.columns
+                        if is_constrainable_identifier(c)]
+                dropped = [c for c in schema.columns if c not in cols]
+                if dropped:
+                    # Loud either way: a dropped column is UNSPELLABLE
+                    # under the grammar, so questions about it will be
+                    # answered with confidently wrong SQL over the
+                    # remaining columns.
+                    log.warning(
+                        "constrain_sql: column(s) %s in %s are not "
+                        "identifier-shaped and cannot enter the grammar — "
+                        "the model cannot reference them%s",
+                        dropped, file_name,
+                        "" if cols else "; generating unconstrained",
+                    )
+                if cols:
+                    constrain = {"table": cfg.view_name, "columns": cols}
         # §2.2 NL→SQL system prompt, verbatim (FastAPI/app.py:85-89).
         res = self.service.generate(
             model=cfg.sql_model,
@@ -110,6 +153,7 @@ class Pipeline:
             ),
             prompt=input_text,
             max_new_tokens=cfg.max_new_tokens,
+            constrain=constrain,
         )
         result.sql_query = res.response
         status("processing", ST_GEN_OK)

@@ -1,0 +1,52 @@
+"""Grammar-constrained SQL decoding: the engine can only emit valid Spark SQL.
+
+The reference pipeline *hopes* the model emits executable SQL and routes
+the Spark stack trace to a second LLM when it doesn't (PAPER.md L3). This
+subsystem replaces hope with a guarantee: a compact Spark-SQL SELECT
+grammar is compiled to a token-level DFA whose per-state vocabulary masks
+ride the decode loops as precomputed device tables — sampling simply
+cannot pick a token that leaves the language, and budget-aware "closing"
+masks steer every completion to a full parse before the token budget runs
+out.
+
+Layering (each module's docstring carries the detail):
+
+    dfa.py      regex combinators -> NFA -> trimmed char DFA (+ difference)
+    grammar.py  the SELECT subset; generic or schema-aware identifiers
+    parser.py   independent recursive-descent oracle (evalh validity metric)
+    masks.py    tokenizer classification -> [states, vocab] mask tables,
+                shortest-distance closing rows, per-process compile cache
+
+Integration points: ops/sampling.apply_token_mask, the constrained branch
+of engine/generate, per-slot FSM state in serve/scheduler, the
+`constrain="spark_sql"` request field in serve/service + app/api, and
+grammar-valid%/executable% scoring in evalh.
+"""
+
+from .dfa import CharDfa, compile_dfa, difference
+from .grammar import RESERVED, grammar_fingerprint, spark_sql_dfa
+from .masks import (
+    CompiledMask,
+    ConstraintSpec,
+    compile_token_masks,
+    get_constraint,
+    trivial_tables,
+)
+from .parser import SqlSyntaxError, is_valid_spark_sql, parse_spark_sql
+
+__all__ = [
+    "CharDfa",
+    "CompiledMask",
+    "ConstraintSpec",
+    "RESERVED",
+    "SqlSyntaxError",
+    "compile_dfa",
+    "compile_token_masks",
+    "difference",
+    "get_constraint",
+    "grammar_fingerprint",
+    "is_valid_spark_sql",
+    "parse_spark_sql",
+    "spark_sql_dfa",
+    "trivial_tables",
+]

@@ -1,0 +1,345 @@
+"""Token-level vocabulary masks: the char DFA lifted onto a tokenizer.
+
+This is the layer the decode loops actually consume. Compilation happens
+ONCE per (tokenizer, grammar, stop-ids) triple — cached in-module — and
+produces four dense tables over DFA states S and tokenizer vocab V:
+
+    mask[s, t]        True iff emitting token t from state s keeps the
+                      automaton alive (a completion still exists)
+    next_state[s, t]  the state after emitting t (frozen for dead pairs)
+    dist[s]           tokens on the shortest path from s to an accepting
+                      state (0 at accepting)
+    need[s, t]        tokens required to FINISH if t is emitted now:
+                      1 + dist[next] + 1 (one for t, the shortest path to
+                      accept, one for the stop id), or exactly 1 for a
+                      stop id at an accepting state; huge for dead pairs.
+                      The decode-time mask is just `need <= remaining
+                      budget` — a token that would start an identifier too
+                      long to ever close is masked the moment it stops
+                      fitting, which guarantees every constrained
+                      completion is a COMPLETE parse (never a truncated
+                      prefix) whenever max_new >= min_new_tokens. A plain
+                      "switch to strict-progress tokens near the end" rule
+                      is NOT sound: one token can grow the distance by
+                      dozens (the first byte of a long column name), and
+                      by the next step the budget can no longer cover it.
+
+Row 0 of every table is the reserved UNCONSTRAINED sentinel (all tokens
+allowed, self-loop, dist 0): a state value of 0 means "no grammar", which
+is what lets the continuous-batching scheduler serve mixed
+constrained/unconstrained batches from ONE compiled decode program — the
+per-slot state is just an int32, and unconstrained slots sit at 0.
+
+Per-token classification is vectorized (numpy transition-matrix
+composition over the token's characters, all states at once), so even a
+32k-token BPE vocabulary classifies in seconds — and it happens at load
+time, never in the decode hot loop. The per-step cost in the loops is two
+table gathers on device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Dict, Iterable, Optional, Tuple, Union
+
+import numpy as np
+
+from .dfa import CharDfa
+from .grammar import grammar_fingerprint, spark_sql_dfa
+
+_INF = np.int64(1) << 40
+
+#: Compile-count observability: tests assert precompute happens once per
+#: (tokenizer, grammar) pair and NEVER in the decode loop.
+COMPILE_COUNT = 0
+
+_cache_lock = threading.Lock()
+#: LRU-bounded: schema grammars arrive one per distinct uploaded CSV on a
+#: long-running server, and each entry holds multi-MB [S, V] tables (plus
+#: per-width device copies) — unbounded growth would be a slow OOM. 16
+#: matches spark_sql_dfa's char-DFA cache; eviction only costs a recompile
+#: on a schema not seen for 16 schemas.
+_CACHE_MAX = 16
+_constraint_cache: "OrderedDict[tuple, CompiledMask]" = OrderedDict()
+
+
+@dataclasses.dataclass
+class CompiledMask:
+    """Precomputed token tables for one (grammar, tokenizer, eos) triple.
+
+    All arrays are host numpy, over S = char-DFA states + 1 (row 0 is the
+    unconstrained sentinel) and V = tokenizer.vocab_size. `device_tables`
+    pads to a model's logits width and moves them on device (cached per
+    width)."""
+
+    fingerprint: str
+    init_state: int                 # >= 1; 0 is the unconstrained sentinel
+    mask: np.ndarray                # [S, V] bool
+    next_state: np.ndarray          # [S, V] int32
+    dist: np.ndarray                # [S] int64
+    need: np.ndarray                # [S, V] int64 (tokens to finish via t)
+    eos_ids: Tuple[int, ...]
+
+    def __post_init__(self):
+        self._device: Dict[int, Dict[str, object]] = {}
+
+    @property
+    def num_states(self) -> int:
+        return self.mask.shape[0]
+
+    @property
+    def tok_vocab(self) -> int:
+        return self.mask.shape[1]
+
+    @property
+    def min_new_tokens(self) -> int:
+        """Smallest budget that can hold a complete parse + stop token."""
+        return int(self.dist[self.init_state]) + 1
+
+    def walk(self, token_ids: Iterable[int]) -> Optional[int]:
+        """Host-side FSM advance (diagnostics/tests): final state after the
+        ids, or None the moment a token leaves the language."""
+        s = self.init_state
+        for t in token_ids:
+            t = int(t)
+            if t >= self.tok_vocab or not self.mask[s, t]:
+                return None
+            s = int(self.next_state[s, t])
+        return s
+
+    def device_tables(self, vocab_size: int) -> Dict[str, object]:
+        """(next, need) as jnp arrays padded to the model's logits width;
+        computed once per width and cached on the object. The decode loops
+        need ONLY these two: the per-step mask is `need[state] <=
+        remaining`, which already implies aliveness (dead pairs carry a
+        huge need)."""
+        cached = self._device.get(vocab_size)
+        if cached is not None:
+            return cached
+        if vocab_size < self.tok_vocab:
+            raise ValueError(
+                f"model vocab {vocab_size} < tokenizer vocab {self.tok_vocab}"
+            )
+        import jax.numpy as jnp
+
+        s, v = self.mask.shape
+        big = np.int32(2**30)
+        need = np.full((s, vocab_size), big, np.int32)
+        need[:, :v] = np.minimum(self.need, big).astype(np.int32)
+        need[0, :] = 1  # sentinel row: everything allowed at any budget
+        nxt = np.broadcast_to(
+            np.arange(s, dtype=np.int32)[:, None], (s, vocab_size)
+        ).copy()  # out-of-tokenizer ids freeze the state (they're masked)
+        nxt[:, :v] = self.next_state
+        nxt[0, :] = 0
+        tables = {
+            "next": jnp.asarray(nxt),
+            "need": jnp.asarray(need),
+        }
+        self._device[vocab_size] = tables
+        return tables
+
+
+def trivial_tables(vocab_size: int) -> Dict[str, object]:
+    """Single-sentinel-row tables for a scheduler with no grammar
+    installed: every slot sits at state 0, everything is allowed."""
+    import jax.numpy as jnp
+
+    return {
+        "next": jnp.zeros((1, vocab_size), jnp.int32),
+        "need": jnp.ones((1, vocab_size), jnp.int32),
+    }
+
+
+def compile_token_masks(
+    dfa: CharDfa,
+    tokenizer,
+    eos_ids: Iterable[int],
+    fingerprint: str = "",
+) -> CompiledMask:
+    """Classify every tokenizer id against the char DFA and build the
+    decode tables. Pure host precompute — the only pass that ever iterates
+    the vocabulary."""
+    global COMPILE_COUNT
+    COMPILE_COUNT += 1
+
+    n = dfa.num_states
+    sink = n
+    alphabet = sorted(dfa.alphabet)
+    aidx = {ch: i for i, ch in enumerate(alphabet)}
+    trans = np.full((n + 1, len(alphabet)), sink, np.int32)
+    for s, t in enumerate(dfa.trans):
+        for ch, j in t.items():
+            trans[s, aidx[ch]] = j
+
+    vocab = int(tokenizer.vocab_size)
+    eos = tuple(sorted({int(e) for e in eos_ids if 0 <= int(e) < vocab}))
+    if not eos:
+        raise ValueError(
+            "constrained decoding needs at least one stop id inside the "
+            f"tokenizer vocabulary (got {tuple(eos_ids)!r}, vocab {vocab})"
+        )
+
+    # Vectorized classification: compose the char transition matrix over
+    # each token's text for ALL states at once. f maps state-before ->
+    # state-after; sink rows stay sink.
+    next_c = np.full((n, vocab), -1, np.int32)
+    identity = np.arange(n + 1, dtype=np.int32)
+    for tid in range(vocab):
+        text = tokenizer.decode([tid])
+        if not text:
+            continue  # specials (bos/pad/eos) have no char expansion
+        cols = [aidx.get(ch) for ch in text]
+        if any(c is None for c in cols):
+            continue  # contains a char outside the grammar alphabet
+        f = identity
+        for c in cols:
+            f = trans[f, c]
+        live = f[:n]
+        next_c[:, tid] = np.where(live == sink, -1, live)
+
+    mask = next_c >= 0
+    accepting = np.zeros(n, bool)
+    accepting[list(dfa.accepting)] = True
+
+    # Stop ids: allowed exactly at accepting states; the state self-loops
+    # so anything decoded past the stop (overshoot rounds) stays closing.
+    acc_idx = np.where(accepting)[0]
+    for e in eos:
+        mask[acc_idx, e] = True
+        next_c[acc_idx, e] = acc_idx
+
+    # Shortest token-distance to an accepting state (Bellman-Ford to a
+    # fixpoint; the graph is tiny). Unreachable states keep _INF and every
+    # edge into them is pruned below, so surviving transitions always
+    # leave a path to completion.
+    dist = np.where(accepting, np.int64(0), _INF)
+    safe_next = np.clip(next_c, 0, None)
+    while True:
+        nd = np.where(mask, dist[safe_next], _INF)
+        cand = 1 + nd.min(axis=1)
+        new = np.minimum(dist, cand)
+        if np.array_equal(new, dist):
+            break
+        dist = new
+    live_state = dist < _INF
+    mask &= live_state[safe_next]
+    start_live = live_state[dfa.start]
+    if not start_live:
+        raise ValueError(
+            "no token path from the grammar start to an accepting state — "
+            "the tokenizer cannot spell this grammar"
+        )
+
+    # Tokens-to-finish table: emitting t costs 1 token, then the shortest
+    # path to accept, then 1 stop id — except a stop id AT an accepting
+    # state, which finishes in exactly its own 1 token. `need <= remaining`
+    # is the whole decode-time mask (dead pairs carry ~INF), and it is what
+    # makes the completion guarantee hold under ANY budget >=
+    # min_new_tokens: a token whose completion no longer fits is masked
+    # the moment that becomes true, not a step too late.
+    need = np.where(mask, 2 + dist[safe_next], _INF)
+    for e in eos:
+        need[acc_idx, e] = 1
+
+    # Freeze dead transitions on the state itself (they are masked out, but
+    # a frozen target keeps any stray gather harmless), then prepend the
+    # unconstrained sentinel as row 0 and shift real states by +1.
+    states = np.arange(n, dtype=np.int32)[:, None]
+    next_c = np.where(mask, next_c, states)
+
+    full_mask = np.vstack([np.ones((1, vocab), bool), mask])
+    full_next = np.vstack(
+        [np.zeros((1, vocab), np.int32), (next_c + 1).astype(np.int32)]
+    )
+    full_need = np.vstack(
+        [np.ones((1, vocab), np.int64), need]
+    )
+    full_dist = np.concatenate(
+        [np.zeros(1, np.int64), np.where(live_state, dist, 0)]
+    )
+    return CompiledMask(
+        fingerprint=fingerprint,
+        init_state=dfa.start + 1,
+        mask=full_mask,
+        next_state=full_next,
+        dist=full_dist,
+        need=full_need,
+        eos_ids=eos,
+    )
+
+
+#: Specs accepted by get_constraint: the well-known grammar name, or a
+#: schema mapping {"table": ..., "columns": [...]}.
+ConstraintSpec = Union[str, dict, CompiledMask]
+
+
+def _normalize_spec(spec: ConstraintSpec) -> Tuple[str, Optional[str],
+                                                   Optional[Tuple[str, ...]]]:
+    if isinstance(spec, str):
+        if spec != "spark_sql":
+            raise ValueError(
+                f"unknown constraint grammar {spec!r}; known: 'spark_sql'"
+            )
+        return grammar_fingerprint(), None, None
+    if isinstance(spec, dict):
+        table = spec.get("table")
+        cols = spec.get("columns")
+        if cols is not None and not cols:
+            # An explicitly-empty column list would silently fall through
+            # to the GENERIC grammar — the caller clearly meant to
+            # schema-lock and must hear that nothing was locked.
+            raise ValueError(
+                "constrain 'columns' must be non-empty when given "
+                "(omit the key for the generic grammar)"
+            )
+        columns = tuple(cols) if cols else None
+        return grammar_fingerprint(table, columns), table, columns
+    raise TypeError(f"bad constraint spec: {spec!r}")
+
+
+def _tokenizer_key(tokenizer) -> tuple:
+    """Cache identity for a tokenizer: an explicit `cache_key` attribute
+    wins; otherwise class + vocab shape + special ids (exact for the
+    in-tree byte tokenizer; documented-best-effort for external vocabs)."""
+    explicit = getattr(tokenizer, "cache_key", None)
+    if explicit is not None:
+        return ("explicit", explicit)
+    return (
+        type(tokenizer).__name__,
+        int(tokenizer.vocab_size),
+        int(getattr(tokenizer, "bos_id", -1)),
+        int(getattr(tokenizer, "eos_id", -1)),
+        int(getattr(tokenizer, "pad_id", -1)),
+    )
+
+
+def get_constraint(
+    spec: ConstraintSpec,
+    tokenizer,
+    eos_ids: Iterable[int],
+) -> CompiledMask:
+    """Resolve a constraint spec to compiled tables, compiling at most once
+    per (tokenizer, grammar, stop-ids) triple for the process lifetime."""
+    if isinstance(spec, CompiledMask):
+        return spec
+    fingerprint, table, columns = _normalize_spec(spec)
+    vocab = int(tokenizer.vocab_size)
+    eos = tuple(sorted({int(e) for e in eos_ids if 0 <= int(e) < vocab}))
+    key = (_tokenizer_key(tokenizer), fingerprint, eos)
+    with _cache_lock:
+        cached = _constraint_cache.get(key)
+        if cached is not None:
+            _constraint_cache.move_to_end(key)  # LRU touch
+            return cached
+    compiled = compile_token_masks(
+        spark_sql_dfa(table, columns), tokenizer, eos, fingerprint
+    )
+    with _cache_lock:
+        kept = _constraint_cache.setdefault(key, compiled)
+        _constraint_cache.move_to_end(key)
+        while len(_constraint_cache) > _CACHE_MAX:
+            _constraint_cache.popitem(last=False)
+        return kept

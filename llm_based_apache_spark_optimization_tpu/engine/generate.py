@@ -34,7 +34,7 @@ from jax import lax
 from ..models.configs import LlamaConfig
 from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
-from ..ops.sampling import SamplingParams, sample
+from ..ops.sampling import SamplingParams, apply_token_mask, sample
 from ..parallel.sharding import constrain_cache, shard_batch, shard_params
 from .kvcache import bucket_len, init_cache
 
@@ -54,6 +54,7 @@ def make_generate_fn(
     mesh=None,
     attn_impl: Optional[str] = None,
     kv_quant: Optional[str] = None,
+    constrained: bool = False,
 ):
     """Resolve the attention impl *outside* the cache boundary so a
     set_attention_impl() flip between calls maps to a different cache key
@@ -78,12 +79,22 @@ def make_generate_fn(
     bytes (decode is cache-streaming-bound at long context). Decodes via
     the einsum impl (auto default) or, when forced, the int8-streaming
     flash kernel (flash_gqa_attention_quantized).
+
+    `constrained=True` returns a fn taking two extra traced arguments —
+    `(next, need)` grammar tables from
+    constrain.CompiledMask.device_tables, plus `init_states [B]` — and
+    runs the grammar FSM ON DEVICE: every step gathers the state's
+    precomputed tokens-to-finish row, masks out entries that no longer
+    fit the remaining budget, and advances the state by one
+    [state, token] gather. No host round-trip, no per-token Python over
+    the vocab, still ONE XLA program.
     """
     return _make_generate_fn(
         cfg, max_new, sampling, stop_ids, mesh,
         attn_impl or attention_impl(mesh),
         attn_impl or decode_attention_impl(mesh),
         kv_quant,
+        constrained,
     )
 
 
@@ -97,6 +108,7 @@ def _make_generate_fn(
     attn_impl: str,
     decode_impl: str,
     kv_quant: Optional[str] = None,
+    constrained: bool = False,
 ):
     """Build + jit a generate function for a fixed decode-budget cap and sampler.
 
@@ -148,6 +160,8 @@ def _make_generate_fn(
         lengths: jnp.ndarray,
         budget: jnp.ndarray,
         key: jax.Array,
+        grammar=None,       # (next [S,V] i32, need [S,V] i32) device tables
+        init_states=None,   # [B] int32 DFA start states (0 = unconstrained)
     ):
         b, t = tokens.shape
         # The output buffer and cache are sized for the compile-time cap; a
@@ -166,7 +180,18 @@ def _make_generate_fn(
             cfg, params, tokens, positions, cache,
             logit_indices=lengths - 1, attn_impl=prefill_impl, mesh=mesh,
         )
-        first = sample(logits[:, 0], sampling, jax.random.fold_in(key, 0))
+        first_logits = logits[:, 0]
+        if constrained:
+            g_next, g_need = grammar
+            # The first token is constrained too (otherwise one free token
+            # breaks the guarantee): a token is allowed iff the tokens it
+            # commits to — itself, the shortest completion after it, the
+            # stop id — fit the whole budget (g_need table, masks.py).
+            first_logits = apply_token_mask(
+                first_logits, g_need[init_states] <= budget
+            )
+        first = sample(first_logits, sampling, jax.random.fold_in(key, 0))
+        cstate = g_next[init_states, first] if constrained else None
         done = _is_stop(first, stop_ids)
         out = jnp.full((b, max_new), pad_id, jnp.int32)
         out = out.at[:, 0].set(first)
@@ -187,23 +212,46 @@ def _make_generate_fn(
                 cache = constrain_cache(cache, mesh)
 
         def cond(carry):
-            out, cur, pos, done, cache, step = carry
+            done, step = carry[3], carry[5]
             return (step < budget) & ~jnp.all(done)
 
         def body(carry):
-            out, cur, pos, done, cache, step = carry
+            out, cur, pos, done, cache, step = carry[:6]
             logits, cache = forward(
                 cfg, dec_params, cur[:, None], pos[:, None], cache,
                 attn_impl=decode_impl, mesh=mesh,
             )
-            nxt = sample(logits[:, 0], sampling, jax.random.fold_in(key, step))
+            step_logits = logits[:, 0]
+            if constrained:
+                cstate = carry[6]
+                # A token is allowed iff its completion still fits the
+                # remaining budget (need table): tokens that merely keep
+                # the DFA alive but can no longer close in time drop out
+                # exactly when that becomes true, so the guarantee holds
+                # for any budget >= the grammar's shortest parse. One
+                # gather + one compare per step.
+                rem = budget - step
+                step_logits = apply_token_mask(
+                    step_logits, g_need[cstate] <= rem
+                )
+            nxt = sample(step_logits, sampling, jax.random.fold_in(key, step))
             nxt = jnp.where(done, pad_id, nxt)
+            tail = ()
+            if constrained:
+                # Finished rows freeze their state (their pad fill must not
+                # walk the FSM); live rows advance one [state, token]
+                # gather — the whole per-step grammar cost.
+                tail = (jnp.where(done, cstate, g_next[cstate, nxt]),)
             done = done | _is_stop(nxt, stop_ids)
             out = lax.dynamic_update_slice(out, nxt[:, None], (0, step))
-            return (out, nxt, pos + 1, done, cache, step + 1)
+            return (out, nxt, pos + 1, done, cache, step + 1) + tail
 
-        carry = (out, first, lengths.astype(jnp.int32), done, cache, jnp.int32(1))
-        out, _, _, done, _, _ = lax.while_loop(cond, body, carry)
+        carry = (out, first, lengths.astype(jnp.int32), done, cache,
+                 jnp.int32(1))
+        if constrained:
+            carry = carry + (cstate,)
+        final = lax.while_loop(cond, body, carry)
+        out, done = final[0], final[3]
 
         stops = _is_stop(out, stop_ids)
         gen_lens = jnp.where(
@@ -295,9 +343,16 @@ class InferenceEngine:
         max_new_tokens: int = 256,
         sampling: SamplingParams = SamplingParams(),
         seed: int = 0,
+        constraint=None,  # constrain.CompiledMask: grammar-masked decode
     ) -> List[List[int]]:
         assert prompts and all(len(p) >= 1 for p in prompts), "empty prompt"
         b = len(prompts)
+        if constraint is not None and max_new_tokens < constraint.min_new_tokens:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} cannot hold a complete "
+                f"constrained parse (grammar needs >= "
+                f"{constraint.min_new_tokens} tokens incl. the stop id)"
+            )
         t = self.padded_prompt_len(max(len(p) for p in prompts))
         if t + max_new_tokens > self.cfg.max_seq_len:
             raise ValueError(
@@ -318,7 +373,12 @@ class InferenceEngine:
             tokens, lengths = shard_batch((tokens, lengths), self.mesh)
         cap = min(bucket_len(int(max_new_tokens), self.new_bucket),
                   self.cfg.max_seq_len - t)
-        if self.speculative_draft > 0 and sampling.is_greedy:
+        if (self.speculative_draft > 0 and sampling.is_greedy
+                and constraint is None):
+            # Constrained requests take the vanilla loop: the speculative
+            # verify window has no grammar-mask path (drafted tokens would
+            # need per-position FSM states), and dropping the guarantee
+            # silently would defeat the subsystem's whole point.
             from .speculative import make_speculative_generate_fn
 
             fn = make_speculative_generate_fn(
@@ -334,10 +394,19 @@ class InferenceEngine:
             fn = make_generate_fn(
                 self.cfg, cap, sampling, self.stop_ids, self.mesh,
                 kv_quant=self.kv_quant,
+                constrained=constraint is not None,
             )
-            out, gen_lens = fn(
+            args = [
                 self.params, tokens, lengths, jnp.int32(max_new_tokens),
                 jax.random.key(seed),
-            )
+            ]
+            if constraint is not None:
+                tabs = constraint.device_tables(self.cfg.vocab_size)
+                args += [
+                    (tabs["next"], tabs["need"]),
+                    jnp.full((tokens.shape[0],), constraint.init_state,
+                             jnp.int32),
+                ]
+            out, gen_lens = fn(*args)
         out, gen_lens = jax.device_get(out), jax.device_get(gen_lens)
         return [list(map(int, out[i, : gen_lens[i]])) for i in range(b)]

@@ -53,14 +53,19 @@ from jax import lax
 from ..models.configs import LlamaConfig
 from ..models.llama import _UNROLL_MAX_T, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
+from ..parallel.sharding import constrain_cache
+from .kvcache import init_cache
 
 # Measured cost of one T=D+1 verify round relative to a T=1 decode step
 # (module docstring): the single source for every est_speedup_vs_vanilla
 # figure (scheduler speculation_stats, bench speculative block) — re-measure
-# here, and both surfaces move together.
+# here, and both surfaces move together. The measurement is from ONE shape
+# (VERIFY_COST_CALIBRATION below); at other shapes — 7B, int8/int4, TP
+# meshes, different draft lengths — the verify(T=D+1)/decode(T=1) ratio
+# will differ, so /metrics labels the estimate with its calibration point
+# instead of presenting it as universal (ADVICE.md r5 #3).
 VERIFY_COST_RATIO = 1.6
-from ..parallel.sharding import constrain_cache
-from .kvcache import init_cache
+VERIFY_COST_CALIBRATION = "1B bench shape (v5e, bench-1b, B=8, D=8)"
 
 
 def ngram_draft(

@@ -35,6 +35,10 @@ def main(argv=None) -> None:
                     help="run BASELINE configs (all when no KEY given)")
     ap.add_argument("--spider", metavar="DEV_JSON",
                     help="evaluate on real Spider data at this path")
+    ap.add_argument("--constrain", action="store_true",
+                    help="decode under the in-tree Spark-SQL grammar "
+                         "(constrain/): every completion is guaranteed to "
+                         "parse — engine/scheduler backends only")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--virtual-devices", type=int, default=0, metavar="N",
@@ -60,6 +64,15 @@ def main(argv=None) -> None:
     from .fixtures import FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM
     from .harness import evaluate_models, format_summary
 
+    if args.constrain and args.backend != "tiny":
+        # Token masks need the in-tree decode loop: a remote Ollama daemon
+        # cannot be masked, and the canned fake/oracle backends have no
+        # decode loop at all. Fail clearly up front instead of letting the
+        # forwarded kwarg become a mid-run TypeError/ValueError traceback.
+        sys.exit("--constrain needs the in-tree decode loop "
+                 "(--backend tiny, or real checkpoints via the app); "
+                 f"--backend {args.backend} cannot be token-masked")
+
     if args.backend == "ollama":
         from ..serve.ollama_client import OllamaClientService
 
@@ -79,6 +92,13 @@ def main(argv=None) -> None:
     )
 
     if args.configs is not None:
+        if args.constrain:
+            # The BASELINE configs are fixed reproduction scenarios; a
+            # silently ignored --constrain would print unconstrained
+            # numbers under a constrained-looking invocation.
+            sys.exit("--constrain applies to the suite evaluation, not "
+                     "--configs (the BASELINE scenarios are fixed); drop "
+                     "one of the two flags")
         if args.backend == "oracle":
             # Error-analysis configs (2/3) have no expected SQL; the oracle
             # would read 0% there under a banner that says below-100 means
@@ -127,13 +147,19 @@ def main(argv=None) -> None:
         from .report import make_taxi_exec_backend
 
         exec_backend = make_taxi_exec_backend()
-    models = args.models or service.models()
-    unknown = sorted(set(models) - set(service.models()))
+    # ONE models() round trip serves both the default and the unknown-set
+    # check: with --backend ollama each call was an extra HTTP request to
+    # the daemon, and two calls could even disagree if the daemon's model
+    # list changed between them (ADVICE.md r5 #4).
+    available = service.models()
+    models = args.models or available
+    unknown = sorted(set(models) - set(available))
     if unknown:
-        sys.exit(f"unknown model(s) {unknown}; available: {service.models()}")
+        sys.exit(f"unknown model(s) {unknown}; available: {available}")
     reports = evaluate_models(
         service, models, cases, system,
         max_new_tokens=args.max_new_tokens, exec_backend=exec_backend,
+        constrain="spark_sql" if args.constrain else None,
     )
     print(format_summary(reports))
 

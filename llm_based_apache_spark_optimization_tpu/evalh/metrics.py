@@ -70,43 +70,74 @@ def _is_query(sql: str) -> bool:
     )
 
 
-def execution_match(
-    generated: str, expected: str, backend
-) -> Optional[bool]:
-    """Execution accuracy: run both queries on `backend` (sql/backend.py
-    protocol, with the fixture table already loaded) and compare results —
-    column order kept; rows compare as a multiset, EXCEPT when the expected
-    query carries ORDER BY, where row order is part of the asked-for
-    semantics and compares as an ordered list (Spider's test-suite
-    convention).
-
-    Returns None when the EXPECTED query itself fails (the case cannot be
-    judged), False when only the generated query fails or results differ.
-    Non-SELECT statements never execute (see _is_query).
-    """
-    import re
-
-    if not _is_query(expected):
-        return None
-    try:
-        exp = backend.execute(expected)
-    except Exception:
-        return None
+def executes(generated: str, backend) -> bool:
+    """Executability oracle (weaker than execution_match, no expected query
+    needed): does the generated statement RUN on the backend at all? This
+    is the metric grammar-constrained decoding moves directly — a
+    completion that parses under the in-tree grammar should also execute —
+    reported beside grammar-valid% in the constrained-vs-unconstrained
+    tables. Non-SELECT statements never execute (same _is_query guard)."""
     if not _is_query(generated):
         return False
     try:
-        got = backend.execute(generated)
+        backend.execute(generated)
     except Exception:
         return False
+    return True
+
+
+def execution_outcome(
+    generated: str, expected: str, backend
+) -> "tuple[Optional[bool], bool]":
+    """(execution match, generated-executes) with the generated statement
+    run AT MOST ONCE — the harness scores both metrics per case, and a
+    second identical round trip per case doubled the oracle I/O across a
+    suite.
+
+    Match semantics (Spider's test-suite convention): run both queries,
+    compare columns-count + rows — as a multiset, EXCEPT when the expected
+    query carries ORDER BY, where row order is part of the asked-for
+    semantics and compares as an ordered list. None when the EXPECTED
+    query itself fails (the case cannot be judged), False when only the
+    generated query fails or results differ. Non-SELECT statements never
+    execute (see _is_query)."""
+    import re
+
+    got = None
+    if _is_query(generated):
+        try:
+            got = backend.execute(generated)
+            gen_ok = True
+        except Exception:
+            gen_ok = False
+    else:
+        gen_ok = False
+
+    if not _is_query(expected):
+        return None, gen_ok
+    try:
+        exp = backend.execute(expected)
+    except Exception:
+        return None, gen_ok
+    if not gen_ok:
+        return False, False
     if len(got.columns) != len(exp.columns):
-        return False
+        return False, True
 
     def norm(rows):
         return [tuple(_norm_cell(x) for x in r) for r in rows]
 
     if re.search(r"\border\s+by\b", expected, re.IGNORECASE):
-        return norm(got.rows) == norm(exp.rows)
-    return sorted(norm(got.rows)) == sorted(norm(exp.rows))
+        return norm(got.rows) == norm(exp.rows), True
+    return sorted(norm(got.rows)) == sorted(norm(exp.rows)), True
+
+
+def execution_match(
+    generated: str, expected: str, backend
+) -> Optional[bool]:
+    """Execution accuracy alone (see execution_outcome for the shared-run
+    form and the full semantics)."""
+    return execution_outcome(generated, expected, backend)[0]
 
 
 def _edit_distance_dp(a: str, b: str) -> int:

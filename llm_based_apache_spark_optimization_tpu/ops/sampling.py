@@ -39,6 +39,15 @@ def greedy(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def apply_token_mask(logits: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Additive grammar mask: disallowed vocabulary entries drop to NEG_INF
+    BEFORE any sampler runs, so argmax/top-k/top-p/categorical all see the
+    same constrained distribution (constrain/ precomputes `mask` per DFA
+    state; this is the only sampling-side hook it needs). `mask` is [V] or
+    [B, V] bool, True = allowed."""
+    return jnp.where(mask, logits, NEG_INF)
+
+
 def _apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
     return jnp.where(logits < kth, NEG_INF, logits)

@@ -39,6 +39,19 @@ class Completion:
     ttft_s: float = 0.0
 
 
+def resolve_constraint(constrain, tokenizer, stop_ids):
+    """Spec ("spark_sql" / {"table","columns"} / CompiledMask) -> compiled
+    grammar tables for a backend's tokenizer + stop ids; None passes
+    through. get_constraint caches per triple, so repeated requests reuse
+    the same precomputed masks. Shared by EngineBackend and
+    SchedulerBackend — one resolution path, not two drifting copies."""
+    if constrain is None:
+        return None
+    from ..constrain import get_constraint
+
+    return get_constraint(constrain, tokenizer, stop_ids)
+
+
 def trim_stop_texts(text: str, stop_texts: Sequence[str]) -> str:
     """Cut the completion at the first occurrence of any stop string."""
     for stop in stop_texts:
@@ -52,6 +65,9 @@ class EngineBackend:
     """Tokenize → engine.generate → detokenize. Thread-safe: one lock per
     backend serializes device work (the continuous-batching scheduler
     replaces this lock for concurrent serving)."""
+
+    #: GenerationService checks this before forwarding a `constrain=` spec.
+    supports_constrain = True
 
     def __init__(
         self,
@@ -202,13 +218,25 @@ class EngineBackend:
         return cls(engine, tokenizer, **kwargs)
 
     def check_budget(self, prompt: str,
-                     max_new_tokens: Optional[int] = None) -> None:
+                     max_new_tokens: Optional[int] = None,
+                     constraint=None) -> None:
         """Raise ValueError if `prompt` leaves no decode room — the same
         rejection complete() would make, runnable BEFORE any response
         bytes go on the wire (streaming handlers must turn request-shape
-        errors into 400s, which is impossible once 200 headers are sent)."""
+        errors into 400s, which is impossible once 200 headers are sent).
+        With a compiled `constraint`, also checks the CLAMPED budget
+        (after the context-room clamp complete() applies) against the
+        grammar's shortest complete parse."""
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
-        self._room(len(ids))
+        room = self._room(len(ids))
+        if constraint is not None:
+            budget = min(max_new_tokens or self.max_new_tokens, room)
+            if budget < constraint.min_new_tokens:
+                raise ValueError(
+                    f"decode budget {budget} (after the context-room "
+                    f"clamp) cannot hold a complete constrained parse "
+                    f"(grammar needs >= {constraint.min_new_tokens} tokens)"
+                )
 
     def _room(self, n_prompt_tokens: int) -> int:
         cfg = self.engine.cfg
@@ -220,8 +248,13 @@ class EngineBackend:
             )
         return room
 
+    def _resolve_constraint(self, constrain):
+        return resolve_constraint(constrain, self.tokenizer,
+                                  self.engine.stop_ids)
+
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
-                 sampling: Optional[SamplingParams] = None, seed: int = 0) -> Completion:
+                 sampling: Optional[SamplingParams] = None, seed: int = 0,
+                 constrain=None) -> Completion:
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
         # Clamp the decode budget to what fits the model context after the
         # bucketed (and sp-padded, on a sequence-parallel mesh) prompt: a
@@ -235,6 +268,7 @@ class EngineBackend:
                 max_new_tokens=budget,
                 sampling=sampling or self.sampling,
                 seed=seed,
+                constraint=self._resolve_constraint(constrain),
             )[0]
         # Strip the stop token itself from the text.
         if out and out[-1] in self.engine.stop_ids:
@@ -245,6 +279,7 @@ class EngineBackend:
     def complete_batch(
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None, seed: int = 0,
+        constrain=None,
     ) -> List[Completion]:
         """One batched device program for many prompts (BASELINE config 4:
         batch=32 Spider questions) — amortizes weight streaming across the
@@ -260,6 +295,7 @@ class EngineBackend:
             outs = self.engine.generate(
                 ids, max_new_tokens=budget,
                 sampling=sampling or self.sampling, seed=seed,
+                constraint=self._resolve_constraint(constrain),
             )
         completions = []
         for prompt_ids, out in zip(ids, outs):

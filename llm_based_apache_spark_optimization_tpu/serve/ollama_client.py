@@ -140,20 +140,27 @@ class OllamaClientService:
         seed: int = 0,
     ) -> List[GenerateResult]:
         # Sequential on purpose (module docstring): the measured wall IS
-        # the reference engine's serialized serving behavior. Each result
-        # keeps its own latency; the harness sums the chunk wall from
-        # result[0], so stamp every result with the cumulative wall the
-        # way GenerationService's batch path reports the shared wall.
-        results = [
-            self.generate(model, p, system, max_new_tokens, sampling, seed)
-            for p in prompts
-        ]
-        wall = sum(r.latency_s for r in results)
-        return [
-            GenerateResult(response=r.response, model=r.model,
-                           latency_s=wall, output_tokens=r.output_tokens)
-            for r in results
-        ]
+        # the reference engine's serialized serving behavior. Request i's
+        # submitted-together latency is therefore the CUMULATIVE wall
+        # through i (it waited for requests 0..i-1 first), not the whole
+        # chunk's sum — stamping every member with the total inflated the
+        # reference engine's avg_latency_s ~batch/2x in the side-by-side
+        # tables this adapter exists to keep honest (ADVICE.md r5 #1).
+        # Contract the harness reads: results[-1].latency_s IS the chunk
+        # wall (equals the shared batch wall GenerationService stamps on
+        # every member), which evaluate_model_batched sums for
+        # aggregate tok/s.
+        results: List[GenerateResult] = []
+        wall = 0.0
+        for p in prompts:
+            r = self.generate(model, p, system, max_new_tokens, sampling,
+                              seed)
+            wall += r.latency_s
+            results.append(GenerateResult(
+                response=r.response, model=r.model, latency_s=wall,
+                output_tokens=r.output_tokens,
+            ))
+        return results
 
     def close(self) -> None:  # surface parity; nothing to shut down
         pass

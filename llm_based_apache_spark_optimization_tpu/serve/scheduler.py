@@ -114,11 +114,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..constrain.masks import CompiledMask, trivial_tables
 from ..engine.kvcache import bucket_len, init_cache
 from ..models.configs import LlamaConfig
 from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
-from ..ops.sampling import SamplingParams, sample_runtime
+from ..ops.sampling import SamplingParams, apply_token_mask, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
 
 _log = logging.getLogger("lsot.scheduler")
@@ -172,6 +173,10 @@ class _Request:
     # of the budget into an abandoned consumer (client disconnects must not
     # pin slots).
     cancelled: bool = False
+    # Grammar-constrained decoding (constrain.CompiledMask): the slot's
+    # on-device DFA state starts at constraint.init_state and every decode
+    # step applies the state's precomputed vocabulary mask. None = free.
+    constraint: Optional[CompiledMask] = None
     # live state (set at admission)
     generated: List[int] = dataclasses.field(default_factory=list)
     # chunked-prefill progress: prompt tokens already written to the cache.
@@ -322,6 +327,23 @@ class ContinuousBatchingScheduler:
         # mirroring nothing to the host.
         self._seeds = jnp.zeros(num_slots, jnp.uint32)
         self._counts = jnp.zeros(num_slots, jnp.int32)
+        # Grammar constraining: per-slot DFA state (0 = unconstrained
+        # sentinel row of the installed tables) and remaining token budget
+        # (drives the closing-mask switch) — both live on device and chain
+        # between rounds like every other slot array. ONE grammar's tables
+        # are installed at a time ([S, V] mask/next/dist/closing, passed to
+        # the decode jit as regular args); mixed constrained/unconstrained
+        # batches need no recompilation because "no grammar" is just state
+        # 0. Installing a DIFFERENT grammar (new schema) swaps the tables
+        # on the worker thread once no constrained slot is active — that is
+        # one retrace per grammar, never per request.
+        self._cstates = jnp.zeros(num_slots, jnp.int32)
+        # crem rests at 1 for inactive slots (sentinel need is 1, so the
+        # parked row is genuinely all-allowed — see park_slot).
+        self._crem = jnp.ones(num_slots, jnp.int32)
+        self._constraint: Optional[CompiledMask] = None
+        self._ctables = trivial_tables(cfg.vocab_size)
+        self._constraint_wait: "deque[_Request]" = deque()
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
         # In-flight rounds awaiting harvest: (issue-time slot->req list,
         # toks device array, firsts list of (slot, req, first_tok device)).
@@ -445,26 +467,48 @@ class ContinuousBatchingScheduler:
         park = self._park
         pad = self.cfg.pad_id
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def park_slot(cur, pos, slot):
-            return cur.at[slot].set(pad), pos.at[slot].set(park)
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def park_slot(cur, pos, cstates, crem, slot):
+            # A freshly reserved slot also drops any previous occupant's
+            # grammar state: parked garbage decode must run the sentinel
+            # (all-allowed) row, not a stale budget-starved one. crem
+            # parks at 1 — the sentinel row's need is 1, so `need <= crem`
+            # genuinely allows everything (crem=0 would mask the whole
+            # vocabulary: harmless for output, which is discarded, but the
+            # inverse of the invariant); it never decrements while the
+            # slot is inactive.
+            return (
+                cur.at[slot].set(pad),
+                pos.at[slot].set(park),
+                cstates.at[slot].set(0),
+                crem.at[slot].set(1),
+            )
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
-        def retire_slot(temps, topps, topks, slot):
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def retire_slot(temps, topps, topks, cstates, slot):
             # Reset the sampling knobs so a retired sampled request doesn't
             # leave temperature > 0 behind: sample_runtime's all-greedy
             # lax.cond fast path keys on EVERY slot's temperature, and one
             # stale hot slot would force the full vocab-sort path on all
-            # subsequent rounds of an otherwise greedy workload.
+            # subsequent rounds of an otherwise greedy workload. The
+            # grammar state resets for the same hygiene (a stale
+            # constrained state would keep masking the slot's parked
+            # garbage decode).
             return (
                 temps.at[slot].set(0.0),
                 topps.at[slot].set(1.0),
                 topks.at[slot].set(0),
+                cstates.at[slot].set(0),
             )
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6))
-        def ready_slot(cur, pos, temps, topps, topks, seeds, counts, slot,
-                       tok, pos_val, temp, topp, topk, seed):
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+        def ready_slot(cur, pos, temps, topps, topks, seeds, counts,
+                       cstates, crem, next_t, slot,
+                       tok, pos_val, temp, topp, topk, seed, cinit, cbudget):
+            # The first sampled token (still on device) advances the
+            # grammar FSM here: cinit is the grammar start state (0 for
+            # unconstrained requests — row 0 of next_t self-loops, so the
+            # same scatter serves both).
             return (
                 cur.at[slot].set(tok[0]),
                 pos.at[slot].set(pos_val),
@@ -473,6 +517,8 @@ class ContinuousBatchingScheduler:
                 topks.at[slot].set(topk),
                 seeds.at[slot].set(seed),
                 counts.at[slot].set(1),
+                cstates.at[slot].set(next_t[cinit, tok[0]]),
+                crem.at[slot].set(cbudget - 1),
             )
 
         return park_slot, ready_slot, retire_slot
@@ -525,7 +571,7 @@ class ContinuousBatchingScheduler:
         # donated arg: the chunk's tokens scatter into hist rows at the
         # same positions their K/V land at (drafting needs the prompt text,
         # and it is already on device for the forward anyway).
-        donate = tuple(range(1, 1 + nc)) + ((9 + nc,) if spec else ())
+        donate = tuple(range(1, 1 + nc)) + ((12 + nc,) if spec else ())
 
         @partial(jax.jit, donate_argnums=donate)
         def prefill(params, *args):
@@ -554,8 +600,9 @@ class ContinuousBatchingScheduler:
             """
             cache = args[:nc]
             (tokens, lengths, slots, starts, temps, topps, topks,
-             seeds) = args[nc:nc + 8]
-            hist = args[nc + 8] if spec else None
+             seeds, cinits, cbudgets) = args[nc:nc + 10]
+            g_need = args[nc + 10]
+            hist = args[nc + 11] if spec else None
             rows = [c[:, slots] for c in cache]  # [L, k, K, S(, H)] gathers
             if quant:
                 row_cache = {
@@ -607,7 +654,16 @@ class ContinuousBatchingScheduler:
             keys = jax.vmap(
                 lambda s: jax.random.fold_in(jax.random.key(s), 0)
             )(seeds)
-            toks = sample_runtime(logits[:, 0], temps, topps, topks, keys)
+            # Constrained rows sample their FIRST token under the grammar
+            # start-state's budget-aware mask, computed ON DEVICE from the
+            # installed need table and per-row (init state, budget) scalars
+            # — the host ships 2*k ints per round, not a [k, vocab] bool
+            # array. Unconstrained/padding rows carry state 0 (need 1):
+            # all-allowed.
+            first_logits = apply_token_mask(
+                logits[:, 0], g_need[cinits] <= cbudgets[:, None]
+            )
+            toks = sample_runtime(first_logits, temps, topps, topks, keys)
             if spec:
                 # OOB padding slots drop their history writes too.
                 hist = hist.at[slots[:, None], positions].set(tokens)
@@ -623,17 +679,18 @@ class ContinuousBatchingScheduler:
         nc = len(self._cache)
 
         @partial(jax.jit,
-                 donate_argnums=tuple(range(1, 3 + nc)) + (8 + nc,))
+                 donate_argnums=tuple(range(1, 3 + nc))
+                 + (8 + nc, 9 + nc, 10 + nc))
         def decode(params, *args):
             cache = args[:nc]
             (cur, pos, active, temps, topps, topks, seeds,
-             counts) = args[nc:]
+             counts, cstates, crem, g_next, g_need) = args[nc:]
             # Per-layer slices outside the chunk scan: decode-matmul layout
             # conversions run once per round, not per token (split_blocks).
             params = split_blocks(params)
 
             def step(carry, i):
-                cache, cur, pos = carry
+                cache, cur, pos, cstates, crem = carry
                 logits, new_cache = forward(
                     cfg, params, cur[:, None], pos[:, None],
                     _cache_dict(cache), attn_impl=impl, mesh=mesh,
@@ -643,24 +700,39 @@ class ContinuousBatchingScheduler:
                     # pays S_max bandwidth per slot (pallas impl only).
                     kv_lens=jnp.where(active, pos + 1, 0),
                 )
+                # Grammar masking: ONE table gather + compare per step, no
+                # host involvement and no per-token vocab iteration. A
+                # token is allowed iff the tokens it commits to (itself +
+                # shortest completion + stop id, the precomputed `need`
+                # table) fit the slot's remaining budget — so constrained
+                # completions always parse, never truncate. cstate 0 is
+                # the all-allowed sentinel row (need 1), so mixed
+                # constrained/unconstrained batches share this one
+                # program.
+                step_logits = apply_token_mask(
+                    logits[:, 0], g_need[cstates] <= crem[:, None]
+                )
                 # Slot s's i-th token of this chunk is sample number
                 # counts[s]+i of its request's stream — reproducible across
                 # any batch composition.
                 keys = jax.vmap(
                     lambda s, c: jax.random.fold_in(jax.random.key(s), c)
                 )(seeds, counts + i)
-                nxt = sample_runtime(logits[:, 0], temps, topps, topks, keys)
+                nxt = sample_runtime(step_logits, temps, topps, topks, keys)
                 nxt = jnp.where(active, nxt, pad_id)
+                cstates = jnp.where(active, g_next[cstates, nxt], cstates)
+                crem = jnp.where(active, crem - 1, crem)
                 pos = jnp.where(active, pos + 1, pos)
-                return (_cache_tuple(new_cache), nxt, pos), nxt
+                return (_cache_tuple(new_cache), nxt, pos, cstates, crem), nxt
 
-            (cache, cur, pos), toks = lax.scan(
-                step, (cache, cur, pos), jnp.arange(chunk)
+            (cache, cur, pos, cstates, crem), toks = lax.scan(
+                step, (cache, cur, pos, cstates, crem), jnp.arange(chunk)
             )
             # RNG stream bookkeeping advances on device too: every active
             # slot consumed `chunk` samples.
             counts = jnp.where(active, counts + chunk, counts)
-            return (*cache, cur, pos, counts, toks.T)  # toks: [slots, chunk]
+            # toks: [slots, chunk]
+            return (*cache, cur, pos, counts, cstates, crem, toks.T)
 
         return decode
 
@@ -781,6 +853,9 @@ class ContinuousBatchingScheduler:
                 jnp.ones(kb, jnp.float32),
                 jnp.zeros(kb, jnp.int32),
                 jnp.zeros(kb, jnp.uint32),
+                jnp.zeros(kb, jnp.int32),   # cinits: sentinel state
+                jnp.ones(kb, jnp.int32),    # cbudgets: need<=1 all-True
+                self._ctables["need"],
             ]
             if self._spec_draft:
                 args.append(self._hist)
@@ -830,9 +905,30 @@ class ContinuousBatchingScheduler:
         # Streaming consumer: called with each accepted token id in order
         # from the worker thread (see _Request.on_token).
         on_token: Optional[Callable[[int], None]] = None,
+        # Grammar constraining (constrain.CompiledMask): the request's
+        # tokens are masked to the compiled language; the slot's FSM state
+        # rides the decode program on device. Requests with and without a
+        # constraint share the batch; a request with a DIFFERENT grammar
+        # than the installed one waits for constrained slots to drain, then
+        # swaps the tables (one retrace per grammar, never per request).
+        constraint: Optional[CompiledMask] = None,
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
+        if constraint is not None:
+            if self._spec_draft:
+                raise ValueError(
+                    "constrained decoding does not compose with the "
+                    "speculative scheduler: drafted tokens bypass the "
+                    "grammar mask — serve constrained traffic on a "
+                    "non-speculative scheduler"
+                )
+            if max_new_tokens < constraint.min_new_tokens:
+                raise ValueError(
+                    f"max_new_tokens={max_new_tokens} cannot hold a "
+                    f"complete constrained parse (grammar needs >= "
+                    f"{constraint.min_new_tokens} tokens incl. the stop id)"
+                )
         # Overshoot bound: the device can run (harvest_lag + 1) rounds past
         # a budget or stop token before the host notices (rounds are
         # harvested one lag late); those tokens are discarded but their
@@ -865,7 +961,7 @@ class ContinuousBatchingScheduler:
             ids=list(ids), max_new=max_new_tokens,
             temperature=sampling.temperature, top_p=sampling.top_p,
             top_k=sampling.top_k, seed=seed,
-            future=Future(), on_token=on_token,
+            future=Future(), on_token=on_token, constraint=constraint,
         )
         req.future._lsot_request = req  # cancel() handle
         with self._submit_lock:
@@ -926,9 +1022,16 @@ class ContinuousBatchingScheduler:
         go/no-go number for --speculative on a given workload."""
         if not self._spec_draft:
             return None
-        from ..engine.speculative import VERIFY_COST_RATIO
+        from ..engine.speculative import (
+            VERIFY_COST_CALIBRATION,
+            VERIFY_COST_RATIO,
+        )
 
-        rounds, toks = self._spec_rounds, self._spec_tokens
+        # Copy the pair under the scheduler's lock: the harvest thread
+        # updates both counters under it, so this read can never see a
+        # half-applied round (ADVICE.md r5 #2).
+        with self._submit_lock:
+            rounds, toks = self._spec_rounds, self._spec_tokens
         tpr = toks / rounds if rounds else 0.0
         return {
             "verify_rounds": rounds,
@@ -936,6 +1039,9 @@ class ContinuousBatchingScheduler:
             "tokens_per_round": round(tpr, 3),
             "est_speedup_vs_vanilla":
                 round(tpr / VERIFY_COST_RATIO, 3) if rounds else 0.0,
+            # The ratio under that estimate was measured at ONE shape; a
+            # 7B/int4/TP serving config can sit meaningfully off it.
+            "est_speedup_calibration": VERIFY_COST_CALIBRATION,
         }
 
     @property
@@ -954,6 +1060,36 @@ class ContinuousBatchingScheduler:
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self._slot_req) if r is None]
 
+    def _constrained_busy(self) -> bool:
+        return any(
+            r is not None and r.constraint is not None for r in self._slot_req
+        )
+
+    def _grammar_matches(self, c: CompiledMask) -> bool:
+        """Is `c` servable by the INSTALLED tables? Content identity
+        (fingerprint + stop ids), not object identity: the constrain-side
+        compile cache is LRU-bounded, so the same schema can legitimately
+        arrive as a fresh CompiledMask object after an eviction — a
+        spurious drain+reinstall for identical tables would serialize the
+        batch for nothing."""
+        inst = self._constraint
+        return inst is not None and (
+            c is inst
+            or (c.fingerprint == inst.fingerprint
+                and c.eos_ids == inst.eos_ids)
+        )
+
+    def _install_constraint(self, compiled: CompiledMask) -> None:
+        """Swap in a grammar's precompiled device tables (worker thread
+        only; callers guarantee no constrained slot is active, so no live
+        FSM state can index into the wrong table). Tables are compiled and
+        cached by constrain.get_constraint — installing is a device_put of
+        existing arrays plus ONE decode retrace when the state count
+        changes; per-request admissions with the already-installed grammar
+        touch nothing."""
+        self._constraint = compiled
+        self._ctables = compiled.device_tables(self.cfg.vocab_size)
+
     def _admit(self, slot: int, req: _Request) -> None:
         """Reserve `slot` and queue the prompt for chunked prefill, reusing
         any cached prefix blocks first (device-to-device copy, no forward)."""
@@ -964,8 +1100,8 @@ class ContinuousBatchingScheduler:
         # Park the slot's decode writes before its prompt starts streaming in
         # (it may still be frozen at the previous occupant's position).
         # Async scatter — no host sync.
-        self._cur, self._pos = self._park_fn(
-            self._cur, self._pos, jnp.int32(slot)
+        self._cur, self._pos, self._cstates, self._crem = self._park_fn(
+            self._cur, self._pos, self._cstates, self._crem, jnp.int32(slot)
         )
         if self._prefix_cache_blocks:
             pb = self._pblock
@@ -1053,6 +1189,13 @@ class ContinuousBatchingScheduler:
 
         tokens, lengths, slots, starts = [], [], [], []
         temps, topps, topks, seeds, chunk_lens = [], [], [], [], []
+        # First-token grammar state/budget per row: the grammar start
+        # state on FINAL chunks of constrained requests (admission
+        # guarantees the request's grammar IS the installed one), state 0
+        # (the all-allowed sentinel) everywhere else. The prefill fn turns
+        # these into a budget-aware mask on device — 2 ints per row cross
+        # the host boundary, never a [k, vocab] array.
+        cinits, cbudgets = [], []
         for slot, req in group:
             chunk_ids = req.ids[req.prefilled : req.prefilled + t]
             tokens.append(chunk_ids + [self.cfg.pad_id] * (t - len(chunk_ids)))
@@ -1064,6 +1207,10 @@ class ContinuousBatchingScheduler:
             topps.append(req.top_p)
             topks.append(req.top_k)
             seeds.append(req.seed & 0xFFFFFFFF)
+            final = req.prefilled + len(chunk_ids) >= len(req.ids)
+            con = req.constraint is not None and final
+            cinits.append(req.constraint.init_state if con else 0)
+            cbudgets.append(req.max_new if con else 1)
         # Padding rows: OOB slot index (writes dropped), positions [0, t)
         # over the clamped gather row — finite garbage, output discarded.
         for _ in range(kb - len(group)):
@@ -1075,12 +1222,16 @@ class ContinuousBatchingScheduler:
             topps.append(1.0)
             topks.append(0)
             seeds.append(0)
+            cinits.append(0)
+            cbudgets.append(1)
 
         call_args = [
             jnp.asarray(tokens, jnp.int32), jnp.asarray(lengths, jnp.int32),
             jnp.asarray(slots, jnp.int32), jnp.asarray(starts, jnp.int32),
             jnp.asarray(temps, jnp.float32), jnp.asarray(topps, jnp.float32),
             jnp.asarray(topks, jnp.int32), jnp.asarray(seeds, jnp.uint32),
+            jnp.asarray(cinits, jnp.int32), jnp.asarray(cbudgets, jnp.int32),
+            self._ctables["need"],
         ]
         if self._spec_draft:
             call_args.append(self._hist)
@@ -1106,13 +1257,18 @@ class ContinuousBatchingScheduler:
             # accounts for.
             req.ready = True
             tok = toks[i : i + 1]
+            cinit = (req.constraint.init_state if req.constraint is not None
+                     else 0)
             (self._cur, self._pos, self._temps, self._topps, self._topks,
-             self._seeds, self._counts) = self._ready_fn(
+             self._seeds, self._counts, self._cstates,
+             self._crem) = self._ready_fn(
                 self._cur, self._pos, self._temps, self._topps, self._topks,
-                self._seeds, self._counts, jnp.int32(slot), tok,
+                self._seeds, self._counts, self._cstates, self._crem,
+                self._ctables["next"], jnp.int32(slot), tok,
                 jnp.int32(len(req.ids)),
                 jnp.float32(req.temperature), jnp.float32(req.top_p),
                 jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
+                jnp.int32(cinit), jnp.int32(req.max_new),
             )
             if self._spec_draft:
                 self._hist, self._hlen = self._spec_ready_fn(
@@ -1165,13 +1321,16 @@ class ContinuousBatchingScheduler:
             (self._hist, self._hlen, self._cur, self._pos, self._counts,
              toks, n_emit) = out[nc:]
         else:
+            t = self._ctables
             out = self._decode_fn(
                 self.params, *self._cache, self._cur, self._pos,
                 jnp.asarray(active), self._temps, self._topps, self._topks,
-                self._seeds, self._counts,
+                self._seeds, self._counts, self._cstates, self._crem,
+                t["next"], t["need"],
             )
             self._cache = out[:nc]
-            self._cur, self._pos, self._counts, toks = out[nc:]
+            (self._cur, self._pos, self._counts, self._cstates, self._crem,
+             toks) = out[nc:]
             n_emit = None
         self._pending.append((issue_reqs, toks, n_emit, self._first_pending))
         self._first_pending = []
@@ -1182,8 +1341,9 @@ class ContinuousBatchingScheduler:
         sample_runtime's all-greedy fast path for every later round)."""
         req.future.set_result(result)
         self._slot_req[slot] = None
-        self._temps, self._topps, self._topks = self._retire_fn(
-            self._temps, self._topps, self._topks, jnp.int32(slot)
+        self._temps, self._topps, self._topks, self._cstates = self._retire_fn(
+            self._temps, self._topps, self._topks, self._cstates,
+            jnp.int32(slot)
         )
 
     def _append_first(self, slot: int, req: _Request, first: int) -> None:
@@ -1230,8 +1390,15 @@ class ContinuousBatchingScheduler:
             else:
                 row = toks[i][: int(n_emit[i])]
                 if req.temperature <= 0.0 and int(n_emit[i]) > 0:
-                    self._spec_rounds += 1
-                    self._spec_tokens += int(n_emit[i])
+                    # Both counters move under the scheduler's lock so
+                    # speculation_stats (HTTP/metrics threads) and
+                    # bench.py's pre/post delta bracketing always read a
+                    # COHERENT (rounds, tokens) pair — unlocked, a reader
+                    # could see rounds bumped but tokens not yet
+                    # (ADVICE.md r5 #2).
+                    with self._submit_lock:
+                        self._spec_rounds += 1
+                        self._spec_tokens += int(n_emit[i])
             done = False
             for tok in row:
                 tok = int(tok)
@@ -1271,6 +1438,9 @@ class ContinuousBatchingScheduler:
         self._prefill_q.clear()  # their requests fail via the slot sweep below
         self._pending.clear()    # in-flight rounds: futures fail below
         self._first_pending = []
+        for req in self._constraint_wait:  # waiting on a grammar swap
+            req.future.set_exception(exc)
+        self._constraint_wait.clear()
         for i, req in enumerate(self._slot_req):
             if req is not None:
                 req.future.set_exception(exc)
@@ -1288,14 +1458,39 @@ class ContinuousBatchingScheduler:
             # Admit pending requests into every free slot, then issue one
             # prompt chunk and one decode round — all asynchronously — and
             # harvest the oldest round once the pipeline is `_harvest_lag`
-            # deep. When fully idle, drain and block for work.
+            # deep. When fully idle, drain and block for work. Requests
+            # whose grammar differs from the installed one wait in
+            # `_constraint_wait` until the constrained slots drain (the
+            # table swap must not move live FSM states between grammars),
+            # then install and admit in arrival order. Fairness: while
+            # waiters exist, NEW constrained requests also queue behind
+            # them (even for the currently installed grammar) — otherwise
+            # a steady same-grammar stream keeps _constrained_busy() true
+            # forever and a different-grammar waiter starves. Waiters
+            # matching the installed grammar admit immediately (no drain
+            # needed); unconstrained traffic always flows directly.
             while self._free_slots():
-                try:
-                    req = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if req is not None:
-                    self._admit(self._free_slots()[0], req)
+                wait = self._constraint_wait
+                if wait and self._grammar_matches(wait[0].constraint):
+                    req = wait.popleft()
+                elif wait and not self._constrained_busy():
+                    req = wait.popleft()
+                    self._install_constraint(req.constraint)
+                else:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if req is None:
+                        continue
+                    c = req.constraint
+                    if c is not None and (not self._grammar_matches(c)
+                                          or wait):
+                        if self._constrained_busy() or wait:
+                            wait.append(req)
+                            continue
+                        self._install_constraint(c)
+                self._admit(self._free_slots()[0], req)
             # Fair interleave: at most one prompt chunk per decode round —
             # admission work is bounded, so active slots never wait longer
             # than one prompt_bucket forward.
@@ -1311,13 +1506,18 @@ class ContinuousBatchingScheduler:
                 while self._pending:
                     self._harvest_round()
                 self._harvest_firsts()
-                if self._prefill_q or any(
+                if self._prefill_q or self._constraint_wait or any(
                     r is not None for r in self._slot_req
                 ):
                     continue  # harvests freed work — go admit/issue again
                 try:
                     req = self._queue.get(timeout=0.05)
                     if req is not None:
+                        # Fully idle here (no slots, no waiters), so a new
+                        # grammar can install immediately.
+                        c = req.constraint
+                        if c is not None and not self._grammar_matches(c):
+                            self._install_constraint(c)
                         self._admit(self._free_slots()[0], req)
                 except queue.Empty:
                     pass
@@ -1358,6 +1558,16 @@ class SchedulerPool:
         return self.schedulers[0].decode_chunk
 
     @property
+    def stop_ids(self):
+        return self.schedulers[0].stop_ids
+
+    @property
+    def _spec_draft(self) -> int:
+        # Replicas are homogeneous; SchedulerBackend's constrain guard
+        # reads this through the pool exactly like a single scheduler.
+        return self.schedulers[0]._spec_draft
+
+    @property
     def prompt_bucket(self) -> int:
         return self.schedulers[0].prompt_bucket
 
@@ -1390,7 +1600,7 @@ class SchedulerPool:
 
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
-               on_token=None):
+               on_token=None, constraint=None):
         # Skip replicas whose event loop has crashed: a dead scheduler must
         # not keep failing its round-robin share while healthy ones idle.
         # The try/except covers the race where a replica dies between the
@@ -1404,7 +1614,7 @@ class SchedulerPool:
             try:
                 return sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
-                    seed=seed, on_token=on_token,
+                    seed=seed, on_token=on_token, constraint=constraint,
                 )
             except ValueError:
                 # Request-shape rejection (oversize prompt): identical on
@@ -1439,6 +1649,9 @@ class SchedulerBackend:
     HTTP handler threads calling `complete()` concurrently share one decode
     batch instead of serializing on a lock.
     """
+
+    #: GenerationService checks this before forwarding a `constrain=` spec.
+    supports_constrain = True
 
     def __init__(
         self,
@@ -1599,13 +1812,41 @@ class SchedulerBackend:
         return cls(sched, tokenizer, **kwargs)
 
     def check_budget(self, prompt: str,
-                     max_new_tokens: Optional[int] = None) -> None:
+                     max_new_tokens: Optional[int] = None,
+                     constraint=None) -> None:
         """Raise ValueError if `prompt` leaves no decode room in the serving
         window — the same rejection complete()/complete_stream() would make,
         runnable BEFORE a streaming handler puts 200 headers on the wire
-        (after which a request-shape error can only be a mid-stream line)."""
+        (after which a request-shape error can only be a mid-stream line).
+        With a compiled `constraint`, also checks that the CLAMPED budget
+        (what submit() will actually receive after the decode-room clamp,
+        not the raw requested value) can hold a complete parse."""
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
-        self._budget(len(ids), max_new_tokens)
+        budget = self._budget(len(ids), max_new_tokens)
+        if constraint is not None and budget < constraint.min_new_tokens:
+            raise ValueError(
+                f"decode budget {budget} (after the serving-window clamp) "
+                f"cannot hold a complete constrained parse (grammar needs "
+                f">= {constraint.min_new_tokens} tokens incl. the stop id)"
+            )
+
+    def _resolve_constraint(self, constrain):
+        from .backends import resolve_constraint
+
+        if constrain is not None and getattr(self.scheduler,
+                                             "_spec_draft", 0):
+            # Mirror submit()'s rejection HERE so GenerationService
+            # .validate() (which calls this resolver) turns the error into
+            # a 400 before a streaming 200 goes on the wire — submit's own
+            # guard then never fires mid-stream.
+            raise ValueError(
+                "constrained decoding does not compose with the "
+                "speculative scheduler: drafted tokens bypass the grammar "
+                "mask — serve constrained traffic on a non-speculative "
+                "scheduler"
+            )
+        return resolve_constraint(constrain, self.tokenizer,
+                                  self.scheduler.stop_ids)
 
     def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
         sched = self.scheduler
@@ -1624,7 +1865,8 @@ class SchedulerBackend:
                         max_new_tokens: Optional[int] = None,
                         sampling: Optional[SamplingParams] = None,
                         seed: int = 0,
-                        stats_out: Optional[dict] = None):
+                        stats_out: Optional[dict] = None,
+                        constrain=None):
         """Stream the completion as text chunks while it decodes — the
         capability Ollama's `stream=true` API exposes and the reference
         never used. Token ids arrive from the scheduler's per-request
@@ -1653,7 +1895,7 @@ class SchedulerBackend:
         fut = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed,
-            on_token=on_tok,
+            on_token=on_tok, constraint=self._resolve_constraint(constrain),
         )
         out_ids: List[int] = []
         emitted = ""
@@ -1708,7 +1950,8 @@ class SchedulerBackend:
                     stats_out["ttft_s"] = first_at[0] - t_submit
 
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
-                 sampling: Optional[SamplingParams] = None, seed: int = 0):
+                 sampling: Optional[SamplingParams] = None, seed: int = 0,
+                 constrain=None):
         from .backends import Completion, trim_stop_texts
 
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
@@ -1717,6 +1960,7 @@ class SchedulerBackend:
         out = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed, on_token=on_tok,
+            constraint=self._resolve_constraint(constrain),
         ).result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
         return Completion(text=text, output_tokens=len(out),
@@ -1726,6 +1970,7 @@ class SchedulerBackend:
     def complete_batch(
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None, seed: int = 0,
+        constrain=None,
     ):
         """Submit the whole batch at once: the scheduler interleaves the
         prompts through its slot pool, so this IS continuous batching —
@@ -1733,6 +1978,7 @@ class SchedulerBackend:
         nothing beyond bucketing."""
         from .backends import Completion, trim_stop_texts
 
+        constraint = self._resolve_constraint(constrain)
         ids_list = [
             self.tokenizer.encode(p, add_bos=self.add_bos) for p in prompts
         ]
@@ -1742,7 +1988,7 @@ class SchedulerBackend:
             self.scheduler.submit(
                 ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
                 sampling=sampling or self.sampling, seed=seed,
-                on_token=on_tok,
+                on_token=on_tok, constraint=constraint,
             )
             for ids, (on_tok, _) in zip(ids_list, timers)
         ]

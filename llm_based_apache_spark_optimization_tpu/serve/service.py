@@ -106,6 +106,21 @@ class GenerationService:
                 seen.add(id(e.backend))
                 shutdown()
 
+    @staticmethod
+    def _constrain_kwargs(entry: ModelEntry, constrain) -> Dict:
+        """`constrain` is opt-in per request ("spark_sql", or a schema dict
+        {"table", "columns"}): forwarded only to backends that declare
+        `supports_constrain`; anything else is a clear request-shape error
+        rather than a silently unconstrained completion."""
+        if constrain is None:
+            return {}
+        if not getattr(entry.backend, "supports_constrain", False):
+            raise ValueError(
+                f"model {entry.name!r} backend does not support "
+                f"constrained decoding"
+            )
+        return {"constrain": constrain}
+
     def generate(
         self,
         model: str,
@@ -114,6 +129,7 @@ class GenerationService:
         max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
+        constrain=None,
     ) -> GenerateResult:
         entry = self._entry(model)
         rendered = entry.template(system, prompt)
@@ -121,7 +137,7 @@ class GenerationService:
         with trace_capture(f"generate-{model}"):
             completion = entry.backend.complete(
                 rendered, max_new_tokens=max_new_tokens, sampling=sampling,
-                seed=seed,
+                seed=seed, **self._constrain_kwargs(entry, constrain),
             )
         latency = time.perf_counter() - t0
         with self._lock:
@@ -149,13 +165,20 @@ class GenerationService:
         prompt: str,
         system: str = "",
         max_new_tokens: Optional[int] = None,
+        constrain=None,
     ) -> None:
         """Raise the same KeyError/ValueError generate() would raise for a
-        bad model name or an oversize prompt — WITHOUT generating. Streaming
-        handlers call this before sending response headers: a request-shape
-        error must become a 400/404 status, which is impossible once the
-        NDJSON stream's 200 is on the wire. Backends without a budget seam
-        (fakes) validate trivially.
+        bad model name, an oversize prompt, or a bad `constrain` spec —
+        WITHOUT generating. Streaming handlers call this before sending
+        response headers: a request-shape error must become a 400/404
+        status, which is impossible once the NDJSON stream's 200 is on the
+        wire. Backends without a budget seam (fakes) validate trivially.
+
+        `constrain` checks mirror the generate path: unsupported backend
+        (ValueError here, not a mid-stream line), an uncompilable schema
+        spec (e.g. no usable identifiers — the compile runs here and is
+        cached for the actual request), and a budget below the grammar's
+        shortest complete parse.
 
         The check tokenizes the rendered prompt a second time (the
         generate call re-encodes it); that is host-side microseconds per
@@ -163,9 +186,20 @@ class GenerationService:
         keeping validate() stateless beats threading encoded ids through
         the service/backend seam."""
         entry = self._entry(model)
+        self._constrain_kwargs(entry, constrain)  # supports check
+        compiled = None
+        if constrain is not None:
+            resolve = getattr(entry.backend, "_resolve_constraint", None)
+            if resolve is not None:
+                compiled = resolve(constrain)  # compile errors become 400s
         check = getattr(entry.backend, "check_budget", None)
         if check is not None:
-            check(entry.template(system, prompt), max_new_tokens)
+            # The backend checks its CLAMPED budget (what generate will
+            # actually run with after the decode-room clamp) against the
+            # grammar's shortest complete parse — the raw requested value
+            # can pass while the clamp still makes the parse impossible.
+            check(entry.template(system, prompt), max_new_tokens,
+                  constraint=compiled)
 
     def generate_stream(
         self,
@@ -175,12 +209,14 @@ class GenerationService:
         max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
+        constrain=None,
     ):
         """Yield the completion as text chunks while it decodes (Ollama's
         `stream=true` surface). Backends without a `complete_stream` seam
         (the one-XLA-program engine, fakes) degrade to a single chunk.
         Metrics record the request exactly like generate()."""
         entry = self._entry(model)
+        ckw = self._constrain_kwargs(entry, constrain)
         rendered = entry.template(system, prompt)
         t0 = time.perf_counter()
         out_tokens = prompt_tokens = 0
@@ -190,7 +226,7 @@ class GenerationService:
             if streamer is None:
                 completion = entry.backend.complete(
                     rendered, max_new_tokens=max_new_tokens, sampling=sampling,
-                    seed=seed,
+                    seed=seed, **ckw,
                 )
                 out_tokens, prompt_tokens = (completion.output_tokens,
                                              completion.prompt_tokens)
@@ -203,6 +239,7 @@ class GenerationService:
                 inner = streamer(
                     rendered, max_new_tokens=max_new_tokens,
                     sampling=sampling, seed=seed, stats_out=stream_stats,
+                    **ckw,
                 )
                 try:
                     with trace_capture(f"generate-{model}"):
@@ -244,6 +281,7 @@ class GenerationService:
         max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
+        constrain=None,
     ) -> "list[GenerateResult]":
         """Batched twin of generate(): one device program for all prompts.
 
@@ -257,7 +295,7 @@ class GenerationService:
         with trace_capture(f"generate-batch-{model}"):
             completions = entry.backend.complete_batch(
                 rendered, max_new_tokens=max_new_tokens, sampling=sampling,
-                seed=seed,
+                seed=seed, **self._constrain_kwargs(entry, constrain),
             )
         latency = time.perf_counter() - t0
         with self._lock:

@@ -453,3 +453,90 @@ def test_execution_match_with_prefixed_dml_blocked():
     with pytest.raises(Exception):
         b.execute("DELETE FROM taxi")
     assert b.execute(gold).rows[0][0] == n_before
+
+
+def test_cli_calls_models_exactly_once(monkeypatch, capsys):
+    """ADVICE r5 #4: the unknown-model check reuses ONE service.models()
+    result — with --backend ollama a second call was an extra HTTP round
+    trip (and a race if the daemon's model list changed between calls)."""
+    from llm_based_apache_spark_optimization_tpu.app import __main__ as app_main
+    from llm_based_apache_spark_optimization_tpu.evalh.__main__ import main
+
+    calls = {"n": 0}
+    real_factory = app_main.make_fake_service
+
+    def counting_fake_service():
+        svc = real_factory()
+        orig = svc.models
+
+        def counted():
+            calls["n"] += 1
+            return orig()
+
+        svc.models = counted
+        return svc
+
+    monkeypatch.setattr(app_main, "make_fake_service", counting_fake_service)
+    main(["--backend", "fake", "--cpu"])
+    out = capsys.readouterr().out
+    assert "Final Evaluation Summary" in out
+    assert calls["n"] == 1
+
+
+def test_grammar_valid_and_executable_fields():
+    """SQL cases score grammar validity (in-tree parser) and executability
+    (sqlite oracle); error-analysis cases (no expected SQL) stay None so
+    the rates never mix workloads."""
+    from llm_based_apache_spark_optimization_tpu.evalh.fixtures import EvalCase
+    from llm_based_apache_spark_optimization_tpu.evalh.harness import (
+        evaluate_model,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import (
+        make_taxi_exec_backend,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+
+    svc = GenerationService()
+    svc.register("m", FakeBackend(
+        lambda p: "SELECT VendorID FROM taxi;" if "vendor" in p
+        else "not sql at all"))
+    cases = [
+        EvalCase(nl="vendor query", expected_sql="SELECT VendorID FROM taxi;"),
+        EvalCase(nl="garbage", expected_sql="SELECT 1;"),
+        EvalCase(nl="error trace", expected_sql=""),
+    ]
+    rep = evaluate_model(svc, "m", cases, system="s",
+                         exec_backend=make_taxi_exec_backend())
+    assert [c.grammar_valid for c in rep.cases] == [1, 0, None]
+    assert [c.executable for c in rep.cases] == [1, 0, None]
+    assert rep.grammar_valid_rate == 50.0
+    assert rep.executable_rate == 50.0
+
+
+def test_report_constrain_compare_section():
+    """render_report's constrained-vs-unconstrained table shows validity /
+    executable / exact side by side."""
+    from llm_based_apache_spark_optimization_tpu.evalh.harness import (
+        CaseResult,
+        ModelReport,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.report import (
+        render_report,
+    )
+
+    def rep(gv, exe):
+        return ModelReport(model="m", cases=[CaseResult(
+            nl="q", generated_sql="SELECT 1;", expected_sql="SELECT 1;",
+            exact_match=0, edit_distance=3, latency_s=0.1, output_tokens=4,
+            grammar_valid=gv, executable=exe,
+        )])
+
+    text = render_report(
+        {"m": rep(0, 0)}, [], backend_desc="d", platform="cpu",
+        constrained_reports={"m": rep(1, 1)},
+    )
+    assert "Constrained decoding" in text
+    assert "| m | 0.0 % | 100.0 % | 0.0 % | 100.0 % |" in text

@@ -94,6 +94,42 @@ def test_harness_scores_live_endpoint_exactly(fake_ollama):
     assert rep_b.wall_clock_s > 0
 
 
+def test_generate_batch_stamps_cumulative_wall(fake_ollama):
+    """ADVICE r5 #1: Ollama serves sequentially, so request i's
+    submitted-together latency is the CUMULATIVE wall through i — stamping
+    every member with the chunk total inflated avg_latency_s ~batch/2x.
+    Contract: latencies are strictly increasing and results[-1] carries
+    the whole chunk wall (the index the harness sums)."""
+    svc = OllamaClientService(fake_ollama)
+    outs = svc.generate_batch(
+        "duckdb-nsql", [c.nl for c in FOUR_QUERY_SUITE],
+        system=TAXI_DDL_SYSTEM, max_new_tokens=16,
+    )
+    lats = [r.latency_s for r in outs]
+    assert all(a < b for a, b in zip(lats, lats[1:]))  # cumulative
+    assert lats[-1] == max(lats)
+    # avg over members is strictly below the old all-equal-total stamping.
+    assert sum(lats) / len(lats) < lats[-1]
+    # The harness reads outs[-1] (NOT outs[0]) as the chunk wall: with a
+    # stub stamping cumulative latencies 1, 2, 3 the batch wall is 3.
+    from llm_based_apache_spark_optimization_tpu.serve.service import (
+        GenerateResult,
+    )
+
+    class _Stub:
+        def generate_batch(self, model, prompts, system="",
+                           max_new_tokens=None, sampling=None, seed=0):
+            return [
+                GenerateResult(response="SELECT 1;", model=model,
+                               latency_s=float(i + 1), output_tokens=2)
+                for i in range(len(prompts))
+            ]
+
+    rep = evaluate_model_batched(_Stub(), "m", FOUR_QUERY_SUITE[:3],
+                                 TAXI_DDL_SYSTEM, batch_size=3)
+    assert rep.wall_clock_s == 3.0
+
+
 def test_sampling_options_forwarded(fake_ollama):
     from llm_based_apache_spark_optimization_tpu.ops.sampling import (
         SamplingParams,

@@ -289,9 +289,16 @@ def test_speculation_stats_counted_and_surfaced(tiny):
         cfg, params, num_slots=2, prompt_bucket=16, stop_ids=(-1,),
         speculative_draft=4,
     )
+    from llm_based_apache_spark_optimization_tpu.engine.speculative import (
+        VERIFY_COST_CALIBRATION,
+    )
+
     assert sched.speculation_stats == {
         "verify_rounds": 0, "tokens_emitted": 0, "tokens_per_round": 0.0,
         "est_speedup_vs_vanilla": 0.0,
+        # ADVICE r5 #3: the estimate is labeled with the shape it was
+        # measured at instead of posing as universal.
+        "est_speedup_calibration": VERIFY_COST_CALIBRATION,
     }
     rep = [1, 5, 9, 5, 9, 5, 9, 5, 9, 5, 9]
     with sched:
@@ -301,6 +308,43 @@ def test_speculation_stats_counted_and_surfaced(tiny):
     assert stats["verify_rounds"] >= 1
     assert stats["tokens_emitted"] >= 24  # every greedy token was counted
     assert 1.0 <= stats["tokens_per_round"] <= 5.0
+
+
+def test_speculation_stats_reads_pair_under_lock(tiny):
+    """ADVICE r5 #2: the harvest thread bumps _spec_rounds/_spec_tokens as
+    a pair under the scheduler's lock, and speculation_stats copies them
+    under the same lock — a reader can never observe a half-applied round.
+    Pin the locking contract: while the lock is held, the property call
+    blocks; once released it returns a consistent pair."""
+    import threading
+    import time as _time
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=16, stop_ids=(-1,),
+        speculative_draft=4,
+    )
+    # Simulate a mid-update harvest: rounds bumped, tokens not yet — the
+    # lock is held across both, so a reader must not see this state.
+    got = {}
+
+    def reader():
+        got["stats"] = sched.speculation_stats
+
+    with sched._submit_lock:
+        sched._spec_rounds += 1          # half-applied update, lock held
+        t = threading.Thread(target=reader)
+        t.start()
+        t.join(timeout=0.2)
+        assert "stats" not in got        # reader blocked on the lock
+        sched._spec_tokens += 3          # complete the pair
+    t.join(timeout=5)
+    assert got["stats"]["verify_rounds"] == 1
+    assert got["stats"]["tokens_emitted"] == 3
 
 
 @pytest.mark.slow
