@@ -1027,7 +1027,7 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
             speculative_draft=draft,
         )
         from llm_based_apache_spark_optimization_tpu.engine.speculative import (
-            VERIFY_COST_RATIO,
+            verify_cost_ratio,
         )
 
         spec_sched.warmup(prompt_len)
@@ -1050,13 +1050,17 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
                     toks_sp = (post.get("tokens_emitted", 0)
                                - pre.get("tokens_emitted", 0))
         tpr = toks_sp / rounds if rounds else 0.0
+        # Cost model priced at THIS run's draft length (ADVICE r5 #3), not
+        # the old D=8-only constant.
+        ratio = verify_cost_ratio(draft)
         out["speculative"] = {
             "draft": draft,
             "tok_s": round(spec_tok_s, 1),
             "verify_rounds": rounds,
             "tokens_emitted": toks_sp,
             "tokens_per_round": round(tpr, 3),
-            "est_speedup_vs_vanilla": round(tpr / VERIFY_COST_RATIO, 3),
+            "verify_cost_ratio": round(ratio, 3),
+            "est_speedup_vs_vanilla": round(tpr / ratio, 3),
         }
 
     if os.environ.get("BENCH_SCHED_PREFIX", "1") == "1" and kv_quant is None:

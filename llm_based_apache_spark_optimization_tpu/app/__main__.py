@@ -72,6 +72,9 @@ def make_tiny_service(
         ("llama3.2", cfg, params, "completion"),
         ("mistral", mistral_cfg, mistral_params, "mistral-instruct"),
     )
+    # Fault-tolerance knobs (LSOT_MAX_QUEUE_DEPTH / LSOT_DEADLINE_S) reach
+    # the scheduler here — admission control is a constructor property.
+    app_cfg = AppConfig.from_env()
     for name, mcfg, mparams, template in models:
         if scheduler:
             from ..serve.scheduler import (
@@ -81,10 +84,12 @@ def make_tiny_service(
 
             sched = ContinuousBatchingScheduler(
                 mcfg, mparams, num_slots=8, prompt_bucket=64, mesh=mesh,
+                max_queue_depth=app_cfg.max_queue_depth,
             )
             svc.register(
                 name,
-                SchedulerBackend(sched, tok, max_new_tokens=max_new_tokens),
+                SchedulerBackend(sched, tok, max_new_tokens=max_new_tokens,
+                                 deadline_s=app_cfg.deadline_s or None),
                 template=template,
             )
         else:
@@ -195,6 +200,8 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     if int4 and args.int8:
         sys.exit("pick one of --int8 / --int4")
 
+    app_cfg = AppConfig.from_env()
+
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
         if path.endswith(".gguf") and tok_dir is None:
@@ -206,7 +213,9 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 common = dict(mesh=scheduler_meshes[0],
                               max_new_tokens=max_new_tokens,
                               add_bos=add_bos, num_slots=args.slots,
-                              kv_quant=kv_quant)
+                              kv_quant=kv_quant,
+                              max_queue_depth=app_cfg.max_queue_depth,
+                              deadline_s=app_cfg.deadline_s or None)
                 common["speculative_draft"] = getattr(args, "speculative", 0)
                 common["quantize_int8"] = args.int8
                 common["quantize_int4"] = int4
@@ -242,12 +251,14 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                     stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
                     kv_quant=kv_quant,
                     speculative_draft=getattr(args, "speculative", 0),
+                    max_queue_depth=app_cfg.max_queue_depth,
                 )
                 for m in scheduler_meshes
             ]
             return SchedulerBackend(
                 SchedulerPool(scheds), tok,
                 max_new_tokens=max_new_tokens, add_bos=add_bos,
+                deadline_s=app_cfg.deadline_s or None,
             )
         if path.endswith(".gguf"):
             return EngineBackend.from_gguf(

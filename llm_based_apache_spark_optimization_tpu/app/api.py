@@ -14,14 +14,55 @@ file must already exist in the input dir (no upload — reference
 
 from __future__ import annotations
 
+import math
 import os
 
 from ..history.store import HistoryStore
+from ..serve.resilience import (
+    CircuitOpen,
+    DeadlineExceeded,
+    Overloaded,
+    SchedulerCrashed,
+)
 from ..serve.service import GenerationService
 from ..sql.backend import SQLBackend
 from .config import AppConfig
 from .pipeline import Pipeline
 from .wsgi import App, Request, Response
+
+
+def _retry_after_headers(exc) -> list:
+    after = max(1, int(math.ceil(getattr(exc, "retry_after_s", 1.0))))
+    return [("Retry-After", str(after))]
+
+
+def unavailable_response(exc) -> Response:
+    """Map the typed fault-tolerance errors (serve/resilience.py) to their
+    HTTP semantics — used by the headless API frontend (the web UI keeps
+    the reference's §2.2 page flow, routing every failure through the
+    error-analysis page):
+
+      Overloaded        → 429 + Retry-After (admission control shed it;
+                          back off and resubmit)
+      SchedulerCrashed  → 503 (engine dead — not a per-request 500)
+      CircuitOpen       → 503 + Retry-After (a dependency is down; the
+                          breaker names the probe window)
+      DeadlineExceeded  → 504 (the request's own budget ran out)
+    """
+    if isinstance(exc, Overloaded):
+        return Response.json({"error": str(exc)}, status=429,
+                             headers=_retry_after_headers(exc))
+    if isinstance(exc, CircuitOpen):
+        return Response.json({"error": str(exc)}, status=503,
+                             headers=_retry_after_headers(exc))
+    if isinstance(exc, SchedulerCrashed):
+        return Response.json({"error": str(exc)}, status=503)
+    return Response.json({"error": str(exc)}, status=504)
+
+
+#: The except clause the API routes guard generation calls with.
+UNAVAILABLE_ERRORS = (Overloaded, CircuitOpen, SchedulerCrashed,
+                      DeadlineExceeded)
 
 
 def create_api_app(
@@ -50,7 +91,13 @@ def create_api_app(
         file_path = os.path.join(cfg.input_dir, file_name)
         if not os.path.exists(file_path):
             return Response.json({"error": "CSV file not found at " + file_path})
-        result = pipeline.run(file_path, input_text)
+        try:
+            result = pipeline.run(file_path, input_text)
+        except UNAVAILABLE_ERRORS as e:
+            # Overload/outage is the SERVER's state, not a §2.2 pipeline
+            # outcome: answer 429/503/504 so clients back off, instead of
+            # the catch-all 500 that reads as a bug.
+            return unavailable_response(e)
         if not result.ok:
             return Response.json({
                 "error": "SQL execution failed",
@@ -105,6 +152,15 @@ def create_api_app(
                 {"error": "'max_new_tokens' must be a positive integer"},
                 status=400,
             )
+        deadline_s = data.get("deadline_s")
+        if deadline_s is not None and (
+            not isinstance(deadline_s, (int, float))
+            or isinstance(deadline_s, bool) or deadline_s <= 0
+        ):
+            return Response.json(
+                {"error": "'deadline_s' must be a positive number"},
+                status=400,
+            )
         constrain = data.get("constrain")
         if constrain is not None and not (
             constrain == "spark_sql"
@@ -145,7 +201,7 @@ def create_api_app(
             if not data.get("stream", False):
                 res = service.generate(
                     model, prompt, system=system, max_new_tokens=max_new,
-                    constrain=constrain,
+                    constrain=constrain, deadline_s=deadline_s,
                 )
                 return Response.json({
                     "model": model, "response": res.response, "done": True,
@@ -160,22 +216,51 @@ def create_api_app(
             service.validate(model, prompt, system=system,
                              max_new_tokens=max_new, constrain=constrain)
 
+            # PRIME the stream before sending headers: the scheduler's
+            # submit (admission control!) runs lazily on the generator's
+            # first step, and a shed must be a real 429/503/504 with
+            # Retry-After — under overload, exactly when backoff matters
+            # most, a 200 + error line would leave streaming clients with
+            # no signal to back off on. Nothing useful ever precedes the
+            # first chunk, so holding the 200 until it exists costs only
+            # what the client was waiting for anyway.
+            inner = service.generate_stream(
+                model, prompt, system=system, max_new_tokens=max_new,
+                constrain=constrain, deadline_s=deadline_s,
+            )
+            try:
+                first = next(inner)
+            except StopIteration:
+                first = None
+
             def chunks():
                 try:
-                    for piece in service.generate_stream(
-                        model, prompt, system=system, max_new_tokens=max_new,
-                        constrain=constrain,
-                    ):
-                        yield {"model": model, "response": piece,
-                               "done": False}
-                except Exception as e:  # mid-stream failure: headers are
-                    # already sent, so surface the error as a final line
-                    # instead of severing the connection silently.
-                    yield {"model": model, "error": str(e), "done": True}
-                    return
-                yield {"model": model, "done": True}
+                    try:
+                        if first is not None:
+                            yield {"model": model, "response": first,
+                                   "done": False}
+                        for piece in inner:
+                            yield {"model": model, "response": piece,
+                                   "done": False}
+                    except Exception as e:  # mid-stream failure: headers
+                        # are already sent, so surface the error as a final
+                        # line instead of severing the connection silently.
+                        yield {"model": model, "error": str(e), "done": True}
+                        return
+                    yield {"model": model, "done": True}
+                finally:
+                    # Deterministic unwind on client disconnect: the
+                    # service generator's finally cancels the scheduler
+                    # request and records metrics.
+                    inner.close()
 
             return Response.ndjson_stream(chunks())
+        except UNAVAILABLE_ERRORS as e:
+            # Overload / engine-dead / dependency-down / deadline burned:
+            # 429/503/504 with Retry-After where meaningful — a shed
+            # request is the server asking the client to back off, not a
+            # client mistake (400) or a bug (500).
+            return unavailable_response(e)
         except KeyError as e:
             return Response.json({"error": str(e)}, status=404)
         except ValueError as e:

@@ -33,6 +33,20 @@ class AppConfig:
     # (LSOT_CONSTRAIN_SQL=1): only engine/scheduler backends support it —
     # fake/demo backends would reject the request.
     constrain_sql: bool = False
+    # --- fault tolerance (serve/resilience.py; README "Operating under
+    # load"). All off/unbounded by default — production deployments should
+    # set every one of them.
+    # Scheduler admission control: submits beyond this backlog shed with a
+    # typed Overloaded → HTTP 429 + Retry-After. 0 = unbounded.
+    max_queue_depth: int = 0
+    # Per-request latency budget in seconds, threaded request → queue →
+    # decode; expiry fails typed (DeadlineExceeded → 504). 0 = none.
+    deadline_s: float = 0.0
+    # Circuit breaker on the SQL execution backend: consecutive INFRA
+    # failures (not per-query SQL errors) before the circuit opens, and how
+    # long it stays open before one half-open probe.
+    breaker_threshold: int = 5
+    breaker_reset_s: float = 10.0
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

@@ -76,9 +76,25 @@ class Pipeline:
         backend — its own connection and its own `temp_view` — so concurrent
         requests can't read each other's tables (the reference shares one
         SparkSession-wide view across all users, `Flask/app.py:16,113`)."""
+        from ..sql.backend import ResilientSQLBackend
+
         self.service = service
-        self._sql_factory = (
+        raw_factory = (
             sql_backend if callable(sql_backend) else (lambda: sql_backend)
+        )
+        # One shared breaker across runs (the wrapper is per-run, like the
+        # backend): transient exec failures retry with backoff, and a DOWN
+        # engine sheds with CircuitOpen instead of burning a retry ladder
+        # per request — which the error-analysis fallback then degrades
+        # exactly like any other SQL failure (§2.2 contract preserved).
+        from ..serve.resilience import CircuitBreaker
+
+        shared_breaker = CircuitBreaker(
+            "sql backend", failure_threshold=config.breaker_threshold,
+            reset_after_s=config.breaker_reset_s,
+        )
+        self._sql_factory = lambda: ResilientSQLBackend(
+            raw_factory(), breaker=shared_breaker,
         )
         self.history = history
         self.config = config
@@ -154,6 +170,10 @@ class Pipeline:
             prompt=input_text,
             max_new_tokens=cfg.max_new_tokens,
             constrain=constrain,
+            # Per-request latency budget (LSOT_DEADLINE_S; 0 = none):
+            # enforced end to end by deadline-capable backends — the
+            # request fails typed instead of pinning a slot forever.
+            deadline_s=cfg.deadline_s or None,
         )
         result.sql_query = res.response
         status("processing", ST_GEN_OK)
@@ -187,19 +207,44 @@ class Pipeline:
         return result
 
     def explain_error(self, error_message: str, status: StatusCb = _noop_status) -> str:
-        """Error-analysis path — §2.2 prompts verbatim (FastAPI/app.py:99-111)."""
-        status("error", ST_ERR_RESOLVE)
-        res = self.service.generate(
-            model=self.config.error_model,
-            system=(
-                "You are an AI that helps troubleshoot Apache Spark errors. "
-                "Provide clear, concise solutions."
-            ),
-            prompt=(
-                f"The following Spark error occurred:\n\n{error_message}\n\n"
-                f"Please analyze this error and suggest possible solutions."
-            ),
-            max_new_tokens=self.config.max_new_tokens,
+        """Error-analysis path — §2.2 prompts verbatim (FastAPI/app.py:99-111).
+
+        Degrades gracefully: if the error-analysis model is UNAVAILABLE
+        (breaker open, scheduler crashed, overloaded, deadline burned), the
+        raw engine error string comes back instead — the §2.2 contract
+        promises the user an `error_details` field, and a second failure
+        must not turn a diagnosable SQL error into a dead request. Only
+        the typed unavailability errors degrade: a misconfigured model
+        name (KeyError) or a programming bug must SURFACE, not ship to
+        production disguised as intended degradation."""
+        from ..serve.resilience import (
+            CircuitOpen,
+            DeadlineExceeded,
+            Overloaded,
+            SchedulerCrashed,
         )
+
+        status("error", ST_ERR_RESOLVE)
+        try:
+            res = self.service.generate(
+                model=self.config.error_model,
+                system=(
+                    "You are an AI that helps troubleshoot Apache Spark errors. "
+                    "Provide clear, concise solutions."
+                ),
+                prompt=(
+                    f"The following Spark error occurred:\n\n{error_message}\n\n"
+                    f"Please analyze this error and suggest possible solutions."
+                ),
+                max_new_tokens=self.config.max_new_tokens,
+                deadline_s=self.config.deadline_s or None,
+            )
+        except (CircuitOpen, DeadlineExceeded, Overloaded, SchedulerCrashed):
+            log.exception(
+                "error-analysis model unavailable; degrading to the raw "
+                "engine error"
+            )
+            status("error", ST_ERR_DONE)
+            return error_message
         status("error", ST_ERR_DONE)
         return res.response

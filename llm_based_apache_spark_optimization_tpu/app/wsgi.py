@@ -105,7 +105,8 @@ class Request:
 
 _STATUS = {200: "200 OK", 302: "302 Found", 400: "400 Bad Request",
            404: "404 Not Found", 405: "405 Method Not Allowed",
-           500: "500 Internal Server Error"}
+           429: "429 Too Many Requests", 500: "500 Internal Server Error",
+           503: "503 Service Unavailable", 504: "504 Gateway Timeout"}
 
 
 @dataclass
@@ -119,11 +120,13 @@ class Response:
     stream: Any = None
 
     @classmethod
-    def json(cls, obj: Any, status: int = 200) -> "Response":
+    def json(cls, obj: Any, status: int = 200,
+             headers: Optional[List[Tuple[str, str]]] = None) -> "Response":
         return cls(
             body=jsonlib.dumps(obj).encode(),
             status=status,
-            headers=[("Content-Type", "application/json")],
+            headers=[("Content-Type", "application/json")]
+            + list(headers or []),
         )
 
     @classmethod

@@ -5,8 +5,9 @@ One grammar, two compilation modes:
 - **generic** (`spark_sql_dfa()`): identifiers are any non-reserved word —
   the mode the eval harness scores, covering the evalh fixture suite and
   Spider-style single-table queries: projections (with aggregates and
-  aliases), WHERE, GROUP BY/HAVING, ORDER BY (ASC/DESC), LIMIT, JOIN..ON,
-  numeric and string literals.
+  aliases), WHERE (comparisons, `IS [NOT] NULL`, `[NOT] LIKE 'pat%'`),
+  GROUP BY/HAVING, ORDER BY (ASC/DESC), LIMIT, JOIN..ON, numeric and
+  string literals.
 - **schema-aware** (`spark_sql_dfa(table=..., columns=...)`): the
   table/column branches are compiled from the uploaded CSV's schema — the
   same strings app/pipeline.py already feeds the prompt — so the model
@@ -51,6 +52,7 @@ RESERVED: Tuple[str, ...] = (
     "SELECT", "DISTINCT", "FROM", "WHERE", "GROUP", "BY", "HAVING",
     "ORDER", "LIMIT", "JOIN", "INNER", "LEFT", "RIGHT", "ON", "AS",
     "AND", "OR", "ASC", "DESC",
+    "IS", "NOT", "NULL", "LIKE",
     "SUM", "AVG", "COUNT", "MIN", "MAX",
 )
 
@@ -134,7 +136,17 @@ def _build(table: Optional[str], columns: Optional[Tuple[str, ...]]) -> Re:
     operand = Alt(col_ref, number, string_lit, func_call)
     cmp = Alt(Lit("="), Lit("<="), Lit(">="), Lit("<>"), Lit("!="),
               Lit("<"), Lit(">"))
-    predicate = Seq(operand, OWS, cmp, OWS, operand)
+    # IS [NOT] NULL applies to column references (the only operand that
+    # can be null in this subset); [NOT] LIKE takes a string-literal
+    # pattern ('%'/'_' wildcards are already in STRING_CHARS). Both are
+    # word-keyword predicates, so WS separation is mandatory like every
+    # other clause keyword.
+    null_pred = Seq(col_ref, WS, kw("IS"), WS,
+                    Opt(Seq(kw("NOT"), WS)), kw("NULL"))
+    like_pred = Seq(col_ref, WS, Opt(Seq(kw("NOT"), WS)),
+                    kw("LIKE"), WS, string_lit)
+    predicate = Alt(Seq(operand, OWS, cmp, OWS, operand),
+                    null_pred, like_pred)
     condition = Seq(predicate,
                     Star(Seq(WS, Alt(kw("AND"), kw("OR")), WS, predicate)))
 

@@ -178,6 +178,29 @@ class _Parser:
 
     def predicate(self) -> None:
         self.operand()
+        # IS [NOT] NULL / [NOT] LIKE 'pattern' — keyword predicates; the
+        # lexer already split words, so (unlike the DFA) `a IS  NULL` with
+        # any whitespace parses. Leniency note: the DFA restricts the
+        # left side to a column reference while this parser accepts any
+        # operand ("5 IS NULL" parses here, is unspellable there) — safe
+        # in the guaranteed direction, DFA ⊆ parser.
+        if self.at_kw("IS"):
+            self.take()
+            if self.at_kw("NOT"):
+                self.take()
+            self.expect_kw("NULL")
+            return
+        if self.at_kw("NOT", "LIKE"):
+            if self.at_kw("NOT"):
+                self.take()
+            self.expect_kw("LIKE")
+            tok = self.take()
+            if tok.kind != "string":
+                raise SqlSyntaxError(
+                    f"LIKE needs a string pattern at {tok.pos}, "
+                    f"got {tok.text!r}"
+                )
+            return
         tok = self.take()
         if tok.kind != "op":
             raise SqlSyntaxError(

@@ -39,12 +39,37 @@ def main(argv=None) -> None:
                     help="decode under the in-tree Spark-SQL grammar "
                          "(constrain/): every completion is guaranteed to "
                          "parse — engine/scheduler backends only")
+    ap.add_argument("--chaos", nargs="?", metavar="SPEC",
+                    const="",  # bare --chaos = the default spec
+                    help="fault-injection run: drive the fixture suite "
+                         "through a self-contained serving stack (fake "
+                         "Ollama daemon + resilient SQLite) under this "
+                         "LSOT_FAULTS-style spec (default "
+                         "'ollama:connect:0.5,sql:exec:1' — "
+                         "evalh.chaos.DEFAULT_SPEC) and report "
+                         "success-after-retry / shed / degraded rates — "
+                         "asserts zero hung requests. Self-contained: "
+                         "ignores --backend")
+    ap.add_argument("--chaos-seed", type=int, default=0, metavar="N",
+                    help="seed for the --chaos injection RNG (same spec + "
+                         "seed replays the same fault schedule)")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--virtual-devices", type=int, default=0, metavar="N",
                     help="expose N virtual CPU devices (implies --cpu) so "
                          "tp=4/tp=8 config rows run their named mesh")
     args = ap.parse_args(argv)
+
+    if args.chaos is not None:
+        # Pure-host run (fake daemon + SQLite): no jax platform needed.
+        from .chaos import run_chaos
+
+        print(json.dumps(
+            run_chaos(args.chaos or None, seed=args.chaos_seed,
+                      max_new_tokens=args.max_new_tokens),
+            indent=2,
+        ))
+        return
 
     if args.virtual_devices:
         from .report import force_virtual_devices

@@ -2,6 +2,15 @@
 
 from .backends import Completion, EngineBackend, FakeBackend  # noqa: F401
 from .ollama_client import OllamaClientService  # noqa: F401
+from .resilience import (  # noqa: F401
+    CircuitBreaker,
+    CircuitOpen,
+    Deadline,
+    DeadlineExceeded,
+    Overloaded,
+    RetryPolicy,
+    SchedulerCrashed,
+)
 from .scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     SchedulerBackend,

@@ -29,20 +29,41 @@ reporting a fake batched wall-clock would flatter it.
 from __future__ import annotations
 
 import json
+import random
 import time
 import urllib.request
 from typing import List, Optional
 
+from ..utils.faults import FAULTS, InjectedFault
+from .resilience import CircuitBreaker, RetryPolicy
 from .service import GenerateResult
 
 
 class OllamaClientService:
-    """Duck-typed GenerationService over a live Ollama HTTP endpoint."""
+    """Duck-typed GenerationService over a live Ollama HTTP endpoint.
+
+    Fault tolerance (serve/resilience.py): connect-phase failures — the
+    request never reached the daemon, so replaying cannot double-generate —
+    retry with capped exponential backoff + full jitter; repeated failures
+    open a per-service circuit breaker so a down daemon sheds calls
+    instantly (CircuitOpen) instead of burning a connect timeout per
+    request. HTTP error responses (the daemon answered: model not found,
+    bad request) are NEVER retried and count as breaker successes — the
+    dependency is up. Chaos seam: `ollama:connect` (utils/faults.py)."""
 
     def __init__(self, base_url: str = "http://127.0.0.1:11434",
-                 timeout_s: float = 300.0):
+                 timeout_s: float = 300.0,
+                 retry: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+        )
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            f"ollama {self.base_url}", failure_threshold=5, reset_after_s=10.0,
+        )
+        self._rng = random.Random()  # backoff jitter only
         # Kept for surface parity with GenerationService consumers that
         # read .stats (the /models route); remote requests are accounted
         # by the harness itself.
@@ -50,26 +71,60 @@ class OllamaClientService:
 
     # ----------------------------------------------------------- plumbing
 
+    @staticmethod
+    def _connect_phase(e: BaseException) -> bool:
+        """Safe to retry: the request never reached the daemon. HTTPError
+        subclasses URLError but IS a server response — excluded."""
+        import urllib.error
+
+        return isinstance(
+            e, (urllib.error.URLError, InjectedFault, OSError)
+        ) and not isinstance(e, urllib.error.HTTPError)
+
     def _open(self, req) -> dict:
         # Surface the server's JSON error body ("model 'x' not found",
         # overload, ...) instead of a bare HTTPError traceback that aborts
         # a multi-model report with no explanation.
         import urllib.error
 
-        try:
+        if not self._breaker.allow():
+            raise self._breaker.shed()
+
+        def attempt() -> dict:
+            FAULTS.check("ollama:connect")
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return json.load(r)
+
+        try:
+            out = self._retry.call(
+                attempt, retryable=self._connect_phase, rng=self._rng,
+            )
         except urllib.error.HTTPError as e:
+            # The daemon responded: it is UP (breaker-wise), the request
+            # itself is bad.
+            self._breaker.record_success()
             body = e.read().decode(errors="replace")[:500]
             raise RuntimeError(
                 f"ollama server returned {e.code} for "
                 f"{getattr(req, 'full_url', req)}: {body}"
             ) from e
-        except urllib.error.URLError as e:
+        except (urllib.error.URLError, OSError) as e:
+            self._breaker.record_failure()
+            reason = getattr(e, "reason", e)
             raise RuntimeError(
-                f"cannot reach ollama at {self.base_url}: {e.reason} — is "
+                f"cannot reach ollama at {self.base_url}: {reason} — is "
                 f"the daemon running (`ollama serve`)?"
             ) from e
+        except Exception:
+            # Anything else (e.g. a 200 with a non-JSON body: proxy error
+            # page, truncated response) is still an unhealthy dependency —
+            # and EVERY outcome must be recorded: a half-open probe that
+            # escaped both clauses above would otherwise keep its permit
+            # (_probing) forever and wedge the breaker open.
+            self._breaker.record_failure()
+            raise
+        self._breaker.record_success()
+        return out
 
     def _get(self, path: str) -> dict:
         return self._open(self.base_url + path)

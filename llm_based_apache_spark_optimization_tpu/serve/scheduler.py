@@ -121,6 +121,14 @@ from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
 from ..ops.sampling import SamplingParams, apply_token_mask, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
+from ..utils.faults import FAULTS
+from ..utils.observability import resilience
+from .resilience import (
+    Deadline,
+    DeadlineExceeded,
+    Overloaded,
+    SchedulerCrashed,
+)
 
 _log = logging.getLogger("lsot.scheduler")
 
@@ -173,6 +181,12 @@ class _Request:
     # of the budget into an abandoned consumer (client disconnects must not
     # pin slots).
     cancelled: bool = False
+    # Per-request deadline (serve/resilience.Deadline), threaded submit →
+    # queue → decode: expired queued requests fail fast at admission
+    # (never occupying a slot); expired in-flight requests are retired at
+    # the next harvest through the same path cancellation uses — either
+    # way the future fails with a typed DeadlineExceeded.
+    deadline: Optional[Deadline] = None
     # Grammar-constrained decoding (constrain.CompiledMask): the slot's
     # on-device DFA state starts at constraint.init_state and every decode
     # step applies the state's precomputed vocabulary mask. None = free.
@@ -190,6 +204,15 @@ class _Request:
                 self.on_token(tok)
             except Exception:  # noqa: BLE001 — consumer bugs must not kill serving
                 self.on_token = None
+
+    def past_deadline(self) -> bool:
+        return self.deadline is not None and self.deadline.expired()
+
+    def deadline_error(self) -> DeadlineExceeded:
+        return DeadlineExceeded(
+            f"request deadline exceeded with {len(self.generated)} of "
+            f"{self.max_new} tokens generated"
+        )
 
 
 class ContinuousBatchingScheduler:
@@ -214,9 +237,16 @@ class ContinuousBatchingScheduler:
         speculative_draft: int = 0,
         spec_ngram: int = 3,
         fuse_matmuls: bool = False,
+        max_queue_depth: int = 0,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # Admission control: submits beyond this many queued-not-yet-slotted
+        # requests shed with a typed Overloaded (HTTP 429 upstream) instead
+        # of growing the backlog without bound — under sustained overload an
+        # unbounded queue turns every request into a timeout. 0 = unbounded
+        # (the historical behavior, kept as default for library users).
+        self.max_queue_depth = int(max_queue_depth)
         if fuse_matmuls:
             # Fewer, wider MXU matmuls for admission prefill (the phase
             # that stalls decode rounds under load).
@@ -865,10 +895,19 @@ class ContinuousBatchingScheduler:
             if self._spec_draft:
                 self._hist = out[nc]
 
+    def _crash_error(self) -> SchedulerCrashed:
+        """The typed "engine dead" error for this scheduler's crash (HTTP
+        503 upstream, vs a per-request 500): carries the loop's original
+        traceback so every rejected submit points at the real device
+        failure, not just its own stack."""
+        if isinstance(self._crash, SchedulerCrashed):
+            return self._crash
+        return SchedulerCrashed.from_exception(self._crash)
+
     def start(self) -> "ContinuousBatchingScheduler":
         if self._thread is None:
             if self._crash is not None:
-                raise RuntimeError("scheduler loop crashed") from self._crash
+                raise self._crash_error()
             self._stop_evt.clear()
             with self._submit_lock:
                 self._closed = False
@@ -912,9 +951,16 @@ class ContinuousBatchingScheduler:
         # than the installed one waits for constrained slots to drain, then
         # swaps the tables (one retrace per grammar, never per request).
         constraint: Optional[CompiledMask] = None,
+        # Per-request latency budget in seconds (serve/resilience.Deadline):
+        # the request fails with a typed DeadlineExceeded — fast at
+        # admission if it expired while queued, or at the next harvest once
+        # in flight. None = no deadline.
+        deadline_s: Optional[float] = None,
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         if constraint is not None:
             if self._spec_draft:
                 raise ValueError(
@@ -962,17 +1008,34 @@ class ContinuousBatchingScheduler:
             temperature=sampling.temperature, top_p=sampling.top_p,
             top_k=sampling.top_k, seed=seed,
             future=Future(), on_token=on_token, constraint=constraint,
+            deadline=(Deadline.after(deadline_s)
+                      if deadline_s is not None else None),
         )
         req.future._lsot_request = req  # cancel() handle
         with self._submit_lock:
             if self._closed:
                 if self._crash is not None:
-                    raise RuntimeError("scheduler loop crashed") from self._crash
+                    raise self._crash_error()
                 raise RuntimeError("scheduler has shut down")
             if self._thread is None:
                 raise RuntimeError(
                     "scheduler not started — call start() or use it as a "
                     "context manager (a queued Future would never resolve)"
+                )
+            # Admission control: shed instead of queueing without bound.
+            # qsize() counts requests not yet pulled into slots/prefill —
+            # the true backlog a new request would wait behind.
+            if self.max_queue_depth and \
+                    self._queue.qsize() >= self.max_queue_depth:
+                resilience.inc("shed")
+                raise Overloaded(
+                    f"scheduler queue at capacity "
+                    f"({self.max_queue_depth} waiting requests)",
+                    # Backpressure hint: roughly one queue-drain of decode
+                    # rounds; precise ETA needs workload knowledge the
+                    # scheduler doesn't have — 1s is the floor clients
+                    # should wait before retrying.
+                    retry_after_s=1.0,
                 )
             self._queue.put(req)
         return req.future
@@ -1024,7 +1087,7 @@ class ContinuousBatchingScheduler:
             return None
         from ..engine.speculative import (
             VERIFY_COST_CALIBRATION,
-            VERIFY_COST_RATIO,
+            verify_cost_ratio,
         )
 
         # Copy the pair under the scheduler's lock: the harvest thread
@@ -1033,14 +1096,20 @@ class ContinuousBatchingScheduler:
         with self._submit_lock:
             rounds, toks = self._spec_rounds, self._spec_tokens
         tpr = toks / rounds if rounds else 0.0
+        # The verify cost scales with THIS scheduler's draft length
+        # (ADVICE r5 #3: a D=4 deployment's breakeven is not D=8's) — the
+        # per-D linear model replaces the old single 1.6 constant.
+        ratio = verify_cost_ratio(self._spec_draft)
         return {
             "verify_rounds": rounds,
             "tokens_emitted": toks,
             "tokens_per_round": round(tpr, 3),
             "est_speedup_vs_vanilla":
-                round(tpr / VERIFY_COST_RATIO, 3) if rounds else 0.0,
-            # The ratio under that estimate was measured at ONE shape; a
-            # 7B/int4/TP serving config can sit meaningfully off it.
+                round(tpr / ratio, 3) if rounds else 0.0,
+            # The estimate's denominator, at this config's draft length,
+            # plus where the model's anchors were measured — a 7B/int4/TP
+            # serving config can still sit meaningfully off it.
+            "verify_cost_ratio": round(ratio, 3),
             "est_speedup_calibration": VERIFY_COST_CALIBRATION,
         }
 
@@ -1095,6 +1164,14 @@ class ContinuousBatchingScheduler:
         any cached prefix blocks first (device-to-device copy, no forward)."""
         if req.cancelled:  # cancelled while queued: never occupy a slot
             req.future.set_result(req.generated)
+            return
+        if req.past_deadline():
+            # Expired while queued: fail fast with the typed error before
+            # ever occupying a slot — under overload, prefilling work whose
+            # caller already gave up only steals device time from requests
+            # that can still make their deadlines.
+            resilience.inc("deadline_expired")
+            req.future.set_exception(req.deadline_error())
             return
         self._slot_req[slot] = req
         # Park the slot's decode writes before its prompt starts streaming in
@@ -1303,6 +1380,11 @@ class ContinuousBatchingScheduler:
         """Dispatch one decode round asynchronously: state chains on device,
         nothing syncs here. The round's tokens are harvested `_harvest_lag`
         rounds later so the transfer round-trip overlaps later compute."""
+        # Chaos seam (utils/faults.py): a `sched:decode` fault simulates a
+        # device/loop failure mid-round — the loop dies, _run wraps it in
+        # SchedulerCrashed, and every client future must fail typed, never
+        # hang (asserted by the chaos tests).
+        FAULTS.check("sched:decode")
         active = np.asarray(
             [r is not None and r.ready for r in self._slot_req]
         )
@@ -1340,6 +1422,15 @@ class ContinuousBatchingScheduler:
         on-device sampling knobs (a lingering temperature > 0 would defeat
         sample_runtime's all-greedy fast path for every later round)."""
         req.future.set_result(result)
+        self._release_slot(slot)
+
+    def _fail_slot(self, slot: int, req: _Request, exc: Exception) -> None:
+        """Retire a slot with a typed FAILURE (deadline expiry): same slot
+        release as _retire, but the future carries the error."""
+        req.future.set_exception(exc)
+        self._release_slot(slot)
+
+    def _release_slot(self, slot: int) -> None:
         self._slot_req[slot] = None
         self._temps, self._topps, self._topks, self._cstates = self._retire_fn(
             self._temps, self._topps, self._topks, self._cstates,
@@ -1355,6 +1446,12 @@ class ContinuousBatchingScheduler:
             return  # cleared by shutdown/crash path meanwhile
         if req.cancelled:
             self._retire(slot, req, req.generated)
+            return
+        if req.past_deadline():
+            # In-flight expiry rides the cancel path's timing (next
+            # harvest) but fails the future with the typed error.
+            resilience.inc("deadline_expired")
+            self._fail_slot(slot, req, req.deadline_error())
             return
         if first in self.stop_ids or req.max_new < 1:
             self._retire(slot, req, [])
@@ -1382,6 +1479,10 @@ class ContinuousBatchingScheduler:
                 continue  # inactive at issue, or already retired
             if req.cancelled:
                 self._retire(i, req, req.generated)
+                continue
+            if req.past_deadline():
+                resilience.inc("deadline_expired")
+                self._fail_slot(i, req, req.deadline_error())
                 continue
             # Speculative rounds emit a variable number of accepted tokens
             # per slot; vanilla rounds emit the whole chunk row.
@@ -1427,8 +1528,12 @@ class ContinuousBatchingScheduler:
             self._loop()
             self._close(RuntimeError("scheduler shut down mid-request"))
         except BaseException as exc:  # noqa: BLE001 — a dead loop must not hang clients
-            self._crash = exc
-            self._close(exc)
+            # Fail everything with the TYPED crash error (original
+            # traceback attached): callers distinguish "engine dead" (503,
+            # breaker-relevant) from a per-request failure (500).
+            wrapped = SchedulerCrashed.from_exception(exc)
+            self._crash = wrapped
+            self._close(wrapped)
             raise
 
     def _close(self, exc: BaseException) -> None:
@@ -1600,11 +1705,12 @@ class SchedulerPool:
 
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
-               on_token=None, constraint=None):
+               on_token=None, constraint=None, deadline_s=None):
         # Skip replicas whose event loop has crashed: a dead scheduler must
         # not keep failing its round-robin share while healthy ones idle.
         # The try/except covers the race where a replica dies between the
         # _crash check and its submit() — fail over, don't fail the request.
+        last_overloaded: Optional[Overloaded] = None
         for _ in range(len(self.schedulers)):
             with self._lock:
                 sched = self.schedulers[self._rr % len(self.schedulers)]
@@ -1615,11 +1721,17 @@ class SchedulerPool:
                 return sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
                     seed=seed, on_token=on_token, constraint=constraint,
+                    deadline_s=deadline_s,
                 )
             except ValueError:
                 # Request-shape rejection (oversize prompt): identical on
                 # every replica — re-raise rather than spinning the ring.
                 raise
+            except Overloaded as e:
+                # This replica's queue is full; another may have room. Shed
+                # (429) only when EVERY live replica is at capacity.
+                last_overloaded = e
+                continue
             except RuntimeError:
                 # Failover only for genuine crashes that landed between the
                 # _crash check and submit(); lifecycle misuse ("not started",
@@ -1628,6 +1740,8 @@ class SchedulerPool:
                 if sched._crash is None:
                     raise
                 continue
+        if last_overloaded is not None:
+            raise last_overloaded
         raise RuntimeError("all scheduler replicas have crashed")
 
     cancel = staticmethod(ContinuousBatchingScheduler.cancel)
@@ -1652,6 +1766,10 @@ class SchedulerBackend:
 
     #: GenerationService checks this before forwarding a `constrain=` spec.
     supports_constrain = True
+    #: GenerationService checks this before forwarding a `deadline_s`: the
+    #: scheduler can actually retire an in-flight request at harvest time,
+    #: unlike the one-XLA-program engine.
+    supports_deadline = True
 
     def __init__(
         self,
@@ -1661,6 +1779,7 @@ class SchedulerBackend:
         sampling: SamplingParams = SamplingParams(),
         stop_texts: Sequence[str] = (),
         add_bos: bool = True,
+        deadline_s: Optional[float] = None,
     ):
         self.scheduler = scheduler.start()
         self.tokenizer = tokenizer
@@ -1668,6 +1787,9 @@ class SchedulerBackend:
         self.sampling = sampling
         self.stop_texts = tuple(stop_texts)
         self.add_bos = add_bos
+        # Default per-request deadline (None = no deadline); a request's
+        # own deadline_s overrides it.
+        self.deadline_s = deadline_s
 
     def shutdown(self) -> None:
         """Stop the scheduler's event loop (idempotent; safe on shared
@@ -1702,6 +1824,7 @@ class SchedulerBackend:
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         speculative_draft: int = 0,
+        max_queue_depth: int = 0,
         **kwargs,
     ) -> "SchedulerBackend":
         """Deployment path for concurrent serving: HF checkpoint straight
@@ -1748,6 +1871,7 @@ class SchedulerBackend:
             else resolve_stop_ids(cfg, tokenizer),
             mesh=sched_mesh, kv_quant=kv_quant,
             speculative_draft=speculative_draft,
+            max_queue_depth=max_queue_depth,
         )
         return cls(sched, tokenizer, **kwargs)
 
@@ -1769,6 +1893,7 @@ class SchedulerBackend:
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         speculative_draft: int = 0,
+        max_queue_depth: int = 0,
         **kwargs,
     ) -> "SchedulerBackend":
         """GGUF blob -> continuous-batching scheduler (C++ parse + dequant,
@@ -1808,6 +1933,7 @@ class SchedulerBackend:
             else resolve_stop_ids(cfg, tokenizer),
             mesh=mesh, kv_quant=kv_quant,
             speculative_draft=speculative_draft,
+            max_queue_depth=max_queue_depth,
         )
         return cls(sched, tokenizer, **kwargs)
 
@@ -1866,7 +1992,8 @@ class SchedulerBackend:
                         sampling: Optional[SamplingParams] = None,
                         seed: int = 0,
                         stats_out: Optional[dict] = None,
-                        constrain=None):
+                        constrain=None,
+                        deadline_s: Optional[float] = None):
         """Stream the completion as text chunks while it decodes — the
         capability Ollama's `stream=true` API exposes and the reference
         never used. Token ids arrive from the scheduler's per-request
@@ -1896,6 +2023,8 @@ class SchedulerBackend:
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed,
             on_token=on_tok, constraint=self._resolve_constraint(constrain),
+            deadline_s=deadline_s if deadline_s is not None
+            else self.deadline_s,
         )
         out_ids: List[int] = []
         emitted = ""
@@ -1951,7 +2080,7 @@ class SchedulerBackend:
 
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
-                 constrain=None):
+                 constrain=None, deadline_s: Optional[float] = None):
         from .backends import Completion, trim_stop_texts
 
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
@@ -1961,6 +2090,8 @@ class SchedulerBackend:
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed, on_token=on_tok,
             constraint=self._resolve_constraint(constrain),
+            deadline_s=deadline_s if deadline_s is not None
+            else self.deadline_s,
         ).result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
         return Completion(text=text, output_tokens=len(out),
@@ -1970,7 +2101,7 @@ class SchedulerBackend:
     def complete_batch(
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None, seed: int = 0,
-        constrain=None,
+        constrain=None, deadline_s: Optional[float] = None,
     ):
         """Submit the whole batch at once: the scheduler interleaves the
         prompts through its slot pool, so this IS continuous batching —
@@ -1979,6 +2110,8 @@ class SchedulerBackend:
         from .backends import Completion, trim_stop_texts
 
         constraint = self._resolve_constraint(constrain)
+        effective_deadline = (deadline_s if deadline_s is not None
+                              else self.deadline_s)
         ids_list = [
             self.tokenizer.encode(p, add_bos=self.add_bos) for p in prompts
         ]
@@ -1989,6 +2122,7 @@ class SchedulerBackend:
                 ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
                 sampling=sampling or self.sampling, seed=seed,
                 on_token=on_tok, constraint=constraint,
+                deadline_s=effective_deadline,
             )
             for ids, (on_tok, _) in zip(ids_list, timers)
         ]

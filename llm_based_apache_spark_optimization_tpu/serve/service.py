@@ -16,7 +16,12 @@ import time
 from typing import Dict, Optional
 
 from ..ops.sampling import SamplingParams
-from ..utils.observability import MetricsRegistry, RequestMetrics, trace_capture
+from ..utils.observability import (
+    MetricsRegistry,
+    RequestMetrics,
+    resilience,
+    trace_capture,
+)
 from .templates import TEMPLATES, Template
 
 
@@ -87,10 +92,18 @@ class GenerationService:
     def metrics_snapshot(self) -> Dict[str, Dict]:
         """The /metrics payload: per-model request aggregates with each
         model's serving-layer stats merged under "serving" — ONE
-        definition for the web and headless-API endpoints."""
+        definition for the web and headless-API endpoints. Process-wide
+        fault-tolerance counters (retries, sheds, deadline expiries,
+        breaker trips — serve/resilience.py) ride under the reserved
+        "resilience" key whenever any fired: under load these numbers ARE
+        the serving story, and an operator reading only per-model
+        aggregates would see throughput without the sheds that bought it."""
         snap = self.metrics.snapshot()
         for model, extra in self.backend_stats().items():
             snap.setdefault(model, {})["serving"] = extra
+        counters = resilience.snapshot()
+        if any(counters.values()):
+            snap["resilience"] = counters
         return snap
 
     def close(self) -> None:
@@ -121,6 +134,20 @@ class GenerationService:
             )
         return {"constrain": constrain}
 
+    @staticmethod
+    def _deadline_kwargs(entry: ModelEntry, deadline_s) -> Dict:
+        """Per-request deadline (seconds), forwarded only to backends that
+        can actually enforce one (`supports_deadline`: the scheduler
+        retires in-flight work at harvest). Other backends — the
+        one-XLA-program engine, fakes — silently ignore it: a deadline is
+        best-effort latency control, not a correctness contract, and
+        failing the request over an unenforceable hint would be worse than
+        serving it."""
+        if deadline_s is None or not getattr(
+                entry.backend, "supports_deadline", False):
+            return {}
+        return {"deadline_s": deadline_s}
+
     def generate(
         self,
         model: str,
@@ -130,6 +157,7 @@ class GenerationService:
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
         constrain=None,
+        deadline_s: Optional[float] = None,
     ) -> GenerateResult:
         entry = self._entry(model)
         rendered = entry.template(system, prompt)
@@ -138,6 +166,7 @@ class GenerationService:
             completion = entry.backend.complete(
                 rendered, max_new_tokens=max_new_tokens, sampling=sampling,
                 seed=seed, **self._constrain_kwargs(entry, constrain),
+                **self._deadline_kwargs(entry, deadline_s),
             )
         latency = time.perf_counter() - t0
         with self._lock:
@@ -210,6 +239,7 @@ class GenerationService:
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
         constrain=None,
+        deadline_s: Optional[float] = None,
     ):
         """Yield the completion as text chunks while it decodes (Ollama's
         `stream=true` surface). Backends without a `complete_stream` seam
@@ -217,6 +247,7 @@ class GenerationService:
         Metrics record the request exactly like generate()."""
         entry = self._entry(model)
         ckw = self._constrain_kwargs(entry, constrain)
+        ckw.update(self._deadline_kwargs(entry, deadline_s))
         rendered = entry.template(system, prompt)
         t0 = time.perf_counter()
         out_tokens = prompt_tokens = 0

@@ -1,6 +1,12 @@
 """SQL execution backends: CSV → temp_view → SQL → single-file CSV export."""
 
-from .backend import ResultTable, SQLBackend, TableSchema  # noqa: F401
+from .backend import (  # noqa: F401
+    ResilientSQLBackend,
+    ResultTable,
+    SQLBackend,
+    TableSchema,
+    is_transient_sql_error,
+)
 from .spark_backend import SparkBackend, spark_available  # noqa: F401
 from .sqlite_backend import SQLiteBackend  # noqa: F401
 

@@ -19,7 +19,8 @@ Two implementations:
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Protocol, Sequence, Tuple
+import random
+from typing import List, Optional, Protocol, Sequence, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,3 +54,90 @@ class SQLBackend(Protocol):
     def write_csv(self, result: ResultTable, out_path: str) -> str:
         """Write result as ONE headed CSV file (coalesce(1) semantics)."""
         ...
+
+
+def is_transient_sql_error(e: BaseException) -> bool:
+    """Infra-shaped SQL failures worth retrying (and breaker-counting):
+    injected chaos faults, sqlite lock/busy contention, py4j/Spark
+    connection drops. A syntax/semantic error is DETERMINISTIC — retrying
+    replays the same failure and must instead go straight to the
+    error-analysis path."""
+    from ..utils.faults import InjectedFault
+
+    if isinstance(e, InjectedFault):
+        return True
+    import sqlite3
+
+    if isinstance(e, sqlite3.OperationalError):
+        msg = str(e).lower()
+        return "locked" in msg or "busy" in msg
+    # Spark's py4j surfaces dead-gateway errors as generic Py4JError /
+    # ConnectionError shapes; match by type name so the sqlite-only image
+    # needs no pyspark import.
+    if isinstance(e, ConnectionError):
+        return True
+    return type(e).__name__ in ("Py4JNetworkError", "Py4JJavaError") and \
+        "connection" in str(e).lower()
+
+
+class ResilientSQLBackend:
+    """SQLBackend wrapper: fault injection seams + transient-error retry +
+    a circuit breaker around `execute()` (serve/resilience.py).
+
+    The retry replays only failures `is_transient_sql_error` classifies as
+    infrastructure (the queries are SELECTs over temp views — idempotent by
+    construction); deterministic engine errors propagate immediately to the
+    error-analysis stage, exactly as before. The breaker counts only those
+    infra failures: when the engine itself is down, requests shed with
+    `CircuitOpen` instead of each burning a full retry ladder, and the
+    pipeline degrades along its existing SQL-failure path. Chaos seams:
+    `sql:load` and `sql:exec` (utils/faults.py)."""
+
+    def __init__(self, inner: SQLBackend, retry=None, breaker=None,
+                 rng: Optional[random.Random] = None):
+        from ..serve.resilience import CircuitBreaker, RetryPolicy
+
+        self.inner = inner
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=3, base_delay_s=0.02, max_delay_s=0.5,
+        )
+        self._breaker = breaker if breaker is not None else CircuitBreaker(
+            "sql backend", failure_threshold=5, reset_after_s=10.0,
+        )
+        self._rng = rng if rng is not None else random.Random()
+
+    def load_csv(self, path: str, view_name: str = "temp_view") -> TableSchema:
+        from ..utils.faults import FAULTS
+
+        # No retry: load failures (missing file, malformed CSV) are
+        # deterministic; the seam exists so chaos runs can fail the load
+        # boundary too.
+        FAULTS.check("sql:load")
+        return self.inner.load_csv(path, view_name)
+
+    def execute(self, sql: str) -> ResultTable:
+        from ..utils.faults import FAULTS
+
+        if not self._breaker.allow():
+            raise self._breaker.shed()
+
+        def attempt() -> ResultTable:
+            FAULTS.check("sql:exec")
+            return self.inner.execute(sql)
+
+        try:
+            out = self._retry.call(
+                attempt, retryable=is_transient_sql_error, rng=self._rng,
+            )
+        except Exception as e:
+            if is_transient_sql_error(e):
+                self._breaker.record_failure()
+            else:
+                # The engine answered (with an error): it is up.
+                self._breaker.record_success()
+            raise
+        self._breaker.record_success()
+        return out
+
+    def write_csv(self, result: ResultTable, out_path: str) -> str:
+        return self.inner.write_csv(result, out_path)

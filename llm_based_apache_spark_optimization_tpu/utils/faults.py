@@ -1,0 +1,136 @@
+"""Deterministic fault injection at the serving stack's failure boundaries.
+
+A fault-tolerance layer that has never seen a fault is untested by
+definition (FlashInfer-Bench's thesis, PAPERS.md: a serving stack is only
+trustworthy when its failure behavior is itself exercised by the harness).
+This registry lets tests, the chaos evalh mode, and `scripts/chaos_smoke.sh`
+make the out-of-process boundaries fail ON DEMAND, reproducibly:
+
+    LSOT_FAULTS=ollama:connect:0.5,sql:exec:1 LSOT_FAULTS_SEED=0 pytest -m chaos
+
+Spec grammar: comma-separated `site:point:probability` triples. The first
+two fields name an injection site (`ollama:connect`, `sql:exec`,
+`sql:load`, `sched:decode` — grep for `FAULTS.check` to enumerate); the
+probability is a float in (0, 1]. The RNG is seeded (`LSOT_FAULTS_SEED`,
+default 0), so the same spec + seed + call sequence replays the exact same
+fault schedule — chaos tests assert concrete outcomes, not distributions.
+
+Injection points call `FAULTS.check("site:point")`, which raises
+`InjectedFault` (a ConnectionError subclass, so connect-phase retry
+classifiers treat it exactly like a real refused connection) with the
+configured probability. With no spec configured the check is one dict
+lookup on an empty dict — effectively free on the serving path.
+
+Determinism caveat: the registry draws from ONE seeded stream, so replay
+is exact only when the injection points are hit in a deterministic order
+(single-threaded harnesses, or probability 1). Concurrent chaos runs still
+get the configured *rates*, just not a bit-exact schedule.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict
+
+from .observability import resilience
+
+__all__ = ["FAULTS", "FaultRegistry", "InjectedFault"]
+
+
+class InjectedFault(ConnectionError):
+    """A deliberately injected failure. Subclasses ConnectionError so the
+    retry layers' connect-phase classifiers (and generic OSError handlers)
+    treat it like the real outage it simulates."""
+
+    def __init__(self, site: str):
+        super().__init__(f"injected fault at {site!r} (LSOT_FAULTS)")
+        self.site = site
+
+
+class FaultRegistry:
+    """Seeded per-site fault probabilities + injected-fault counters."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._probs: Dict[str, float] = {}
+        self._rng = random.Random(0)
+        self._counts: Dict[str, int] = {}
+
+    # ------------------------------------------------------------- config
+
+    @staticmethod
+    def parse(spec: str) -> Dict[str, float]:
+        """`"ollama:connect:0.5,sql:exec:1"` -> {"ollama:connect": 0.5,
+        "sql:exec": 1.0}. Raises ValueError on malformed entries — a typo'd
+        chaos spec must fail the run, not silently inject nothing."""
+        probs: Dict[str, float] = {}
+        for entry in filter(None, (s.strip() for s in spec.split(","))):
+            parts = entry.rsplit(":", 1)
+            if len(parts) != 2 or ":" not in parts[0]:
+                raise ValueError(
+                    f"bad fault spec entry {entry!r} (want site:point:prob)"
+                )
+            site, prob_s = parts
+            try:
+                prob = float(prob_s)
+            except ValueError:
+                raise ValueError(
+                    f"bad fault probability in {entry!r}"
+                ) from None
+            if not 0.0 < prob <= 1.0:
+                raise ValueError(
+                    f"fault probability must be in (0, 1], got {prob} "
+                    f"in {entry!r}"
+                )
+            probs[site] = prob
+        return probs
+
+    def configure(self, spec: str, seed: int = 0) -> "FaultRegistry":
+        """(Re)configure sites + reseed the stream; empty spec disables."""
+        probs = self.parse(spec)
+        with self._lock:
+            self._probs = probs
+            self._rng = random.Random(seed)
+            self._counts = {}
+        return self
+
+    def configure_from_env(self) -> "FaultRegistry":
+        return self.configure(
+            os.environ.get("LSOT_FAULTS", ""),
+            int(os.environ.get("LSOT_FAULTS_SEED", "0")),
+        )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._probs = {}
+            self._counts = {}
+
+    @property
+    def active(self) -> bool:
+        return bool(self._probs)
+
+    # ----------------------------------------------------------- checking
+
+    def check(self, site: str) -> None:
+        """Raise InjectedFault with the site's configured probability."""
+        if not self._probs:  # fast path: injection off
+            return
+        with self._lock:
+            prob = self._probs.get(site)
+            if prob is None or self._rng.random() >= prob:
+                return
+            self._counts[site] = self._counts.get(site, 0) + 1
+        resilience.inc("faults_injected")
+        raise InjectedFault(site)
+
+    def counts(self) -> Dict[str, int]:
+        """Injected faults per site since configure()."""
+        with self._lock:
+            return dict(self._counts)
+
+
+#: Process-wide registry every injection point consults; configured from
+#: LSOT_FAULTS / LSOT_FAULTS_SEED at import (tests reconfigure directly).
+FAULTS = FaultRegistry().configure_from_env()

@@ -166,6 +166,36 @@ class MetricsRegistry:
 registry = MetricsRegistry()
 
 
+class CounterSet:
+    """Named monotonic counters (thread-safe) for low-cardinality event
+    counts the per-request registry cannot express: retries, sheds,
+    breaker trips, injected faults. Snapshot is a plain dict for the
+    /metrics payload."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+#: Process-wide fault-tolerance counters (serve/resilience.py writes them:
+#: retries/retry_giveups, shed, deadline_expired, breaker_trips/
+#: breaker_open_shed/breaker_closes, faults_injected) — merged into the
+#: /metrics payload by GenerationService.metrics_snapshot.
+resilience = CounterSet()
+
+
 @contextlib.contextmanager
 def trace_capture(name: str = "lsot") -> Iterator[None]:
     """jax.profiler trace of the enclosed region when LSOT_TRACE_DIR is set.

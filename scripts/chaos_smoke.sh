@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Chaos smoke: the fault-injection test lane under a FIXED spec + seed.
+#
+# Runs every `chaos`-marked test (scheduler crash typing, admission
+# shedding, retry/breaker behavior at the Ollama and SQL boundaries, the
+# chaos evalh report) with LSOT_FAULTS/LSOT_FAULTS_SEED pinned so the
+# injected fault schedule — and therefore every assertion — replays
+# exactly. These tests are NOT marked slow: the default tier-1 run
+# (`pytest -m 'not slow'`) includes them; this script is the focused lane
+# for iterating on the fault-tolerance layer.
+#
+#   LSOT_FAULTS=... LSOT_FAULTS_SEED=... scripts/chaos_smoke.sh [pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export LSOT_FAULTS="${LSOT_FAULTS:-ollama:connect:0.5,sql:exec:1}"
+export LSOT_FAULTS_SEED="${LSOT_FAULTS_SEED:-0}"
+export JAX_PLATFORMS=cpu
+
+exec python -m pytest tests -q -m chaos -p no:cacheprovider "$@"

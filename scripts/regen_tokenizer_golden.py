@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Regenerate the HF/BPE token-mask classification golden fixtures.
+
+tests/golden/sql_bpe/tokenizer.json is a SMALL but REAL byte-level BPE
+vocabulary (trained with the `tokenizers` library on a Spark-SQL corpus, so
+it learns the merges that make mask compilation interesting: multi-char
+tokens like `SELECT`, leading-space tokens like ` FROM` that decode through
+the ByteLevel Ġ-alphabet, punctuation runs). tokenizer_golden.json pins the
+per-token `decode([id])` classification the mask compiler derives from it
+(ROADMAP: byte-fallback BPE merges that decode differently in context
+deserve a golden against a real vocab).
+
+Rerun after changing the grammar (constrain/grammar.py) or the mask
+compiler's classification pass (constrain/masks.py):
+
+    python scripts/regen_tokenizer_golden.py
+
+and review the golden diff like any behavior change.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "..",
+                          "tests", "golden", "sql_bpe")
+
+CORPUS = [
+    "SELECT VendorID, SUM(total_amount) AS total_fare FROM taxi "
+    "WHERE passenger_count > 2 GROUP BY VendorID ORDER BY total_fare DESC;",
+    "SELECT AVG(trip_distance) FROM taxi WHERE fare_amount >= 10 LIMIT 5;",
+    "select tip_amount, tolls_amount from taxi where extra <> 0.5;",
+    "SELECT COUNT(*) FROM taxi WHERE tpep_pickup_datetime IS NOT NULL;",
+    "SELECT * FROM taxi WHERE VendorID LIKE 'abc%' AND tip_amount IS NULL;",
+    "SELECT improvement_surcharge FROM taxi JOIN zones ON taxi.VendorID "
+    "= zones.id HAVING MIN(fare_amount) < 42 OR MAX(extra) != 1;",
+    "SELECT DISTINCT passenger_count FROM taxi ORDER BY 'literal', extra ASC",
+]
+
+
+def build_tokenizer(path: str) -> None:
+    from tokenizers import Tokenizer, models, pre_tokenizers, decoders, trainers
+
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=320,
+        special_tokens=["<s>", "</s>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(CORPUS, trainer)
+    tok.save(path)
+
+
+def main() -> None:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    tok_path = os.path.join(GOLDEN_DIR, "tokenizer.json")
+    build_tokenizer(tok_path)
+
+    from llm_based_apache_spark_optimization_tpu.constrain.grammar import (
+        spark_sql_dfa,
+    )
+    from llm_based_apache_spark_optimization_tpu.constrain.masks import (
+        compile_token_masks,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer.hf import (
+        HFTokenizer,
+    )
+
+    tok = HFTokenizer(tok_path)
+    cm = compile_token_masks(spark_sql_dfa(), tok, (tok.eos_id,))
+    tokens = []
+    for tid in range(tok.vocab_size):
+        # decode([id]) is exactly what the classification pass consumes.
+        text = tok._tok.decode([tid], skip_special_tokens=False)
+        tokens.append({
+            "id": tid,
+            "text": text,
+            # Classified: the token maps SOME real DFA state to a live
+            # state (row 0 is the unconstrained sentinel — excluded).
+            "classified": bool(cm.mask[1:, tid].any()),
+            # Allowed as the FIRST token of a completion.
+            "init_allowed": bool(cm.mask[cm.init_state, tid]),
+        })
+    golden = {
+        "eos_id": tok.eos_id,
+        "vocab_size": tok.vocab_size,
+        "init_state": cm.init_state,
+        "min_new_tokens": cm.min_new_tokens,
+        "tokens": tokens,
+    }
+    out_path = os.path.join(GOLDEN_DIR, "tokenizer_golden.json")
+    with open(out_path, "w") as f:
+        json.dump(golden, f, indent=1)
+        f.write("\n")
+    n_cls = sum(t["classified"] for t in tokens)
+    n_init = sum(t["init_allowed"] for t in tokens)
+    print(f"wrote {out_path}: vocab={tok.vocab_size} "
+          f"classified={n_cls} init_allowed={n_init}")
+
+
+if __name__ == "__main__":
+    main()

@@ -90,6 +90,46 @@ def test_parser_rejects_with_positions():
         parse_spark_sql("SELECT a FROM taxi; extra")
 
 
+def test_null_and_like_predicates_accepted_by_both():
+    """ROADMAP grammar-coverage slice: IS [NOT] NULL and [NOT] LIKE are in
+    the language (grammar + parser; the token-mask compiler needed no
+    changes — the keywords are plain letters already in the alphabet)."""
+    dfa = spark_sql_dfa()
+    sdfa = spark_sql_dfa("taxi", tuple(TAXI_COLUMNS))
+    good = [
+        "SELECT * FROM taxi WHERE tip_amount IS NULL;",
+        "SELECT * FROM taxi WHERE tip_amount IS NOT NULL",
+        "SELECT VendorID FROM taxi WHERE extra LIKE 'a%_b'",
+        "SELECT VendorID FROM taxi WHERE extra NOT LIKE 'x%' "
+        "AND fare_amount > 2",
+        "select trip_distance from taxi where trip_distance is not null "
+        "or extra like '%5' order by trip_distance desc limit 3;",
+        "SELECT COUNT(*) AS n FROM taxi "
+        "GROUP BY VendorID HAVING extra IS NULL",
+    ]
+    for sql in good:
+        assert dfa.accepts(sql), sql
+        assert sdfa.accepts(sql), sql
+        parse_spark_sql(sql)  # must not raise
+
+
+def test_null_and_like_invalid_forms_rejected_by_both():
+    dfa = spark_sql_dfa()
+    bad = [
+        "SELECT * FROM taxi WHERE IS NULL",        # no operand
+        "SELECT * FROM taxi WHERE a LIKE b",       # pattern must be a string
+        "SELECT * FROM taxi WHERE a LIKE",         # missing pattern
+        "SELECT * FROM taxi WHERE a IS",           # missing NULL
+        "SELECT * FROM taxi WHERE a NOT NULL",     # NOT without LIKE/IS
+        "SELECT * FROM taxi WHERE a ISNULL",       # keywords must separate
+        "SELECT null FROM taxi",                   # NULL is reserved now
+        "SELECT is FROM taxi",                     # IS is reserved now
+    ]
+    for sql in bad:
+        assert not dfa.accepts(sql), sql
+        assert not is_valid_spark_sql(sql), sql
+
+
 def test_schema_mode_blocks_unknown_identifiers():
     sdfa = spark_sql_dfa("taxi", tuple(TAXI_COLUMNS))
     # A column not in the schema cannot even be *spelled*.
@@ -154,6 +194,64 @@ def test_golden_first_state_mask_byte_tokenizer(tok, generic):
     ids = tok.encode(FIXTURE_SQL[0], add_bos=False)
     end = generic.walk(ids)
     assert end is not None and generic.mask[end, EOS]
+
+
+def test_hf_bpe_golden_classification():
+    """ROADMAP open item: the mask compiler classifies tokens via
+    per-token decode([id]); byte-fallback BPE merges that decode
+    differently in context deserve a golden against a REAL vocab.
+    tests/golden/sql_bpe/ holds a small byte-level BPE tokenizer.json
+    (trained with the `tokenizers` library on a SQL corpus — multi-char
+    merges, leading-space Ġ tokens) plus the pinned per-token
+    classification. Regenerate with scripts/regen_tokenizer_golden.py
+    after grammar/compiler changes and review the diff."""
+    pytest.importorskip("tokenizers")
+    import json
+    from pathlib import Path
+
+    from llm_based_apache_spark_optimization_tpu.constrain.masks import (
+        compile_token_masks,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer.hf import (
+        HFTokenizer,
+    )
+
+    gdir = Path(__file__).parent / "golden" / "sql_bpe"
+    golden = json.loads((gdir / "tokenizer_golden.json").read_text())
+    hft = HFTokenizer(str(gdir / "tokenizer.json"))
+    assert hft.vocab_size == golden["vocab_size"]
+    assert hft.eos_id == golden["eos_id"]
+
+    cm = compile_token_masks(spark_sql_dfa(), hft, (hft.eos_id,))
+    assert cm.init_state == golden["init_state"]
+    assert cm.min_new_tokens == golden["min_new_tokens"]
+    for rec in golden["tokens"]:
+        tid = rec["id"]
+        # The exact string the classification pass consumed…
+        assert hft._tok.decode([tid], skip_special_tokens=False) \
+            == rec["text"], tid
+        # …and both classification bits, token for token.
+        assert bool(cm.mask[1:, tid].any()) == rec["classified"], \
+            (tid, rec["text"])
+        assert bool(cm.mask[cm.init_state, tid]) == rec["init_allowed"], \
+            (tid, rec["text"])
+
+    # The real-vocab concern in context: a full statement encoded through
+    # LEARNED MERGES (not char-by-char) must walk the FSM to a state where
+    # the stop id is legal.
+    ids = hft.encode(
+        "SELECT VendorID FROM taxi WHERE tip_amount IS NULL;",
+        add_bos=False,
+    )
+    end = cm.walk(ids)
+    assert end is not None and cm.mask[end, hft.eos_id]
+    # And the vocab genuinely contains classified multi-char merges with a
+    # leading space (the ByteLevel Ġ decode path) — the shapes a byte
+    # tokenizer never exercises.
+    assert any(
+        len(r["text"]) > 1 and r["text"].startswith(" ") and r["classified"]
+        for r in golden["tokens"]
+    )
 
 
 def test_walk_dies_on_invalid_tokens(tok, generic):

@@ -86,7 +86,12 @@ def test_metrics_endpoint():
     client = app.test_client()
     res = client.request("GET", "/metrics")
     assert res.status == 200
-    assert json.loads(res.body) == {}
+    body = json.loads(res.body)
+    # The reserved "resilience" key carries PROCESS-GLOBAL fault-tolerance
+    # counters (serve/resilience.py) — other tests in the same process may
+    # legitimately have moved them; per-model metrics must still be empty.
+    body.pop("resilience", None)
+    assert body == {}
     svc.generate("duckdb-nsql", "q")
     res = client.request("GET", "/metrics")
     assert json.loads(res.body)["duckdb-nsql"]["requests"] == 1

@@ -291,13 +291,16 @@ def test_speculation_stats_counted_and_surfaced(tiny):
     )
     from llm_based_apache_spark_optimization_tpu.engine.speculative import (
         VERIFY_COST_CALIBRATION,
+        verify_cost_ratio,
     )
 
     assert sched.speculation_stats == {
         "verify_rounds": 0, "tokens_emitted": 0, "tokens_per_round": 0.0,
         "est_speedup_vs_vanilla": 0.0,
-        # ADVICE r5 #3: the estimate is labeled with the shape it was
-        # measured at instead of posing as universal.
+        # ADVICE r5 #3: the verify cost is priced at THIS scheduler's
+        # draft length (linear model), and the estimate stays labeled with
+        # its calibration instead of posing as universal.
+        "verify_cost_ratio": round(verify_cost_ratio(4), 3),
         "est_speedup_calibration": VERIFY_COST_CALIBRATION,
     }
     rep = [1, 5, 9, 5, 9, 5, 9, 5, 9, 5, 9]
