@@ -27,8 +27,31 @@ from .config import AppConfig
 from .web import create_web_app
 
 
+#: Per-process spill-path disambiguation: the same source path can build
+#: two supervisors (e.g. --error-model-path equal to --sql-model-path),
+#: and sharing one file would let the second drain clobber the first's
+#: journal. Construction order is deterministic for a fixed CLI, so the
+#: numeric suffix is stable across restarts — recovery finds its file.
+_SPILL_TAGS: dict = {}
+
+
+def _spill_path(app_cfg, tag: str):
+    """Per-model journal-spill path (None when spilling is off): one
+    naming rule for every scheduler path, so drain and recovery always
+    agree on the file."""
+    if not app_cfg.journal_spill:
+        return None
+    safe = tag.replace("/", "_").replace(":", "_")
+    n = _SPILL_TAGS.get(safe, 0) + 1
+    _SPILL_TAGS[safe] = n
+    if n > 1:
+        safe = f"{safe}.{n}"
+    return f"{app_cfg.journal_spill}.{safe}.jsonl"
+
+
 def make_tiny_service(
-    max_new_tokens: int, scheduler: bool = False, tp: int = 1
+    max_new_tokens: int, scheduler: bool = False, tp: int = 1,
+    supervise: bool = True,
 ) -> GenerationService:
     import dataclasses
 
@@ -82,10 +105,29 @@ def make_tiny_service(
                 SchedulerBackend,
             )
 
-            sched = ContinuousBatchingScheduler(
-                mcfg, mparams, num_slots=8, prompt_bucket=64, mesh=mesh,
-                max_queue_depth=app_cfg.max_queue_depth,
-            )
+            def make_sched(mcfg=mcfg, mparams=mparams):
+                return ContinuousBatchingScheduler(
+                    mcfg, mparams, num_slots=8, prompt_bucket=64, mesh=mesh,
+                    max_queue_depth=app_cfg.max_queue_depth,
+                )
+
+            if supervise:
+                # Crash recovery (serve/supervisor.py): the loop is a
+                # crash-only component — journal, restart, replay. The
+                # factory closes over the already-initialized params, so a
+                # restart re-allocates the cache, not the checkpoint.
+                from ..serve.supervisor import SupervisedScheduler
+
+                sched = SupervisedScheduler(
+                    make_sched, max_restarts=app_cfg.max_restarts,
+                    spill_path=_spill_path(app_cfg, name),
+                    name=f"scheduler:{name}",
+                )
+            else:
+                sched = make_sched()
+            # SchedulerBackend recovers any journal spill from a previous
+            # process at construction (results land in the idempotency
+            # cache where retried keys find them).
             svc.register(
                 name,
                 SchedulerBackend(sched, tok, max_new_tokens=max_new_tokens,
@@ -209,13 +251,17 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                      "PATH.gguf:TOKDIR")
         tok = HFTokenizer(tok_dir or path)
         if args.scheduler:
+            supervise = getattr(args, "supervise", True)
             if len(scheduler_meshes) == 1:
                 common = dict(mesh=scheduler_meshes[0],
                               max_new_tokens=max_new_tokens,
                               add_bos=add_bos, num_slots=args.slots,
                               kv_quant=kv_quant,
                               max_queue_depth=app_cfg.max_queue_depth,
-                              deadline_s=app_cfg.deadline_s or None)
+                              deadline_s=app_cfg.deadline_s or None,
+                              supervise=supervise,
+                              max_restarts=app_cfg.max_restarts,
+                              journal_spill=_spill_path(app_cfg, src))
                 common["speculative_draft"] = getattr(args, "speculative", 0)
                 common["quantize_int8"] = args.int8
                 common["quantize_int4"] = int4
@@ -245,18 +291,36 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 from ..ops.quant import quantize_params
 
                 params = quantize_params(params)
-            scheds = [
-                ContinuousBatchingScheduler(
-                    cfg, params, num_slots=args.slots,
-                    stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
-                    kv_quant=kv_quant,
-                    speculative_draft=getattr(args, "speculative", 0),
-                    max_queue_depth=app_cfg.max_queue_depth,
+            def make_pool():
+                return SchedulerPool([
+                    ContinuousBatchingScheduler(
+                        cfg, params, num_slots=args.slots,
+                        stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
+                        kv_quant=kv_quant,
+                        speculative_draft=getattr(args, "speculative", 0),
+                        max_queue_depth=app_cfg.max_queue_depth,
+                    )
+                    for m in scheduler_meshes
+                ])
+
+            if supervise:
+                # The supervisor wraps the whole pool: a replica crash
+                # (NEW submits already fail over inside the pool) rebuilds
+                # the full pool and replays journaled work — in-flight
+                # requests on the healthy replicas ride the replay too
+                # (teardown crossfire, serve/supervisor.py), restoring
+                # full dp capacity instead of limping on survivors.
+                from ..serve.supervisor import SupervisedScheduler
+
+                pool = SupervisedScheduler(
+                    make_pool, max_restarts=app_cfg.max_restarts,
+                    spill_path=_spill_path(app_cfg, src),
+                    name=f"scheduler-pool:{src}",
                 )
-                for m in scheduler_meshes
-            ]
+            else:
+                pool = make_pool()
             return SchedulerBackend(
-                SchedulerPool(scheds), tok,
+                pool, tok,
                 max_new_tokens=max_new_tokens, add_bos=add_bos,
                 deadline_s=app_cfg.deadline_s or None,
             )
@@ -333,6 +397,14 @@ def main(argv=None) -> None:
                          "--no-scheduler restores lock-serialized engines)")
     ap.add_argument("--slots", type=int, default=8,
                     help="scheduler sequence slots (concurrent decode lanes)")
+    ap.add_argument("--supervise", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="crash supervision for scheduler backends (default "
+                         "on): journal admitted requests, restart a crashed "
+                         "decode loop with backoff, and replay journaled "
+                         "work — /readyz reports "
+                         "ready|restarting|degraded|dead. --no-supervise "
+                         "restores crash-to-503 behavior")
     ap.add_argument("--max-new-tokens", type=int, default=256)
     ap.add_argument("--host", default=None)
     ap.add_argument("--port", type=int, default=None)
@@ -367,7 +439,8 @@ def main(argv=None) -> None:
     else:
         # max_new small for the tiny demo model: it babbles bytes, not SQL.
         service = (
-            make_tiny_service(32, scheduler=args.scheduler, tp=args.tp)
+            make_tiny_service(32, scheduler=args.scheduler, tp=args.tp,
+                              supervise=args.supervise)
             if args.backend == "tiny" else make_fake_service()
         )
     history = SQLiteHistory(cfg.history_db)
@@ -378,7 +451,37 @@ def main(argv=None) -> None:
     kind = "JSON API" if args.api else "web UI"
     print(f"serving {kind} on http://{cfg.host}:{cfg.port} "
           f"(backend={args.backend})", file=sys.stderr)
-    app.serve(cfg.host, cfg.port)
+    app.serve(cfg.host, cfg.port,
+              ready_cb=lambda server: _install_graceful_drain(
+                  service, server, cfg))
+
+
+def _install_graceful_drain(service, server, cfg) -> None:
+    """SIGTERM → graceful drain (README "Crash recovery & lifecycle"):
+    stop admitting (the drain gate answers new POSTs with 503 +
+    Retry-After, /readyz flips to draining), finish in-flight work up to
+    LSOT_DRAIN_DEADLINE_S, journal-and-exit what is left (supervised
+    schedulers spill to LSOT_JOURNAL_SPILL), then stop the HTTP server.
+    Installed on the main thread before serve_forever (signal handlers
+    cannot be installed elsewhere); the drain itself runs on a worker
+    thread because server.shutdown() must not be called from the serving
+    thread."""
+    import signal
+    import threading
+
+    def drain_and_stop():
+        print(f"SIGTERM: draining (deadline {cfg.drain_deadline_s}s)",
+              file=sys.stderr)
+        try:
+            service.drain(cfg.drain_deadline_s)
+        finally:
+            server.shutdown()
+
+    def handler(signum, frame):
+        threading.Thread(target=drain_and_stop, daemon=True,
+                         name="lsot-drain").start()
+
+    signal.signal(signal.SIGTERM, handler)
 
 
 if __name__ == "__main__":

@@ -21,12 +21,14 @@ from ..history.store import HistoryStore
 from ..serve.resilience import (
     CircuitOpen,
     DeadlineExceeded,
+    Draining,
     Overloaded,
     SchedulerCrashed,
 )
 from ..serve.service import GenerationService
 from ..sql.backend import SQLBackend
 from .config import AppConfig
+from .health import add_health_routes, install_drain_gate
 from .pipeline import Pipeline
 from .wsgi import App, Request, Response
 
@@ -44,11 +46,16 @@ def unavailable_response(exc) -> Response:
 
       Overloaded        → 429 + Retry-After (admission control shed it;
                           back off and resubmit)
+      Draining          → 503 + Retry-After (the whole server is shutting
+                          down gracefully, not one queue backing up)
       SchedulerCrashed  → 503 (engine dead — not a per-request 500)
       CircuitOpen       → 503 + Retry-After (a dependency is down; the
                           breaker names the probe window)
       DeadlineExceeded  → 504 (the request's own budget ran out)
     """
+    if isinstance(exc, Draining):
+        return Response.json({"error": str(exc)}, status=503,
+                             headers=_retry_after_headers(exc))
     if isinstance(exc, Overloaded):
         return Response.json({"error": str(exc)}, status=429,
                              headers=_retry_after_headers(exc))
@@ -75,6 +82,10 @@ def create_api_app(
     cfg.ensure_dirs()
     pipeline = Pipeline(service, sql_backend, history, cfg)
     app = App(secret_key=cfg.secret_key)
+    # Lifecycle surface: /healthz (liveness), /readyz (supervisor-aware
+    # readiness), and the SIGTERM drain gate (app/health.py).
+    add_health_routes(app, service)
+    install_drain_gate(app, service)
 
     @app.route("/process-data/", methods=("POST",))
     def process_data(req: Request) -> Response:
@@ -116,7 +127,7 @@ def create_api_app(
     def api_generate(req: Request) -> Response:
         """Direct generation endpoint, Ollama wire shape: body
         `{"model", "prompt", "system"?, "stream"?, "max_new_tokens"?,
-        "constrain"?}`.
+        "constrain"?, "deadline_s"?, "idempotency_key"?}`.
         stream=false (default) returns `{"model", "response", "done": true}`
         in one JSON object; stream=true returns NDJSON lines
         `{"model", "response": <chunk>, "done": false}` flushed per chunk,
@@ -161,6 +172,29 @@ def create_api_app(
                 {"error": "'deadline_s' must be a positive number"},
                 status=400,
             )
+        # Retry safety on the BLOCKING path: a resubmit carrying the same
+        # key after a 503 gets the journaled result instead of a second
+        # generation (supervised scheduler backends; ignored elsewhere).
+        # Rejected with stream=true rather than silently dropped: a
+        # deduped stream would need the journaled tokens replayed into
+        # the new connection, which the streaming path does not do — a
+        # client believing its key protected a retried stream would be
+        # double-generating.
+        idempotency_key = data.get("idempotency_key")
+        if idempotency_key is not None and (
+            not isinstance(idempotency_key, str) or not idempotency_key
+        ):
+            return Response.json(
+                {"error": "'idempotency_key' must be a non-empty string"},
+                status=400,
+            )
+        if idempotency_key is not None and data.get("stream", False):
+            return Response.json(
+                {"error": "'idempotency_key' applies to blocking requests "
+                          "only (stream=false): a retried stream is a new "
+                          "generation"},
+                status=400,
+            )
         constrain = data.get("constrain")
         if constrain is not None and not (
             constrain == "spark_sql"
@@ -202,6 +236,7 @@ def create_api_app(
                 res = service.generate(
                     model, prompt, system=system, max_new_tokens=max_new,
                     constrain=constrain, deadline_s=deadline_s,
+                    idempotency_key=idempotency_key,
                 )
                 return Response.json({
                     "model": model, "response": res.response, "done": True,

@@ -47,6 +47,19 @@ class AppConfig:
     # long it stays open before one half-open probe.
     breaker_threshold: int = 5
     breaker_reset_s: float = 10.0
+    # --- crash recovery & lifecycle (serve/supervisor.py; README "Crash
+    # recovery & lifecycle").
+    # Supervisor restart budget: how many times a crashed decode loop is
+    # rebuilt (with backoff) before /readyz reports "dead" and journaled
+    # work fails typed.
+    max_restarts: int = 5
+    # SIGTERM graceful-drain budget in seconds: stop admitting, finish
+    # in-flight up to this long, then journal-and-exit.
+    drain_deadline_s: float = 10.0
+    # Optional on-disk journal spill (JSONL): unfinished requests are
+    # written here at drain/exit and recovered (resubmitted) at the next
+    # start, so retried idempotency keys find their results. "" = off.
+    journal_spill: str = ""
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

@@ -31,6 +31,7 @@ from ..history.store import HistoryStore
 from ..serve.service import GenerationService
 from ..sql.backend import SQLBackend
 from .config import AppConfig
+from .health import add_health_routes, install_drain_gate
 from .pipeline import ST_UPLOAD, Pipeline
 from .wsgi import App, Request, Response
 
@@ -80,6 +81,10 @@ def create_web_app(
     cfg.ensure_dirs()
     pipeline = Pipeline(service, sql_backend, history, cfg)
     app = App(secret_key=cfg.secret_key)
+    # Same lifecycle surface as the headless API (app/health.py): probes
+    # and the SIGTERM drain gate are frontend-independent.
+    add_health_routes(app, service)
+    install_drain_gate(app, service)
     board = StatusBoard()
     env = Environment(
         loader=FileSystemLoader(str(_TEMPLATES_DIR)),

@@ -192,6 +192,7 @@ class App:
     def __init__(self, secret_key: str = "dev"):
         self._routes: Dict[Tuple[str, str], Handler] = {}
         self._codec = SessionCodec(secret_key)
+        self._before: List[Callable[[Request], Optional[Response]]] = []
 
     def route(self, path: str, methods: Tuple[str, ...] = ("GET",)):
         def deco(fn: Handler) -> Handler:
@@ -199,6 +200,16 @@ class App:
                 self._routes[(m.upper(), path)] = fn
             return fn
         return deco
+
+    def before_request(
+        self, fn: Callable[[Request], Optional[Response]]
+    ) -> Callable[[Request], Optional[Response]]:
+        """Register a gate that runs before routing: returning a Response
+        short-circuits the request (None lets it through). The drain gate
+        (app/health.py) uses this to answer 503 + Retry-After for new work
+        during graceful shutdown without touching every handler."""
+        self._before.append(fn)
+        return fn
 
     def __call__(self, environ, start_response):
         req = Request(environ)
@@ -210,8 +221,21 @@ class App:
                 req.session = self._codec.decode(value)
                 had_cookie = True
         session_before = jsonlib.dumps(req.session, sort_keys=True)
+        resp = None
+        for gate in self._before:
+            try:
+                resp = gate(req)
+            except Exception as e:  # a broken gate must not take the app down
+                resp = Response.json(
+                    {"error": "internal server error", "detail": str(e)},
+                    status=500,
+                )
+            if resp is not None:
+                break
         handler = self._routes.get((req.method, req.path))
-        if handler is None:
+        if resp is not None:
+            pass  # a before-request gate answered (e.g. drain mode)
+        elif handler is None:
             if any(p == req.path for (_, p) in self._routes):
                 resp = Response.json({"error": "method not allowed"}, status=405)
             else:
@@ -254,7 +278,11 @@ class App:
     # --- dev server ---------------------------------------------------------
 
     def serve(self, host: str = "127.0.0.1", port: int = 8000,
-              background: bool = False):
+              background: bool = False, ready_cb=None):
+        """`ready_cb(server)` runs with the bound server BEFORE requests
+        flow — on the main thread, so callers can install signal handlers
+        (the SIGTERM graceful-drain wiring in app/__main__.py) against the
+        live server instance even in foreground mode."""
         import socketserver
         from wsgiref.simple_server import WSGIServer
 
@@ -270,6 +298,8 @@ class App:
             host, port, self, server_class=ThreadingServer,
             handler_class=QuietHandler,
         )
+        if ready_cb is not None:
+            ready_cb(server)
         if background:
             t = threading.Thread(target=server.serve_forever, daemon=True)
             t.start()

@@ -45,11 +45,13 @@ def main(argv=None) -> None:
                          "through a self-contained serving stack (fake "
                          "Ollama daemon + resilient SQLite) under this "
                          "LSOT_FAULTS-style spec (default "
-                         "'ollama:connect:0.5,sql:exec:1' — "
-                         "evalh.chaos.DEFAULT_SPEC) and report "
-                         "success-after-retry / shed / degraded rates — "
-                         "asserts zero hung requests. Self-contained: "
-                         "ignores --backend")
+                         "'ollama:connect:0.5,sql:exec:1,sched:crash:0.2' "
+                         "— evalh.chaos.DEFAULT_SPEC), then a supervised "
+                         "scheduler through sched:crash loop deaths, and "
+                         "report success-after-retry / shed / degraded "
+                         "rates plus restart/replay/lost counts — asserts "
+                         "zero hung requests and zero lost acknowledged "
+                         "requests. Self-contained: ignores --backend")
     ap.add_argument("--chaos-seed", type=int, default=0, metavar="N",
                     help="seed for the --chaos injection RNG (same spec + "
                          "seed replays the same fault schedule)")

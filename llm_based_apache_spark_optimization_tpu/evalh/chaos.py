@@ -20,20 +20,28 @@ The contract the report asserts, and `evalh --chaos` prints:
   failure. Nothing blocks, nothing leaks.
 - the resilience counters (retries, breaker trips, sheds) moved — the
   layer actually did work, the run didn't just get lucky.
+- **zero lost acknowledged requests** across scheduler crashes: a second
+  stage drives a supervised scheduler (serve/supervisor.py over a
+  host-only loop replica) under `sched:crash` injection — the loop dies
+  MID-BATCH, the supervisor restarts it and replays the journal, and the
+  report's `scheduler` section shows restart/replay/lost counts with
+  `lost == 0` and duplicate idempotency keys deduplicated to one result.
 
 Deterministic: the injection RNG is seeded and every boundary is hit from
-the driving thread in a fixed order, so the same (spec, seed) replays the
-same fault schedule and the same outcome histogram.
+the driving thread in a fixed order (the scheduler stage's single worker
+included), so the same (spec, seed) replays the same fault schedule and
+the same outcome histogram.
 """
 
 from __future__ import annotations
 
 import json
+import queue as queue_mod
 import threading
 from http.server import BaseHTTPRequestHandler, HTTPServer
 from typing import Dict, Optional
 
-DEFAULT_SPEC = "ollama:connect:0.5,sql:exec:1"
+DEFAULT_SPEC = "ollama:connect:0.5,sql:exec:1,sched:crash:0.2"
 
 
 def _fake_ollama_daemon(answers: Dict[str, str]):
@@ -72,16 +80,174 @@ def _fake_ollama_daemon(answers: Dict[str, str]):
     return srv, f"http://127.0.0.1:{srv.server_port}"
 
 
+class _ToyScheduler:
+    """Host-only replica of the scheduler's submit/crash surface (no jax).
+
+    One worker thread pops requests and 'decodes' them deterministically
+    (token i of request (ids, seed) is a pure function of both), consulting
+    `FAULTS.check("sched:crash")` before each emitted token — so a
+    configured spec kills the loop MID-BATCH exactly like the real
+    scheduler's harvest-time seam, failing every in-flight and queued
+    future with one `SchedulerCrashed`. The supervisor is deliberately
+    scheduler-agnostic (duck-typed factory); this replica lets the chaos
+    harness prove the journal/replay/zero-lost contract self-contained,
+    without standing up a device scheduler (the `chaos` pytest lane drives
+    the REAL scheduler through the same seam — tests/test_supervisor.py).
+    """
+
+    def __init__(self, tokens_per_request: int = 6):
+        self.tokens_per_request = tokens_per_request
+        self._queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._crash = None
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self):
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join()
+            self._thread = None
+
+    def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
+               on_token=None, constraint=None, deadline_s=None):
+        from concurrent.futures import Future
+
+        with self._lock:
+            if self._crash is not None:
+                raise self._crash
+        fut = Future()
+        self._queue.put((list(ids), min(max_new_tokens,
+                                        self.tokens_per_request),
+                         seed, on_token, fut))
+        return fut
+
+    @staticmethod
+    def expected(ids, n, seed):
+        """The deterministic 'completion' — replay MUST reproduce it."""
+        return [(sum(ids) * 31 + seed * 17 + i * 7) % 997 for i in range(n)]
+
+    def _run(self):
+        from ..serve.resilience import SchedulerCrashed
+        from ..utils.faults import FAULTS
+
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            ids, n, seed, on_token, fut = item
+            toks = self.expected(ids, n, seed)
+            try:
+                out = []
+                for t in toks:
+                    FAULTS.check("sched:crash")  # mid-batch death seam
+                    out.append(t)
+                    if on_token is not None:
+                        on_token(t)
+            except Exception as exc:  # noqa: BLE001 — loop death, like _run's guard
+                crash = SchedulerCrashed.from_exception(exc)
+                with self._lock:
+                    self._crash = crash
+                fut.set_exception(crash)
+                while True:  # fail everything queued behind the corpse
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except queue_mod.Empty:
+                        return
+                    if nxt is not None:
+                        nxt[-1].set_exception(crash)
+            else:
+                fut.set_result(out)
+
+
+def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
+    """Drive a supervised crash-prone scheduler and prove zero lost
+    acknowledged requests: every future resolves with the deterministic
+    expected tokens (replayed across however many restarts the injected
+    schedule causes), and duplicate idempotency keys return ONE result."""
+    import random
+
+    from ..serve.resilience import RetryPolicy
+    from ..serve.supervisor import SupervisedScheduler
+
+    sup = SupervisedScheduler(
+        _ToyScheduler,
+        # Generous budget + millisecond backoff: the stage exercises the
+        # journal/replay logic, not production restart pacing.
+        max_restarts=1000,
+        restart_policy=RetryPolicy(max_attempts=1001, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(seed),
+    ).start()
+    try:
+        futs, expect = [], []
+        for i in range(requests):
+            ids, rseed = [1 + i, 2 + i], i
+            # Every third request is submitted TWICE under one key: the
+            # journal must collapse the pair to a single generation.
+            key = f"chaos-req-{i}" if i % 3 == 0 else None
+            fut = sup.submit(ids, seed=rseed, idempotency_key=key)
+            futs.append(fut)
+            expect.append(_ToyScheduler.expected(ids, 6, rseed))
+            if key is not None:
+                dup = sup.submit(ids, seed=rseed, idempotency_key=key)
+                futs.append(dup)
+                expect.append(expect[-1])
+        hung = mismatched = 0
+        for fut, want in zip(futs, expect):
+            try:
+                got = fut.result(timeout=60)
+            except Exception:  # noqa: BLE001 — typed terminal ≠ hung, but IS lost here
+                got = None
+            if got is None:
+                hung += 1
+            elif got != want:
+                mismatched += 1
+        health = sup.health()
+    finally:
+        sup.shutdown()
+    report = {
+        "requests": requests,
+        "duplicate_keys": sum(1 for i in range(requests) if i % 3 == 0),
+        "restarts": health["restarts"],
+        "replayed": health["replayed"],
+        "lost": health["lost"],
+        "unresolved": hung,
+        "mismatched": mismatched,
+        "state": health["state"],
+    }
+    assert hung == 0, (
+        f"{hung} acknowledged request(s) never produced their result "
+        f"across scheduler crashes"
+    )
+    assert mismatched == 0, (
+        f"{mismatched} replayed request(s) diverged from the deterministic "
+        f"expected completion"
+    )
+    assert health["lost"] == 0, (
+        f"{health['lost']} acknowledged request(s) lost across restarts"
+    )
+    return report
+
+
 def run_chaos(
     spec: Optional[str] = None,
     seed: int = 0,
     rounds: int = 4,
     max_new_tokens: int = 64,
 ) -> Dict:
-    """Drive the fixture suite `rounds` times under the injection spec;
-    return the outcome histogram + counter deltas. Raises AssertionError
-    if any request fails to reach a terminal state (the zero-hung
-    contract) — a chaos run that hangs is the bug it exists to catch."""
+    """Drive the fixture suite `rounds` times under the injection spec,
+    then the supervised-scheduler crash stage; return the outcome
+    histogram + the scheduler's restart/replay/lost counts + counter
+    deltas. Raises AssertionError if any request fails to reach a
+    terminal state (zero-hung) or any acknowledged scheduler request is
+    lost across crashes (zero-lost) — a chaos run that hangs or loses
+    work is the bug it exists to catch."""
     import random
     import tempfile
 
@@ -179,6 +345,11 @@ def run_chaos(
                     outcomes["ok_after_retry"] += 1
                 else:
                     outcomes["ok"] += 1
+        # Stage 2 — crash recovery: a supervised scheduler under the
+        # spec's `sched:crash` site must lose ZERO acknowledged requests
+        # across however many mid-batch loop deaths the schedule injects
+        # (runs inside the injection scope: same seeded stream).
+        scheduler_report = _run_scheduler_stage(seed, requests=3 * rounds)
     finally:
         srv.shutdown()
         fault_counts = FAULTS.counts()  # clear() wipes them
@@ -187,6 +358,7 @@ def run_chaos(
     after = resilience.snapshot()
     requests = rounds * len(FOUR_QUERY_SUITE)
     hung = requests - sum(outcomes.values())
+    hung += scheduler_report["unresolved"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
     return {
         "spec": spec,
@@ -194,6 +366,7 @@ def run_chaos(
         "requests": requests,
         "outcomes": outcomes,
         "hung": hung,
+        "scheduler": scheduler_report,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)
             for k in sorted(set(before) | set(after))

@@ -7,14 +7,17 @@ from .resilience import (  # noqa: F401
     CircuitOpen,
     Deadline,
     DeadlineExceeded,
+    Draining,
     Overloaded,
     RetryPolicy,
     SchedulerCrashed,
+    breaker_states,
 )
 from .scheduler import (  # noqa: F401
     ContinuousBatchingScheduler,
     SchedulerBackend,
     SchedulerPool,
 )
+from .supervisor import SupervisedScheduler  # noqa: F401
 from .service import GenerateResult, GenerationService  # noqa: F401
 from .templates import TEMPLATES  # noqa: F401

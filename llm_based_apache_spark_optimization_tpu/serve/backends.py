@@ -68,6 +68,14 @@ class EngineBackend:
 
     #: GenerationService checks this before forwarding a `constrain=` spec.
     supports_constrain = True
+    #: Deadline enforcement, smallest slice (ROADMAP follow-up): the
+    #: one-XLA-program decode cannot retire mid-flight like the scheduler,
+    #: but the STEP BUDGET can be clamped at issue time from the request's
+    #: remaining deadline × the measured per-token service rate — so a
+    #: nearly-expired request occupies the device for roughly its budget,
+    #: not a full max-tokens decode. An already-expired deadline fails
+    #: typed before any device work.
+    supports_deadline = True
 
     def __init__(
         self,
@@ -89,6 +97,19 @@ class EngineBackend:
         self.stop_texts = tuple(stop_texts)
         self.add_bos = add_bos
         self._lock = threading.Lock()
+        # EWMA of seconds-per-output-token over completed requests (wall /
+        # tokens, prefill amortized in): the deadline→step-budget exchange
+        # rate. The FIRST completion of each program shape (batch size ×
+        # padded prompt length) is discarded — its wall is dominated by
+        # that shape's one-time XLA compilation, orders of magnitude off
+        # steady state, and would poison the exchange rate into spurious
+        # DeadlineExceeded for affordable requests. Until a real sample
+        # exists, requests run unclamped (a guessed rate would silently
+        # truncate output); shapes the key doesn't capture (budget
+        # buckets) can still land one inflated sample, which the 0.2 EWMA
+        # bounds (ROADMAP notes the follow-up).
+        self._sec_per_tok: Optional[float] = None
+        self._rate_warm_shapes: set = set()
 
     @classmethod
     def from_hf_checkpoint(
@@ -252,9 +273,72 @@ class EngineBackend:
         return resolve_constraint(constrain, self.tokenizer,
                                   self.engine.stop_ids)
 
+    @staticmethod
+    def _make_deadline(deadline_s: Optional[float]):
+        """Stamp the deadline at REQUEST ENTRY: the exchange below runs
+        inside the backend lock, so time queued behind another decode on
+        this serialized engine is charged against the budget too."""
+        if deadline_s is None:
+            return None
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s}")
+        from .resilience import Deadline
+
+        return Deadline.after(deadline_s)
+
+    def _deadline_budget(self, budget: int, deadline) -> int:
+        """Exchange the REMAINING deadline for a step budget: tokens the
+        request can afford at the measured rate. Expired (or unaffordable
+        even for one token) fails typed DeadlineExceeded BEFORE the device
+        is touched — the engine has no mid-decode retirement, so issue
+        time is the only enforcement point (smallest slice)."""
+        if deadline is None:
+            return budget
+        from ..utils.observability import resilience
+        from .resilience import DeadlineExceeded
+
+        remaining = deadline.remaining()
+        if remaining <= 0:
+            resilience.inc("deadline_expired")
+            raise DeadlineExceeded(
+                "request deadline expired before issue (burned queueing "
+                "behind the serialized engine)"
+            )
+        rate = self._sec_per_tok
+        if rate is None or rate <= 0:
+            return budget
+        afford = int(remaining / rate)
+        if afford < 1:
+            resilience.inc("deadline_expired")
+            raise DeadlineExceeded(
+                f"remaining deadline of {remaining:.3f}s cannot afford one "
+                f"token at the measured {rate:.4f}s/token — not issued"
+            )
+        if afford < budget:
+            resilience.inc("deadline_clamps")
+            return afford
+        return budget
+
+    def _record_rate(self, wall_s: float, output_tokens: int,
+                     shape: tuple) -> None:
+        if output_tokens < 1 or wall_s <= 0:
+            return
+        if shape not in self._rate_warm_shapes:
+            # First completion at this program shape: wall includes that
+            # shape's jit compile — discard.
+            self._rate_warm_shapes.add(shape)
+            return
+        rate = wall_s / output_tokens
+        prev = self._sec_per_tok
+        self._sec_per_tok = rate if prev is None else 0.2 * rate + 0.8 * prev
+
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
-                 constrain=None) -> Completion:
+                 constrain=None,
+                 deadline_s: Optional[float] = None) -> Completion:
+        import time
+
+        deadline = self._make_deadline(deadline_s)
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
         # Clamp the decode budget to what fits the model context after the
         # bucketed (and sp-padded, on a sequence-parallel mesh) prompt: a
@@ -262,14 +346,25 @@ class EngineBackend:
         # erroring (the engine itself raises on overflow).
         room = self._room(len(ids))
         budget = min(max_new_tokens or self.max_new_tokens, room)
+        # Resolve (and first-use compile) the grammar OUTSIDE the timed
+        # window: a one-off token-mask precompute inside it would poison
+        # the s/token rate the deadline exchange runs on.
+        constraint = self._resolve_constraint(constrain)
         with self._lock:
+            # Inside the lock: the wait behind another decode has already
+            # been charged against the deadline by the time we exchange
+            # what REMAINS for a step budget.
+            budget = self._deadline_budget(budget, deadline)
+            t0 = time.perf_counter()
             out = self.engine.generate(
                 [ids],
                 max_new_tokens=budget,
                 sampling=sampling or self.sampling,
                 seed=seed,
-                constraint=self._resolve_constraint(constrain),
+                constraint=constraint,
             )[0]
+            self._record_rate(time.perf_counter() - t0, len(out),
+                              (1, self.engine.padded_prompt_len(len(ids))))
         # Strip the stop token itself from the text.
         if out and out[-1] in self.engine.stop_ids:
             out = out[:-1]
@@ -279,11 +374,16 @@ class EngineBackend:
     def complete_batch(
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None, seed: int = 0,
-        constrain=None,
+        constrain=None, deadline_s: Optional[float] = None,
     ) -> List[Completion]:
         """One batched device program for many prompts (BASELINE config 4:
         batch=32 Spider questions) — amortizes weight streaming across the
-        whole batch instead of paying it per request."""
+        whole batch instead of paying it per request. A `deadline_s` clamps
+        the SHARED step budget (the batch decodes in lockstep, so the
+        deadline is the batch's, not per member)."""
+        import time
+
+        deadline = self._make_deadline(deadline_s)
         ids = [self.tokenizer.encode(p, add_bos=self.add_bos) for p in prompts]
         room = self.engine.cfg.max_seq_len - self.engine.padded_prompt_len(
             max(len(i) for i in ids)
@@ -291,11 +391,20 @@ class EngineBackend:
         if room < 1:
             raise ValueError("longest prompt leaves no decode room")
         budget = min(max_new_tokens or self.max_new_tokens, room)
+        constraint = self._resolve_constraint(constrain)  # outside the timer
         with self._lock:
+            budget = self._deadline_budget(budget, deadline)
+            t0 = time.perf_counter()
             outs = self.engine.generate(
                 ids, max_new_tokens=budget,
                 sampling=sampling or self.sampling, seed=seed,
-                constraint=self._resolve_constraint(constrain),
+                constraint=constraint,
+            )
+            self._record_rate(
+                time.perf_counter() - t0,
+                max(len(o) for o in outs) if outs else 0,
+                (len(prompts), self.engine.padded_prompt_len(
+                    max(len(i) for i in ids))),
             )
         completions = []
         for prompt_ids, out in zip(ids, outs):

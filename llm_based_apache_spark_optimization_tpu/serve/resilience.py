@@ -27,8 +27,13 @@ the Ollama client adapter, and the SQL backends:
 Typed errors are the API contract: `Overloaded` (shed at admission, HTTP
 429), `DeadlineExceeded` (budget burned, HTTP 504), `CircuitOpen`
 (dependency down, HTTP 503), `SchedulerCrashed` (engine dead — 503 and
-breaker-relevant, distinct from a per-request 500). All subclass
+breaker-relevant, distinct from a per-request 500), `Draining` (the server
+is shutting down gracefully — 503 + Retry-After). All subclass
 RuntimeError so existing broad handlers keep working.
+
+Every constructed breaker also registers itself by dependency name in a
+process-wide registry (`breaker_states()`), so `/metrics` can show the
+per-dependency open/closed picture instead of aggregate counters only.
 
 Everything here is stdlib + thread-safe, with injectable clock/rng/sleep
 so tests replay deterministically. Counters land in
@@ -50,9 +55,11 @@ __all__ = [
     "CircuitOpen",
     "Deadline",
     "DeadlineExceeded",
+    "Draining",
     "Overloaded",
     "RetryPolicy",
     "SchedulerCrashed",
+    "breaker_states",
 ]
 
 
@@ -72,6 +79,14 @@ class Overloaded(RuntimeError):
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class Draining(Overloaded):
+    """The server is draining for shutdown (SIGTERM): new work is refused
+    and journaled-but-unfinished work is spilled for the next process —
+    HTTP 503 + Retry-After (the replacement instance will take the retry).
+    Subclasses Overloaded so existing shed handlers keep working; the API
+    layer maps it to 503 (the whole SERVER is going away, not one queue)."""
 
 
 class CircuitOpen(RuntimeError):
@@ -198,6 +213,32 @@ class RetryPolicy:
 
 # ----------------------------------------------------------- circuit breaker
 
+#: Process-wide registry of the LIVE breaker per dependency name (last
+#: constructed wins — deployments build one breaker per dependency; tests
+#: that churn breakers just update the pointer). /metrics reads it through
+#: `breaker_states()` so operators see WHICH dependency (ollama, sql,
+#: scheduler-restart) is open, not just that some aggregate counter moved.
+_BREAKERS: dict = {}
+_BREAKERS_LOCK = threading.Lock()
+
+
+def breaker_states() -> dict:
+    """{name: {state, consecutive_failures, retry_after_s}} for every
+    registered breaker — the per-dependency view the aggregate trip/shed
+    counters cannot give (ROADMAP fault-tolerance follow-up)."""
+    with _BREAKERS_LOCK:
+        items = list(_BREAKERS.items())
+    out = {}
+    for name, b in items:
+        with b._lock:
+            state, failures = b._state, b._failures
+        out[name] = {
+            "state": state,
+            "consecutive_failures": failures,
+            "retry_after_s": round(b.retry_after_s(), 3),
+        }
+    return out
+
 
 class CircuitBreaker:
     """Closed/open/half-open breaker for ONE external dependency.
@@ -228,6 +269,8 @@ class CircuitBreaker:
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False
+        with _BREAKERS_LOCK:
+            _BREAKERS[name] = self
 
     @property
     def state(self) -> str:
@@ -275,6 +318,15 @@ class CircuitBreaker:
                 self._state = "open"
                 self._opened_at = self._clock()
                 resilience.inc("breaker_trips")
+
+    def unregister(self) -> None:
+        """Drop this breaker from the /metrics registry (if it is still
+        the registered instance for its name). Long-lived owners that
+        tear down — a supervised scheduler shutting down — call this so
+        the per-dependency view doesn't accumulate dead dependencies."""
+        with _BREAKERS_LOCK:
+            if _BREAKERS.get(self.name) is self:
+                del _BREAKERS[self.name]
 
     def retry_after_s(self) -> float:
         """Seconds until the next half-open probe window (Retry-After)."""

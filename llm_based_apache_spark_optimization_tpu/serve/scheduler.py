@@ -197,6 +197,9 @@ class _Request:
     # A slot is decode-eligible only once the whole prompt is in (`ready`).
     prefilled: int = 0
     ready: bool = False
+    # Submit wall-clock origin: feeds the per-request service-time EWMA
+    # behind retry_after_hint() (queue-depth-aware Retry-After).
+    submitted_at: float = 0.0
 
     def emit(self, tok: int) -> None:
         if self.on_token is not None:
@@ -469,6 +472,12 @@ class ContinuousBatchingScheduler:
         self._prefix_hits = 0
         self._prefix_blocks_reused = 0
         self._slice_block_fn, self._restore_block_fn = self._build_block_ops()
+
+        # Recent per-request service time (EWMA of completed requests'
+        # submit→retire wall): the backpressure estimate behind
+        # retry_after_hint(). None until the first completion — the static
+        # 1s floor serves until there is something to estimate from.
+        self._svc_ewma: Optional[float] = None
 
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._prefill_q: "deque[Tuple[int, _Request]]" = deque()
@@ -1031,12 +1040,12 @@ class ContinuousBatchingScheduler:
                 raise Overloaded(
                     f"scheduler queue at capacity "
                     f"({self.max_queue_depth} waiting requests)",
-                    # Backpressure hint: roughly one queue-drain of decode
-                    # rounds; precise ETA needs workload knowledge the
-                    # scheduler doesn't have — 1s is the floor clients
-                    # should wait before retrying.
-                    retry_after_s=1.0,
+                    # Backpressure hint: current queue depth × the recent
+                    # per-request service time (retry_after_hint), with a
+                    # 1s floor until the first completion seeds the EWMA.
+                    retry_after_s=self.retry_after_hint(),
                 )
+            req.submitted_at = time.perf_counter()
             self._queue.put(req)
         return req.future
 
@@ -1112,6 +1121,40 @@ class ContinuousBatchingScheduler:
             "verify_cost_ratio": round(ratio, 3),
             "est_speedup_calibration": VERIFY_COST_CALIBRATION,
         }
+
+    def retry_after_hint(self) -> float:
+        """Queue-depth-aware Retry-After (ROADMAP follow-up): a shed client
+        should wait roughly until the current backlog has drained through
+        the slot pool — queue depth × recent per-request service time /
+        concurrent lanes — not a static constant. Clamped to [1, 60]s:
+        the floor keeps retry storms decorrelated when the estimate is
+        tiny (or not yet seeded), the ceiling keeps one pathological slow
+        request from telling everyone to come back in an hour. Shared by
+        the 429 shed path and the drain-mode 503.
+
+        Lock-free read ON PURPOSE: submit() calls this while HOLDING
+        _submit_lock (the Overloaded raise), so taking the lock here
+        would self-deadlock; a float attribute read is atomic under the
+        GIL and a one-update-stale estimate is still an estimate."""
+        ewma = self._svc_ewma
+        if ewma is None:
+            return 1.0
+        depth = self._queue.qsize() + 1  # the retry waits behind itself too
+        return float(min(60.0, max(1.0, depth * ewma / max(1, self.num_slots))))
+
+    def _record_service_time(self, req: _Request) -> None:
+        """EWMA of submit→retire wall for COMPLETED requests (failures and
+        cancels say nothing about healthy service time — a disconnect-heavy
+        streaming workload retiring fractional decodes would otherwise
+        drag the estimate down and tell shed clients to retry too soon).
+        Under the submit lock: retry_after_hint reads it from HTTP
+        threads."""
+        if req.submitted_at <= 0.0 or req.cancelled:
+            return
+        wall = time.perf_counter() - req.submitted_at
+        with self._submit_lock:
+            prev = self._svc_ewma
+            self._svc_ewma = wall if prev is None else 0.2 * wall + 0.8 * prev
 
     @property
     def prefix_stats(self) -> Dict[str, int]:
@@ -1421,6 +1464,7 @@ class ContinuousBatchingScheduler:
         """Resolve a finished request, free its slot, and reset the slot's
         on-device sampling knobs (a lingering temperature > 0 would defeat
         sample_runtime's all-greedy fast path for every later round)."""
+        self._record_service_time(req)
         req.future.set_result(result)
         self._release_slot(slot)
 
@@ -1465,6 +1509,13 @@ class ContinuousBatchingScheduler:
         """Sync the OLDEST in-flight round: one device_get brings down its
         chunk tokens plus any prefill first-tokens attached to it; retire
         finished requests and free their slots."""
+        # Chaos seam (utils/faults.py): `sched:crash` kills the loop
+        # MID-BATCH — rounds issued, tokens possibly already streamed to
+        # clients, slots occupied. The supervisor (serve/supervisor.py)
+        # must restart the loop and replay every acknowledged request
+        # without duplicating delivered tokens (chaos tests assert zero
+        # lost, zero double-streamed).
+        FAULTS.check("sched:crash")
         issue_reqs, toks_dev, n_emit_dev, firsts = self._pending.popleft()
         toks, n_emit, first_vals = jax.device_get(
             (toks_dev, n_emit_dev, [t for (_, _, t) in firsts])
@@ -1684,6 +1735,14 @@ class SchedulerPool:
     def overshoot(self) -> int:
         return self.schedulers[0].overshoot
 
+    def retry_after_hint(self) -> float:
+        """Soonest-available replica's hint: a shed pool request retries
+        whichever replica drains first."""
+        live = [s for s in self.schedulers if s._crash is None]
+        if not live:
+            return 1.0
+        return min(s.retry_after_hint() for s in live)
+
     def warmup(self, prompt_len=None) -> None:
         for s in self.schedulers:
             s.warmup(prompt_len)
@@ -1742,7 +1801,13 @@ class SchedulerPool:
                 continue
         if last_overloaded is not None:
             raise last_overloaded
-        raise RuntimeError("all scheduler replicas have crashed")
+        # Typed (not a bare RuntimeError): every replica holds a
+        # SchedulerCrashed, the pool just summarizes — and the supervisor
+        # classifies crashes by TYPE, so the pool-wide death must carry
+        # it (a message-string contract would silently break recovery on
+        # rewording). Subclasses RuntimeError: existing handlers keep
+        # working.
+        raise SchedulerCrashed("all scheduler replicas have crashed")
 
     cancel = staticmethod(ContinuousBatchingScheduler.cancel)
 
@@ -1790,6 +1855,18 @@ class SchedulerBackend:
         # Default per-request deadline (None = no deadline); a request's
         # own deadline_s overrides it.
         self.deadline_s = deadline_s
+        # Idempotency keys need a journal to dedupe against — only the
+        # supervised wrapper (serve/supervisor.py) has one.
+        self.supports_idempotency = bool(
+            getattr(scheduler, "supports_idempotency", False)
+        )
+        # Journal-spill recovery happens HERE, the one seam every
+        # deployment path (tiny, HF, GGUF, dp pool) funnels through: a
+        # previous process's drained-but-unfinished requests resubmit so
+        # retried idempotency keys find their results.
+        recover = getattr(scheduler, "recover", None)
+        if callable(recover) and getattr(scheduler, "spill_path", None):
+            recover()
 
     def shutdown(self) -> None:
         """Stop the scheduler's event loop (idempotent; safe on shared
@@ -1797,14 +1874,39 @@ class SchedulerBackend:
         ContinuousBatchingScheduler.shutdown is itself idempotent)."""
         self.scheduler.shutdown()
 
+    def health(self) -> Optional[Dict[str, object]]:
+        """Supervisor lifecycle state (ready/restarting/degraded/dead +
+        restart counters) for /readyz; None for a bare scheduler (always
+        'ready or crashed' — the crash already answers 503 per request)."""
+        h = getattr(self.scheduler, "health", None)
+        return h() if callable(h) else None
+
+    def drain(self, deadline_s: Optional[float] = None) -> None:
+        """Graceful-shutdown seam (SIGTERM path): supervised schedulers
+        stop admitting, finish in-flight up to the deadline, and journal
+        the rest; bare schedulers just stop."""
+        d = getattr(self.scheduler, "drain", None)
+        if callable(d):
+            d(deadline_s)
+        else:
+            self.scheduler.shutdown()
+
+    def retry_after_hint(self) -> float:
+        hint = getattr(self.scheduler, "retry_after_hint", None)
+        return hint() if callable(hint) else 1.0
+
     def stats(self) -> Dict[str, object]:
         """Serving-layer observability beyond per-request metrics: prefix
-        cache reuse and (when --speculative is on) draft acceptance —
-        merged into the app's /metrics payload per model."""
+        cache reuse, (when --speculative is on) draft acceptance, and
+        (when supervised) the crash-recovery lifecycle — merged into the
+        app's /metrics payload per model."""
         out: Dict[str, object] = {"prefix_cache": self.scheduler.prefix_stats}
         spec = self.scheduler.speculation_stats
         if spec is not None:
             out["speculation"] = spec
+        sup = self.health()
+        if sup is not None:
+            out["supervisor"] = sup
         return out
 
     @classmethod
@@ -1825,6 +1927,9 @@ class SchedulerBackend:
         decode_chunk: int = 8,
         speculative_draft: int = 0,
         max_queue_depth: int = 0,
+        supervise: bool = False,
+        max_restarts: int = 5,
+        journal_spill: Optional[str] = None,
         **kwargs,
     ) -> "SchedulerBackend":
         """Deployment path for concurrent serving: HF checkpoint straight
@@ -1833,7 +1938,11 @@ class SchedulerBackend:
         incl. int8 weight-only quantization (and `kv_quant="int8"` for the
         persistent KV cache — halves the serving window's HBM footprint
         and decode streaming); the mesh (if any) must be dp=1 — request
-        parallelism comes from slots."""
+        parallelism comes from slots. With `supervise=True` the scheduler
+        runs under a crash supervisor (serve/supervisor.py): the params
+        stay loaded, and a decode-loop crash tears down + rebuilds the
+        scheduler and replays journaled requests instead of 503ing until
+        a human restarts the process."""
         import jax.numpy as jnp
 
         from ..checkpoint import load_hf_checkpoint
@@ -1864,16 +1973,31 @@ class SchedulerBackend:
                 ckpt_dir, dtype=dtype or jnp.bfloat16, mesh=mesh
             )
             sched_mesh = mesh
-        sched = ContinuousBatchingScheduler(
-            cfg, params, num_slots=num_slots, max_seq=max_seq,
-            decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
-            stop_ids=stop_ids if stop_ids is not None
-            else resolve_stop_ids(cfg, tokenizer),
-            mesh=sched_mesh, kv_quant=kv_quant,
-            speculative_draft=speculative_draft,
-            max_queue_depth=max_queue_depth,
-        )
-        return cls(sched, tokenizer, **kwargs)
+        def make_sched():
+            # Factory, not instance: the supervisor rebuilds from the SAME
+            # loaded (and possibly quantized/sharded) params after a crash
+            # — one disk read per process, not per restart.
+            return ContinuousBatchingScheduler(
+                cfg, params, num_slots=num_slots, max_seq=max_seq,
+                decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
+                stop_ids=stop_ids if stop_ids is not None
+                else resolve_stop_ids(cfg, tokenizer),
+                mesh=sched_mesh, kv_quant=kv_quant,
+                speculative_draft=speculative_draft,
+                max_queue_depth=max_queue_depth,
+            )
+
+        if supervise:
+            import os
+
+            from .supervisor import SupervisedScheduler
+
+            return cls(SupervisedScheduler(
+                make_sched, max_restarts=max_restarts,
+                spill_path=journal_spill,
+                name=f"scheduler:{os.path.basename(ckpt_dir.rstrip('/'))}",
+            ), tokenizer, **kwargs)
+        return cls(make_sched(), tokenizer, **kwargs)
 
     @classmethod
     def from_gguf(
@@ -1894,12 +2018,17 @@ class SchedulerBackend:
         decode_chunk: int = 8,
         speculative_draft: int = 0,
         max_queue_depth: int = 0,
+        supervise: bool = False,
+        max_restarts: int = 5,
+        journal_spill: Optional[str] = None,
         **kwargs,
     ) -> "SchedulerBackend":
         """GGUF blob -> continuous-batching scheduler (C++ parse + dequant,
         native/src/gguf.cpp). `quantize_int8`/`quantize_int4` re-quantize
         the dequantized blob into the in-tree serving formats (a Q4 blob
-        served with quantize_int4 stays 4-bit end to end)."""
+        served with quantize_int4 stays 4-bit end to end). `supervise=True`
+        wraps the scheduler in the crash supervisor, exactly like
+        `from_hf_checkpoint`."""
         from ..checkpoint import load_gguf_checkpoint
         from .backends import resolve_stop_ids
 
@@ -1926,16 +2055,28 @@ class SchedulerBackend:
             cfg, params = load_gguf_checkpoint(
                 gguf_path, cfg=cfg, dtype=dtype, mesh=mesh
             )
-        sched = ContinuousBatchingScheduler(
-            cfg, params, num_slots=num_slots, max_seq=max_seq,
-            decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
-            stop_ids=stop_ids if stop_ids is not None
-            else resolve_stop_ids(cfg, tokenizer),
-            mesh=mesh, kv_quant=kv_quant,
-            speculative_draft=speculative_draft,
-            max_queue_depth=max_queue_depth,
-        )
-        return cls(sched, tokenizer, **kwargs)
+        def make_sched():
+            return ContinuousBatchingScheduler(
+                cfg, params, num_slots=num_slots, max_seq=max_seq,
+                decode_chunk=decode_chunk, prompt_bucket=prompt_bucket,
+                stop_ids=stop_ids if stop_ids is not None
+                else resolve_stop_ids(cfg, tokenizer),
+                mesh=mesh, kv_quant=kv_quant,
+                speculative_draft=speculative_draft,
+                max_queue_depth=max_queue_depth,
+            )
+
+        if supervise:
+            import os
+
+            from .supervisor import SupervisedScheduler
+
+            return cls(SupervisedScheduler(
+                make_sched, max_restarts=max_restarts,
+                spill_path=journal_spill,
+                name=f"scheduler:{os.path.basename(gguf_path)}",
+            ), tokenizer, **kwargs)
+        return cls(make_sched(), tokenizer, **kwargs)
 
     def check_budget(self, prompt: str,
                      max_new_tokens: Optional[int] = None,
@@ -2080,18 +2221,26 @@ class SchedulerBackend:
 
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
-                 constrain=None, deadline_s: Optional[float] = None):
+                 constrain=None, deadline_s: Optional[float] = None,
+                 idempotency_key: Optional[str] = None):
         from .backends import Completion, trim_stop_texts
 
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
         t_submit = time.perf_counter()
         on_tok, first_at = _first_token_timer()
+        kwargs = {}
+        if idempotency_key is not None:
+            # Only the supervised scheduler takes the key (journal dedup);
+            # GenerationService gates on supports_idempotency before
+            # forwarding, so a bare scheduler never sees the kwarg.
+            kwargs["idempotency_key"] = idempotency_key
         out = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed, on_token=on_tok,
             constraint=self._resolve_constraint(constrain),
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
+            **kwargs,
         ).result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
         return Completion(text=text, output_tokens=len(out),

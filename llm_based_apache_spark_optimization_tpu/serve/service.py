@@ -55,6 +55,9 @@ class GenerationService:
         self._lock = threading.Lock()
         self.stats: Dict[str, Dict[str, float]] = {}
         self.metrics = MetricsRegistry()
+        # Drain mode (SIGTERM path): once set, the HTTP layers answer new
+        # work with 503 + Retry-After while in-flight requests finish.
+        self._draining = False
 
     def register(self, name: str, backend, template: str = "completion") -> None:
         if template not in TEMPLATES:
@@ -94,17 +97,127 @@ class GenerationService:
         model's serving-layer stats merged under "serving" — ONE
         definition for the web and headless-API endpoints. Process-wide
         fault-tolerance counters (retries, sheds, deadline expiries,
-        breaker trips — serve/resilience.py) ride under the reserved
-        "resilience" key whenever any fired: under load these numbers ARE
-        the serving story, and an operator reading only per-model
-        aggregates would see throughput without the sheds that bought it."""
+        breaker trips, supervisor restart/replay/lost counts —
+        serve/resilience.py, serve/supervisor.py) ride under the reserved
+        "resilience" key whenever any fired — or any breaker is live:
+        under load these numbers ARE the serving story, and an operator
+        reading only per-model aggregates would see throughput without
+        the sheds that bought it. Per-dependency breaker state (ollama,
+        sql backend, each supervised scheduler's restart breaker) rides
+        beside them under "breakers" — WHICH dependency is open, not just
+        that some trip counter moved; owners unregister their breakers at
+        teardown so the view tracks live dependencies."""
+        from .resilience import breaker_states
+
         snap = self.metrics.snapshot()
         for model, extra in self.backend_stats().items():
             snap.setdefault(model, {})["serving"] = extra
         counters = resilience.snapshot()
-        if any(counters.values()):
-            snap["resilience"] = counters
+        breakers = breaker_states()
+        if any(counters.values()) or breakers:
+            snap["resilience"] = dict(counters)
+            if breakers:
+                snap["resilience"]["breakers"] = breakers
         return snap
+
+    # ------------------------------------------------------------- lifecycle
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def health(self) -> Dict[str, object]:
+        """Aggregate lifecycle state for /readyz: the WORST state across
+        backends exposing a health() seam (the supervised scheduler's
+        ready | restarting | degraded | dead), plus per-model detail and
+        summed restart counters. Backends without the seam (engine,
+        fakes) are 'ready' by construction — their failures are
+        per-request, not lifecycle."""
+        order = {"ready": 0, "degraded": 1, "restarting": 2, "dead": 3}
+        worst = "ready"
+        models: Dict[str, Dict] = {}
+        totals = {"restarts": 0, "replayed": 0, "lost": 0}
+        with self._lock:
+            entries = list(self._models.values())
+        seen = set()
+        for e in entries:
+            hfn = getattr(e.backend, "health", None)
+            h = hfn() if callable(hfn) else None
+            if not h:
+                continue
+            models[e.name] = h
+            state = h.get("state", "ready")
+            if order.get(state, 0) > order[worst]:
+                worst = state
+            # Dedupe by the underlying SCHEDULER, not the backend wrapper:
+            # the shared-weights aliasing rule (serve/factory.py) wraps
+            # one supervisor in two SchedulerBackends, and double-counting
+            # its restarts would make /readyz report phantom instability.
+            key = id(getattr(e.backend, "scheduler", e.backend))
+            if key not in seen:
+                seen.add(key)
+                for k in totals:
+                    totals[k] += int(h.get(k, 0) or 0)
+        return {
+            "state": worst,
+            "draining": self._draining,
+            "models": models,
+            **totals,
+        }
+
+    def supports_idempotency(self, model: str) -> bool:
+        """Can `model`'s backend dedupe an idempotency key against a
+        journal? The drain gate uses this to decide whether a keyed
+        request during shutdown is a safe journal lookup (let through) or
+        plain new work wearing a key (refused like any other)."""
+        with self._lock:
+            entry = self._models.get(model)
+        return bool(entry and getattr(entry.backend, "supports_idempotency",
+                                      False))
+
+    def retry_after_hint(self, default: float = 1.0) -> float:
+        """Backpressure hint for drain-mode 503s / readiness failures: the
+        largest queue-drain estimate across backends exposing one (the
+        scheduler's queue-depth × service-time estimate)."""
+        hints = []
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            fn = getattr(e.backend, "retry_after_hint", None)
+            if callable(fn):
+                hints.append(fn())
+        return max(hints) if hints else default
+
+    def drain(self, deadline_s: Optional[float] = None) -> None:
+        """Graceful shutdown (SIGTERM): stop admitting — the HTTP drain
+        gate answers 503 + Retry-After from here on — then let each
+        backend finish in-flight work up to the shared drain deadline
+        (supervised schedulers journal-and-exit what is left), then close
+        everything."""
+        from .resilience import Deadline
+
+        self._draining = True
+        # deadline_s <= 0 means "journal-and-exit NOW", never "wait
+        # forever": a 0-configured drain must not block on a wedged loop.
+        deadline = (Deadline.after(deadline_s)
+                    if deadline_s is not None and deadline_s > 0 else None)
+        immediate = deadline_s is not None and deadline_s <= 0
+        seen = set()
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            d = getattr(e.backend, "drain", None)
+            # Same scheduler-level dedupe as health(): two wrappers over
+            # one supervisor must drain (and spill) it exactly once.
+            key = id(getattr(e.backend, "scheduler", e.backend))
+            if d is None or key in seen:
+                continue
+            seen.add(key)
+            remaining = deadline.remaining() if deadline is not None else None
+            if immediate or (remaining is not None and remaining <= 0):
+                remaining = 0.0  # burned: backends spill without waiting
+            d(remaining)
+        self.close()
 
     def close(self) -> None:
         """Shut down owned backend resources (scheduler threads, slot-pool
@@ -138,15 +251,28 @@ class GenerationService:
     def _deadline_kwargs(entry: ModelEntry, deadline_s) -> Dict:
         """Per-request deadline (seconds), forwarded only to backends that
         can actually enforce one (`supports_deadline`: the scheduler
-        retires in-flight work at harvest). Other backends — the
-        one-XLA-program engine, fakes — silently ignore it: a deadline is
-        best-effort latency control, not a correctness contract, and
-        failing the request over an unenforceable hint would be worse than
-        serving it."""
+        retires in-flight work at harvest; the one-XLA-program engine
+        clamps its step budget at issue time from the remaining deadline
+        and the measured per-token rate). Backends without the seam —
+        fakes — silently ignore it: a deadline is best-effort latency
+        control, not a correctness contract, and failing the request over
+        an unenforceable hint would be worse than serving it."""
         if deadline_s is None or not getattr(
                 entry.backend, "supports_deadline", False):
             return {}
         return {"deadline_s": deadline_s}
+
+    @staticmethod
+    def _idempotency_kwargs(entry: ModelEntry, idempotency_key) -> Dict:
+        """Client-suppliable idempotency key, forwarded only to backends
+        with a journal to dedupe against (`supports_idempotency`: the
+        supervised scheduler). Elsewhere it is silently dropped — the key
+        is a retry-safety hint, and a backend that cannot honor it still
+        serves the request correctly once."""
+        if idempotency_key is None or not getattr(
+                entry.backend, "supports_idempotency", False):
+            return {}
+        return {"idempotency_key": idempotency_key}
 
     def generate(
         self,
@@ -158,6 +284,7 @@ class GenerationService:
         seed: int = 0,
         constrain=None,
         deadline_s: Optional[float] = None,
+        idempotency_key: Optional[str] = None,
     ) -> GenerateResult:
         entry = self._entry(model)
         rendered = entry.template(system, prompt)
@@ -167,6 +294,7 @@ class GenerationService:
                 rendered, max_new_tokens=max_new_tokens, sampling=sampling,
                 seed=seed, **self._constrain_kwargs(entry, constrain),
                 **self._deadline_kwargs(entry, deadline_s),
+                **self._idempotency_kwargs(entry, idempotency_key),
             )
         latency = time.perf_counter() - t0
         with self._lock:

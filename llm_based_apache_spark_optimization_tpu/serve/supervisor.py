@@ -1,0 +1,901 @@
+"""Supervised scheduler lifecycle: crash-only serving with journal + replay.
+
+Before this module a decode-loop crash was *typed* (PR 2: every future
+fails `SchedulerCrashed` → 503) but still an outage: every queued and
+in-flight request died with the loop, and the process had no notion of
+"restarting" vs "dead". Production serving systems (vLLM/TGI survey,
+PAPERS.md) treat the engine loop as a crash-only component: supervise it,
+journal admitted work, and replay on restart. `SupervisedScheduler` is
+that supervisor, wrapped around `ContinuousBatchingScheduler` (or a
+`SchedulerPool` — anything with the scheduler's submit surface):
+
+- **Write-ahead journal.** Every admitted request gets a monotonic request
+  id and a journal entry (prompt ids, params, constraint, deadline, and a
+  client-suppliable *idempotency key*) BEFORE it reaches the inner
+  scheduler. Once journaled (and not shed with a typed `Overloaded` /
+  request-shape `ValueError`), the request is ACKNOWLEDGED: it reaches
+  exactly one terminal state — a result or a typed error — no matter how
+  many times the loop underneath dies. Clients hold the supervisor's OWN
+  future; the inner scheduler's future is an implementation detail that
+  crashes with the loop.
+- **Idempotency keys.** A duplicate key while the original is in flight
+  returns the SAME future; after completion it returns the journaled
+  result (bounded LRU) without generating again — the retry contract that
+  makes "resubmit on 503" safe for clients.
+- **Crash → restart → replay.** When an inner future (or submit) fails
+  with `SchedulerCrashed`, the supervisor tears the dead loop down,
+  rebuilds the scheduler from its factory under bounded restarts with
+  full-jitter backoff (`RetryPolicy`), and replays journaled work in
+  request-id order: queued requests always; in-flight requests only when
+  idempotent-safe — generation IS (per-request seeded RNG streams make
+  the replayed prefix byte-identical, so streaming consumers have their
+  already-delivered tokens suppressed), while side-effectful consumers
+  can opt out with `idempotent=False` (the SQL-execute stage has its own
+  breaker and is never replayed blind — it lives above this layer).
+  Requests whose deadline expired during the outage fail typed
+  `DeadlineExceeded` and count as lost.
+- **Health.** `health()` reports `ready | restarting | degraded | dead`
+  plus restart/replay/lost counters — the `/readyz` payload. `degraded`
+  means the last restart dropped acknowledged work; it clears on the next
+  clean completion. Restart budget exhausted → `dead`: everything
+  journaled fails typed, new submits are refused. A breaker named
+  `scheduler-restart` records each crash/recovery so the per-dependency
+  breaker view in `/metrics` includes the engine itself.
+- **Drain.** `drain(deadline_s)` stops admitting (new submits raise
+  `Draining` → 503 + Retry-After), waits for in-flight work up to the
+  drain deadline, then journals what is left to the optional on-disk
+  spill and shuts the loop down — the SIGTERM path. `recover()` resubmits
+  a spill file at the next start so retried idempotency keys find their
+  results.
+
+Counters land in `utils.observability.resilience` (`sched_restarts`,
+`sched_replayed`, `sched_lost`, `sched_idempotent_hits`) and surface in
+`/metrics`; `evalh --chaos` and tests/test_supervisor.py assert the
+zero-lost-acknowledged-requests contract under injected `sched:crash`
+faults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..ops.sampling import SamplingParams
+from ..utils.observability import resilience
+from .resilience import (
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceeded,
+    Draining,
+    Overloaded,
+    RetryPolicy,
+    SchedulerCrashed,
+)
+
+_log = logging.getLogger("lsot.supervisor")
+
+__all__ = ["JournalEntry", "SupervisedScheduler"]
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """One acknowledged request in the write-ahead journal. Everything
+    needed to resubmit it verbatim after a restart, plus the delivery
+    state that makes streaming replay idempotent (`generated` holds the
+    tokens the CLIENT has seen; a replay suppresses that prefix)."""
+
+    rid: int
+    ids: List[int]
+    max_new: int
+    sampling: SamplingParams
+    seed: int
+    idempotency_key: Optional[str]
+    constraint: object
+    deadline: Optional[Deadline]
+    on_token: Optional[Callable[[int], None]]
+    idempotent: bool
+    future: Future
+    generated: List[int] = dataclasses.field(default_factory=list)
+    inner: Optional[Future] = None
+    cancelled: bool = False
+    done: bool = False
+
+
+class SupervisedScheduler:
+    """Crash-supervised wrapper with the scheduler's submit surface.
+
+    `factory` is a zero-arg callable building a fresh (not-started)
+    scheduler; the supervisor owns start/shutdown of every instance it
+    builds. Duck-typed: anything exposing the `ContinuousBatchingScheduler`
+    submit contract works (SchedulerPool, the chaos harness's host-only
+    replica), so the supervisor's journal/replay logic is testable without
+    a device.
+    """
+
+    #: GenerationService/SchedulerBackend gate `idempotency_key=` on this.
+    supports_idempotency = True
+
+    #: Uniquifies the default breaker name across supervisors in one
+    #: process (a multi-model service builds several; a shared last-wins
+    #: registry slot would report only the last one's loop health).
+    _instances = 0
+    _instances_lock = threading.Lock()
+
+    def __init__(
+        self,
+        factory: Callable[[], object],
+        max_restarts: int = 5,
+        restart_policy: Optional[RetryPolicy] = None,
+        spill_path: Optional[str] = None,
+        completed_keys: int = 1024,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        name: Optional[str] = None,
+    ):
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        if name is None:
+            with SupervisedScheduler._instances_lock:
+                SupervisedScheduler._instances += 1
+                n = SupervisedScheduler._instances
+            name = "scheduler" if n == 1 else f"scheduler-{n}"
+        self.name = name
+        self._factory = factory
+        self._inner = factory()
+        self.max_restarts = max_restarts
+        self._restart_policy = restart_policy or RetryPolicy(
+            max_attempts=max_restarts + 1, base_delay_s=0.1, max_delay_s=5.0
+        )
+        self.spill_path = spill_path
+        self._completed_cap = max(1, completed_keys)
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        # RLock: terminal futures resolve under the lock, and a client
+        # done-callback is allowed to submit follow-up work inline.
+        self._lock = threading.RLock()
+        self._journal: Dict[int, JournalEntry] = OrderedDict()
+        self._by_key: Dict[str, JournalEntry] = {}
+        self._completed: "OrderedDict[str, tuple]" = OrderedDict()
+        self._next_rid = 1
+        self._state = "ready"
+        self._draining = False
+        self._closed = False
+        self._crash_exc: Optional[BaseException] = None
+        self._restarts = 0
+        self._replayed = 0
+        self._lost = 0
+        # Single-flight drain: orchestrators commonly repeat SIGTERM, and
+        # a second concurrent drain would cut the first's grace period
+        # short and rewrite ('w' mode) the spill it just wrote.
+        self._drain_lock = threading.Lock()
+        self._drain_report: Optional[Dict[str, object]] = None
+        # Per-dependency breaker view: the engine loop is a dependency too.
+        # A crash records a failure, a successful restart a success — so
+        # /metrics "resilience.breakers.<name>-restart" tells operators
+        # EACH supervised loop's health the same way "ollama"/"sql" tell
+        # dependency health (the registry is last-wins per name, hence the
+        # per-instance name). Never consulted for shedding: the journal
+        # admits during restarts on purpose (replay picks the work up).
+        self._breaker = CircuitBreaker(
+            f"{name}-restart",
+            failure_threshold=max(1, max_restarts),
+            reset_after_s=60.0,
+        )
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> "SupervisedScheduler":
+        self._inner.start()
+        return self
+
+    def shutdown(self) -> None:
+        """Stop the inner loop; fail anything still journaled (clean
+        shutdown is not a crash: no restart, no replay). Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending = [e for e in self._journal.values() if not e.done]
+        try:
+            self._inner.shutdown()
+        except Exception:  # noqa: BLE001 — a broken inner must not wedge close
+            _log.exception("inner scheduler shutdown failed")
+        exc = RuntimeError("scheduler shut down mid-request")
+        with self._lock:
+            for e in pending:
+                if not e.done:
+                    self._fail_locked(e, exc)
+        # This supervisor's loop is no longer a live dependency: keep the
+        # /metrics per-dependency breaker view free of corpses.
+        self._breaker.unregister()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+
+    def warmup(self, prompt_len: Optional[int] = None) -> None:
+        warm = getattr(self._inner, "warmup", None)
+        if callable(warm):
+            warm(prompt_len)
+
+    # Admission-arithmetic surface, mirrored from the live inner scheduler
+    # so SchedulerBackend wraps a supervisor exactly like a bare scheduler.
+    @property
+    def cfg(self):
+        return self._inner.cfg
+
+    @property
+    def max_seq(self):
+        return self._inner.max_seq
+
+    @property
+    def decode_chunk(self):
+        return self._inner.decode_chunk
+
+    @property
+    def prompt_bucket(self):
+        return self._inner.prompt_bucket
+
+    @property
+    def stop_ids(self):
+        return self._inner.stop_ids
+
+    @property
+    def overshoot(self):
+        return self._inner.overshoot
+
+    @property
+    def _spec_draft(self):
+        return getattr(self._inner, "_spec_draft", 0)
+
+    @property
+    def _harvest_lag(self):
+        return getattr(self._inner, "_harvest_lag", 1)
+
+    @property
+    def prefix_stats(self):
+        return getattr(self._inner, "prefix_stats", {})
+
+    @property
+    def speculation_stats(self):
+        return getattr(self._inner, "speculation_stats", None)
+
+    def retry_after_hint(self) -> float:
+        hint = getattr(self._inner, "retry_after_hint", None)
+        return hint() if callable(hint) else 1.0
+
+    # ---------------------------------------------------------------- client
+
+    def submit(
+        self,
+        ids: Sequence[int],
+        max_new_tokens: int = 256,
+        sampling: SamplingParams = SamplingParams(),
+        seed: int = 0,
+        on_token: Optional[Callable[[int], None]] = None,
+        constraint=None,
+        deadline_s: Optional[float] = None,
+        idempotency_key: Optional[str] = None,
+        idempotent: bool = True,
+    ) -> "Future[List[int]]":
+        """Journal + submit. The returned future survives loop crashes: it
+        resolves from whichever scheduler incarnation finishes the work.
+        `idempotency_key` dedupes retries (same key → same result);
+        `idempotent=False` marks a consumer whose delivered tokens cannot
+        be replayed (the entry fails typed instead of double-streaming)."""
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s}")
+        with self._lock:
+            if idempotency_key is not None:
+                # Idempotency lookups come BEFORE every lifecycle check:
+                # serving an already-journaled result admits no new work,
+                # so even a draining or DEAD supervisor honors the "retry
+                # with the same key is safe" contract — a client whose
+                # response was lost on the wire must not get a 503 for a
+                # result sitting in memory.
+                live = self._by_key.get(idempotency_key)
+                if live is not None and not live.done:
+                    # Same request already acknowledged: one result, one
+                    # generation — the retry rides the original's future.
+                    resilience.inc("sched_idempotent_hits")
+                    return live.future
+                hit = self._completed.get(idempotency_key)
+                if hit is not None:
+                    resilience.inc("sched_idempotent_hits")
+                    self._completed.move_to_end(idempotency_key)
+                    f: Future = Future()
+                    f.set_result(list(hit))
+                    return f
+            if self._draining:
+                # Checked before _closed: a drained-then-shut supervisor
+                # still answers the RETRYABLE typed error (the replacement
+                # instance takes the retry), not lifecycle misuse.
+                raise Draining(
+                    "server draining: not admitting new requests",
+                    retry_after_s=self.retry_after_hint(),
+                )
+            if self._closed:
+                raise RuntimeError("scheduler has shut down")
+            if self._state == "dead":
+                raise self._dead_error()
+            entry = JournalEntry(
+                rid=self._next_rid,
+                ids=list(ids),
+                max_new=max_new_tokens,
+                sampling=sampling,
+                seed=seed,
+                idempotency_key=idempotency_key,
+                constraint=constraint,
+                deadline=(Deadline.after(deadline_s)
+                          if deadline_s is not None else None),
+                on_token=on_token,
+                idempotent=idempotent,
+                future=Future(),
+            )
+            self._next_rid += 1
+            entry.future._lsot_entry = entry  # cancel() handle
+            self._journal[entry.rid] = entry
+            if idempotency_key is not None:
+                self._by_key[idempotency_key] = entry
+            if self._state == "restarting":
+                # Acknowledged while the loop is down: the replay pass
+                # after the restart submits it in rid order.
+                return entry.future
+            try:
+                self._submit_entry_locked(entry)
+            except (ValueError, Overloaded):
+                # Request-shape rejection or a typed shed: NOT acknowledged
+                # — the caller got a real error, nothing to replay.
+                self._forget_locked(entry)
+                raise
+            except Exception as exc:  # noqa: BLE001 — crash classification below
+                if self._is_crash(exc):
+                    # The loop died under us: the request IS acknowledged
+                    # (journaled); restart + replay will serve it.
+                    self._notice_crash_locked(self._wrap_crash(exc))
+                    return entry.future
+                self._forget_locked(entry)
+                raise
+            return entry.future
+
+    def cancel(self, future: "Future[List[int]]") -> None:
+        """Cooperative cancel, supervisor-aware: marks the journal entry so
+        a replay resolves with what was already delivered, and forwards to
+        the inner scheduler's cancel seam. Safe on foreign futures."""
+        entry: Optional[JournalEntry] = getattr(future, "_lsot_entry", None)
+        if entry is None:
+            return
+        entry.cancelled = True
+        inner = entry.inner
+        if inner is not None:
+            req = getattr(inner, "_lsot_request", None)
+            if req is not None:
+                req.cancelled = True
+
+    def generate(
+        self,
+        prompts: List[List[int]],
+        max_new_tokens: int = 256,
+        sampling: SamplingParams = SamplingParams(),
+        seed: int = 0,
+    ) -> List[List[int]]:
+        """Synchronous batch helper (scheduler-compatible signature)."""
+        futs = [
+            self.submit(p, max_new_tokens=max_new_tokens, sampling=sampling,
+                        seed=seed)
+            for p in prompts
+        ]
+        return [f.result() for f in futs]
+
+    # ---------------------------------------------------------------- health
+
+    def health(self) -> Dict[str, object]:
+        """The `/readyz` payload: lifecycle state + restart counters."""
+        with self._lock:
+            return {
+                "state": self._state,
+                "draining": self._draining,
+                "restarts": self._restarts,
+                "max_restarts": self.max_restarts,
+                "replayed": self._replayed,
+                "lost": self._lost,
+                "journal_depth": sum(
+                    1 for e in self._journal.values() if not e.done
+                ),
+                "last_crash": (str(self._crash_exc)
+                               if self._crash_exc is not None else None),
+            }
+
+    # ----------------------------------------------------------------- drain
+
+    def drain(self, deadline_s: Optional[float] = None) -> Dict[str, object]:
+        """SIGTERM path: stop admitting (submits raise `Draining`), let
+        in-flight work finish up to the drain deadline, then journal what
+        is left to the spill file and shut down. `deadline_s=None` waits
+        for everything; `deadline_s <= 0` means journal-and-exit NOW (no
+        waiting — an unbounded wait on a wedged loop is exactly the hang
+        a drain deadline exists to prevent). Returns the accounting the
+        shutdown log wants. Single-flight: a repeated SIGTERM joins the
+        in-progress drain and gets its report instead of clobbering the
+        freshly written spill."""
+        with self._drain_lock:
+            if self._drain_report is not None:
+                return self._drain_report
+            with self._lock:
+                self._draining = True
+                waiting = [e for e in self._journal.values() if not e.done]
+            if deadline_s is not None and deadline_s <= 0:
+                waiting = []  # deadline already burned: straight to the spill
+            deadline = (Deadline.after(deadline_s)
+                        if deadline_s is not None and deadline_s > 0 else None)
+            finished = 0
+            for e in waiting:
+                timeout = None
+                if deadline is not None:
+                    timeout = deadline.remaining()
+                    if timeout <= 0:
+                        break
+                try:
+                    e.future.result(timeout=timeout)
+                    finished += 1
+                except FutureTimeoutError:
+                    break
+                except Exception:  # noqa: BLE001 — typed terminal states count as drained
+                    finished += 1
+            spilled = self._spill_pending()
+            self.shutdown()
+            self._drain_report = {
+                "drained": finished,
+                "spilled": spilled,
+                "spill_path": self.spill_path if spilled else None,
+            }
+            return self._drain_report
+
+    def _spill_pending(self) -> int:
+        """Journal-and-exit: persist unfinished entries (JSONL) so the next
+        process can `recover()` them, then fail their futures typed
+        `Draining` — the client is told to retry, and a retry with the
+        same idempotency key finds the recovered result. Only KEYED
+        entries spill: the idempotency cache is the sole cross-process
+        handle to a recovered result, so regenerating keyless work would
+        burn startup device time on futures nobody can claim. Constrained
+        entries carry a compiled device object and are not serializable:
+        both fail typed without a spill record (documented smallest
+        slice).
+
+        The COMPLETED idempotency cache spills too, as literal `result`
+        records: a client whose response was lost on the wire retries its
+        key against the NEXT process, and regenerating there would be
+        wasteful at best, wrong at worst (the result already exists).
+        Every record carries the spill wall-clock so recovery charges
+        downtime against remaining deadlines."""
+        now = time.time()
+        with self._lock:
+            pending = [e for e in self._journal.values() if not e.done]
+            records = []
+            for e in pending:
+                if e.constraint is None and not e.cancelled \
+                        and e.idempotency_key is not None:
+                    rem = (e.deadline.remaining()
+                           if e.deadline is not None else None)
+                    records.append({
+                        "rid": e.rid,
+                        "ids": e.ids,
+                        "max_new": e.max_new,
+                        "temperature": e.sampling.temperature,
+                        "top_p": e.sampling.top_p,
+                        "top_k": e.sampling.top_k,
+                        "seed": e.seed,
+                        "idempotency_key": e.idempotency_key,
+                        "deadline_remaining_s": rem,
+                        "spilled_at_unix": now,
+                        # Forensic only ("how far did it get before the
+                        # drain"): recover() regenerates from scratch —
+                        # deterministic decode makes the result identical,
+                        # so there is no cross-process suppression to do.
+                        "delivered": len(e.generated),
+                    })
+            for key, result in self._completed.items():
+                records.append({
+                    "idempotency_key": key,
+                    "result": list(result),
+                    "spilled_at_unix": now,
+                })
+        spilled = 0
+        spilled_keys = set()
+        if records and self.spill_path:
+            with open(self.spill_path, "w", encoding="utf-8") as f:
+                for rec in records:
+                    f.write(json.dumps(rec) + "\n")
+            spilled = len(records)
+            spilled_keys = {r["idempotency_key"] for r in records}
+        hint = self.retry_after_hint()
+        # Tell each client the truth: only entries actually WRITTEN to
+        # the spill may promise their key will find a journaled result;
+        # keyless/constrained/spill-disabled entries just get the drain.
+        journaled_exc = Draining(
+            "server draining: request journaled for restart; retry with "
+            "the same idempotency key",
+            retry_after_s=hint,
+        )
+        plain_exc = Draining(
+            "server draining: request not completed; retry later",
+            retry_after_s=hint,
+        )
+        with self._lock:
+            for e in pending:
+                if not e.done:
+                    self._fail_locked(
+                        e, journaled_exc if e.idempotency_key in spilled_keys
+                        else plain_exc,
+                    )
+        return spilled
+
+    def recover(self, path: Optional[str] = None) -> int:
+        """Restore a spill file from a previous process: completed
+        `result` records load straight into the idempotency cache (no
+        regeneration — retried keys find them immediately); pending
+        records resubmit server-side, their results landing in the same
+        cache. Deadlines are charged for the DOWNTIME between spill and
+        recovery (the spill wall-clock stamp); entries that no longer fit
+        their budget count as lost. Returns the number of records
+        restored; removes the file.
+
+        Never raises: recovery runs during server startup, and the
+        crash-recovery feature must not itself become a startup crash — a
+        truncated line (SIGKILL mid-spill), a record that no longer fits
+        a reconfigured scheduler (ValueError), or a shed (Overloaded) is
+        logged and counted lost; every parseable record still gets its
+        chance."""
+        path = path or self.spill_path
+        if not path or not os.path.exists(path):
+            return 0
+        recovered = 0
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = [line for line in f if line.strip()]
+            os.remove(path)
+        except OSError:
+            _log.exception("journal spill at %s unreadable; skipping", path)
+            return 0
+        now = time.time()
+        for line in lines:
+            try:
+                rec = json.loads(line)
+                if "result" in rec:
+                    # A completed result from the previous process: serve
+                    # future retries of this key from memory.
+                    with self._lock:
+                        self._completed[rec["idempotency_key"]] = tuple(
+                            rec["result"]
+                        )
+                        while len(self._completed) > self._completed_cap:
+                            self._completed.popitem(last=False)
+                    recovered += 1
+                    continue
+                rem = rec.get("deadline_remaining_s")
+                if rem is not None:
+                    # The clock kept running while the process was down.
+                    rem -= max(0.0, now - rec.get("spilled_at_unix", now))
+                    if rem <= 0:
+                        with self._lock:
+                            self._lost += 1
+                        resilience.inc("sched_lost")
+                        continue
+                self.submit(
+                    rec["ids"], max_new_tokens=rec["max_new"],
+                    sampling=SamplingParams(
+                        temperature=rec.get("temperature", 0.0),
+                        top_p=rec.get("top_p", 1.0),
+                        top_k=rec.get("top_k", 0),
+                    ),
+                    seed=rec.get("seed", 0),
+                    deadline_s=rem,
+                    idempotency_key=rec.get("idempotency_key"),
+                )
+            except Exception:  # noqa: BLE001 — per-record: salvage the rest
+                _log.exception("unrecoverable journal spill record: %.120s",
+                               line)
+                with self._lock:
+                    self._lost += 1
+                resilience.inc("sched_lost")
+                continue
+            recovered += 1
+        return recovered
+
+    # -------------------------------------------------------------- internal
+
+    @staticmethod
+    def _is_crash(exc: BaseException) -> bool:
+        # Crashes are classified by TYPE only: the scheduler's loop death
+        # and the pool's everything-dead summary both raise
+        # SchedulerCrashed (a message-string contract would silently
+        # break recovery on rewording).
+        return isinstance(exc, SchedulerCrashed)
+
+    @staticmethod
+    def _wrap_crash(exc: BaseException) -> SchedulerCrashed:
+        if isinstance(exc, SchedulerCrashed):
+            return exc
+        return SchedulerCrashed.from_exception(exc)
+
+    def _dead_error(self) -> SchedulerCrashed:
+        msg = (f"scheduler dead: restart budget exhausted "
+               f"({self._restarts}/{self.max_restarts} restarts)")
+        err = SchedulerCrashed(msg)
+        if self._crash_exc is not None:
+            err.__cause__ = self._crash_exc
+            err.crash_traceback = getattr(
+                self._crash_exc, "crash_traceback", "")
+        return err
+
+    def _make_on_token(self, entry: JournalEntry) -> Callable[[int], None]:
+        """Per-attempt token tap: counts/records delivered tokens for
+        replay, suppressing the prefix the client already received (the
+        replayed stream is byte-identical — per-request seeded RNG)."""
+        suppress = len(entry.generated)
+        seen = 0
+
+        def tap(tok: int) -> None:
+            nonlocal seen
+            seen += 1
+            if seen <= suppress:
+                return
+            entry.generated.append(tok)
+            if entry.on_token is not None:
+                try:
+                    entry.on_token(tok)
+                except Exception:  # noqa: BLE001 — consumer bugs must not break accounting
+                    entry.on_token = None
+
+        return tap
+
+    def _submit_entry_locked(self, entry: JournalEntry) -> None:
+        if entry.deadline is not None:
+            rem = entry.deadline.remaining()
+            if rem <= 0:
+                resilience.inc("deadline_expired")
+                raise DeadlineExceeded(
+                    "request deadline exceeded before admission"
+                )
+            deadline_s = rem
+        else:
+            deadline_s = None
+        fut = self._inner.submit(
+            entry.ids, max_new_tokens=entry.max_new, sampling=entry.sampling,
+            seed=entry.seed, on_token=self._make_on_token(entry),
+            constraint=entry.constraint, deadline_s=deadline_s,
+        )
+        entry.inner = fut
+        if entry.cancelled:  # cancelled while the loop was down
+            req = getattr(fut, "_lsot_request", None)
+            if req is not None:
+                req.cancelled = True
+        fut.add_done_callback(
+            lambda f, e=entry: self._on_inner_done(e, f)
+        )
+
+    def _on_inner_done(self, entry: JournalEntry, fut: Future) -> None:
+        with self._lock:
+            if entry.done or entry.inner is not fut:
+                return  # stale attempt from a torn-down incarnation
+            exc = fut.exception()
+            if exc is None:
+                self._finish_locked(entry, fut.result())
+                if self._state == "degraded":
+                    # A clean completion proves the restarted loop serves.
+                    self._state = "ready"
+                return
+            if self._is_crash(exc):
+                # The entry stays journaled: restart + replay owns it now.
+                self._notice_crash_locked(self._wrap_crash(exc))
+                return
+            if not self._closed and isinstance(exc, RuntimeError) \
+                    and str(exc) == "scheduler shut down mid-request":
+                # Teardown CROSSFIRE, not a per-request failure: the
+                # restart driver shut the old incarnation down and a
+                # HEALTHY replica's in-flight work (pool case) was closed
+                # with it. The request is acknowledged — leave it
+                # journaled; the replay pass resubmits it on the rebuilt
+                # scheduler. (Outside supervisor-owned teardown this
+                # message can only mean lifecycle misuse — the supervisor
+                # owns start/shutdown of every inner it builds.)
+                return
+            self._fail_locked(entry, exc)
+
+    def _finish_locked(self, entry: JournalEntry, result: List[int]) -> None:
+        entry.done = True
+        self._journal.pop(entry.rid, None)
+        if entry.idempotency_key is not None:
+            if self._by_key.get(entry.idempotency_key) is entry:
+                del self._by_key[entry.idempotency_key]
+            if not entry.cancelled:
+                # A cancelled entry resolves with its PARTIAL tokens —
+                # never cache that as the key's authoritative result; a
+                # retry with the key deserves a full generation.
+                self._completed[entry.idempotency_key] = tuple(result)
+                while len(self._completed) > self._completed_cap:
+                    self._completed.popitem(last=False)
+        entry.future.set_result(result)
+
+    def _fail_locked(self, entry: JournalEntry, exc: BaseException) -> None:
+        entry.done = True
+        self._journal.pop(entry.rid, None)
+        if entry.idempotency_key is not None and \
+                self._by_key.get(entry.idempotency_key) is entry:
+            del self._by_key[entry.idempotency_key]
+        entry.future.set_exception(exc)
+
+    def _forget_locked(self, entry: JournalEntry) -> None:
+        """Un-acknowledge: the submit itself answered the caller (shed or
+        request-shape error), so nothing may linger for replay."""
+        entry.done = True
+        self._journal.pop(entry.rid, None)
+        if entry.idempotency_key is not None and \
+                self._by_key.get(entry.idempotency_key) is entry:
+            del self._by_key[entry.idempotency_key]
+
+    def _notice_crash_locked(self, exc: SchedulerCrashed) -> None:
+        self._crash_exc = exc
+        if self._state in ("restarting", "dead") or self._closed:
+            return  # single-flight: one restart driver at a time
+        self._breaker.record_failure()
+        self._state = "restarting"
+        _log.warning("scheduler loop crashed; supervisor restarting: %s", exc)
+        threading.Thread(
+            target=self._restart_and_replay, daemon=True,
+            name="lsot-supervisor-restart",
+        ).start()
+
+    def _restart_and_replay(self) -> None:
+        """The restart driver (one thread per crash episode): tear down,
+        rebuild with backoff under the restart budget, replay the journal.
+        A crash DURING replay loops back to another rebuild; budget
+        exhaustion fails everything typed and marks the supervisor dead."""
+        while True:
+            old = self._inner
+            try:
+                old.shutdown()  # joins the dead worker: all its
+            except Exception:   # done-callbacks have run past this point
+                _log.exception("dead scheduler teardown failed; continuing")
+            with self._lock:
+                if self._closed:
+                    return
+                if self._restarts >= self.max_restarts:
+                    self._die_locked()
+                    return
+                attempt = self._restarts
+                self._restarts += 1
+            resilience.inc("sched_restarts")
+            self._sleep(self._restart_policy.delay_s(attempt, self._rng))
+            try:
+                inner = self._factory()
+                inner.start()
+            except Exception:  # noqa: BLE001 — rebuild failure burns one restart credit
+                _log.exception("scheduler rebuild failed (restart %d/%d)",
+                               attempt + 1, self.max_restarts)
+                self._breaker.record_failure()
+                continue
+            with self._lock:
+                if self._closed:
+                    inner.shutdown()
+                    return
+                self._inner = inner
+                try:
+                    lost = self._replay_locked()
+                except _CrashedAgain:
+                    continue  # the fresh loop died mid-replay: go again
+                self._state = "degraded" if lost else "ready"
+                self._breaker.record_success()
+                _log.info(
+                    "scheduler restarted (restart %d/%d): state=%s lost=%d",
+                    self._restarts, self.max_restarts, self._state, lost,
+                )
+                return
+
+    def _replay_locked(self) -> int:
+        """Resubmit journaled work in rid order. Returns how many
+        acknowledged requests were LOST (failed typed instead of
+        replayed): expired deadlines, and in-flight non-idempotent
+        streams. Raises `_CrashedAgain` if the fresh loop dies under the
+        replay itself."""
+        lost = 0
+        for rid in sorted(self._journal):
+            e = self._journal[rid]
+            if e.done:
+                continue
+            if e.cancelled:
+                # The consumer already gave up: resolve with what it got
+                # (the bare scheduler's cancel contract), don't re-decode.
+                self._finish_locked(e, list(e.generated))
+                continue
+            if e.deadline is not None and e.deadline.expired():
+                resilience.inc("deadline_expired")
+                resilience.inc("sched_lost")
+                self._lost += 1
+                lost += 1
+                self._fail_locked(e, DeadlineExceeded(
+                    f"request deadline expired during scheduler restart "
+                    f"with {len(e.generated)} of {e.max_new} tokens "
+                    f"delivered"
+                ))
+                continue
+            if not e.idempotent and e.generated:
+                # Tokens already reached a consumer that declared itself
+                # replay-unsafe: failing typed beats double-applying.
+                resilience.inc("sched_lost")
+                self._lost += 1
+                lost += 1
+                self._fail_locked(e, self._wrap_crash(
+                    self._crash_exc
+                    or SchedulerCrashed("scheduler loop crashed")
+                ))
+                continue
+            try:
+                self._submit_entry_locked(e)
+            except DeadlineExceeded as exc:
+                resilience.inc("sched_lost")
+                self._lost += 1
+                lost += 1
+                self._fail_locked(e, exc)
+                continue
+            except Overloaded as exc:
+                # A fresh loop's queue should hold the journal; a cap
+                # smaller than the backlog is a deployment error — fail
+                # typed rather than spin the restart thread.
+                resilience.inc("sched_lost")
+                self._lost += 1
+                lost += 1
+                self._fail_locked(e, exc)
+                continue
+            except Exception as exc:  # noqa: BLE001 — crash classification
+                if self._is_crash(exc):
+                    self._crash_exc = self._wrap_crash(exc)
+                    self._breaker.record_failure()
+                    raise _CrashedAgain() from exc
+                resilience.inc("sched_lost")
+                self._lost += 1
+                lost += 1
+                self._fail_locked(e, exc)
+                continue
+            if not e.done and e.inner is not None and e.inner.done():
+                # The fresh loop killed this submit before its callback
+                # was even attached: the callback ran INLINE on this
+                # thread (RLock), where _notice_crash_locked's
+                # single-flight guard no-ops because WE are the restart
+                # driver. Detect it here — otherwise the entry would stay
+                # journaled forever with a dead inner future and its
+                # client would hang.
+                exc2 = e.inner.exception()
+                if exc2 is not None and self._is_crash(exc2):
+                    self._crash_exc = self._wrap_crash(exc2)
+                    self._breaker.record_failure()
+                    raise _CrashedAgain()
+            self._replayed += 1
+            resilience.inc("sched_replayed")
+        return lost
+
+    def _die_locked(self) -> None:
+        self._state = "dead"
+        err = self._dead_error()
+        _log.error("supervisor giving up: %s", err)
+        for e in list(self._journal.values()):
+            if not e.done:
+                resilience.inc("sched_lost")
+                self._lost += 1
+                self._fail_locked(e, err)
+
+
+class _CrashedAgain(Exception):
+    """Internal signal: the freshly restarted loop crashed during replay."""

@@ -10,10 +10,14 @@ make the out-of-process boundaries fail ON DEMAND, reproducibly:
 
 Spec grammar: comma-separated `site:point:probability` triples. The first
 two fields name an injection site (`ollama:connect`, `sql:exec`,
-`sql:load`, `sched:decode` — grep for `FAULTS.check` to enumerate); the
-probability is a float in (0, 1]. The RNG is seeded (`LSOT_FAULTS_SEED`,
-default 0), so the same spec + seed + call sequence replays the exact same
-fault schedule — chaos tests assert concrete outcomes, not distributions.
+`sql:load`, `sched:decode` — kills the loop at round issue, before any
+token of the round exists — and `sched:crash` — kills it at harvest,
+MID-BATCH, after tokens may already have streamed to clients: the
+supervisor's replay-without-duplicates seam; grep for `FAULTS.check` to
+enumerate); the probability is a float in (0, 1]. The RNG is seeded
+(`LSOT_FAULTS_SEED`, default 0), so the same spec + seed + call sequence
+replays the exact same fault schedule — chaos tests assert concrete
+outcomes, not distributions.
 
 Injection points call `FAULTS.check("site:point")`, which raises
 `InjectedFault` (a ConnectionError subclass, so connect-phase retry

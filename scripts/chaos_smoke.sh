@@ -1,13 +1,17 @@
 #!/usr/bin/env bash
 # Chaos smoke: the fault-injection test lane under a FIXED spec + seed.
 #
-# Runs every `chaos`-marked test (scheduler crash typing, admission
-# shedding, retry/breaker behavior at the Ollama and SQL boundaries, the
-# chaos evalh report) with LSOT_FAULTS/LSOT_FAULTS_SEED pinned so the
-# injected fault schedule — and therefore every assertion — replays
-# exactly. These tests are NOT marked slow: the default tier-1 run
-# (`pytest -m 'not slow'`) includes them; this script is the focused lane
-# for iterating on the fault-tolerance layer.
+# Runs every `chaos`-marked test (scheduler crash typing + supervised
+# crash-restart-replay, admission shedding, retry/breaker behavior at the
+# Ollama and SQL boundaries, the chaos evalh report) with
+# LSOT_FAULTS/LSOT_FAULTS_SEED pinned so the injected fault schedule —
+# and therefore every assertion — replays exactly, then runs the
+# crash-restart scenario end to end through `evalh --chaos` (supervised
+# scheduler under sched:crash: zero hung, zero lost acknowledged
+# requests, restart/replay counts in the summary). These tests are NOT
+# marked slow: the default tier-1 run (`pytest -m 'not slow'`) includes
+# them; this script is the focused lane for iterating on the
+# fault-tolerance layer.
 #
 #   LSOT_FAULTS=... LSOT_FAULTS_SEED=... scripts/chaos_smoke.sh [pytest args]
 set -euo pipefail
@@ -17,4 +21,12 @@ export LSOT_FAULTS="${LSOT_FAULTS:-ollama:connect:0.5,sql:exec:1}"
 export LSOT_FAULTS_SEED="${LSOT_FAULTS_SEED:-0}"
 export JAX_PLATFORMS=cpu
 
-exec python -m pytest tests -q -m chaos -p no:cacheprovider "$@"
+python -m pytest tests -q -m chaos -p no:cacheprovider "$@"
+
+# Crash-restart scenario in the default lane: the supervised scheduler
+# must survive injected mid-batch loop deaths with zero lost acknowledged
+# requests (run_chaos asserts it; the JSON summary shows
+# restarts/replayed/lost).
+LSOT_FAULTS= python -m llm_based_apache_spark_optimization_tpu.evalh \
+  --chaos "ollama:connect:0.5,sql:exec:1,sched:crash:0.2" \
+  --chaos-seed "${LSOT_FAULTS_SEED}"

@@ -386,8 +386,16 @@ def test_checkpoint_backend_cli_scheduler_pool_dp2(tiny_model, tmp_path):
     )
     svc = make_checkpoint_service(args, max_new_tokens=4)
     sql = svc._models["duckdb-nsql"].backend
-    assert isinstance(sql.scheduler, SchedulerPool)
-    assert len(sql.scheduler.schedulers) == 2
+    # The crash supervisor (default on) wraps the dp pool: individual
+    # replica crashes fail over inside the pool; an all-dead pool is
+    # rebuilt + replayed by the supervisor.
+    from llm_based_apache_spark_optimization_tpu.serve.supervisor import (
+        SupervisedScheduler,
+    )
+
+    assert isinstance(sql.scheduler, SupervisedScheduler)
+    assert isinstance(sql.scheduler._inner, SchedulerPool)
+    assert len(sql.scheduler._inner.schedulers) == 2
     try:
         with ThreadPoolExecutor(max_workers=4) as pool:
             outs = [

@@ -17,9 +17,11 @@ from llm_based_apache_spark_optimization_tpu.serve.resilience import (
     CircuitOpen,
     Deadline,
     DeadlineExceeded,
+    Draining,
     Overloaded,
     RetryPolicy,
     SchedulerCrashed,
+    breaker_states,
 )
 from llm_based_apache_spark_optimization_tpu.utils.faults import (
     FaultRegistry,
@@ -604,6 +606,7 @@ def _api_client(tmp_path, svc):
     (CircuitOpen("engine down", retry_after_s=3.0), 503, True),
     (SchedulerCrashed("scheduler loop crashed: boom"), 503, False),
     (DeadlineExceeded("request deadline exceeded"), 504, False),
+    (Draining("server draining", retry_after_s=2.0), 503, True),
 ])
 def test_api_generate_maps_typed_errors(tmp_path, exc, status, retry_after):
     from llm_based_apache_spark_optimization_tpu.serve import GenerationService
@@ -721,3 +724,155 @@ def test_chaos_evalh_all_ok_without_faults():
     assert rep["hung"] == 0
     assert rep["outcomes"]["ok"] == rep["requests"]
     assert rep["faults_injected"] == {}
+
+
+# ------------------------------------------- per-dependency breaker metrics
+
+
+def test_breaker_states_surface_per_dependency_in_metrics():
+    """ROADMAP follow-up: /metrics shows WHICH dependency's circuit is
+    open (name → state/failures/retry window), not aggregate counters
+    only."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        GenerationService,
+    )
+
+    b = CircuitBreaker("testdep", failure_threshold=1, reset_after_s=60.0)
+    try:
+        b.record_failure()
+        states = breaker_states()
+        assert states["testdep"]["state"] == "open"
+        assert states["testdep"]["consecutive_failures"] == 1
+        assert states["testdep"]["retry_after_s"] > 0
+        snap = GenerationService().metrics_snapshot()
+        assert snap["resilience"]["breakers"]["testdep"]["state"] == "open"
+        b.record_success()
+        assert breaker_states()["testdep"]["state"] == "closed"
+    finally:
+        # The registry is process-wide: leave no phantom dependency for
+        # later tests' /metrics assertions.
+        b.unregister()
+    assert "testdep" not in breaker_states()
+
+
+# ------------------------------------------- queue-depth-aware Retry-After
+
+
+def test_retry_after_hint_scales_with_queue_depth(tiny_model_module):
+    """ROADMAP follow-up: the 429/drain Retry-After estimates queue depth
+    × recent per-request service time / slots instead of a static 1s —
+    clamped to [1, 60]."""
+    cfg, params = tiny_model_module
+    sched = make_sched(cfg, params)  # never started: queue is inert
+    assert sched.retry_after_hint() == 1.0  # no EWMA yet → floor
+    sched._svc_ewma = 2.0
+    for _ in range(4):
+        sched._queue.put(None)
+    # (4 queued + the retry itself) * 2.0s / 2 slots = 5.0
+    assert sched.retry_after_hint() == 5.0
+    sched._svc_ewma = 1000.0
+    assert sched.retry_after_hint() == 60.0  # ceiling
+    sched._svc_ewma = 0.001
+    assert sched.retry_after_hint() == 1.0  # floor
+
+
+def test_scheduler_completion_seeds_service_time_ewma(tiny_model_module):
+    cfg, params = tiny_model_module
+    with make_sched(cfg, params) as sched:
+        assert sched._svc_ewma is None
+        sched.submit([1, 5], max_new_tokens=4).result(timeout=120)
+        assert sched._svc_ewma is not None and sched._svc_ewma > 0
+
+
+# ------------------------------------- engine-backend deadline clamp (issue)
+
+
+class _StubEngine:
+    """Engine-shaped stub: generate() echoes its granted budget so the
+    clamp is observable without device work."""
+
+    def __init__(self):
+        from llm_based_apache_spark_optimization_tpu.models import TINY
+
+        self.cfg = TINY
+        self.stop_ids = ()
+        self.budgets = []
+
+    def padded_prompt_len(self, n):
+        return n
+
+    def generate(self, prompts, max_new_tokens=256, sampling=None, seed=0,
+                 constraint=None):
+        self.budgets.append(max_new_tokens)
+        return [[1] * max_new_tokens for _ in prompts]
+
+
+def _engine_backend(max_new=50):
+    from llm_based_apache_spark_optimization_tpu.serve.backends import (
+        EngineBackend,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import (
+        ByteTokenizer,
+    )
+
+    return EngineBackend(_StubEngine(), ByteTokenizer(),
+                         max_new_tokens=max_new)
+
+
+def test_engine_backend_clamps_budget_from_deadline():
+    """ROADMAP follow-up, smallest slice: the one-XLA-program engine
+    clamps its step budget at issue time from remaining deadline × the
+    measured per-token rate, so a nearly-expired request cannot occupy
+    the device for a full max-tokens decode."""
+    backend = _engine_backend()
+    assert backend.supports_deadline
+    # No measured rate yet: first request runs unclamped, and its wall
+    # (jit-compile-dominated in real deployments) is DISCARDED rather
+    # than seeding a poisoned exchange rate.
+    backend.complete("hi", deadline_s=0.5)
+    assert backend.engine.budgets[-1] == 50
+    assert backend._sec_per_tok is None
+    backend.complete("hi")
+    assert backend._sec_per_tok is not None  # second completion seeds it
+    # Measured rate 0.1 s/token: a 2s deadline affords ~20 of 50 tokens
+    # (the exchange uses the REMAINING deadline inside the backend lock,
+    # so a tick below the nominal 2s is expected).
+    backend._sec_per_tok = 0.1
+    before = resilience.get("deadline_clamps")
+    backend.complete("hi", deadline_s=2.0)
+    assert 18 <= backend.engine.budgets[-1] <= 20
+    assert resilience.get("deadline_clamps") == before + 1
+    # A roomy deadline leaves the budget alone.
+    backend._sec_per_tok = 0.001
+    backend.complete("hi", deadline_s=2.0)
+    assert backend.engine.budgets[-1] == 50
+
+
+def test_engine_backend_rejects_unaffordable_deadline_typed():
+    backend = _engine_backend()
+    backend._sec_per_tok = 0.1
+    before = resilience.get("deadline_expired")
+    with pytest.raises(DeadlineExceeded, match="cannot afford"):
+        backend.complete("hi", deadline_s=0.05)  # affords < 1 token
+    assert resilience.get("deadline_expired") == before + 1
+    assert backend.engine.budgets == []  # the device was never touched
+    # complete_batch shares the clamp (the batch decodes in lockstep).
+    backend2 = _engine_backend()
+    backend2._sec_per_tok = 0.1
+    backend2.complete_batch(["a", "b"], deadline_s=2.0)
+    assert 18 <= backend2.engine.budgets[-1] <= 20
+
+
+def test_service_forwards_deadline_to_engine_backend():
+    """supports_deadline on the engine backend: GenerationService now
+    forwards deadline_s instead of silently dropping it."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        GenerationService,
+    )
+
+    svc = GenerationService()
+    backend = _engine_backend()
+    backend._sec_per_tok = 0.1
+    svc.register("m", backend)
+    svc.generate("m", "q", deadline_s=2.0)
+    assert 18 <= backend.engine.budgets[-1] <= 20

@@ -1,0 +1,798 @@
+"""Crash recovery: supervised scheduler lifecycle (serve/supervisor.py).
+
+Unit tests drive the supervisor against a hand-cranked fake scheduler
+(futures resolve when the TEST says so) to pin the journal/replay
+semantics deterministically; the `chaos`-marked integration test kills
+the REAL continuous-batching scheduler mid-batch through the
+`sched:crash` fault seam and asserts zero lost acknowledged requests.
+App-level tests cover /healthz, /readyz and the SIGTERM drain gate.
+"""
+
+import json
+import random
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+    Deadline,
+    DeadlineExceeded,
+    Draining,
+    Overloaded,
+    RetryPolicy,
+    SchedulerCrashed,
+)
+from llm_based_apache_spark_optimization_tpu.serve.supervisor import (
+    SupervisedScheduler,
+)
+from llm_based_apache_spark_optimization_tpu.utils.faults import FAULTS
+from llm_based_apache_spark_optimization_tpu.utils.observability import (
+    resilience,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class ManualInner:
+    """Fake scheduler with the submit surface; the test resolves futures
+    and triggers crashes by hand, so every interleaving is scripted."""
+
+    def __init__(self):
+        self.submitted = []
+        self.started = False
+        self.shut = False
+        self._crash = None
+
+    def start(self):
+        self.started = True
+        return self
+
+    def shutdown(self):
+        # Mimic the real scheduler's _close: a clean shutdown fails every
+        # outstanding future with the untyped mid-request RuntimeError —
+        # the exact crossfire a supervised POOL's healthy replicas see
+        # when the restart driver tears the old incarnation down.
+        self.shut = True
+        for rec in self.submitted:
+            if not rec["future"].done():
+                rec["future"].set_exception(
+                    RuntimeError("scheduler shut down mid-request"))
+
+    def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
+               on_token=None, constraint=None, deadline_s=None):
+        if self._crash is not None:
+            raise self._crash
+        rec = {"ids": list(ids), "max_new": max_new_tokens, "seed": seed,
+               "on_token": on_token, "deadline_s": deadline_s,
+               "future": Future()}
+        self.submitted.append(rec)
+        return rec["future"]
+
+    def emit(self, i, toks):
+        for t in toks:
+            self.submitted[i]["on_token"](t)
+
+    def finish(self, i, result):
+        self.submitted[i]["future"].set_result(list(result))
+
+    def crash(self, exc=None):
+        exc = exc or SchedulerCrashed("boom")
+        self._crash = exc
+        for rec in self.submitted:
+            if not rec["future"].done():
+                rec["future"].set_exception(exc)
+
+    def crash_one(self, i, exc=None):
+        """Pool-shaped partial crash: ONE replica's request dies typed
+        while the rest stay in flight (to be closed as crossfire when the
+        supervisor tears the pool down)."""
+        exc = exc or SchedulerCrashed("replica boom")
+        self._crash = exc
+        self.submitted[i]["future"].set_exception(exc)
+
+
+class Factory:
+    def __init__(self, fail_builds=0):
+        self.instances = []
+        self.fail_builds = fail_builds
+
+    def __call__(self):
+        if self.fail_builds > 0:
+            self.fail_builds -= 1
+            raise RuntimeError("rebuild failed")
+        inner = ManualInner()
+        self.instances.append(inner)
+        return inner
+
+
+def make_sup(max_restarts=3, sleep=None, **kw):
+    fac = Factory()
+    delays = []
+    sup = SupervisedScheduler(
+        fac, max_restarts=max_restarts,
+        restart_policy=RetryPolicy(max_attempts=max_restarts + 1,
+                                   base_delay_s=0.01, max_delay_s=0.05),
+        rng=random.Random(0),
+        sleep=sleep if sleep is not None else delays.append,
+        **kw,
+    )
+    return sup, fac, delays
+
+
+# ------------------------------------------------------------------ journal
+
+
+def test_journal_dedup_by_idempotency_key():
+    """Same key in flight → the SAME future (one generation); after
+    completion → the journaled result, no new generation; a different
+    key → a fresh generation."""
+    sup, fac, _ = make_sup()
+    sup.start()
+    before = resilience.get("sched_idempotent_hits")
+    f1 = sup.submit([1, 2], idempotency_key="k")
+    f2 = sup.submit([1, 2], idempotency_key="k")
+    assert f1 is f2
+    inner = fac.instances[0]
+    assert len(inner.submitted) == 1
+    inner.emit(0, [5, 6])
+    inner.finish(0, [5, 6])
+    assert f1.result(timeout=5) == [5, 6]
+    f3 = sup.submit([1, 2], idempotency_key="k")
+    assert f3 is not f1
+    assert f3.result(timeout=5) == [5, 6]
+    assert len(inner.submitted) == 1  # journaled result, not a re-decode
+    assert resilience.get("sched_idempotent_hits") == before + 2
+    f4 = sup.submit([1, 2], idempotency_key="other")
+    assert len(inner.submitted) == 2
+    inner.finish(1, [9])
+    assert f4.result(timeout=5) == [9]
+    sup.shutdown()
+
+
+def test_shed_and_shape_errors_are_not_acknowledged():
+    """A ValueError (request shape) or Overloaded (typed shed) from the
+    inner submit propagates and leaves NOTHING journaled for replay."""
+    sup, fac, _ = make_sup()
+    sup.start()
+    inner = fac.instances[0]
+
+    real_submit = inner.submit
+    def shedding_submit(*a, **kw):
+        raise Overloaded("queue full", retry_after_s=1.0)
+    inner.submit = shedding_submit
+    with pytest.raises(Overloaded):
+        sup.submit([1], idempotency_key="k")
+    inner.submit = real_submit
+    assert sup.health()["journal_depth"] == 0
+    # The key is free again (the shed attempt must not poison retries).
+    f = sup.submit([1], idempotency_key="k")
+    inner.finish(0, [3])
+    assert f.result(timeout=5) == [3]
+    sup.shutdown()
+
+
+# ----------------------------------------------------------- crash + replay
+
+
+def test_crash_restart_replays_and_suppresses_streamed_tokens():
+    """Mid-stream crash: the restarted scheduler replays the request and
+    the client's stream continues WITHOUT duplicate tokens (the replayed
+    deterministic prefix is suppressed); the future resolves with the
+    full result."""
+    sup, fac, _ = make_sup()
+    sup.start()
+    toks = []
+    f = sup.submit([1, 2, 3], seed=7, on_token=toks.append)
+    inner = fac.instances[0]
+    inner.emit(0, [10, 11])  # two tokens reach the client...
+    inner.crash()            # ...then the loop dies mid-batch
+    wait_for(lambda: len(fac.instances) == 2, msg="restart")
+    inner2 = fac.instances[1]
+    wait_for(lambda: len(inner2.submitted) == 1, msg="replay")
+    rec = inner2.submitted[0]
+    assert rec["ids"] == [1, 2, 3] and rec["seed"] == 7
+    inner2.emit(0, [10, 11, 12])  # deterministic replay re-emits all three
+    assert toks == [10, 11, 12]   # client saw each token exactly once
+    inner2.finish(0, [10, 11, 12])
+    assert f.result(timeout=5) == [10, 11, 12]
+    assert fac.instances[0].shut  # the corpse was torn down
+    h = sup.health()
+    assert h["state"] == "ready" and h["restarts"] == 1
+    assert h["replayed"] == 1 and h["lost"] == 0
+    sup.shutdown()
+
+
+def test_replay_skips_expired_deadlines_typed():
+    """Replay serves requests whose deadlines still hold; expired ones
+    fail typed DeadlineExceeded, count as lost, and leave the supervisor
+    degraded until the next clean completion."""
+    sup, fac, _ = make_sup()
+    sup.start()
+    doomed = sup.submit([1], deadline_s=0.05)
+    alive = sup.submit([2], deadline_s=60.0)
+    inner = fac.instances[0]
+    assert len(inner.submitted) == 2
+    time.sleep(0.1)  # burn the first deadline while "in flight"
+    before_lost = resilience.get("sched_lost")
+    inner.crash()
+    wait_for(lambda: len(fac.instances) == 2, msg="restart")
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=5)
+    inner2 = fac.instances[1]
+    wait_for(lambda: len(inner2.submitted) == 1, msg="replay")
+    assert inner2.submitted[0]["ids"] == [2]
+    assert inner2.submitted[0]["deadline_s"] < 60.0  # remaining, not reset
+    assert sup.health()["state"] == "degraded"
+    assert sup.health()["lost"] == 1
+    assert resilience.get("sched_lost") == before_lost + 1
+    inner2.finish(0, [9])
+    assert alive.result(timeout=5) == [9]
+    wait_for(lambda: sup.health()["state"] == "ready",
+             msg="degraded clears on clean completion")
+    sup.shutdown()
+
+
+def test_non_idempotent_inflight_not_replayed():
+    """A consumer that declared idempotent=False and already received
+    tokens must NOT be double-streamed: the entry fails typed with the
+    crash instead of replaying."""
+    sup, fac, _ = make_sup()
+    sup.start()
+    toks = []
+    f = sup.submit([1], on_token=toks.append, idempotent=False)
+    queued = sup.submit([2], idempotent=False)  # no tokens yet: replayable
+    inner = fac.instances[0]
+    inner.emit(0, [4])
+    inner.crash()
+    wait_for(lambda: len(fac.instances) == 2, msg="restart")
+    with pytest.raises(SchedulerCrashed):
+        f.result(timeout=5)
+    inner2 = fac.instances[1]
+    wait_for(lambda: len(inner2.submitted) == 1, msg="replay of queued")
+    assert inner2.submitted[0]["ids"] == [2]
+    inner2.finish(0, [8])
+    assert queued.result(timeout=5) == [8]
+    sup.shutdown()
+
+
+def test_pool_crossfire_inflight_replayed_not_lost():
+    """One replica of a supervised pool crashes while another replica
+    still decodes acknowledged work: tearing the old pool down closes the
+    healthy replica's future with the untyped mid-request RuntimeError —
+    that is teardown CROSSFIRE, and the entry must replay on the rebuilt
+    pool, not fail untyped (the zero-lost-acknowledged contract)."""
+    sup, fac, _ = make_sup()
+    sup.start()
+    crashed = sup.submit([1], idempotency_key="a")
+    healthy = sup.submit([2], idempotency_key="b")
+    inner = fac.instances[0]
+    assert len(inner.submitted) == 2
+    inner.crash_one(0)  # replica A dies; B's request is still in flight
+    wait_for(lambda: len(fac.instances) == 2, msg="restart")
+    # old.shutdown() closed B's future mid-request — both entries replay.
+    inner2 = fac.instances[1]
+    wait_for(lambda: len(inner2.submitted) == 2, msg="both replayed")
+    assert [r["ids"] for r in inner2.submitted] == [[1], [2]]
+    inner2.finish(0, [10])
+    inner2.finish(1, [20])
+    assert crashed.result(timeout=5) == [10]
+    assert healthy.result(timeout=5) == [20]
+    h = sup.health()
+    assert h["lost"] == 0 and h["replayed"] == 2 and h["state"] == "ready"
+    sup.shutdown()
+
+
+def test_restart_backoff_caps_then_dead():
+    """Each restart sleeps a full-jitter backoff bounded by the policy;
+    the budget caps total restarts — beyond it the supervisor is dead:
+    journaled work fails typed, new submits are refused, /readyz says
+    dead."""
+    sup, fac, delays = make_sup(max_restarts=2)
+    sup.start()
+    f = sup.submit([1])
+    policy = sup._restart_policy
+    for n in range(2):
+        fac.instances[-1].crash()
+        wait_for(lambda: len(fac.instances) == n + 2, msg=f"restart {n+1}")
+        wait_for(lambda: len(fac.instances[-1].submitted) == 1,
+                 msg="replay")
+    # Third crash exhausts the budget of 2.
+    fac.instances[-1].crash()
+    wait_for(lambda: sup.health()["state"] == "dead", msg="dead")
+    with pytest.raises(SchedulerCrashed):
+        f.result(timeout=5)
+    with pytest.raises(SchedulerCrashed, match="restart budget exhausted"):
+        sup.submit([9])
+    assert len(delays) == 2  # one backoff per restart, none after death
+    rng = random.Random(0)
+    for attempt, d in enumerate(delays):
+        assert 0.0 <= d <= min(policy.max_delay_s,
+                               policy.base_delay_s * 2 ** attempt)
+    assert sup.health()["restarts"] == 2
+    sup.shutdown()
+
+
+def test_rebuild_failures_burn_restart_credits():
+    """A factory that cannot build (device gone) consumes the restart
+    budget instead of spinning forever."""
+    fac = Factory()
+    sup = SupervisedScheduler(
+        fac, max_restarts=2,
+        restart_policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                   max_delay_s=0.002),
+        rng=random.Random(0), sleep=lambda s: None,
+    )
+    sup.start()
+    f = sup.submit([1])
+    fac.fail_builds = 10  # every rebuild attempt raises
+    fac.instances[0].crash()
+    wait_for(lambda: sup.health()["state"] == "dead", msg="dead")
+    with pytest.raises(SchedulerCrashed):
+        f.result(timeout=5)
+    sup.shutdown()
+
+
+def test_submit_during_restart_is_journaled_and_replayed():
+    """A request arriving while the loop is down is acknowledged into the
+    journal and served by the replay pass — the restart window is not an
+    outage for new admissions."""
+    gate = threading.Event()
+    sup, fac, _ = make_sup(sleep=lambda s: gate.wait(timeout=5))
+    sup.start()
+    f1 = sup.submit([1])
+    fac.instances[0].crash()
+    wait_for(lambda: sup.health()["state"] == "restarting", msg="restarting")
+    f2 = sup.submit([5])  # journaled while the loop is being rebuilt
+    assert sup.health()["journal_depth"] == 2
+    gate.set()
+    wait_for(lambda: len(fac.instances) == 2, msg="restart")
+    inner2 = fac.instances[1]
+    wait_for(lambda: len(inner2.submitted) == 2, msg="both submitted")
+    assert [r["ids"] for r in inner2.submitted] == [[1], [5]]  # rid order
+    inner2.finish(0, [1])
+    inner2.finish(1, [2])
+    assert f1.result(timeout=5) == [1] and f2.result(timeout=5) == [2]
+    sup.shutdown()
+
+
+# -------------------------------------------------------------------- drain
+
+
+def test_drain_semantics_and_spill_recovery(tmp_path):
+    """drain(): new keyless submits shed typed Draining (keyed retries of
+    COMPLETED work still serve from the cache); unfinished keyed work AND
+    the completed-results cache spill to disk; a fresh supervisor
+    recovers both — retried keys find completed results without any
+    regeneration and pending work resubmits."""
+    spill = str(tmp_path / "journal.jsonl")
+    sup, fac, _ = make_sup(spill_path=spill)
+    sup.start()
+    done = sup.submit([1], idempotency_key="a")
+    pend = sup.submit([2, 3], max_new_tokens=5, idempotency_key="b",
+                      deadline_s=60.0)
+    inner = fac.instances[0]
+    inner.emit(1, [7])  # one token delivered on the pending request
+    inner.finish(0, [4])
+    assert done.result(timeout=5) == [4]
+    report = sup.drain(deadline_s=0.2)
+    # Two records: the unfinished keyed entry + the completed result.
+    assert report["spilled"] == 2 and report["spill_path"] == spill
+    # Single-flight: a repeated SIGTERM joins the finished drain instead
+    # of rewriting the spill it just produced.
+    assert sup.drain(deadline_s=0.2) == report
+    with pytest.raises(Draining):
+        pend.result(timeout=5)
+    with pytest.raises(Draining):  # drain gate at the scheduler layer
+        sup.submit([9])
+    # A keyed retry of COMPLETED work is served even while drained: the
+    # result exists only here, so 503ing it would lose acknowledged work.
+    assert sup.submit([1], idempotency_key="a").result(timeout=5) == [4]
+    recs = [json.loads(line) for line in open(spill)]
+    by_key = {r["idempotency_key"]: r for r in recs}
+    assert by_key["b"]["ids"] == [2, 3] and by_key["b"]["delivered"] == 1
+    assert 0 < by_key["b"]["deadline_remaining_s"] <= 60.0
+    assert by_key["b"]["spilled_at_unix"] > 0
+    assert by_key["a"]["result"] == [4]
+
+    # Next process: recover the spill. The completed key serves from the
+    # cache with NO resubmission; the pending one regenerates.
+    sup2, fac2, _ = make_sup(spill_path=spill)
+    sup2.start()
+    assert sup2.recover() == 2
+    inner2 = fac2.instances[0]
+    assert len(inner2.submitted) == 1  # only the pending record resubmits
+    assert inner2.submitted[0]["ids"] == [2, 3]
+    assert sup2.submit([1], idempotency_key="a").result(timeout=5) == [4]
+    inner2.finish(0, [7, 8])
+    retry = sup2.submit([2, 3], idempotency_key="b")
+    assert retry.result(timeout=5) == [7, 8]
+    assert len(inner2.submitted) == 1  # dedup, not a second decode
+    import os
+    assert not os.path.exists(spill)  # consumed
+    sup2.shutdown()
+
+
+def test_recover_charges_downtime_against_deadlines(tmp_path):
+    """The spill stamp makes downtime count: a record whose remaining
+    deadline is smaller than the outage is lost (typed), not regenerated
+    with a fresh budget an hour after its SLO died."""
+    spill = str(tmp_path / "stale.jsonl")
+    stale = {"ids": [1], "max_new": 4, "seed": 0, "idempotency_key": "s",
+             "deadline_remaining_s": 5.0,
+             "spilled_at_unix": time.time() - 3600.0}
+    fresh = {"ids": [2], "max_new": 4, "seed": 0, "idempotency_key": "f",
+             "deadline_remaining_s": 3600.0,
+             "spilled_at_unix": time.time() - 10.0}
+    with open(spill, "w") as f:
+        f.write(json.dumps(stale) + "\n" + json.dumps(fresh) + "\n")
+    sup, fac, _ = make_sup(spill_path=spill)
+    sup.start()
+    before = resilience.get("sched_lost")
+    assert sup.recover() == 1  # only the fresh record survives
+    assert resilience.get("sched_lost") == before + 1
+    inner = fac.instances[0]
+    assert len(inner.submitted) == 1
+    assert inner.submitted[0]["ids"] == [2]
+    assert inner.submitted[0]["deadline_s"] < 3600.0  # downtime charged
+    sup.shutdown()
+
+
+def test_recover_survives_corrupt_spill(tmp_path):
+    """A truncated line (SIGKILL mid-spill) or an unreplayable record must
+    not turn recovery into a startup crash: the bad record counts lost,
+    the good ones still recover."""
+    spill = str(tmp_path / "corrupt.jsonl")
+    good = {"ids": [5], "max_new": 4, "seed": 0, "idempotency_key": "g",
+            "deadline_remaining_s": None}
+    with open(spill, "w") as f:
+        f.write('{"ids": [1], "max_new"')  # truncated mid-write
+        f.write("\n" + json.dumps(good) + "\n")
+    sup, fac, _ = make_sup(spill_path=spill)
+    sup.start()
+    assert sup.recover() == 1  # no raise; the good record recovered
+    assert fac.instances[0].submitted[0]["ids"] == [5]
+    sup.shutdown()
+
+
+def test_cancelled_partial_result_not_cached_for_key():
+    """A cancelled entry resolves with its partial tokens but must NOT
+    poison the idempotency cache: a retry with the key gets a full fresh
+    generation, not the fragment."""
+    sup, fac, _ = make_sup()
+    sup.start()
+    f = sup.submit([1, 2], idempotency_key="k")
+    inner = fac.instances[0]
+    inner.emit(0, [9])
+    sup.cancel(f)
+    # The scheduler's cancel contract: resolve with what was generated.
+    inner.finish(0, [9])
+    assert f.result(timeout=5) == [9]
+    retry = sup.submit([1, 2], idempotency_key="k")
+    assert len(inner.submitted) == 2  # regenerated, not served from cache
+    inner.emit(1, [9, 10, 11])
+    inner.finish(1, [9, 10, 11])
+    assert retry.result(timeout=5) == [9, 10, 11]
+    sup.shutdown()
+
+
+# ----------------------------------------------------- app-level lifecycle
+
+
+class _HealthyFake:
+    """FakeBackend + a controllable supervisor-style health payload."""
+
+    def __init__(self):
+        self.h = {"state": "ready", "restarts": 0, "replayed": 0, "lost": 0}
+
+    def health(self):
+        return self.h
+
+    def retry_after_hint(self):
+        return 2.5
+
+    def complete(self, prompt, **kw):
+        from llm_based_apache_spark_optimization_tpu.serve.backends import (
+            Completion,
+        )
+
+        return Completion(text="SELECT 1", output_tokens=2, prompt_tokens=2)
+
+
+def _client(tmp_path, svc):
+    from llm_based_apache_spark_optimization_tpu.app import (
+        AppConfig,
+        create_api_app,
+    )
+    from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+    from llm_based_apache_spark_optimization_tpu.sql import SQLiteBackend
+
+    cfg = AppConfig(input_dir=str(tmp_path / "in"),
+                    output_dir=str(tmp_path / "out"),
+                    history_db=":memory:", secret_key="t")
+    return create_api_app(svc, SQLiteBackend, SQLiteHistory(":memory:"),
+                          cfg).test_client()
+
+
+def test_healthz_readyz_transitions(tmp_path):
+    """/healthz is liveness (always 200); /readyz follows the supervisor
+    lifecycle: ready/degraded serve 200, restarting 503 + Retry-After,
+    dead 503 — with restart counters in the body."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        GenerationService,
+    )
+
+    svc = GenerationService()
+    backend = _HealthyFake()
+    svc.register("m", backend)
+    client = _client(tmp_path, svc)
+    assert client.get("/healthz").status == 200
+
+    res = client.get("/readyz")
+    assert res.status == 200 and res.json()["state"] == "ready"
+
+    backend.h = {"state": "restarting", "restarts": 1, "replayed": 3,
+                 "lost": 0}
+    res = client.get("/readyz")
+    assert res.status == 503
+    assert res.json()["state"] == "restarting"
+    assert res.json()["restarts"] == 1 and res.json()["replayed"] == 3
+    assert int(res.headers["Retry-After"]) >= 1
+
+    backend.h = {"state": "degraded", "restarts": 2, "replayed": 3,
+                 "lost": 1}
+    res = client.get("/readyz")
+    assert res.status == 200 and res.json()["state"] == "degraded"
+
+    backend.h = {"state": "dead", "restarts": 5, "replayed": 3, "lost": 4}
+    res = client.get("/readyz")
+    assert res.status == 503 and res.json()["state"] == "dead"
+
+
+def test_api_rejects_idempotency_key_on_streaming(tmp_path):
+    """The key's dedup contract only holds on the blocking path (the
+    journaled result can be returned whole); stream=true + a key is a
+    400, not a silently unprotected retry."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        GenerationService,
+    )
+
+    svc = GenerationService()
+    svc.register("m", _HealthyFake())
+    client = _client(tmp_path, svc)
+    res = client.post_json("/api/generate", {
+        "model": "m", "prompt": "q", "stream": True,
+        "idempotency_key": "k",
+    })
+    assert res.status == 400
+    assert "idempotency_key" in res.json()["error"]
+    for bad in ("", 7):
+        res = client.post_json("/api/generate", {
+            "model": "m", "prompt": "q", "idempotency_key": bad,
+        })
+        assert res.status == 400
+
+
+def test_scheduler_backend_recovers_spill_at_construction(tmp_path):
+    """The deployment seam (SchedulerBackend) recovers a previous
+    process's journal spill no matter which factory path built it."""
+    spill = str(tmp_path / "spill.jsonl")
+    with open(spill, "w") as f:
+        f.write(json.dumps({"ids": [2, 3], "max_new": 5, "seed": 0,
+                            "idempotency_key": "b",
+                            "deadline_remaining_s": None}) + "\n")
+    sup, fac, _ = make_sup(spill_path=spill)
+
+    class _Tok:
+        def encode(self, s, add_bos=True):
+            return [1]
+
+        def decode(self, ids):
+            return "x"
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        SchedulerBackend,
+    )
+
+    # Proxies (max_seq etc.) are only touched per request, and recovery
+    # only needs submit — the ManualInner surface suffices.
+    SchedulerBackend(sup, _Tok())
+    assert len(fac.instances[0].submitted) == 1
+    assert fac.instances[0].submitted[0]["ids"] == [2, 3]
+    import os
+    assert not os.path.exists(spill)
+    sup.shutdown()
+
+
+def test_drain_gate_refuses_new_posts(tmp_path):
+    """Once draining, new POSTs answer 503 + Retry-After while GETs
+    (probes, metrics) stay up; /readyz reports draining."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        GenerationService,
+    )
+
+    class _JournaledFake(_HealthyFake):
+        supports_idempotency = True
+
+        def complete(self, prompt, idempotency_key=None, **kw):
+            return super().complete(prompt, **kw)
+
+    svc = GenerationService()
+    svc.register("m", _HealthyFake())
+    svc.register("j", _JournaledFake())
+    client = _client(tmp_path, svc)
+    res = client.post_json("/api/generate", {"model": "m", "prompt": "q"})
+    assert res.status == 200
+
+    svc._draining = True
+    res = client.post_json("/api/generate", {"model": "m", "prompt": "q"})
+    assert res.status == 503
+    assert int(res.headers["Retry-After"]) >= 1
+    assert "draining" in res.json()["error"]
+    assert client.get("/healthz").status == 200
+    assert client.get("/metrics").status == 200
+    res = client.get("/readyz")
+    assert res.status == 503 and res.json()["state"] == "draining"
+    # A KEYED generate passes the gate ONLY for a backend with a journal
+    # to dedupe against (supports_idempotency): the supervisor, not the
+    # HTTP layer, then decides — cached result or typed Draining. The
+    # journaled fake serves it here.
+    res = client.post_json("/api/generate", {
+        "model": "j", "prompt": "q", "idempotency_key": "k",
+    })
+    assert res.status == 200
+    # A key aimed at a journal-less backend is just new work: refused.
+    res = client.post_json("/api/generate", {
+        "model": "m", "prompt": "q", "idempotency_key": "k",
+    })
+    assert res.status == 503
+
+
+def test_service_drain_calls_backend_drain_and_closes(tmp_path):
+    """GenerationService.drain(): sets the gate flag, forwards the drain
+    deadline to backends exposing the seam (shared backends once), then
+    closes."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        GenerationService,
+    )
+
+    calls = []
+
+    class DrainBackend(_HealthyFake):
+        def drain(self, deadline_s=None):
+            calls.append(deadline_s)
+
+        def shutdown(self):
+            calls.append("shutdown")
+
+    svc = GenerationService()
+    b = DrainBackend()
+    svc.register("m1", b)
+    svc.register("m2", b)  # shared: must drain once
+    svc.drain(deadline_s=5.0)
+    assert svc.draining
+    drains = [c for c in calls if isinstance(c, float)]
+    assert len(drains) == 1 and 0 < drains[0] <= 5.0
+
+
+# ------------------------------------------------- real-scheduler chaos lane
+
+
+@pytest.fixture(scope="module")
+def tiny_model_module():
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+
+    return TINY, init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+
+
+@pytest.mark.chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_supervised_real_scheduler_crash_zero_lost(tiny_model_module):
+    """The acceptance scenario: an injected `sched:crash` kills the REAL
+    continuous-batching loop mid-batch; the supervisor restarts it and
+    every acknowledged request completes with the exact tokens a
+    crash-free run produces — zero lost, zero duplicated, /readyz back to
+    ready, restart counters visible."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny_model_module
+
+    def build():
+        return ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+            stop_ids=(-1,),
+        )
+
+    # Crash-free control run pins the expected (deterministic greedy)
+    # completions.
+    with build() as control:
+        expected = control.generate(
+            [[1, 5], [1, 6], [1, 7]], max_new_tokens=6
+        )
+
+    builds = []
+
+    def factory():
+        if builds:
+            # Exactly ONE crash: the rebuild clears injection before the
+            # fresh loop starts, making the schedule deterministic.
+            FAULTS.clear()
+        builds.append(1)
+        return build()
+
+    FAULTS.configure("sched:crash:1", seed=0)
+    restarts_before = resilience.get("sched_restarts")
+    sup = SupervisedScheduler(
+        factory, max_restarts=3,
+        restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(0),
+    ).start()
+    streamed = [[] for _ in range(3)]
+    futs = [
+        sup.submit([1, 5 + i], max_new_tokens=6,
+                   on_token=streamed[i].append,
+                   idempotency_key=f"req-{i}")
+        for i in range(3)
+    ]
+    dup = sup.submit([1, 5], max_new_tokens=6, idempotency_key="req-0")
+    outs = [f.result(timeout=120) for f in futs]
+    assert outs == expected          # replay reproduced the exact tokens
+    assert streamed == expected      # streams saw each token exactly once
+    assert dup.result(timeout=120) == expected[0]  # key deduped, 1 result
+    h = sup.health()
+    assert h["state"] == "ready" and h["lost"] == 0
+    assert h["restarts"] == 1 and len(builds) == 2
+    assert resilience.get("sched_restarts") == restarts_before + 1
+    sup.shutdown()
+
+
+@pytest.mark.chaos
+def test_chaos_evalh_reports_scheduler_recovery():
+    """`evalh --chaos` zero-hung summary now carries the crash-recovery
+    stage: restarts happened, replays happened, zero acknowledged
+    requests lost — deterministically for a fixed (spec, seed)."""
+    from llm_based_apache_spark_optimization_tpu.evalh.chaos import run_chaos
+
+    a = run_chaos("sched:crash:0.2", seed=0, rounds=2)
+    b = run_chaos("sched:crash:0.2", seed=0, rounds=2)
+
+    # Seeded replay: the OUTCOME-side fields are deterministic. The
+    # `replayed` count is not compared exactly — whether a request was
+    # journaled during a restart (replayed++) or submitted just after
+    # (direct) is a benign thread-timing artifact, not a fault-schedule
+    # property.
+    def stable(rep):
+        return {k: v for k, v in rep["scheduler"].items() if k != "replayed"}
+
+    assert stable(a) == stable(b)
+    assert a["scheduler"]["restarts"] >= 1
+    assert a["scheduler"]["replayed"] >= 1
+    assert a["scheduler"]["lost"] == 0
+    assert a["scheduler"]["unresolved"] == 0
+    assert a["hung"] == 0
+    assert a["faults_injected"]["sched:crash"] >= 1
