@@ -51,7 +51,7 @@ def _spill_path(app_cfg, tag: str):
 
 def make_tiny_service(
     max_new_tokens: int, scheduler: bool = False, tp: int = 1,
-    supervise: bool = True,
+    supervise: bool = True, speculative: int = 0,
 ) -> GenerationService:
     import dataclasses
 
@@ -109,6 +109,7 @@ def make_tiny_service(
                 return ContinuousBatchingScheduler(
                     mcfg, mparams, num_slots=8, prompt_bucket=64, mesh=mesh,
                     max_queue_depth=app_cfg.max_queue_depth,
+                    speculative_draft=speculative,
                 )
 
             if supervise:
@@ -136,7 +137,8 @@ def make_tiny_service(
             )
         else:
             eng = InferenceEngine(mcfg, mparams, stop_ids=(mcfg.eos_id,),
-                                  prompt_bucket=64, mesh=mesh)
+                                  prompt_bucket=64, mesh=mesh,
+                                  speculative_draft=speculative)
             svc.register(
                 name,
                 EngineBackend(eng, tok, max_new_tokens=max_new_tokens),
@@ -324,6 +326,15 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 max_new_tokens=max_new_tokens, add_bos=add_bos,
                 deadline_s=app_cfg.deadline_s or None,
             )
+        # Deadline-clamp s/token seed (ROADMAP PR-3 follow-up): an
+        # explicit LSOT_STOK_SEED wins; otherwise the last bench
+        # artifact's headline converts to a per-step wall. Unseeded, the
+        # first request after boot runs unclamped.
+        stok = app_cfg.stok_seed or None
+        if stok is None and app_cfg.stok_seed_bench:
+            from ..serve.backends import stok_seed_from_bench
+
+            stok = stok_seed_from_bench(app_cfg.stok_seed_bench)
         if path.endswith(".gguf"):
             return EngineBackend.from_gguf(
                 path, tok, mesh=mesh, max_new_tokens=max_new_tokens,
@@ -331,6 +342,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 kv_quant=kv_quant, quantize_int8=args.int8,
                 quantize_int4=int4,
                 quantize_unembed8=getattr(args, "int8_unembed", False),
+                sec_per_tok_seed=stok,
             )
         return EngineBackend.from_hf_checkpoint(
             path, tok, mesh=mesh, quantize_int8=args.int8,
@@ -339,6 +351,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             max_new_tokens=max_new_tokens, add_bos=add_bos,
             speculative_draft=getattr(args, "speculative", 0),
             kv_quant=kv_quant,
+            sec_per_tok_seed=stok,
         )
 
     from ..serve.factory import assemble_reference_service
@@ -369,13 +382,18 @@ def main(argv=None) -> None:
                          "per round for greedy requests, on both the "
                          "scheduler (default) and engine serving paths — "
                          "copy-heavy NL→SQL workloads on real checkpoints "
-                         "benefit most. NOTE: temperature>0 requests emit 1 "
-                         "token per ~1.6x-cost verify round under a "
-                         "speculative scheduler (~1.6x device time per "
-                         "sampled token, with no draft upside; the "
+                         "benefit most. Composes with constrained decoding "
+                         "(constrain= / LSOT_CONSTRAIN_SQL): the grammar "
+                         "mask is evaluated at every draft position, so "
+                         "output stays token-identical to "
+                         "constrained-vanilla decode. NOTE: temperature>0 "
+                         "requests emit 1 token per ~1.6x-cost verify round "
+                         "under a speculative scheduler (~1.6x device time "
+                         "per sampled token, with no draft upside; the "
                          "scheduler logs a warning) — keep sampled traffic "
                          "off --speculative deployments. Acceptance is "
-                         "surfaced at /metrics (serving.speculation)")
+                         "surfaced at /metrics (serving.speculation, split "
+                         "by constrained/unconstrained class)")
     ap.add_argument("--kv-int8", action="store_true",
                     help="int8 KV cache with per-slot scales: halves the "
                          "serving window's HBM footprint and decode cache "
@@ -424,14 +442,6 @@ def main(argv=None) -> None:
         cfg = type(cfg)(**{**cfg.__dict__, "port": args.port})
     cfg.ensure_dirs()
 
-    if cfg.constrain_sql and getattr(args, "speculative", 0) > 0:
-        # Same startup-rejection policy as --kv-int8/--speculative: a
-        # speculative scheduler rejects every constrained submit, so this
-        # combination would turn EVERY CSV upload into a generate-time
-        # failure — fail at launch, not per request.
-        sys.exit("LSOT_CONSTRAIN_SQL cannot combine with --speculative: "
-                 "drafted tokens bypass the grammar mask")
-
     if args.backend == "checkpoint":
         if not args.sql_model_path:
             ap.error("--backend checkpoint requires --sql-model-path")
@@ -440,7 +450,8 @@ def main(argv=None) -> None:
         # max_new small for the tiny demo model: it babbles bytes, not SQL.
         service = (
             make_tiny_service(32, scheduler=args.scheduler, tp=args.tp,
-                              supervise=args.supervise)
+                              supervise=args.supervise,
+                              speculative=getattr(args, "speculative", 0))
             if args.backend == "tiny" else make_fake_service()
         )
     history = SQLiteHistory(cfg.history_db)

@@ -47,6 +47,16 @@ class AppConfig:
     # long it stays open before one half-open probe.
     breaker_threshold: int = 5
     breaker_reset_s: float = 10.0
+    # Startup seed for the ENGINE backend's deadline-clamp s/token EWMA
+    # (serve/backends.EngineBackend): without a seed the first request
+    # after boot runs unclamped — there is nothing to exchange a deadline
+    # against until one completion has been measured. LSOT_STOK_SEED is an
+    # explicit seconds-per-output-token figure (wins when both are set);
+    # LSOT_STOK_SEED_BENCH points at a bench artifact JSONL whose last
+    # line is converted via serve.backends.stok_seed_from_bench. 0/"" =
+    # unseeded (the historical behavior).
+    stok_seed: float = 0.0
+    stok_seed_bench: str = ""
     # --- crash recovery & lifecycle (serve/supervisor.py; README "Crash
     # recovery & lifecycle").
     # Supervisor restart budget: how many times a crashed decode loop is

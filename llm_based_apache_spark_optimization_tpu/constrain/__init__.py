@@ -29,6 +29,7 @@ from .masks import (
     CompiledMask,
     ConstraintSpec,
     compile_token_masks,
+    fsm_advance_chain,
     get_constraint,
     trivial_tables,
 )
@@ -43,6 +44,7 @@ __all__ = [
     "compile_dfa",
     "compile_token_masks",
     "difference",
+    "fsm_advance_chain",
     "get_constraint",
     "grammar_fingerprint",
     "is_valid_spark_sql",

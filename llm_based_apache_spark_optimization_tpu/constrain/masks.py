@@ -153,6 +153,47 @@ def trivial_tables(vocab_size: int) -> Dict[str, object]:
     }
 
 
+def fsm_advance_chain(next_t, need_t, states, chain, rem):
+    """Vectorized multi-step FSM advance for a drafted token chain — the
+    primitive that lets speculative decoding compose with the grammar
+    (engine/speculative.py, serve/scheduler.py spec rounds).
+
+    Given each row's committed state `states [B]`, a drafted chain
+    `chain [B, D]`, and the row's remaining token budget `rem [B]` (budget
+    left BEFORE the chain's first token), returns:
+
+      per_pos [B, D+1]  per-position states: column 0 is the input state,
+                        column j the state after accepting chain[:, :j]
+      valid_len [B]     length of the longest chain prefix that is
+                        grammar-valid AND budget-affordable at every
+                        position — chain[:, j] passes iff
+                        `need[state_j, tok] <= rem - j`, the exact mask
+                        vanilla decode would apply at that step
+
+    States FREEZE at the first rejected position, so columns past
+    `valid_len` are well-defined junk a caller must not accept (and never
+    does: the accepted chain is capped by `valid_len`). Pure
+    [state, token] gathers over the precompiled tables, a static D-step
+    unroll — jit-safe, no host round-trip, D gathers per round. Row 0 of
+    the tables is the unconstrained sentinel, so mixed batches run this
+    unchanged: sentinel rows accept any chain their budget affords."""
+    import jax.numpy as jnp
+
+    d = chain.shape[1]
+    s = states
+    per_pos = [s]
+    ok = []
+    for j in range(d):
+        tok = chain[:, j]
+        allowed = need_t[s, tok] <= rem - j
+        ok.append(allowed)
+        s = jnp.where(allowed, next_t[s, tok], s)
+        per_pos.append(s)
+    okm = jnp.stack(ok, axis=1).astype(jnp.int32)
+    valid_len = jnp.sum(jnp.cumprod(okm, axis=1), axis=1)
+    return jnp.stack(per_pos, axis=1), valid_len
+
+
 def compile_token_masks(
     dfa: CharDfa,
     tokenizer,

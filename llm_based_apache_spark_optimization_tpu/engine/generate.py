@@ -373,21 +373,31 @@ class InferenceEngine:
             tokens, lengths = shard_batch((tokens, lengths), self.mesh)
         cap = min(bucket_len(int(max_new_tokens), self.new_bucket),
                   self.cfg.max_seq_len - t)
-        if (self.speculative_draft > 0 and sampling.is_greedy
-                and constraint is None):
-            # Constrained requests take the vanilla loop: the speculative
-            # verify window has no grammar-mask path (drafted tokens would
-            # need per-position FSM states), and dropping the guarantee
-            # silently would defeat the subsystem's whole point.
+        if self.speculative_draft > 0 and sampling.is_greedy:
+            # Constrained greedy requests speculate too: the verify window
+            # evaluates the grammar mask at every draft position
+            # (constrain.fsm_advance_chain threads per-position FSM states
+            # through the chain), so drafted tokens cannot bypass the mask
+            # and the output stays token-identical to constrained vanilla
+            # decode. Sampled requests still take the vanilla loop
+            # (rejection-sampling drafts would be needed to stay unbiased).
             from .speculative import make_speculative_generate_fn
 
             fn = make_speculative_generate_fn(
                 self.cfg, cap, self.stop_ids, self.mesh,
                 self.speculative_draft, self.speculative_ngram,
+                constrained=constraint is not None,
             )
-            out, gen_lens, rounds = fn(
-                self.params, tokens, lengths, jnp.int32(max_new_tokens)
-            )
+            args = [self.params, tokens, lengths, jnp.int32(max_new_tokens)]
+            if constraint is not None:
+                tabs = constraint.device_tables(self.cfg.vocab_size)
+                args += [
+                    None,  # key: unused by the greedy speculative loop
+                    (tabs["next"], tabs["need"]),
+                    jnp.full((tokens.shape[0],), constraint.init_state,
+                             jnp.int32),
+                ]
+            out, gen_lens, rounds = fn(*args)
             self.last_spec_rounds = int(jax.device_get(rounds))
         else:
             self.last_spec_rounds = None  # this call ran no speculation

@@ -50,9 +50,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..constrain.masks import fsm_advance_chain
 from ..models.configs import LlamaConfig
 from ..models.llama import _UNROLL_MAX_T, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
+from ..ops.sampling import apply_token_mask
 from ..parallel.sharding import constrain_cache
 from .kvcache import init_cache
 
@@ -145,6 +147,7 @@ def make_speculative_generate_fn(
     draft_len: int = 8,
     ngram: int = 3,
     attn_impl: Optional[str] = None,
+    constrained: bool = False,
 ):
     """Greedy generate with prompt-lookup speculation.
 
@@ -153,6 +156,20 @@ def make_speculative_generate_fn(
     rounds < total emitted tokens means speculation paid off; equality means
     every draft missed (the worst case, which still emits one token per
     round like vanilla decode, paying only the wider verify unembed).
+
+    `constrained=True` returns a fn taking two extra traced arguments —
+    `(next, need)` grammar tables from constrain.CompiledMask.device_tables
+    plus `init_states [B]` — and evaluates the grammar mask AT EVERY DRAFT
+    POSITION: the draft chain advances the FSM per position
+    (constrain.fsm_advance_chain) and truncates at the first
+    grammar-rejected token (so acceptance doesn't crater on junk drafts),
+    every verify-window logit row is masked with ITS position's
+    budget-aware state row before argmax, and the committed FSM state is
+    the one after the ACCEPTED prefix — rejected drafts never advance it
+    (the same rewind-by-construction the rejected-K/V garbage relies on).
+    Greedy parity is the contract: constrained+speculative output is
+    token-identical to the constrained vanilla loop, drafts only change
+    how many forwards it takes.
     """
     if not 1 <= draft_len <= _UNROLL_MAX_T - 1:
         raise ValueError(
@@ -166,6 +183,7 @@ def make_speculative_generate_fn(
         cfg, max_new, stop_ids, mesh, draft_len, ngram,
         attn_impl or attention_impl(mesh),
         attn_impl or decode_attention_impl(mesh),
+        constrained,
     )
 
 
@@ -179,6 +197,7 @@ def _make_speculative_generate_fn(
     ngram: int,
     prefill_impl: str,
     decode_impl: str,
+    constrained: bool = False,
 ):
     from .generate import _is_stop as _is_stop_ids
 
@@ -200,7 +219,9 @@ def _make_speculative_generate_fn(
     def _is_stop(tok):
         return _is_stop_ids(tok, stop_ids)
 
-    def gen(params, tokens, lengths, budget, key=None):
+    def gen(params, tokens, lengths, budget, key=None,
+            grammar=None,       # (next [S,V] i32, need [S,V] i32) tables
+            init_states=None):  # [B] int32 DFA start states
         b, t = tokens.shape
         budget = jnp.minimum(budget, max_new)
         lengths = lengths.astype(jnp.int32)
@@ -213,7 +234,17 @@ def _make_speculative_generate_fn(
             cfg, params, tokens, positions, cache,
             logit_indices=lengths - 1, attn_impl=pre_impl, mesh=mesh,
         )
-        first = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        first_logits = logits[:, 0]
+        if constrained:
+            g_next, g_need = grammar
+            # First token constrained exactly like the vanilla loop: a
+            # token is allowed iff itself + shortest completion + stop id
+            # fit the whole budget (masks.py need table).
+            first_logits = apply_token_mask(
+                first_logits, g_need[init_states] <= budget
+            )
+        first = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+        cstate = g_next[init_states, first] if constrained else None
 
         # History = prompt tokens + generated, contiguous per row (generated
         # tokens land at hlen, after the row's REAL prompt; the pad gap up
@@ -239,7 +270,7 @@ def _make_speculative_generate_fn(
             return ~jnp.all(carry[4])
 
         def body(carry):
-            hist, hlen, out, glen, done, cache, cur, pos, rounds = carry
+            hist, hlen, out, glen, done, cache, cur, pos, rounds = carry[:9]
             drafts = ngram_draft(hist, hlen, draft_len, ngram)  # [B, D]
             verify = jnp.concatenate([cur[:, None], drafts], axis=1)  # [B, D+1]
             vpos = pos[:, None] + jd
@@ -247,10 +278,33 @@ def _make_speculative_generate_fn(
                 cfg, dec_params, verify, vpos, cache,
                 attn_impl=decode_impl, mesh=mesh,
             )
+            if constrained:
+                # The draft chain advances the FSM per position; drafts
+                # stop counting at the first grammar-rejected token
+                # (vlen), and EVERY verify position's logits are masked
+                # with its own state's budget-aware row — the masked
+                # argmax at position j is exactly the token vanilla
+                # constrained decode would emit there, which is what makes
+                # greedy parity hold whatever the drafts were.
+                cstate = carry[9]
+                rem0 = budget - glen                         # [B]
+                pstates, vlen = fsm_advance_chain(
+                    g_next, g_need, cstate, drafts, rem0
+                )                                            # [B,D+1], [B]
+                pos_rem = rem0[:, None] - jd                 # [B, D+1]
+                logits = apply_token_mask(
+                    logits, g_need[pstates] <= pos_rem[:, :, None]
+                )
             preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, D+1]
             # preds[j] is the TRUE greedy token after verify[j] iff all
             # drafts before j were accepted; accept the longest such chain.
             eq = (drafts == preds[:, :draft_len]).astype(jnp.int32)
+            if constrained:
+                # A grammar-rejected draft can never be accepted even if
+                # the (masked-out) model would have agreed: acceptance is
+                # capped at the valid prefix, so the committed chain only
+                # ever walks live FSM transitions.
+                eq = eq * (jd[:, :draft_len] < vlen[:, None]).astype(jnp.int32)
             acc = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)  # [B] in [0, D]
             emit_mask = jd <= acc[:, None]
             stops = _is_stop(preds)
@@ -272,15 +326,33 @@ def _make_speculative_generate_fn(
             cur = jax.vmap(
                 lambda e, n, c: jnp.where(n > 0, e[jnp.maximum(n - 1, 0)], c)
             )(emitted, n_emit, cur)
+            tail = ()
+            if constrained:
+                # Commit the state AFTER the accepted prefix: the last
+                # emitted token advances from ITS per-position state
+                # (pstates[n_emit-1] — for accepted drafts that is the
+                # chain state, and emitted[j] == drafts[j] there).
+                # Rejected drafts never touch the committed state, the
+                # FSM twin of the rejected-K/V rewind. n_emit == 0 rows
+                # (done / budget-exhausted) freeze.
+                idx = jnp.maximum(n_emit - 1, 0)
+                last_s = jnp.take_along_axis(pstates, idx[:, None], 1)[:, 0]
+                last_t = jnp.take_along_axis(emitted, idx[:, None], 1)[:, 0]
+                tail = (jnp.where(n_emit > 0, g_next[last_s, last_t],
+                                  cstate),)
             glen = glen + n_emit
             hlen = hlen + n_emit
             pos = pos + n_emit
             done = done | jnp.any(stops & emit_mask, axis=1) | (glen >= budget)
-            return (hist, hlen, out, glen, done, cache, cur, pos, rounds + 1)
+            return (hist, hlen, out, glen, done, cache, cur, pos,
+                    rounds + 1) + tail
 
         carry = (hist, hlen, out, glen, done, cache, first, lengths,
                  jnp.int32(0))
-        _, _, out, _, _, _, _, _, rounds = lax.while_loop(cond, body, carry)
+        if constrained:
+            carry = carry + (cstate,)
+        final = lax.while_loop(cond, body, carry)
+        out, rounds = final[2], final[8]
 
         out = out[:, :max_new]
         stops = _is_stop(out)

@@ -191,7 +191,19 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
             # Every third request is submitted TWICE under one key: the
             # journal must collapse the pair to a single generation.
             key = f"chaos-req-{i}" if i % 3 == 0 else None
-            fut = sup.submit(ids, seed=rseed, idempotency_key=key)
+            ckw = {}
+            if i == 1:
+                # One CONSTRAINED request rides the chaos schedule: the
+                # journal carries both the (opaque, toy) compiled object
+                # and its serializable spec — the new spill format — and
+                # the entry must replay across loop deaths exactly like
+                # its unconstrained neighbours (zero lost below covers
+                # it). The toy scheduler ignores the constraint; what is
+                # under test is the SUPERVISOR's bookkeeping.
+                ckw = {"constraint": object(),
+                       "constraint_spec": {"table": "taxi",
+                                           "columns": ["VendorID"]}}
+            fut = sup.submit(ids, seed=rseed, idempotency_key=key, **ckw)
             futs.append(fut)
             expect.append(_ToyScheduler.expected(ids, 6, rseed))
             if key is not None:
@@ -214,6 +226,7 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
     report = {
         "requests": requests,
         "duplicate_keys": sum(1 for i in range(requests) if i % 3 == 0),
+        "constrained_requests": 1 if requests > 1 else 0,
         "restarts": health["restarts"],
         "replayed": health["replayed"],
         "lost": health["lost"],

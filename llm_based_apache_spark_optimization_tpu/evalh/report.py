@@ -44,6 +44,7 @@ def render_report(
     quality_meaningful: bool = True,
     timestamp: Optional[str] = None,
     constrained_reports: Optional[Dict[str, ModelReport]] = None,
+    constrained_speculation: Optional[Dict[str, dict]] = None,
 ) -> str:
     """Render harness output as markdown mirroring the reference's report
     structure (per-query table -> aggregate table -> configs -> conclusion)."""
@@ -127,19 +128,22 @@ def render_report(
         def _pct(r: Optional[float]) -> str:
             return "n/a" if r is None else _fmt(r, 1) + " %"
 
+        spec = constrained_speculation or {}
+        spec_col = any(m in spec for m in models)
         lines += [
             "## Constrained decoding (`constrain=\"spark_sql\"`) — "
             "off vs on",
             "",
             "| Model | grammar-valid off | grammar-valid on "
-            "| executable off | executable on | exact off | exact on |",
-            "|---|---|---|---|---|---|---|",
+            "| executable off | executable on | exact off | exact on |"
+            + (" spec tok/round |" if spec_col else ""),
+            "|---|---|---|---|---|---|---|" + ("---|" if spec_col else ""),
         ]
         for m in models:
             off, on = reports[m], constrained_reports.get(m)
             if on is None:
                 continue
-            lines.append(
+            row = (
                 f"| {m} | {_pct(off.grammar_valid_rate)} "
                 f"| {_pct(on.grammar_valid_rate)} "
                 f"| {_pct(off.executable_rate)} "
@@ -147,6 +151,11 @@ def render_report(
                 f"| {_fmt(off.exact_match_rate, 1)} % "
                 f"| {_fmt(on.exact_match_rate, 1)} % |"
             )
+            if spec_col:
+                s = spec.get(m)
+                row += (f" {_fmt(s['tokens_per_round'], 3)} |"
+                        if s and s.get("verify_rounds") else " n/a |")
+            lines.append(row)
         lines += [
             "",
             "The constrained column's grammar-valid rate is a decode-time "
@@ -155,6 +164,17 @@ def render_report(
             "weights.",
             "",
         ]
+        if spec_col:
+            lines += [
+                "`spec tok/round` is the CONSTRAINED class of the serving "
+                "scheduler's speculation counters during the constrained "
+                "pass (grammar-aware draft/verify: the mask is evaluated "
+                "at every draft position, so output is token-identical to "
+                "constrained vanilla decode). Above ~the verify cost "
+                "ratio (engine/speculative.verify_cost_ratio) speculation "
+                "is paying for itself on the constrained hot path.",
+                "",
+            ]
 
     # BASELINE configs (the five north-star scenarios). The Mesh column
     # states what actually ran — never the tp a config merely requested.
@@ -247,6 +267,7 @@ def generate(
         exec_backend=exec_backend,
     )
     constrained_reports = None
+    constrained_speculation: Dict[str, dict] = {}
     if constrain_compare:
         # Second pass decoded under the SCHEMA-AWARE grammar for the taxi
         # fixture (the pipeline-shaped configuration: identifiers are
@@ -264,6 +285,14 @@ def generate(
             return getattr(entry_get(model).backend, "supports_constrain",
                            False)
 
+        def _spec_constrained(model: str) -> Optional[dict]:
+            """The CONSTRAINED class of the model's scheduler speculation
+            counters (None for engine/fake backends or --speculative 0)."""
+            stats = service.backend_stats().get(model, {}).get("speculation")
+            if not stats:
+                return None
+            return dict(stats.get("by_class", {}).get("constrained", {}))
+
         constrained_reports = {}
         for m in models:
             # Explicit capability check instead of a blanket except: only
@@ -274,6 +303,7 @@ def generate(
                 print(f"constrain-compare: skipping {m} (backend has no "
                       f"constrain seam)", file=sys.stderr)
                 continue
+            pre = _spec_constrained(m)
             constrained_reports[m] = evaluate_models(
                 service, [m], cases, TAXI_DDL_SYSTEM,
                 max_new_tokens=max_new_tokens,
@@ -281,6 +311,22 @@ def generate(
                 constrain={"table": "taxi",
                            "columns": list(TAXI_COLUMNS)},
             )[m]
+            post = _spec_constrained(m)
+            if post is not None:
+                # Delta-bracket the constrained pass (the unconstrained
+                # suite above also moved the scheduler's counters — only
+                # the constrained class's movement during THIS pass says
+                # anything about the grammar-masked hot path).
+                rounds = (post.get("verify_rounds", 0)
+                          - (pre or {}).get("verify_rounds", 0))
+                toks = (post.get("tokens_emitted", 0)
+                        - (pre or {}).get("tokens_emitted", 0))
+                constrained_speculation[m] = {
+                    "verify_rounds": rounds,
+                    "tokens_emitted": toks,
+                    "tokens_per_round": round(toks / rounds, 3) if rounds
+                    else 0.0,
+                }
     config_rows = []
     if with_configs:
         for key, cfg in CONFIGS.items():
@@ -302,6 +348,7 @@ def generate(
         backend_desc=backend_desc, platform=platform,
         quality_meaningful=quality_meaningful, timestamp=timestamp,
         constrained_reports=constrained_reports,
+        constrained_speculation=constrained_speculation or None,
     )
 
 
@@ -352,7 +399,14 @@ def main(argv=None) -> None:
                     help="add a constrained-vs-unconstrained section "
                          "(grammar-valid% / executable% with the "
                          "constrain/ token masks on vs off; real-engine "
-                         "backends only)")
+                         "backends only). With --scheduler --speculative "
+                         "N the section also reports the constrained "
+                         "class's speculation tokens/round")
+    ap.add_argument("--speculative", type=int, default=0, metavar="N",
+                    help="with --scheduler: serve through speculative "
+                         "schedulers (draft N tokens/round) — constrained "
+                         "traffic composes, and --constrain-compare "
+                         "surfaces its per-class acceptance")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--virtual-devices", type=int, default=0, metavar="N",
@@ -376,13 +430,15 @@ def main(argv=None) -> None:
     factory = None
     if args.backend == "tiny":
         service = make_tiny_service(args.max_new_tokens,
-                                    scheduler=args.scheduler)
+                                    scheduler=args.scheduler,
+                                    speculative=args.speculative)
         desc = ("tiny in-tree engine, random weights (smoke"
                 + (", scheduler backends)" if args.scheduler else ")"))
 
         def factory(tp):
             return make_tiny_service(args.max_new_tokens,
-                                     scheduler=args.scheduler, tp=tp)
+                                     scheduler=args.scheduler, tp=tp,
+                                     speculative=args.speculative)
     elif args.backend == "oracle":
         service = make_oracle_service()
         desc = ("oracle canned backend (answers every SQL case with its "

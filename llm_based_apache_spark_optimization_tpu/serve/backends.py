@@ -52,6 +52,45 @@ def resolve_constraint(constrain, tokenizer, stop_ids):
     return get_constraint(constrain, tokenizer, stop_ids)
 
 
+def stok_seed_from_bench(path: str) -> Optional[float]:
+    """Seconds-per-output-token seed from the last committed bench
+    artifact line (bench.py emits one JSON artifact per line; the last
+    parseable line is the richest). The artifact's headline is AGGREGATE
+    output tok/s at batch B, and decode is weight-streaming bound, so the
+    wall of one decode step — which is what a serving request pays per
+    token regardless of its own batch size — is ~B / value; B is parsed
+    from the metric string (falls back to 1, which UNDER-estimates
+    s/token and therefore under-clamps: a conservative failure mode, the
+    request may overrun its deadline but is never spuriously rejected).
+    Returns None when the file is missing/unparseable — callers degrade
+    to the unseeded (unclamped-first-request) behavior."""
+    import json
+    import re
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    obj = None
+    for ln in reversed(text.splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                obj = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            break
+    if not isinstance(obj, dict):
+        return None
+    value = obj.get("value")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None
+    m = re.search(r"B=(\d+)", str(obj.get("metric", "")))
+    batch = int(m.group(1)) if m else 1
+    return batch / float(value)
+
+
 def trim_stop_texts(text: str, stop_texts: Sequence[str]) -> str:
     """Cut the completion at the first occurrence of any stop string."""
     for stop in stop_texts:
@@ -85,11 +124,19 @@ class EngineBackend:
         sampling: SamplingParams = SamplingParams(),
         stop_texts: Sequence[str] = (),
         add_bos: bool = True,
+        sec_per_tok_seed: Optional[float] = None,
     ):
         """Set `add_bos=False` for chat templates whose rendered prompt
         already begins with the BOS string (e.g. llama3-chat's
         <|begin_of_text|>) — otherwise the model sees BOS twice, an
-        off-distribution prompt that silently degrades output quality."""
+        off-distribution prompt that silently degrades output quality.
+
+        `sec_per_tok_seed` primes the deadline-clamp s/token EWMA at
+        startup (LSOT_STOK_SEED, or stok_seed_from_bench over the last
+        bench artifact): without it the FIRST request after boot runs
+        unclamped because there is nothing to exchange a deadline against
+        (ROADMAP PR-3 follow-up). The seed is a prior, not a pin — real
+        completions EWMA-blend it away at the usual 0.2 rate."""
         self.engine = engine
         self.tokenizer = tokenizer
         self.max_new_tokens = max_new_tokens
@@ -108,7 +155,11 @@ class EngineBackend:
         # truncate output); shapes the key doesn't capture (budget
         # buckets) can still land one inflated sample, which the 0.2 EWMA
         # bounds (ROADMAP notes the follow-up).
-        self._sec_per_tok: Optional[float] = None
+        self._sec_per_tok: Optional[float] = (
+            float(sec_per_tok_seed)
+            if sec_per_tok_seed is not None and sec_per_tok_seed > 0
+            else None
+        )
         self._rate_warm_shapes: set = set()
 
     @classmethod

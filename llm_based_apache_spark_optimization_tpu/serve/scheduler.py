@@ -77,7 +77,13 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   sampled token from the window's first logits. The verify window runs
   the unrolled small-T einsum path, which also composes with the int8 KV
   cache. Prefix-cache reuse is disabled in this mode (reused tokens never
-  reach the draft history).
+  reach the draft history). Grammar-constrained requests compose: the
+  draft chain advances the slot's FSM per position
+  (constrain.fsm_advance_chain), every verify logit row is masked with
+  its own position's state, acceptance caps at the grammar-valid prefix,
+  and the committed state rewinds past nothing — constrained+speculative
+  greedy output is token-identical to constrained vanilla decode, and
+  speculation_stats splits acceptance by constrained/unconstrained class.
 
 - **Async issue/harvest pipeline**: decode rounds, prompt chunks and
   admission scatters dispatch without waiting; per-slot state (cur/pos/
@@ -447,8 +453,17 @@ class ContinuousBatchingScheduler:
             # is ~1.6 accepted tokens per verify round (the measured cost of
             # a T=D+1 verify vs a T=1 step, engine/speculative.py). Counted
             # at harvest on greedy slots only (sampled slots always emit 1).
+            # The *_con pair counts the CONSTRAINED subset of the totals:
+            # grammar-masked traffic has a different acceptance profile
+            # (forced keyword/identifier runs accept whole chains; branch
+            # points reject), and an operator deciding whether speculation
+            # pays for the NL→SQL hot path needs ITS tokens/round, not a
+            # blend with unconstrained traffic (speculation_stats splits
+            # the classes; /metrics carries both).
             self._spec_rounds = 0
             self._spec_tokens = 0
+            self._spec_rounds_con = 0
+            self._spec_tokens_con = 0
             self._warned_sampled_spec = False
 
         # Prefix cache: block size = the smallest bucket, so chunk boundaries
@@ -792,9 +807,21 @@ class ContinuousBatchingScheduler:
         per slot by prompt lookup over the on-device history, verify with a
         single T=D+1 forward, emit the accepted greedy chain (or 1 sampled
         token for temperature>0 slots). Per-slot state — history, length,
-        position, RNG counts — advances on device; the host harvests
-        (emitted [slots, D+1], n_emit [slots]) a lag late, exactly like
-        vanilla rounds.
+        position, RNG counts, grammar FSM state and budget — advances on
+        device; the host harvests (emitted [slots, D+1], n_emit [slots]) a
+        lag late, exactly like vanilla rounds.
+
+        Grammar constraining composes per position: each slot's draft
+        chain advances its FSM (constrain.fsm_advance_chain — drafts stop
+        counting at the first grammar-rejected token), every verify
+        position's logits are masked with its OWN per-position state's
+        budget-aware row before argmax, acceptance is capped at the
+        grammar-valid prefix, and the committed `cstate` is the state
+        after the accepted prefix — rejected drafts never advance it (the
+        FSM twin of the rejected-K/V rewind the cache-visibility invariant
+        already covers). Unconstrained slots sit at the sentinel state 0
+        (need 1 = all-allowed), so mixed constrained/unconstrained batches
+        ride this ONE compiled program, exactly like vanilla decode.
 
         Attention runs the einsum impl: the verify window needs the
         unrolled small-T path (which is also the only int8-KV path), and
@@ -804,6 +831,7 @@ class ContinuousBatchingScheduler:
         and emit nothing (n_emit=0); their history write is routed past
         max_seq so a slot mid-chunked-prefill cannot have its freshly
         scattered prompt history punched by pad writes at a stale hlen."""
+        from ..constrain.masks import fsm_advance_chain
         from ..engine.speculative import ngram_draft
 
         cfg, mesh = self.cfg, self.mesh
@@ -813,11 +841,12 @@ class ContinuousBatchingScheduler:
         nc = len(self._cache)
 
         @partial(jax.jit,
-                 donate_argnums=tuple(range(1, nc + 5)) + (nc + 10,))
+                 donate_argnums=tuple(range(1, nc + 5))
+                 + (nc + 10, nc + 11, nc + 12))
         def spec_decode(params, *args):
             cache = args[:nc]
             (hist, hlen, cur, pos, active, temps, topps, topks, seeds,
-             counts) = args[nc:]
+             counts, cstates, crem, g_next, g_need) = args[nc:]
             params = split_blocks(params)
             drafts = ngram_draft(hist, hlen, D, ngram)           # [S, D]
             verify = jnp.concatenate([cur[:, None], drafts], 1)  # [S, D+1]
@@ -827,10 +856,27 @@ class ContinuousBatchingScheduler:
                 cfg, params, verify, vpos, _cache_dict(cache),
                 attn_impl="xla", mesh=mesh,
             )
+            # Per-position grammar masking: pstates[:, j] is the slot's
+            # FSM state after accepting drafts[:, :j], vlen the longest
+            # grammar-valid draft prefix under the per-position budget
+            # (crem - j — the exact mask a vanilla round would apply at
+            # that step). Masked argmax at position j therefore IS the
+            # vanilla constrained greedy token there, which is what makes
+            # constrained+speculative output token-identical to
+            # constrained vanilla decode.
+            pstates, vlen = fsm_advance_chain(
+                g_next, g_need, cstates, drafts, crem
+            )                                                    # [S,D+1],[S]
+            logits = apply_token_mask(
+                logits, g_need[pstates] <= (crem[:, None] - jd)[:, :, None]
+            )
             preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, D+1]
             # preds[j] is the true greedy token after verify[j] iff every
-            # draft before j was accepted; accept the longest such chain.
-            eq = (drafts == preds[:, :D]).astype(jnp.int32)
+            # draft before j was accepted; accept the longest such chain —
+            # capped at the grammar-valid prefix (a rejected draft must
+            # not be accepted even where the masked model would agree).
+            eq = ((drafts == preds[:, :D])
+                  & (jd[:, :D] < vlen[:, None])).astype(jnp.int32)
             acc = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)         # [S]
             keys = jax.vmap(
                 lambda s, c: jax.random.fold_in(jax.random.key(s), c)
@@ -857,13 +903,24 @@ class ContinuousBatchingScheduler:
             cur = jax.vmap(
                 lambda e, n, c: jnp.where(n > 0, e[jnp.maximum(n - 1, 0)], c)
             )(emitted, n_emit, cur)
+            # Commit the FSM to the state after the accepted prefix: the
+            # last emitted token advances from ITS per-position state
+            # (for accepted drafts emitted[j] == drafts[j], so this lands
+            # exactly on the chain state). n_emit == 0 rows freeze —
+            # rejected drafts never move the committed state (rewind by
+            # construction). Sampled rows reduce to g_next[cstate, tok].
+            idx = jnp.maximum(n_emit - 1, 0)
+            last_s = jnp.take_along_axis(pstates, idx[:, None], 1)[:, 0]
+            last_t = jnp.take_along_axis(emitted, idx[:, None], 1)[:, 0]
+            cstates = jnp.where(n_emit > 0, g_next[last_s, last_t], cstates)
+            crem = crem - n_emit
             pos = pos + n_emit
             hlen = hlen + n_emit
             # Sampled slots consumed one stream index; greedy argmax
             # consumed none.
             counts = counts + jnp.where(active & ~greedy, 1, 0)
             return (*_cache_tuple(new_cache), hist, hlen, cur, pos, counts,
-                    emitted, n_emit)
+                    cstates, crem, emitted, n_emit)
 
         return spec_decode
 
@@ -971,13 +1028,6 @@ class ContinuousBatchingScheduler:
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         if constraint is not None:
-            if self._spec_draft:
-                raise ValueError(
-                    "constrained decoding does not compose with the "
-                    "speculative scheduler: drafted tokens bypass the "
-                    "grammar mask — serve constrained traffic on a "
-                    "non-speculative scheduler"
-                )
             if max_new_tokens < constraint.min_new_tokens:
                 raise ValueError(
                     f"max_new_tokens={max_new_tokens} cannot hold a "
@@ -1091,7 +1141,12 @@ class ContinuousBatchingScheduler:
         (1.0 = no draft ever accepted .. D+1 = every draft accepted), and
         the estimated speedup vs vanilla decode given the measured ~1.6x
         verify-round cost (engine/speculative.py breakeven math) — the
-        go/no-go number for --speculative on a given workload."""
+        go/no-go number for --speculative on a given workload. `by_class`
+        splits the same acceptance figures by constrained vs unconstrained
+        requests: grammar-masked NL→SQL traffic accepts differently
+        (forced keyword/identifier runs vs free text), and the per-class
+        tokens/round is the number that says whether the constrained hot
+        path specifically is winning (/metrics carries the split)."""
         if not self._spec_draft:
             return None
         from ..engine.speculative import (
@@ -1099,27 +1154,39 @@ class ContinuousBatchingScheduler:
             verify_cost_ratio,
         )
 
-        # Copy the pair under the scheduler's lock: the harvest thread
-        # updates both counters under it, so this read can never see a
+        # Copy the counters under the scheduler's lock: the harvest thread
+        # updates them as a group under it, so this read can never see a
         # half-applied round (ADVICE.md r5 #2).
         with self._submit_lock:
             rounds, toks = self._spec_rounds, self._spec_tokens
-        tpr = toks / rounds if rounds else 0.0
+            rounds_con, toks_con = (self._spec_rounds_con,
+                                    self._spec_tokens_con)
         # The verify cost scales with THIS scheduler's draft length
         # (ADVICE r5 #3: a D=4 deployment's breakeven is not D=8's) — the
         # per-D linear model replaces the old single 1.6 constant.
         ratio = verify_cost_ratio(self._spec_draft)
+
+        def acceptance(r: int, t: int) -> Dict[str, float]:
+            tpr = t / r if r else 0.0
+            return {
+                "verify_rounds": r,
+                "tokens_emitted": t,
+                "tokens_per_round": round(tpr, 3),
+                "est_speedup_vs_vanilla": round(tpr / ratio, 3) if r else 0.0,
+            }
+
         return {
-            "verify_rounds": rounds,
-            "tokens_emitted": toks,
-            "tokens_per_round": round(tpr, 3),
-            "est_speedup_vs_vanilla":
-                round(tpr / ratio, 3) if rounds else 0.0,
+            **acceptance(rounds, toks),
             # The estimate's denominator, at this config's draft length,
             # plus where the model's anchors were measured — a 7B/int4/TP
             # serving config can still sit meaningfully off it.
             "verify_cost_ratio": round(ratio, 3),
             "est_speedup_calibration": VERIFY_COST_CALIBRATION,
+            "by_class": {
+                "constrained": acceptance(rounds_con, toks_con),
+                "unconstrained": acceptance(rounds - rounds_con,
+                                            toks - toks_con),
+            },
         }
 
     def retry_after_hint(self) -> float:
@@ -1437,14 +1504,16 @@ class ContinuousBatchingScheduler:
         ]
         nc = len(self._cache)
         if self._spec_draft:
+            t = self._ctables
             out = self._decode_fn(
                 self.params, *self._cache, self._hist, self._hlen,
                 self._cur, self._pos, jnp.asarray(active), self._temps,
                 self._topps, self._topks, self._seeds, self._counts,
+                self._cstates, self._crem, t["next"], t["need"],
             )
             self._cache = out[:nc]
             (self._hist, self._hlen, self._cur, self._pos, self._counts,
-             toks, n_emit) = out[nc:]
+             self._cstates, self._crem, toks, n_emit) = out[nc:]
         else:
             t = self._ctables
             out = self._decode_fn(
@@ -1551,6 +1620,11 @@ class ContinuousBatchingScheduler:
                     with self._submit_lock:
                         self._spec_rounds += 1
                         self._spec_tokens += int(n_emit[i])
+                        if req.constraint is not None:
+                            # Per-class split: the constrained subset of
+                            # the totals (unconstrained = total - con).
+                            self._spec_rounds_con += 1
+                            self._spec_tokens_con += int(n_emit[i])
             done = False
             for tok in row:
                 tok = int(tok)
@@ -1863,7 +1937,12 @@ class SchedulerBackend:
         # Journal-spill recovery happens HERE, the one seam every
         # deployment path (tiny, HF, GGUF, dp pool) funnels through: a
         # previous process's drained-but-unfinished requests resubmit so
-        # retried idempotency keys find their results.
+        # retried idempotency keys find their results. The backend owns
+        # the tokenizer, so it is also the one that can recompile a
+        # spilled constraint SPEC back into device tables — point the
+        # supervisor's resolver here BEFORE recovery runs.
+        if hasattr(scheduler, "constraint_resolver"):
+            scheduler.constraint_resolver = self._resolve_constraint
         recover = getattr(scheduler, "recover", None)
         if callable(recover) and getattr(scheduler, "spill_path", None):
             recover()
@@ -2098,22 +2177,28 @@ class SchedulerBackend:
             )
 
     def _resolve_constraint(self, constrain):
+        # Constrained requests ride the speculative scheduler too: the
+        # verify window evaluates the grammar mask at every draft position
+        # (scheduler._build_spec_decode), so there is nothing to reject
+        # here anymore — the resolver's only job is compiling the spec.
         from .backends import resolve_constraint
 
-        if constrain is not None and getattr(self.scheduler,
-                                             "_spec_draft", 0):
-            # Mirror submit()'s rejection HERE so GenerationService
-            # .validate() (which calls this resolver) turns the error into
-            # a 400 before a streaming 200 goes on the wire — submit's own
-            # guard then never fires mid-stream.
-            raise ValueError(
-                "constrained decoding does not compose with the "
-                "speculative scheduler: drafted tokens bypass the grammar "
-                "mask — serve constrained traffic on a non-speculative "
-                "scheduler"
-            )
         return resolve_constraint(constrain, self.tokenizer,
                                   self.scheduler.stop_ids)
+
+    def _constraint_kwargs(self, constrain) -> Dict[str, object]:
+        """submit() kwargs for a constraint: the compiled tables always,
+        plus the raw serializable SPEC when the scheduler is supervised
+        (its journal spill writes the spec and recompiles it at
+        recovery — serve/supervisor.py; a bare scheduler has no journal
+        and no constraint_spec parameter)."""
+        kwargs: Dict[str, object] = {
+            "constraint": self._resolve_constraint(constrain)
+        }
+        if constrain is not None and hasattr(self.scheduler,
+                                             "constraint_resolver"):
+            kwargs["constraint_spec"] = constrain
+        return kwargs
 
     def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
         sched = self.scheduler
@@ -2163,7 +2248,7 @@ class SchedulerBackend:
         fut = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed,
-            on_token=on_tok, constraint=self._resolve_constraint(constrain),
+            on_token=on_tok, **self._constraint_kwargs(constrain),
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
         )
@@ -2237,7 +2322,7 @@ class SchedulerBackend:
         out = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed, on_token=on_tok,
-            constraint=self._resolve_constraint(constrain),
+            **self._constraint_kwargs(constrain),
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
             **kwargs,
@@ -2258,7 +2343,7 @@ class SchedulerBackend:
         nothing beyond bucketing."""
         from .backends import Completion, trim_stop_texts
 
-        constraint = self._resolve_constraint(constrain)
+        constraint_kwargs = self._constraint_kwargs(constrain)
         effective_deadline = (deadline_s if deadline_s is not None
                               else self.deadline_s)
         ids_list = [
@@ -2270,7 +2355,7 @@ class SchedulerBackend:
             self.scheduler.submit(
                 ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
                 sampling=sampling or self.sampling, seed=seed,
-                on_token=on_tok, constraint=constraint,
+                on_token=on_tok, **constraint_kwargs,
                 deadline_s=effective_deadline,
             )
             for ids, (on_tok, _) in zip(ids_list, timers)

@@ -81,8 +81,11 @@ class GenerationService:
 
     def backend_stats(self) -> Dict[str, Dict]:
         """Per-model serving-layer stats from backends exposing .stats()
-        (SchedulerBackend: prefix-cache reuse, speculation acceptance) —
-        the /metrics endpoint merges these beside the request aggregates."""
+        (SchedulerBackend: prefix-cache reuse, speculation acceptance —
+        split by constrained/unconstrained class under
+        speculation.by_class, since the grammar-masked hot path prices
+        its speedup separately) — the /metrics endpoint merges these
+        beside the request aggregates."""
         out: Dict[str, Dict] = {}
         with self._lock:
             entries = list(self._models.values())

@@ -46,7 +46,11 @@ that supervisor, wrapped around `ContinuousBatchingScheduler` (or a
   drain deadline, then journals what is left to the optional on-disk
   spill and shuts the loop down — the SIGTERM path. `recover()` resubmits
   a spill file at the next start so retried idempotency keys find their
-  results.
+  results. Constrained entries spill their constraint SPEC (grammar name
+  or schema dict — the compiled device tables are not serializable) and
+  recover() recompiles it through `constraint_resolver`, which
+  SchedulerBackend points at its own spec→tables resolver before
+  recovery runs.
 
 Counters land in `utils.observability.resilience` (`sched_restarts`,
 `sched_replayed`, `sched_lost`, `sched_idempotent_hits`) and surface in
@@ -108,6 +112,13 @@ class JournalEntry:
     inner: Optional[Future] = None
     cancelled: bool = False
     done: bool = False
+    # The constraint SPEC ("spark_sql" / {"table", "columns"} dict) beside
+    # the compiled object: the compiled grammar holds device tables and is
+    # not serializable, but the spec is plain JSON — it is what the drain
+    # spill writes, and recover() recompiles it through the supervisor's
+    # `constraint_resolver` (set by SchedulerBackend, which owns the
+    # tokenizer the tables must be compiled against).
+    constraint_spec: object = None
 
 
 class SupervisedScheduler:
@@ -178,6 +189,11 @@ class SupervisedScheduler:
         # short and rewrite ('w' mode) the spill it just wrote.
         self._drain_lock = threading.Lock()
         self._drain_report: Optional[Dict[str, object]] = None
+        # Recompiles a spilled constraint SPEC at recover() time
+        # (spec -> compiled grammar). Set by SchedulerBackend — the owner
+        # of the tokenizer+stop-ids the tables compile against; None means
+        # constrained spill records cannot be recovered and count lost.
+        self.constraint_resolver: Optional[Callable[[object], object]] = None
         # Per-dependency breaker view: the engine loop is a dependency too.
         # A crash records a failure, a successful restart a success — so
         # /metrics "resilience.breakers.<name>-restart" tells operators
@@ -288,12 +304,17 @@ class SupervisedScheduler:
         deadline_s: Optional[float] = None,
         idempotency_key: Optional[str] = None,
         idempotent: bool = True,
+        constraint_spec=None,
     ) -> "Future[List[int]]":
         """Journal + submit. The returned future survives loop crashes: it
         resolves from whichever scheduler incarnation finishes the work.
         `idempotency_key` dedupes retries (same key → same result);
         `idempotent=False` marks a consumer whose delivered tokens cannot
-        be replayed (the entry fails typed instead of double-streaming)."""
+        be replayed (the entry fails typed instead of double-streaming).
+        `constraint_spec` is the serializable twin of `constraint`
+        (grammar name / schema dict): with it, a keyed constrained entry
+        survives the drain spill — recover() recompiles the spec through
+        `constraint_resolver` instead of failing the request typed."""
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         with self._lock:
@@ -337,6 +358,7 @@ class SupervisedScheduler:
                 seed=seed,
                 idempotency_key=idempotency_key,
                 constraint=constraint,
+                constraint_spec=constraint_spec,
                 deadline=(Deadline.after(deadline_s)
                           if deadline_s is not None else None),
                 on_token=on_token,
@@ -470,9 +492,12 @@ class SupervisedScheduler:
         entries spill: the idempotency cache is the sole cross-process
         handle to a recovered result, so regenerating keyless work would
         burn startup device time on futures nobody can claim. Constrained
-        entries carry a compiled device object and are not serializable:
-        both fail typed without a spill record (documented smallest
-        slice).
+        entries spill their constraint SPEC (grammar name / schema dict —
+        the compiled device tables themselves are not serializable);
+        recover() recompiles the spec through `constraint_resolver`.
+        A constrained entry WITHOUT a serializable spec (a caller handed
+        the scheduler a pre-compiled CompiledMask directly) still fails
+        typed without a record — there is nothing portable to write.
 
         The COMPLETED idempotency cache spills too, as literal `result`
         records: a client whose response was lost on the wire retries its
@@ -485,11 +510,16 @@ class SupervisedScheduler:
             pending = [e for e in self._journal.values() if not e.done]
             records = []
             for e in pending:
-                if e.constraint is None and not e.cancelled \
+                # A constrained entry is spillable only through its
+                # serializable SPEC (str/dict); a raw CompiledMask has no
+                # portable representation and the entry fails typed below.
+                spec_ok = (e.constraint is None
+                           or isinstance(e.constraint_spec, (str, dict)))
+                if spec_ok and not e.cancelled \
                         and e.idempotency_key is not None:
                     rem = (e.deadline.remaining()
                            if e.deadline is not None else None)
-                    records.append({
+                    rec = {
                         "rid": e.rid,
                         "ids": e.ids,
                         "max_new": e.max_new,
@@ -505,7 +535,10 @@ class SupervisedScheduler:
                         # deterministic decode makes the result identical,
                         # so there is no cross-process suppression to do.
                         "delivered": len(e.generated),
-                    })
+                    }
+                    if e.constraint is not None:
+                        rec["constrain"] = e.constraint_spec
+                    records.append(rec)
             for key, result in self._completed.items():
                 records.append({
                     "idempotency_key": key,
@@ -593,6 +626,23 @@ class SupervisedScheduler:
                             self._lost += 1
                         resilience.inc("sched_lost")
                         continue
+                ckw = {}
+                spec = rec.get("constrain")
+                if spec is not None:
+                    # Recompile the spilled SPEC into device tables
+                    # against the serving tokenizer (the compile cache in
+                    # constrain/ dedupes across records). No resolver →
+                    # the ValueError lands in the per-record guard below
+                    # and the record counts lost — logged, not a startup
+                    # crash.
+                    if self.constraint_resolver is None:
+                        raise ValueError(
+                            "constrained spill record needs a "
+                            "constraint_resolver (SchedulerBackend sets "
+                            "one before recovery)"
+                        )
+                    ckw = {"constraint": self.constraint_resolver(spec),
+                           "constraint_spec": spec}
                 self.submit(
                     rec["ids"], max_new_tokens=rec["max_new"],
                     sampling=SamplingParams(
@@ -603,6 +653,7 @@ class SupervisedScheduler:
                     seed=rec.get("seed", 0),
                     deadline_s=rem,
                     idempotency_key=rec.get("idempotency_key"),
+                    **ckw,
                 )
             except Exception:  # noqa: BLE001 — per-record: salvage the rest
                 _log.exception("unrecoverable journal spill record: %.120s",

@@ -77,6 +77,54 @@ def test_bench_incremental_lines_and_leg_status():
         assert ln["value"] == final["value"]
 
 
+def test_probe_accel_outcomes():
+    """The pre-accel tunnel probe (BENCH_r04/r05: two 700s core slices
+    burned on a hung tunnel): success, nonzero exit, and a hang must each
+    resolve within the probe's own budget, never the core slice's."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+
+    ok, err = bench._probe_accel(
+        30, argv=[sys.executable, "-c", "pass"])
+    assert ok and err == ""
+    ok, err = bench._probe_accel(
+        30, argv=[sys.executable, "-c",
+                  "import sys; print('tunnel down', file=sys.stderr); "
+                  "sys.exit(3)"])
+    assert not ok and "rc=3" in err and "tunnel down" in err
+    ok, err = bench._probe_accel(
+        1, argv=[sys.executable, "-c", "import time; time.sleep(30)"])
+    assert not ok and "timeout" in err
+
+
+@pytest.mark.slow
+def test_probe_failure_falls_through_to_cpu():
+    """outer() must never burn an accel core slice on a dead tunnel: with
+    a failing probe (BENCH_PROBE_CMD seam), the run skips every accel
+    attempt, lands on the CPU fallback immediately, and the artifact
+    records why."""
+    env = dict(os.environ)
+    env.update({
+        # NO BENCH_FORCE_CPU: the accel attempts are in the plan, and the
+        # probe must be what removes them.
+        "BENCH_CONFIG": "tiny", "BENCH_BATCH": "2", "BENCH_PROMPT": "32",
+        "BENCH_NEW": "16", "BENCH_REPS": "1", "BENCH_DETAIL": "0",
+        "BENCH_PROBE_CMD": f"{sys.executable} -c 'raise SystemExit(7)'",
+        "BENCH_PROBE_TIMEOUT": "30",
+    })
+    r = subprocess.run(
+        [sys.executable, BENCH], env=env, capture_output=True, text=True,
+        timeout=420, cwd=str(Path(BENCH).parent),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "accel probe failed" in r.stderr
+    # No accel core attempt ever launched.
+    assert "(accel, timeout" not in r.stderr
+    parsed = json.loads([ln for ln in r.stdout.splitlines() if ln.strip()][-1])
+    assert parsed["platform"] == "cpu" and parsed["value"] > 0
+    assert "probe failed" in parsed.get("note", "")
+
+
 @pytest.mark.slow
 def test_bench_leg_failure_recorded_not_fatal():
     """A leg that dies must leave the core artifact intact with a per-leg

@@ -512,34 +512,222 @@ def test_scheduler_grammar_swap_between_requests(tiny_engine, tok, generic,
 
 
 def test_scheduler_constraint_guards(tiny_engine, tok, generic):
+    from llm_based_apache_spark_optimization_tpu.constrain import CompiledMask
     from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
         ContinuousBatchingScheduler,
+        SchedulerBackend,
     )
 
     cfg, eng = tiny_engine
     prompt = tok.encode("q", add_bos=True)
-    spec = ContinuousBatchingScheduler(
-        cfg, eng.params, num_slots=2, prompt_bucket=8,
-        stop_ids=(cfg.eos_id,), speculative_draft=4,
-    )
-    with pytest.raises(ValueError, match="speculative"):
-        spec.submit(prompt, max_new_tokens=40, constraint=generic)
     plain = ContinuousBatchingScheduler(
         cfg, eng.params, num_slots=2, prompt_bucket=8,
         stop_ids=(cfg.eos_id,),
     )
     with pytest.raises(ValueError, match="complete constrained parse"):
         plain.submit(prompt, max_new_tokens=4, constraint=generic)
-    # The backend resolver mirrors the speculative rejection so
-    # service.validate() can 400 a streaming request BEFORE headers ship.
-    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
-        SchedulerBackend,
+    # The speculative scheduler ACCEPTS constrained submits now (the
+    # grammar mask is evaluated at every draft position — the old
+    # rejection guard is gone), and its budget guard matches the plain
+    # scheduler's.
+    spec = ContinuousBatchingScheduler(
+        cfg, eng.params, num_slots=2, prompt_bucket=8,
+        stop_ids=(cfg.eos_id,), speculative_draft=4,
     )
-
+    with pytest.raises(ValueError, match="complete constrained parse"):
+        spec.submit(prompt, max_new_tokens=4, constraint=generic)
+    with spec:
+        out = spec.submit(prompt, max_new_tokens=40,
+                          constraint=generic).result(timeout=180)
+    assert is_valid_spark_sql(_detext(tok, cfg, out))
+    # The backend resolver over a speculative scheduler compiles the spec
+    # instead of raising, so validate()/submit() accept constrain=.
     backend = SchedulerBackend.__new__(SchedulerBackend)
     backend.scheduler, backend.tokenizer = spec, tok
-    with pytest.raises(ValueError, match="speculative"):
-        backend._resolve_constraint("spark_sql")
+    assert isinstance(backend._resolve_constraint("spark_sql"),
+                      CompiledMask)
+
+
+# ------------------------------------ constrained + speculative decode ----
+
+
+def test_engine_constrained_speculative_parity(tiny_engine, tok, generic,
+                                               schema):
+    """The composition's correctness contract: constrained+speculative
+    greedy output is TOKEN-IDENTICAL to constrained-vanilla decode — the
+    grammar mask is evaluated at every draft position, so drafts only
+    change how many verify forwards it takes, never what gets emitted.
+    Both fixture grammars (generic + schema-locked), both the shortest
+    parseable budget and a roomy one; grammar-valid stays 100%."""
+    from llm_based_apache_spark_optimization_tpu.engine import (
+        InferenceEngine,
+    )
+
+    cfg, eng = tiny_engine
+    spec = InferenceEngine(cfg, eng.params, stop_ids=(cfg.eos_id,),
+                           prompt_bucket=8, speculative_draft=4)
+    prompt = tok.encode("Get all taxis.\nSQL: ", add_bos=True)
+    for cm in (generic, schema):
+        for budget in (cm.min_new_tokens, 40):
+            golden = eng.generate([prompt], max_new_tokens=budget,
+                                  constraint=cm)[0]
+            out = spec.generate([prompt], max_new_tokens=budget,
+                                constraint=cm)[0]
+            assert out == golden, (budget, golden, out)
+            assert spec.last_spec_rounds is not None
+            assert 1 <= spec.last_spec_rounds <= len(out)
+            assert is_valid_spark_sql(_detext(tok, cfg, out))
+
+
+@pytest.mark.slow
+def test_bpe_vocab_constrained_speculative_parity():
+    """Same parity contract over the committed tests/golden/sql_bpe/ BPE
+    vocab (multi-char merges, leading-space Ġ tokens — the token shapes a
+    byte tokenizer never exercises): one engine pair at the golden
+    tokenizer's vocab width, constrained+speculative == constrained
+    vanilla, and the output walks the FSM to an accepting state."""
+    pytest.importorskip("tokenizers")
+    import dataclasses
+    from pathlib import Path
+
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.engine import (
+        InferenceEngine,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer.hf import (
+        HFTokenizer,
+    )
+
+    gdir = Path(__file__).parent / "golden" / "sql_bpe"
+    hft = HFTokenizer(str(gdir / "tokenizer.json"))
+    cfg = dataclasses.replace(
+        TINY, name="tiny-sqlbpe", max_seq_len=512,
+        vocab_size=max(TINY.vocab_size, hft.vocab_size),
+        eos_id=hft.eos_id,
+    )
+    params = init_params(cfg, jax.random.key(3), dtype=jnp.float32)
+    cm = get_constraint("spark_sql", hft, (hft.eos_id,))
+    ref = InferenceEngine(cfg, params, stop_ids=(hft.eos_id,),
+                          prompt_bucket=8)
+    spec = InferenceEngine(cfg, params, stop_ids=(hft.eos_id,),
+                           prompt_bucket=8, speculative_draft=4)
+    prompt = hft.encode("SQL: SELECT VendorID FROM taxi; SQL:",
+                        add_bos=False)
+    golden = ref.generate([prompt], max_new_tokens=40, constraint=cm)[0]
+    out = spec.generate([prompt], max_new_tokens=40, constraint=cm)[0]
+    assert out == golden
+    body = out[:-1] if out and out[-1] == hft.eos_id else out
+    end = cm.walk(body)
+    assert end is not None and cm.mask[end, hft.eos_id]
+
+
+def test_scheduler_speculative_mixed_constrained_batch(tiny_engine, tok,
+                                                       generic):
+    """Mixed constrained/unconstrained requests share ONE speculative
+    decode program: constrained outputs are token-identical to
+    constrained-vanilla engine decode, the unconstrained neighbour keeps
+    plain engine parity, nothing compiles per request, and the acceptance
+    counters split by class."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, eng = tiny_engine
+    con_prompt = tok.encode("Total fare per vendor.\nSQL: ", add_bos=True)
+    free_prompt = tok.encode("hello", add_bos=True)
+    golden_free = eng.generate([free_prompt], max_new_tokens=6)[0]
+    golden_con = eng.generate([con_prompt], max_new_tokens=40,
+                              constraint=generic)[0]
+    stripped = (golden_con[:-1] if golden_con[-1] == cfg.eos_id
+                else golden_con)
+
+    sched = ContinuousBatchingScheduler(
+        cfg, eng.params, num_slots=3, prompt_bucket=8,
+        stop_ids=(cfg.eos_id,), speculative_draft=4,
+    )
+    before = masks_mod.COMPILE_COUNT
+    decode_fn = sched._decode_fn
+    with sched:
+        f1 = sched.submit(con_prompt, max_new_tokens=40, constraint=generic)
+        f2 = sched.submit(free_prompt, max_new_tokens=6)
+        f3 = sched.submit(con_prompt, max_new_tokens=40, constraint=generic)
+        o1, o2, o3 = (f.result(timeout=180) for f in (f1, f2, f3))
+    assert o1 == stripped and o3 == stripped
+    assert o2 == golden_free
+    assert is_valid_spark_sql(_detext(tok, cfg, o1))
+    assert masks_mod.COMPILE_COUNT == before  # zero compiles while serving
+    assert sched._decode_fn is decode_fn      # one decode program, reused
+    stats = sched.speculation_stats
+    by = stats["by_class"]
+    assert by["constrained"]["verify_rounds"] >= 1
+    assert by["unconstrained"]["verify_rounds"] >= 1
+    # The split partitions the totals exactly.
+    for k in ("verify_rounds", "tokens_emitted"):
+        assert by["constrained"][k] + by["unconstrained"][k] == stats[k]
+
+
+def test_constrained_speculation_accepts_drafts(tiny_engine, tok):
+    """The speedup exists on constrained fixture traffic, not just in
+    principle: a schema-locked grammar forces long identifier/keyword
+    runs, the prompt (the DDL, as in real NL→SQL serving) contains those
+    identifiers, so prompt-lookup drafts land and constrained
+    tokens/round exceeds 1."""
+    from llm_based_apache_spark_optimization_tpu.evalh.fixtures import (
+        TAXI_COLUMNS,
+        TAXI_DDL_SYSTEM,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, eng = tiny_engine
+    cm = get_constraint({"table": "taxi", "columns": list(TAXI_COLUMNS)},
+                        tok, (cfg.eos_id,))
+    prompt = tok.encode(TAXI_DDL_SYSTEM[:180] + "\nSQL: ", add_bos=True)
+    sched = ContinuousBatchingScheduler(
+        cfg, eng.params, num_slots=2, prompt_bucket=256,
+        stop_ids=(cfg.eos_id,), speculative_draft=4,
+    )
+    with sched:
+        out = sched.submit(prompt, max_new_tokens=64,
+                           constraint=cm).result(timeout=300)
+    assert is_valid_spark_sql(_detext(tok, cfg, out))
+    con = sched.speculation_stats["by_class"]["constrained"]
+    assert con["verify_rounds"] >= 1
+    assert con["tokens_per_round"] > 1.0, con
+
+
+def test_speculation_stats_split_partitions_totals(tiny_engine):
+    """Host-level contract of the per-class counters: constrained counts
+    are a subset of the totals, and by_class reconstructs both classes
+    without double counting."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, eng = tiny_engine
+    sched = ContinuousBatchingScheduler(
+        cfg, eng.params, num_slots=2, prompt_bucket=8,
+        stop_ids=(cfg.eos_id,), speculative_draft=4,
+    )
+    with sched._submit_lock:
+        sched._spec_rounds, sched._spec_tokens = 10, 25
+        sched._spec_rounds_con, sched._spec_tokens_con = 4, 16
+    stats = sched.speculation_stats
+    assert stats["tokens_per_round"] == 2.5
+    assert stats["by_class"]["constrained"] == {
+        "verify_rounds": 4, "tokens_emitted": 16,
+        "tokens_per_round": 4.0,
+        "est_speedup_vs_vanilla": round(4.0 / stats["verify_cost_ratio"], 3),
+    }
+    assert stats["by_class"]["unconstrained"]["verify_rounds"] == 6
+    assert stats["by_class"]["unconstrained"]["tokens_emitted"] == 9
 
 
 # ------------------------------------------------- service / api seam -----

@@ -807,7 +807,7 @@ class _StubEngine:
         return [[1] * max_new_tokens for _ in prompts]
 
 
-def _engine_backend(max_new=50):
+def _engine_backend(max_new=50, **kwargs):
     from llm_based_apache_spark_optimization_tpu.serve.backends import (
         EngineBackend,
     )
@@ -816,7 +816,7 @@ def _engine_backend(max_new=50):
     )
 
     return EngineBackend(_StubEngine(), ByteTokenizer(),
-                         max_new_tokens=max_new)
+                         max_new_tokens=max_new, **kwargs)
 
 
 def test_engine_backend_clamps_budget_from_deadline():
@@ -861,6 +861,66 @@ def test_engine_backend_rejects_unaffordable_deadline_typed():
     backend2._sec_per_tok = 0.1
     backend2.complete_batch(["a", "b"], deadline_s=2.0)
     assert 18 <= backend2.engine.budgets[-1] <= 20
+
+
+def test_engine_backend_seeded_rate_clamps_first_request():
+    """ROADMAP PR-3 follow-up: with a startup seed (LSOT_STOK_SEED or the
+    last bench artifact) the FIRST request after boot is already clamped
+    — the unclamped-first-request window is closed. The seed is a prior:
+    real completions EWMA-blend it at the usual 0.2 rate."""
+    backend = _engine_backend(sec_per_tok_seed=0.1)
+    assert backend._sec_per_tok == 0.1
+    backend.complete("hi", deadline_s=2.0)  # FIRST request, already clamped
+    assert 18 <= backend.engine.budgets[-1] <= 20
+    # Two completions at the same program shape: the first's wall is
+    # discarded (compile), the second blends into the seeded prior
+    # instead of replacing it.
+    backend.complete("hi")
+    seeded = backend._sec_per_tok
+    backend.complete("hi")
+    assert backend._sec_per_tok != seeded
+    assert backend._sec_per_tok == pytest.approx(0.8 * seeded, rel=0.25)
+    # Zero/None seeds keep the historical unseeded behavior.
+    assert _engine_backend(sec_per_tok_seed=0.0)._sec_per_tok is None
+    assert _engine_backend(sec_per_tok_seed=None)._sec_per_tok is None
+
+
+def test_stok_seed_from_bench(tmp_path):
+    """The bench-artifact seeding path: last parseable line wins, the
+    batch size is read from the metric string (aggregate tok/s at B →
+    B/value per-step wall), and unusable files degrade to None instead
+    of raising at server startup."""
+    from llm_based_apache_spark_optimization_tpu.serve.backends import (
+        stok_seed_from_bench,
+    )
+
+    art = tmp_path / "BENCH.jsonl"
+    art.write_text(
+        '{"metric": "x (bench-1b, B=8, prompt=128, new=64)", "value": 100.0}\n'
+        '{"metric": "aggregate greedy decode throughput (bench-1b, B=8, '
+        'prompt=128, new=64)", "value": 1600.0, "unit": "output tok/s"}\n'
+        "{truncated\n"
+    )
+    assert stok_seed_from_bench(str(art)) == pytest.approx(8 / 1600.0)
+    # No B= in the metric: conservative B=1 fallback (under-clamps).
+    art.write_text('{"metric": "headline", "value": 50.0}\n')
+    assert stok_seed_from_bench(str(art)) == pytest.approx(1 / 50.0)
+    # Missing file / no parseable line / nonpositive value → None.
+    assert stok_seed_from_bench(str(tmp_path / "missing.jsonl")) is None
+    art.write_text("noise\n")
+    assert stok_seed_from_bench(str(art)) is None
+    art.write_text('{"value": 0.0}\n')
+    assert stok_seed_from_bench(str(art)) is None
+
+
+def test_appconfig_stok_seed_env(monkeypatch):
+    from llm_based_apache_spark_optimization_tpu.app import AppConfig
+
+    monkeypatch.setenv("LSOT_STOK_SEED", "0.025")
+    monkeypatch.setenv("LSOT_STOK_SEED_BENCH", "/tmp/bench.jsonl")
+    cfg = AppConfig.from_env()
+    assert cfg.stok_seed == 0.025
+    assert cfg.stok_seed_bench == "/tmp/bench.jsonl"
 
 
 def test_service_forwards_deadline_to_engine_backend():

@@ -53,6 +53,10 @@ class ManualInner:
     """Fake scheduler with the submit surface; the test resolves futures
     and triggers crashes by hand, so every interleaving is scripted."""
 
+    #: Lets SchedulerBackend's constraint resolver compile real grammars
+    #: against this fake (resolve_constraint reads scheduler.stop_ids).
+    stop_ids = (2,)
+
     def __init__(self):
         self.submitted = []
         self.started = False
@@ -80,7 +84,7 @@ class ManualInner:
             raise self._crash
         rec = {"ids": list(ids), "max_new": max_new_tokens, "seed": seed,
                "on_token": on_token, "deadline_s": deadline_s,
-               "future": Future()}
+               "constraint": constraint, "future": Future()}
         self.submitted.append(rec)
         return rec["future"]
 
@@ -426,6 +430,99 @@ def test_drain_semantics_and_spill_recovery(tmp_path):
     import os
     assert not os.path.exists(spill)  # consumed
     sup2.shutdown()
+
+
+def test_constrained_spill_records_spec_and_recovers(tmp_path):
+    """ROADMAP PR-3 follow-up closed: a drained constrained request no
+    longer fails typed-without-a-record — its serializable SPEC (grammar
+    name / schema dict) spills beside the usual fields, and recover()
+    recompiles it through constraint_resolver so the resubmission carries
+    real compiled tables. A constrained entry holding only an opaque
+    compiled object (no spec) still fails typed without a record."""
+    spill = str(tmp_path / "con.jsonl")
+    sup, fac, _ = make_sup(spill_path=spill)
+    sup.start()
+    spec = {"table": "taxi", "columns": ["VendorID"]}
+    pend = sup.submit([4, 5], max_new_tokens=30, idempotency_key="c",
+                      constraint=object(), constraint_spec=spec)
+    raw = sup.submit([6], idempotency_key="raw", constraint=object())
+    report = sup.drain(deadline_s=0.2)
+    assert report["spilled"] == 1  # the spec-carrying entry only
+    recs = [json.loads(line) for line in open(spill)]
+    assert recs[0]["idempotency_key"] == "c"
+    assert recs[0]["constrain"] == spec
+    with pytest.raises(Draining):
+        pend.result(timeout=5)
+    with pytest.raises(Draining):
+        raw.result(timeout=5)
+
+    # Next process: the resolver recompiles the SPEC, and the inner
+    # resubmission carries the RESOLVED constraint, not the spec.
+    resolved, seen = object(), []
+    sup2, fac2, _ = make_sup(spill_path=spill)
+    sup2.constraint_resolver = lambda s: (seen.append(s), resolved)[1]
+    sup2.start()
+    assert sup2.recover() == 1
+    assert seen == [spec]
+    inner2 = fac2.instances[0]
+    assert inner2.submitted[0]["ids"] == [4, 5]
+    assert inner2.submitted[0]["constraint"] is resolved
+    inner2.finish(0, [9])
+    assert sup2.submit([4, 5], idempotency_key="c").result(timeout=5) == [9]
+    sup2.shutdown()
+
+
+def test_constrained_spill_without_resolver_counts_lost(tmp_path):
+    """A constrained record recovered into a supervisor with NO resolver
+    is logged + counted lost — never a startup crash, and never silently
+    decoded unconstrained."""
+    spill = str(tmp_path / "orphan.jsonl")
+    with open(spill, "w") as f:
+        f.write(json.dumps({
+            "ids": [7], "max_new": 20, "seed": 0, "idempotency_key": "o",
+            "deadline_remaining_s": None, "constrain": "spark_sql",
+        }) + "\n")
+    sup, fac, _ = make_sup(spill_path=spill)
+    sup.start()
+    before = resilience.get("sched_lost")
+    assert sup.recover() == 0
+    assert resilience.get("sched_lost") == before + 1
+    assert fac.instances[0].submitted == []  # nothing ran unconstrained
+    sup.shutdown()
+
+
+def test_scheduler_backend_wires_constraint_resolver(tmp_path):
+    """The deployment seam: SchedulerBackend points the supervisor's
+    constraint_resolver at its own spec→tables resolver BEFORE recovery,
+    so a constrained spill from the previous process recompiles against
+    the serving tokenizer and resubmits with compiled tables."""
+    spill = str(tmp_path / "conspill.jsonl")
+    with open(spill, "w") as f:
+        f.write(json.dumps({
+            "ids": [2, 3], "max_new": 30, "seed": 0,
+            "idempotency_key": "b", "deadline_remaining_s": None,
+            "constrain": "spark_sql",
+        }) + "\n")
+    sup, fac, _ = make_sup(spill_path=spill)
+
+    from llm_based_apache_spark_optimization_tpu.constrain import (
+        CompiledMask,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        SchedulerBackend,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import (
+        ByteTokenizer,
+    )
+
+    backend = SchedulerBackend(sup, ByteTokenizer())
+    assert sup.constraint_resolver == backend._resolve_constraint
+    rec = fac.instances[0].submitted[0]
+    assert rec["ids"] == [2, 3]
+    assert isinstance(rec["constraint"], CompiledMask)
+    import os
+    assert not os.path.exists(spill)
+    sup.shutdown()
 
 
 def test_recover_charges_downtime_against_deadlines(tmp_path):
