@@ -941,6 +941,51 @@ def _bench_fused(cfg, params, prompt_len, max_new, batch,
     return out
 
 
+def _watchdog_overhead(n: int = 50_000, sched=None) -> dict:
+    """Measured cost of the liveness layer on the scheduler hot path
+    (per-ns): the busy-flag scan + one heartbeat stamp per event-loop
+    iteration plus one round_done per harvested round
+    (serve/watchdog.py). The stamp/round_done are timed on a throwaway
+    Heartbeat so the live scheduler's state is untouched; the busy scan
+    (`_busy_now` — an O(num_slots) sweep plus a queue-mutex peek, which
+    can dominate the stamp itself on wide batches) is timed on the real
+    `sched` when one is passed, since its cost depends on the live slot
+    count. The scheduler leg records it so the watchdog's tax is a
+    number in the artifact, not an assumption."""
+    import time as _t
+
+    from llm_based_apache_spark_optimization_tpu.serve.watchdog import (
+        Heartbeat,
+    )
+
+    hb = Heartbeat()
+    t0 = _t.perf_counter()
+    for _ in range(n):
+        hb.stamp(True)
+    stamp_ns = (_t.perf_counter() - t0) / n * 1e9
+    t0 = _t.perf_counter()
+    for _ in range(n):
+        hb.round_done()
+    round_ns = (_t.perf_counter() - t0) / n * 1e9
+    busy_ns = 0.0
+    busy_now = getattr(sched, "_busy_now", None)
+    if callable(busy_now):
+        t0 = _t.perf_counter()
+        for _ in range(n):
+            busy_now()
+        busy_ns = (_t.perf_counter() - t0) / n * 1e9
+    out = {
+        "stamp_ns": round(stamp_ns, 1),
+        "round_done_ns": round(round_ns, 1),
+        # One loop iteration ≈ one busy scan + one stamp + one round_done
+        # at steady state.
+        "per_round_ns": round(busy_ns + stamp_ns + round_ns, 1),
+    }
+    if callable(busy_now):
+        out["busy_scan_ns"] = round(busy_ns, 1)
+    return out
+
+
 def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
                      kv_quant=None, reps=None, n_req=None,
                      spec_draft=None) -> dict:
@@ -1074,6 +1119,12 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
     if best_ttfts:
         out["ttft_p50_s"] = pctile(best_ttfts, 0.5)
         out["ttft_p95_s"] = pctile(best_ttfts, 0.95)
+    # Liveness tax: per-round heartbeat cost (ns) beside the rounds the
+    # timed run actually harvested — nanoseconds against multi-ms rounds.
+    out["watchdog"] = {
+        **_watchdog_overhead(sched=sched),
+        "rounds_harvested": sched.heartbeat.rounds,
+    }
 
     draft = (int(os.environ.get("BENCH_SCHED_SPEC", "4"))
              if spec_draft is None else spec_draft)

@@ -122,6 +122,8 @@ def make_tiny_service(
                 sched = SupervisedScheduler(
                     make_sched, max_restarts=app_cfg.max_restarts,
                     spill_path=_spill_path(app_cfg, name),
+                    stall_factor=app_cfg.stall_factor,
+                    stall_min_s=app_cfg.stall_min_s,
                     name=f"scheduler:{name}",
                 )
             else:
@@ -263,7 +265,9 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                               deadline_s=app_cfg.deadline_s or None,
                               supervise=supervise,
                               max_restarts=app_cfg.max_restarts,
-                              journal_spill=_spill_path(app_cfg, src))
+                              journal_spill=_spill_path(app_cfg, src),
+                              stall_factor=app_cfg.stall_factor,
+                              stall_min_s=app_cfg.stall_min_s)
                 common["speculative_draft"] = getattr(args, "speculative", 0)
                 common["quantize_int8"] = args.int8
                 common["quantize_int4"] = int4
@@ -317,6 +321,8 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 pool = SupervisedScheduler(
                     make_pool, max_restarts=app_cfg.max_restarts,
                     spill_path=_spill_path(app_cfg, src),
+                    stall_factor=app_cfg.stall_factor,
+                    stall_min_s=app_cfg.stall_min_s,
                     name=f"scheduler-pool:{src}",
                 )
             else:

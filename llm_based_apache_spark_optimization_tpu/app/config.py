@@ -70,6 +70,17 @@ class AppConfig:
     # written here at drain/exit and recovered (resubmitted) at the next
     # start, so retried idempotency keys find their results. "" = off.
     journal_spill: str = ""
+    # --- liveness / hang detection (serve/watchdog.py; README "Liveness &
+    # hangs"). The supervisor's watchdog escalates a BUSY decode loop
+    # whose heartbeat age exceeds
+    # max(stall_min_s, stall_factor × measured round cadence) to a
+    # SchedulerStalled restart — a wedge never raises, so this is the
+    # only way hung requests recover. stall_min_s <= 0 disables the
+    # watchdog. The floor must sit above the worst legitimate
+    # host-thread occupation (a cold XLA compile of an unwarmed prefill
+    # bucket blocks the loop exactly like a wedge).
+    stall_factor: float = 16.0
+    stall_min_s: float = 10.0
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

@@ -17,12 +17,17 @@ definition, app/api.py + app/web.py):
       degraded    200 — serving, but the last restart dropped work
                   (capacity restored, flagged for operators)
       restarting  503 + Retry-After — the loop is being rebuilt; traffic
-                  should go elsewhere and retry
+                  should go elsewhere and retry. A loop the WATCHDOG
+                  caught wedged (stale busy heartbeat, serve/watchdog.py)
+                  lands here too the moment it is escalated — a stalled
+                  loop must stop reading `ready` while requests silently
+                  sit on a hung device; the Retry-After includes the
+                  restart backoff remaining
       dead        503 — restart budget exhausted; pull the instance
       draining    503 + Retry-After — SIGTERM received, shutting down
 
   The body carries the full health payload (per-model states, restart/
-  replay/lost counters) so `/readyz` doubles as the crash-recovery
+  replay/lost/stall counters) so `/readyz` doubles as the crash-recovery
   dashboard.
 - **Drain gate** — a `before_request` hook: once `service.drain()` has
   been triggered (SIGTERM, app/__main__.py), every new mutating request

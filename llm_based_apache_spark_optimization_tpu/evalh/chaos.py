@@ -26,6 +26,14 @@ The contract the report asserts, and `evalh --chaos` prints:
   MID-BATCH, the supervisor restarts it and replays the journal, and the
   report's `scheduler` section shows restart/replay/lost counts with
   `lost == 0` and duplicate idempotency keys deduplicated to one result.
+- **zero silently-hung clients** across a WEDGED loop: a third stage
+  injects a duration-valued `sched:hang` (the loop sleeps instead of
+  raising — the failure mode no exception-based recovery can see), and
+  the supervisor's watchdog must detect the stale heartbeat within its
+  stall threshold, escalate to a `SchedulerStalled` restart, and replay —
+  the report's `watchdog` section shows stalls detected, detection
+  latency (bounded by the configured threshold + one poll), and zero
+  unresolved clients.
 
 Deterministic: the injection RNG is seeded and every boundary is hit from
 the driving thread in a fixed order (the scheduler stage's single worker
@@ -96,11 +104,19 @@ class _ToyScheduler:
     """
 
     def __init__(self, tokens_per_request: int = 6):
+        from ..serve.watchdog import Heartbeat
+
         self.tokens_per_request = tokens_per_request
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._crash = None
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        # Liveness stamp, like the real scheduler's: stamped busy before
+        # every emitted token, idle before blocking on the queue — so the
+        # supervisor's watchdog monitors this replica through the same
+        # seam, and an injected `sched:hang` (the check SLEEPS) reads as
+        # a stale busy heartbeat.
+        self.heartbeat = Heartbeat()
 
     def start(self):
         if self._thread is None:
@@ -108,10 +124,10 @@ class _ToyScheduler:
             self._thread.start()
         return self
 
-    def shutdown(self):
+    def shutdown(self, timeout=None):
         if self._thread is not None:
             self._queue.put(None)
-            self._thread.join()
+            self._thread.join(timeout)
             self._thread = None
 
     def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
@@ -137,6 +153,7 @@ class _ToyScheduler:
         from ..utils.faults import FAULTS
 
         while True:
+            self.heartbeat.stamp(busy=False)  # idle: blocking for work
             item = self._queue.get()
             if item is None:
                 return
@@ -145,10 +162,13 @@ class _ToyScheduler:
             try:
                 out = []
                 for t in toks:
+                    self.heartbeat.stamp(busy=True)
                     FAULTS.check("sched:crash")  # mid-batch death seam
+                    FAULTS.check("sched:hang")   # duration site: wedge here
                     out.append(t)
                     if on_token is not None:
                         on_token(t)
+                    self.heartbeat.round_done()
             except Exception as exc:  # noqa: BLE001 — loop death, like _run's guard
                 crash = SchedulerCrashed.from_exception(exc)
                 with self._lock:
@@ -244,6 +264,112 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
     )
     assert health["lost"] == 0, (
         f"{health['lost']} acknowledged request(s) lost across restarts"
+    )
+    return report
+
+
+def _run_hang_stage(seed: int, hang_s: float = 0.35,
+                    stall_min_s: float = 0.1, requests: int = 3) -> Dict:
+    """Wedge a supervised toy loop with a duration-valued `sched:hang`
+    (the loop SLEEPS mid-batch — no exception ever fires) and prove the
+    watchdog path end to end: the stale busy heartbeat is detected within
+    the stall threshold + one monitor poll, the wedge escalates to a
+    `SchedulerStalled` restart, the journal replays, and every client
+    resolves with the deterministic expected tokens — zero silently-hung
+    clients. The factory clears injection on rebuild (the established
+    one-episode pattern), so the schedule is deterministic. Runs in its
+    OWN injection scope; returns its fault counts for the caller to
+    merge."""
+    import random
+    import time
+
+    from ..serve.resilience import RetryPolicy
+    from ..serve.supervisor import SupervisedScheduler
+    from ..utils.faults import FAULTS
+
+    FAULTS.configure(f"sched:hang:1:{hang_s}", seed)
+    builds = []
+    counts_at_rebuild: Dict[str, int] = {}
+
+    def factory():
+        if builds:
+            # One wedge episode: the rebuilt loop runs clean. Snapshot the
+            # injected-hang counts first — clear() wipes them.
+            counts_at_rebuild.update(FAULTS.counts())
+            FAULTS.clear()
+        builds.append(1)
+        return _ToyScheduler()
+
+    sup = SupervisedScheduler(
+        factory, max_restarts=5,
+        restart_policy=RetryPolicy(max_attempts=6, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(seed),
+        stall_factor=2.0, stall_min_s=stall_min_s,
+        # The wedged toy sleeps through several per-token hangs before it
+        # can join: abandon it fast (the supervisor owns the client
+        # futures; the zombie's late results hit the staleness guard).
+        stall_join_s=0.2,
+    ).start()
+    t0 = time.monotonic()
+    try:
+        futs, expect = [], []
+        for i in range(requests):
+            ids, rseed = [3 + i, 4 + i], 100 + i
+            futs.append(sup.submit(ids, seed=rseed))
+            expect.append(_ToyScheduler.expected(ids, 6, rseed))
+        hung = mismatched = 0
+        for fut, want in zip(futs, expect):
+            try:
+                got = fut.result(timeout=60)
+            except Exception:  # noqa: BLE001 — typed terminal counts lost here
+                got = None
+            if got is None:
+                hung += 1
+            elif got != want:
+                mismatched += 1
+        wall = time.monotonic() - t0
+        health = sup.health()
+        counts = dict(counts_at_rebuild)
+        for site, n in FAULTS.counts().items():
+            counts[site] = counts.get(site, 0) + n
+    finally:
+        FAULTS.clear()
+        sup.shutdown()
+    report = {
+        "requests": requests,
+        "hang_s": hang_s,
+        "stall_threshold_s": stall_min_s,
+        "stalls_detected": health["stalls"],
+        "restarts": health["restarts"],
+        "replayed": health["replayed"],
+        "lost": health["lost"],
+        "unresolved": hung,
+        "mismatched": mismatched,
+        "state": health["state"],
+        "faults_injected": counts,
+    }
+    assert hung == 0, (
+        f"{hung} client(s) silently hung across an injected decode-loop "
+        f"wedge — the watchdog failed to recover them"
+    )
+    assert mismatched == 0, (
+        f"{mismatched} replayed request(s) diverged after the stall restart"
+    )
+    assert health["stalls"] >= 1, (
+        "the injected hang was never detected as a stall"
+    )
+    assert health["lost"] == 0, (
+        f"{health['lost']} acknowledged request(s) lost across the stall"
+    )
+    # Bounded detection + recovery: everything resolved in a small
+    # multiple of the injected wedge (detection <= threshold + poll, then
+    # teardown join + millisecond backoff + replay). A wall anywhere near
+    # requests × hang_s would mean the hang was waited out, not detected.
+    bound = 6 * hang_s + 5.0
+    assert wall < bound, (
+        f"hang stage took {wall:.2f}s (bound {bound:.2f}s): detection or "
+        f"recovery is not bounded"
     )
     return report
 
@@ -365,13 +491,25 @@ def run_chaos(
         scheduler_report = _run_scheduler_stage(seed, requests=3 * rounds)
     finally:
         srv.shutdown()
-        fault_counts = FAULTS.counts()  # clear() wipes them
+        fault_counts = FAULTS.counts()  # clear()/reconfigure wipes them
         FAULTS.clear()
 
     after = resilience.snapshot()
+
+    # Stage 3 — hang detection: a duration-valued `sched:hang` wedges a
+    # supervised loop mid-batch; the watchdog must detect the stale
+    # heartbeat, escalate, restart, and replay — zero silently-hung
+    # clients. Runs in its OWN injection scope (the hang spec must not
+    # perturb the main stages' seeded schedule) AND outside the
+    # before/after resilience snapshot pair, so its fault/stall/restart
+    # counts stay inside its report rather than polluting the
+    # spec-driven `resilience_delta` and `faults` tallies the main
+    # stages reconcile against.
+    watchdog_report = _run_hang_stage(seed)
     requests = rounds * len(FOUR_QUERY_SUITE)
     hung = requests - sum(outcomes.values())
     hung += scheduler_report["unresolved"]
+    hung += watchdog_report["unresolved"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
     return {
         "spec": spec,
@@ -380,6 +518,7 @@ def run_chaos(
         "outcomes": outcomes,
         "hung": hung,
         "scheduler": scheduler_report,
+        "watchdog": watchdog_report,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)
             for k in sorted(set(before) | set(after))

@@ -91,6 +91,12 @@ class OllamaClientService:
             raise self._breaker.shed()
 
         def attempt() -> dict:
+            # Duration-valued stall seam (`ollama:stall:p:secs`): a daemon
+            # that accepts the connection and answers SLOWLY — the check
+            # sleeps, then the request proceeds, so deadline/timeout
+            # handling above this call is exercised against real elapsed
+            # time instead of an instant error.
+            FAULTS.check("ollama:stall")
             FAULTS.check("ollama:connect")
             with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 return json.load(r)

@@ -59,6 +59,8 @@ __all__ = [
     "Overloaded",
     "RetryPolicy",
     "SchedulerCrashed",
+    "SchedulerStalled",
+    "SlotStalled",
     "breaker_states",
 ]
 
@@ -68,6 +70,16 @@ __all__ = [
 
 class DeadlineExceeded(RuntimeError):
     """The request's deadline expired (queued or in flight) — HTTP 504."""
+
+
+class SlotStalled(DeadlineExceeded):
+    """One slot's generation made no progress for N consecutive harvest
+    rounds while other slots in the same batch advanced: the scheduler
+    retires it typed instead of letting it occupy a decode lane forever.
+    504-family (subclasses DeadlineExceeded): the client's latency budget
+    is what a wedged lane burns, and existing 504 handlers keep working.
+    A WHOLE-loop stall is the watchdog's job (`SchedulerStalled`); this is
+    the single-lane case, which must not restart the loop."""
 
 
 class Overloaded(RuntimeError):
@@ -118,6 +130,16 @@ class SchedulerCrashed(RuntimeError):
         wrapped = cls(f"scheduler loop crashed: {exc!r}", crash_traceback=tb)
         wrapped.__cause__ = exc
         return wrapped
+
+
+class SchedulerStalled(SchedulerCrashed):
+    """The decode loop stopped making progress — its heartbeat went stale
+    past the watchdog's stall threshold while work was in flight (hung XLA
+    dispatch, wedged device tunnel). A wedge never *raises*, so the
+    watchdog (serve/watchdog.py + SupervisedScheduler's monitor thread)
+    escalates it to this SYNTHETIC crash: subclassing `SchedulerCrashed`
+    means the existing restart/journal/replay machinery recovers hung
+    requests exactly like crashed ones, and the API still answers 503."""
 
 
 # ------------------------------------------------------------------ deadline

@@ -127,14 +127,16 @@ from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
 from ..ops.sampling import SamplingParams, apply_token_mask, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
-from ..utils.faults import FAULTS
+from ..utils.faults import FAULTS, InjectedFault
 from ..utils.observability import resilience
 from .resilience import (
     Deadline,
     DeadlineExceeded,
     Overloaded,
     SchedulerCrashed,
+    SlotStalled,
 )
+from .watchdog import CombinedHeartbeat, Heartbeat
 
 _log = logging.getLogger("lsot.scheduler")
 
@@ -206,6 +208,15 @@ class _Request:
     # Submit wall-clock origin: feeds the per-request service-time EWMA
     # behind retry_after_hint() (queue-depth-aware Retry-After).
     submitted_at: float = 0.0
+    # Per-slot stall retirement: consecutive harvest rounds in which this
+    # request's slot appended nothing while OTHER slots advanced. At
+    # `slot_stall_rounds` the slot is retired typed (SlotStalled/504)
+    # instead of occupying a decode lane forever. `stall_inject` is the
+    # chaos seam (`sched:slot_stall` site, set at admission): the harvest
+    # treats the slot's round output as empty, simulating a lane the
+    # device produces nothing useful for.
+    stall_rounds: int = 0
+    stall_inject: bool = False
 
     def emit(self, tok: int) -> None:
         if self.on_token is not None:
@@ -247,9 +258,24 @@ class ContinuousBatchingScheduler:
         spec_ngram: int = 3,
         fuse_matmuls: bool = False,
         max_queue_depth: int = 0,
+        slot_stall_rounds: int = 16,
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # Per-slot stall retirement: a slot that appends nothing for this
+        # many consecutive harvest rounds WHILE other slots advance is
+        # retired typed (SlotStalled/504) — a wedged lane must not pin a
+        # batch slot until its deadline burns. 0 disables. Organically
+        # impossible with the current decode programs (every active slot
+        # emits per round), so this is defense-in-depth plus the
+        # `sched:slot_stall` chaos seam's contract.
+        self.slot_stall_rounds = int(slot_stall_rounds)
+        self._slot_stalls = 0
+        # Liveness stamp the event loop touches every iteration (and per
+        # harvested round): the supervisor's watchdog monitor reads it to
+        # tell a wedged loop (hung XLA dispatch/tunnel — age grows while
+        # busy) from a healthy or idle one. serve/watchdog.py.
+        self.heartbeat = Heartbeat()
         # Admission control: submits beyond this many queued-not-yet-slotted
         # requests shed with a typed Overloaded (HTTP 429 upstream) instead
         # of growing the backlog without bound — under sustained overload an
@@ -933,7 +959,18 @@ class ContinuousBatchingScheduler:
         some k-buckets can stay uncompiled and stall a later request with
         an XLA compile). Every row targets the out-of-bounds padding slot:
         the scatter drops all writes, so no slot or cache state changes.
-        Call before start() (or while the loop is idle)."""
+        Also compiles the DECODE program (one all-inactive round: every
+        write lands at the park position, which no query can see) and the
+        per-slot state scatters (driven at the out-of-bounds slot: jax
+        drops OOB scatter writes, so they are true no-ops). Call before
+        start() (or while the loop is idle).
+
+        Liveness note: an unwarmed loop blocks its own thread on each
+        cold XLA compile, which a tight watchdog stall threshold
+        (serve/watchdog.py) cannot tell from a genuine wedge — warm
+        before serving, or keep LSOT_STALL_MIN_S above the compile wall.
+        The supervisor's restart driver warms every rebuilt scheduler
+        through this method while the monitor is quiet."""
         want = prompt_len or self.prompt_bucket
         t = next((b for b in self._buckets if b >= want), self.prompt_bucket)
         pad = self.cfg.pad_id
@@ -960,6 +997,65 @@ class ContinuousBatchingScheduler:
             self._cache = out[:nc]
             if self._spec_draft:
                 self._hist = out[nc]
+        self._warm_state_ops()
+        self._warm_decode()
+
+    def _warm_state_ops(self) -> None:
+        """Compile the per-slot state scatters at the OOB padding slot
+        (index num_slots): jax drops out-of-bounds scatter writes, so
+        these executions change nothing while caching the compiled
+        programs the first admission would otherwise block the loop on."""
+        oob = jnp.int32(self.num_slots)
+        self._cur, self._pos, self._cstates, self._crem = self._park_fn(
+            self._cur, self._pos, self._cstates, self._crem, oob
+        )
+        self._temps, self._topps, self._topks, self._cstates = \
+            self._retire_fn(self._temps, self._topps, self._topks,
+                            self._cstates, oob)
+        (self._cur, self._pos, self._temps, self._topps, self._topks,
+         self._seeds, self._counts, self._cstates,
+         self._crem) = self._ready_fn(
+            self._cur, self._pos, self._temps, self._topps, self._topks,
+            self._seeds, self._counts, self._cstates, self._crem,
+            self._ctables["next"], oob,
+            jnp.full((1,), self.cfg.pad_id, jnp.int32), jnp.int32(self._park),
+            jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+            jnp.uint32(0), jnp.int32(0), jnp.int32(1),
+        )
+        if self._spec_draft:
+            self._hist, self._hlen = self._spec_ready_fn(
+                self._hist, self._hlen, oob,
+                jnp.full((1,), self.cfg.pad_id, jnp.int32), jnp.int32(0),
+            )
+
+    def _warm_decode(self) -> None:
+        """Compile (and execute once) the decode program with every slot
+        inactive: parked-position garbage writes only — the same rounds
+        free slots run between requests anyway, covered by the cache
+        visibility invariant."""
+        nc = len(self._cache)
+        t = self._ctables
+        inactive = np.zeros(self.num_slots, bool)
+        if self._spec_draft:
+            out = self._decode_fn(
+                self.params, *self._cache, self._hist, self._hlen,
+                self._cur, self._pos, jnp.asarray(inactive), self._temps,
+                self._topps, self._topks, self._seeds, self._counts,
+                self._cstates, self._crem, t["next"], t["need"],
+            )
+            self._cache = out[:nc]
+            (self._hist, self._hlen, self._cur, self._pos, self._counts,
+             self._cstates, self._crem, _, _) = out[nc:]
+        else:
+            out = self._decode_fn(
+                self.params, *self._cache, self._cur, self._pos,
+                jnp.asarray(inactive), self._temps, self._topps, self._topks,
+                self._seeds, self._counts, self._cstates, self._crem,
+                t["next"], t["need"],
+            )
+            self._cache = out[:nc]
+            (self._cur, self._pos, self._counts, self._cstates, self._crem,
+             _) = out[nc:]
 
     def _crash_error(self) -> SchedulerCrashed:
         """The typed "engine dead" error for this scheduler's crash (HTTP
@@ -981,11 +1077,27 @@ class ContinuousBatchingScheduler:
             self._thread.start()
         return self
 
-    def shutdown(self) -> None:
+    def shutdown(self, timeout: Optional[float] = None) -> None:
+        """Stop the event loop. `timeout` bounds the join: a WEDGED loop
+        (hung XLA dispatch — the case the watchdog escalates) would block
+        an unbounded join forever, so the supervisor's teardown passes a
+        bound and ABANDONS the daemon thread if it doesn't exit in time.
+        An abandoned zombie exits at its next top-of-loop check once it
+        unwedges; its futures are superseded by the supervisor's replay
+        (bare-scheduler callers should keep the default blocking join —
+        abandonment leaves inner futures unresolved)."""
         if self._thread is not None:
             self._stop_evt.set()
             self._queue.put(None)  # wake the loop
-            self._thread.join()
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                with self._submit_lock:
+                    self._closed = True
+                _log.warning(
+                    "scheduler loop did not join within %.2fs; abandoning "
+                    "wedged worker thread (it exits when it unwedges)",
+                    timeout,
+                )
             self._thread = None
 
     def __enter__(self):
@@ -1071,6 +1183,15 @@ class ContinuousBatchingScheduler:
                       if deadline_s is not None else None),
         )
         req.future._lsot_request = req  # cancel() handle
+        try:
+            # Chaos seam: mark THIS request's slot as a silently
+            # no-progress lane (its harvest rows read empty) — the
+            # per-slot stall retirement's injectable trigger. Checked on
+            # the SUBMITTING thread so a test can scope the spec to
+            # exactly the requests it wants wedged, deterministically.
+            FAULTS.check("sched:slot_stall")
+        except InjectedFault:
+            req.stall_inject = True
         with self._submit_lock:
             if self._closed:
                 if self._crash is not None:
@@ -1232,6 +1353,16 @@ class ContinuousBatchingScheduler:
             "hits": self._prefix_hits,
             "blocks_reused": self._prefix_blocks_reused,
             "cached_blocks": len(self._prefix_cache),
+        }
+
+    @property
+    def watchdog_stats(self) -> Dict[str, object]:
+        """Liveness observability for /metrics: the loop's heartbeat (age,
+        busy, rounds, measured cadence) and per-slot stall retirements.
+        The supervisor layers its stall-detection counters on top."""
+        return {
+            "heartbeat": self.heartbeat.snapshot(),
+            "slots_retired_stalled": self._slot_stalls,
         }
 
     # ------------------------------------------------------------ event loop
@@ -1495,6 +1626,12 @@ class ContinuousBatchingScheduler:
         # SchedulerCrashed, and every client future must fail typed, never
         # hang (asserted by the chaos tests).
         FAULTS.check("sched:decode")
+        # Duration-valued hang seam: `sched:hang:p:secs` SLEEPS here —
+        # the wedge that never raises (hung XLA dispatch, stuck tunnel).
+        # The heartbeat was stamped at the loop top, so its age grows for
+        # the whole sleep and the supervisor's watchdog must detect and
+        # escalate it (SchedulerStalled → restart/replay).
+        FAULTS.check("sched:hang")
         active = np.asarray(
             [r is not None and r.ready for r in self._slot_req]
         )
@@ -1594,15 +1731,28 @@ class ContinuousBatchingScheduler:
         # ready-scatter was dispatched before the round was issued.
         for (slot, req, _), fv in zip(firsts, first_vals):
             self._append_first(slot, req, int(np.asarray(fv)[0]))
+        # Per-slot progress this round: a slot "advanced" if it appended a
+        # token or reached a terminal state. A slot that advanced nothing
+        # in a HARVESTED round accrues a stall round (sweep after the
+        # loop): reaching harvest accounting at all proves the loop is
+        # alive — a genuinely wedged loop blocks inside a jax call and is
+        # the watchdog's case (stale heartbeat), never this one. The
+        # common signature is one frozen lane while its batch neighbours
+        # advance; a LONE frozen slot must retire too, or it pins its
+        # lane until the client's deadline burns.
+        advanced: List[int] = []
+        no_progress: List[Tuple[int, _Request]] = []
         for i, req in enumerate(issue_reqs):
             if req is None or req is not self._slot_req[i]:
                 continue  # inactive at issue, or already retired
             if req.cancelled:
                 self._retire(i, req, req.generated)
+                advanced.append(i)
                 continue
             if req.past_deadline():
                 resilience.inc("deadline_expired")
                 self._fail_slot(i, req, req.deadline_error())
+                advanced.append(i)
                 continue
             # Speculative rounds emit a variable number of accepted tokens
             # per slot; vanilla rounds emit the whole chunk row.
@@ -1625,6 +1775,11 @@ class ContinuousBatchingScheduler:
                             # the totals (unconstrained = total - con).
                             self._spec_rounds_con += 1
                             self._spec_tokens_con += int(n_emit[i])
+            if req.stall_inject:
+                # Injected lane wedge (`sched:slot_stall`): the device
+                # "produced nothing useful" for this slot this round.
+                row = row[:0]
+            before = len(req.generated)
             done = False
             for tok in row:
                 tok = int(tok)
@@ -1638,6 +1793,32 @@ class ContinuousBatchingScheduler:
                     break
             if done:
                 self._retire(i, req, req.generated)
+                advanced.append(i)
+            elif len(req.generated) > before:
+                req.stall_rounds = 0
+                advanced.append(i)
+            else:
+                no_progress.append((i, req))
+        if self.slot_stall_rounds and no_progress:
+            for i, req in no_progress:
+                if req is not self._slot_req[i]:
+                    continue
+                req.stall_rounds += 1
+                if req.stall_rounds >= self.slot_stall_rounds:
+                    self._slot_stalls += 1
+                    resilience.inc("slot_stalls")
+                    _log.warning(
+                        "slot %d made no progress for %d harvested rounds "
+                        "(%d other slot(s) advanced this round); retiring "
+                        "typed", i, req.stall_rounds, len(advanced),
+                    )
+                    self._fail_slot(i, req, SlotStalled(
+                        f"slot {i} made no progress for {req.stall_rounds} "
+                        f"harvested decode rounds while the loop stayed "
+                        f"live ({len(req.generated)} of {req.max_new} "
+                        f"tokens generated before the lane wedged)"
+                    ))
+        self.heartbeat.round_done()
 
     def _harvest_firsts(self) -> None:
         """Drain path: ready slots whose first token never rode a round."""
@@ -1683,8 +1864,26 @@ class ContinuousBatchingScheduler:
             if req is not None:
                 req.future.set_exception(exc)
 
+    def _busy_now(self) -> bool:
+        """Work anywhere in the pipeline: the busy flag the event loop
+        stamps into the heartbeat each iteration. A method (not inlined
+        in `_loop`) so bench's `_watchdog_overhead` can time the FULL
+        per-iteration liveness cost — this scan plus the stamp — instead
+        of the stamp alone."""
+        return bool(
+            self._prefill_q or self._pending or self._constraint_wait
+            or any(r is not None for r in self._slot_req)
+            or not self._queue.empty()
+        )
+
     def _loop(self) -> None:
         while not self._stop_evt.is_set():
+            # Liveness stamp FIRST, so a wedge anywhere below (a hung XLA
+            # dispatch in prefill/decode, a stuck device_get in harvest)
+            # leaves a stale busy stamp for the watchdog to age. Idle
+            # iterations stamp busy=False every <=50ms (the queue.get
+            # timeout below), so an idle loop never looks wedged.
+            self.heartbeat.stamp(busy=self._busy_now())
             # Admit pending requests into every free slot, then issue one
             # prompt chunk and one decode round — all asynchronously — and
             # harvest the oldest round once the pipeline is `_harvest_lag`
@@ -1821,14 +2020,30 @@ class SchedulerPool:
         for s in self.schedulers:
             s.warmup(prompt_len)
 
+    @property
+    def heartbeat(self) -> CombinedHeartbeat:
+        """Monitor view over the replicas' heartbeats: one wedged replica
+        reads stale (oldest busy age) even while its siblings stamp, so
+        the supervisor's watchdog covers pools with the same code path."""
+        return CombinedHeartbeat([s.heartbeat for s in self.schedulers])
+
+    @property
+    def watchdog_stats(self) -> Dict[str, object]:
+        return {
+            "heartbeat": self.heartbeat.snapshot(),
+            "slots_retired_stalled": sum(
+                s._slot_stalls for s in self.schedulers
+            ),
+        }
+
     def start(self) -> "SchedulerPool":
         for s in self.schedulers:
             s.start()
         return self
 
-    def shutdown(self) -> None:
+    def shutdown(self, timeout: Optional[float] = None) -> None:
         for s in self.schedulers:
-            s.shutdown()
+            s.shutdown(timeout=timeout)
 
     def __enter__(self):
         return self.start()
@@ -1983,6 +2198,12 @@ class SchedulerBackend:
         spec = self.scheduler.speculation_stats
         if spec is not None:
             out["speculation"] = spec
+        # Liveness view (serve/watchdog.py): heartbeat age/cadence, slots
+        # retired for per-lane stalls, and — when supervised — whole-loop
+        # stalls detected + the active stall threshold.
+        wd = getattr(self.scheduler, "watchdog_stats", None)
+        if wd is not None:
+            out["watchdog"] = wd
         sup = self.health()
         if sup is not None:
             out["supervisor"] = sup
@@ -2009,6 +2230,8 @@ class SchedulerBackend:
         supervise: bool = False,
         max_restarts: int = 5,
         journal_spill: Optional[str] = None,
+        stall_factor: float = 16.0,
+        stall_min_s: float = 10.0,
         **kwargs,
     ) -> "SchedulerBackend":
         """Deployment path for concurrent serving: HF checkpoint straight
@@ -2074,6 +2297,7 @@ class SchedulerBackend:
             return cls(SupervisedScheduler(
                 make_sched, max_restarts=max_restarts,
                 spill_path=journal_spill,
+                stall_factor=stall_factor, stall_min_s=stall_min_s,
                 name=f"scheduler:{os.path.basename(ckpt_dir.rstrip('/'))}",
             ), tokenizer, **kwargs)
         return cls(make_sched(), tokenizer, **kwargs)
@@ -2100,6 +2324,8 @@ class SchedulerBackend:
         supervise: bool = False,
         max_restarts: int = 5,
         journal_spill: Optional[str] = None,
+        stall_factor: float = 16.0,
+        stall_min_s: float = 10.0,
         **kwargs,
     ) -> "SchedulerBackend":
         """GGUF blob -> continuous-batching scheduler (C++ parse + dequant,
@@ -2153,6 +2379,7 @@ class SchedulerBackend:
             return cls(SupervisedScheduler(
                 make_sched, max_restarts=max_restarts,
                 spill_path=journal_spill,
+                stall_factor=stall_factor, stall_min_s=stall_min_s,
                 name=f"scheduler:{os.path.basename(gguf_path)}",
             ), tokenizer, **kwargs)
         return cls(make_sched(), tokenizer, **kwargs)

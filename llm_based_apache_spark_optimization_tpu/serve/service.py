@@ -139,7 +139,11 @@ class GenerationService:
         order = {"ready": 0, "degraded": 1, "restarting": 2, "dead": 3}
         worst = "ready"
         models: Dict[str, Dict] = {}
-        totals = {"restarts": 0, "replayed": 0, "lost": 0}
+        # `stalls` counts watchdog-detected wedges (serve/watchdog.py): a
+        # stalled loop surfaces as `restarting` here the moment the
+        # monitor escalates it — /readyz must stop saying ready while
+        # requests silently sit on a wedged device.
+        totals = {"restarts": 0, "replayed": 0, "lost": 0, "stalls": 0}
         with self._lock:
             entries = list(self._models.values())
         seen = set()

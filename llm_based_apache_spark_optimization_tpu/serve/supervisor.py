@@ -41,6 +41,23 @@ that supervisor, wrapped around `ContinuousBatchingScheduler` (or a
   journaled fails typed, new submits are refused. A breaker named
   `scheduler-restart` records each crash/recovery so the per-dependency
   breaker view in `/metrics` includes the engine itself.
+- **Liveness (the watchdog).** Everything above only fires when a failure
+  *raises*. A WEDGED loop — hung XLA dispatch, stuck device tunnel — never
+  raises: without detection, queued requests sit until their deadlines
+  burn while `/readyz` keeps saying `ready`. The supervisor runs a monitor
+  thread that reads the inner scheduler's `heartbeat` (stamped every event
+  -loop iteration, serve/watchdog.py) and, when a BUSY loop's heartbeat
+  age exceeds `max(stall_min_s, stall_factor × measured round cadence)`
+  (LSOT_STALL_MIN_S / LSOT_STALL_FACTOR), escalates the wedge to a
+  synthetic `SchedulerStalled` — a `SchedulerCrashed` subclass, so the
+  SAME restart/journal/replay machinery recovers hung requests exactly
+  like crashed ones. Teardown of a wedged loop uses a BOUNDED join (the
+  zombie daemon thread is abandoned and exits when it unwedges); during
+  the restart, `retry_after_hint()` includes the backoff remaining so
+  429/503 hints stay honest instead of quoting a stale EWMA over a frozen
+  queue. Counters: `sched_stalls` in /metrics, `stalls` +
+  `stall_threshold_s` in health()/`watchdog_stats`.
+
 - **Drain.** `drain(deadline_s)` stops admitting (new submits raise
   `Draining` → 503 + Retry-After), waits for in-flight work up to the
   drain deadline, then journals what is left to the optional on-disk
@@ -62,6 +79,7 @@ faults.
 from __future__ import annotations
 
 import dataclasses
+import inspect
 import json
 import logging
 import os
@@ -83,7 +101,9 @@ from .resilience import (
     Overloaded,
     RetryPolicy,
     SchedulerCrashed,
+    SchedulerStalled,
 )
+from .watchdog import stall_threshold
 
 _log = logging.getLogger("lsot.supervisor")
 
@@ -151,6 +171,9 @@ class SupervisedScheduler:
         rng: Optional[random.Random] = None,
         sleep: Callable[[float], None] = time.sleep,
         name: Optional[str] = None,
+        stall_factor: float = 16.0,
+        stall_min_s: float = 10.0,
+        stall_join_s: Optional[float] = None,
     ):
         if max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
@@ -184,6 +207,38 @@ class SupervisedScheduler:
         self._restarts = 0
         self._replayed = 0
         self._lost = 0
+        # Watchdog (serve/watchdog.py): a monitor thread compares the
+        # inner loop's heartbeat age against
+        # max(stall_min_s, stall_factor × measured round cadence) and
+        # escalates a busy-but-stale loop to a synthetic SchedulerStalled.
+        # stall_min_s <= 0 disables monitoring entirely; the floor must
+        # sit above the worst legitimate host-thread occupation (a cold
+        # XLA compile of an unwarmed bucket blocks the loop exactly like
+        # a wedge — warmup() first, or raise the floor).
+        self.stall_factor = float(stall_factor)
+        self.stall_min_s = float(stall_min_s)
+        # How long teardown waits for a (possibly wedged) loop thread to
+        # join before abandoning it — a wedged join must not block the
+        # restart driver for the length of the hang it is recovering from.
+        # None = unbounded: with the watchdog DISABLED (stall_min_s <= 0,
+        # the operator's opt-out for legitimately slow rounds) nothing
+        # ever flags a loop as wedged, so teardown must never abandon a
+        # healthy worker mid-round either.
+        if stall_join_s is not None:
+            self._stall_join_s: Optional[float] = float(stall_join_s)
+        elif self.stall_min_s > 0:
+            self._stall_join_s = max(1.0, self.stall_min_s)
+        else:
+            self._stall_join_s = None
+        self._stalls = 0
+        # Expected-recovery instant (monotonic) while a restart backoff
+        # sleep is pending: retry_after_hint() folds it in so shed/drain
+        # hints during a stall stay honest (the inner's queue-depth ×
+        # service-time estimate is frozen while the loop is down).
+        self._restart_eta: Optional[float] = None
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        self._warned_unspillable = False
         # Single-flight drain: orchestrators commonly repeat SIGTERM, and
         # a second concurrent drain would cut the first's grace period
         # short and rewrite ('w' mode) the spill it just wrote.
@@ -211,18 +266,33 @@ class SupervisedScheduler:
 
     def start(self) -> "SupervisedScheduler":
         self._inner.start()
+        if self.stall_min_s > 0 and self._watch_thread is None \
+                and getattr(self._inner, "heartbeat", None) is not None:
+            self._watch_stop.clear()
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, daemon=True,
+                name="lsot-supervisor-watchdog",
+            )
+            self._watch_thread.start()
         return self
 
     def shutdown(self) -> None:
         """Stop the inner loop; fail anything still journaled (clean
         shutdown is not a crash: no restart, no replay). Idempotent."""
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join()
+            self._watch_thread = None
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             pending = [e for e in self._journal.values() if not e.done]
         try:
-            self._inner.shutdown()
+            # Bounded even on the clean path: a SIGTERM aimed at a wedged
+            # loop must not hang the exit the drain deadline exists to
+            # bound (the abandoned daemon zombie dies with the process).
+            self._shutdown_inner(self._inner)
         except Exception:  # noqa: BLE001 — a broken inner must not wedge close
             _log.exception("inner scheduler shutdown failed")
         exc = RuntimeError("scheduler shut down mid-request")
@@ -288,8 +358,23 @@ class SupervisedScheduler:
         return getattr(self._inner, "speculation_stats", None)
 
     def retry_after_hint(self) -> float:
-        hint = getattr(self._inner, "retry_after_hint", None)
-        return hint() if callable(hint) else 1.0
+        """The inner scheduler's queue-depth × service-time estimate —
+        except while the loop is down (stalled/crashed, mid-restart):
+        then the inner's EWMA is stale and its queue frozen, so the hint
+        is clamped to at least the restart backoff remaining (the
+        watchdog's expected-recovery time). Clamped to [1, 60] s like the
+        scheduler's own estimate."""
+        with self._lock:
+            restarting = self._state == "restarting"
+            eta = self._restart_eta
+        try:
+            hint = getattr(self._inner, "retry_after_hint", None)
+            base = hint() if callable(hint) else 1.0
+        except Exception:  # noqa: BLE001 — a dead/churning inner mid-restart
+            base = 1.0
+        if restarting and eta is not None:
+            base = max(base, eta - time.monotonic())
+        return float(min(60.0, max(1.0, base)))
 
     # ---------------------------------------------------------------- client
 
@@ -350,6 +435,25 @@ class SupervisedScheduler:
                 raise RuntimeError("scheduler has shut down")
             if self._state == "dead":
                 raise self._dead_error()
+            if constraint is not None \
+                    and not isinstance(constraint_spec, (str, dict)):
+                # A raw pre-compiled CompiledMask with no serializable
+                # spec cannot survive the drain spill (there is nothing
+                # portable to write): it fails typed at spill time. Count
+                # and warn NOW so operators see the exposure before a
+                # drain makes it a lost request — the last recovery gap
+                # ROADMAP's crash-recovery item documents.
+                resilience.inc("unspillable_constraints")
+                if not self._warned_unspillable:
+                    self._warned_unspillable = True
+                    _log.warning(
+                        "constrained request submitted with a pre-compiled "
+                        "constraint and no serializable spec: it cannot be "
+                        "journal-spilled across a drain (pass the grammar "
+                        "name/schema dict as constraint_spec). Counted at "
+                        "/metrics resilience.unspillable_constraints; "
+                        "warning once."
+                    )
             entry = JournalEntry(
                 rid=self._next_rid,
                 ids=list(ids),
@@ -423,7 +527,10 @@ class SupervisedScheduler:
     # ---------------------------------------------------------------- health
 
     def health(self) -> Dict[str, object]:
-        """The `/readyz` payload: lifecycle state + restart counters."""
+        """The `/readyz` payload: lifecycle state + restart counters.
+        A loop the watchdog caught wedged reports `restarting` here (the
+        escalation rides the crash path), with `stalls` counting how many
+        times liveness — not an exception — triggered the recovery."""
         with self._lock:
             return {
                 "state": self._state,
@@ -432,12 +539,34 @@ class SupervisedScheduler:
                 "max_restarts": self.max_restarts,
                 "replayed": self._replayed,
                 "lost": self._lost,
+                "stalls": self._stalls,
                 "journal_depth": sum(
                     1 for e in self._journal.values() if not e.done
                 ),
                 "last_crash": (str(self._crash_exc)
                                if self._crash_exc is not None else None),
             }
+
+    @property
+    def heartbeat(self):
+        """The live inner loop's heartbeat (None for heartbeat-less
+        duck-typed inners) — what the monitor thread reads."""
+        return getattr(self._inner, "heartbeat", None)
+
+    @property
+    def watchdog_stats(self) -> Dict[str, object]:
+        """/metrics liveness view: the inner's heartbeat + per-slot stall
+        retirements, plus this supervisor's whole-loop stall detections
+        and the threshold currently in force."""
+        inner = getattr(self._inner, "watchdog_stats", None)
+        out: Dict[str, object] = dict(inner) if inner is not None else {}
+        hb = self.heartbeat
+        out["stalls_detected"] = self._stalls
+        out["stall_threshold_s"] = (
+            round(stall_threshold(hb, self.stall_factor, self.stall_min_s), 3)
+            if hb is not None and self.stall_min_s > 0 else None
+        )
+        return out
 
     # ----------------------------------------------------------------- drain
 
@@ -691,15 +820,26 @@ class SupervisedScheduler:
                 self._crash_exc, "crash_traceback", "")
         return err
 
-    def _make_on_token(self, entry: JournalEntry) -> Callable[[int], None]:
+    def _make_on_token(self, entry: JournalEntry):
         """Per-attempt token tap: counts/records delivered tokens for
         replay, suppressing the prefix the client already received (the
-        replayed stream is byte-identical — per-request seeded RNG)."""
+        replayed stream is byte-identical — per-request seeded RNG).
+        Returns `(tap, cell)`; the caller binds `cell["fut"]` to the
+        attempt's inner future right after submit so the tap can tell
+        whether it still speaks for `entry` — an ABANDONED zombie
+        incarnation (wedged loop the bounded join gave up on) may
+        unwedge and harvest a round long after the replay installed a
+        fresh attempt, and its late tokens must reach neither
+        `entry.generated` nor the client a second time."""
         suppress = len(entry.generated)
         seen = 0
+        cell: Dict[str, object] = {"fut": None}
 
         def tap(tok: int) -> None:
             nonlocal seen
+            f = cell["fut"]
+            if f is not None and entry.inner is not f:
+                return  # stale attempt from a torn-down/abandoned incarnation
             seen += 1
             if seen <= suppress:
                 return
@@ -710,7 +850,7 @@ class SupervisedScheduler:
                 except Exception:  # noqa: BLE001 — consumer bugs must not break accounting
                     entry.on_token = None
 
-        return tap
+        return tap, cell
 
     def _submit_entry_locked(self, entry: JournalEntry) -> None:
         if entry.deadline is not None:
@@ -723,12 +863,19 @@ class SupervisedScheduler:
             deadline_s = rem
         else:
             deadline_s = None
+        # Invalidate any prior attempt BEFORE the new tap snapshots its
+        # suppression prefix: a zombie tap firing from here on sees
+        # `entry.inner is not` its own future and drops the token, so the
+        # prefix length cannot grow under the snapshot.
+        entry.inner = None
+        tap, cell = self._make_on_token(entry)
         fut = self._inner.submit(
             entry.ids, max_new_tokens=entry.max_new, sampling=entry.sampling,
-            seed=entry.seed, on_token=self._make_on_token(entry),
+            seed=entry.seed, on_token=tap,
             constraint=entry.constraint, deadline_s=deadline_s,
         )
         entry.inner = fut
+        cell["fut"] = fut
         if entry.cancelled:  # cancelled while the loop was down
             req = getattr(fut, "_lsot_request", None)
             if req is not None:
@@ -817,8 +964,15 @@ class SupervisedScheduler:
         while True:
             old = self._inner
             try:
-                old.shutdown()  # joins the dead worker: all its
-            except Exception:   # done-callbacks have run past this point
+                # Joins the dead worker (all its done-callbacks have run
+                # past this point) — BOUNDED: a worker the watchdog caught
+                # WEDGED never joins, so schedulers that support a join
+                # timeout get one and the zombie daemon thread is
+                # abandoned (it exits when it unwedges; its late
+                # callbacks are superseded by the replay's fresh inner
+                # futures — the `entry.inner is not fut` staleness guard).
+                self._shutdown_inner(old)
+            except Exception:
                 _log.exception("dead scheduler teardown failed; continuing")
             with self._lock:
                 if self._closed:
@@ -829,9 +983,23 @@ class SupervisedScheduler:
                 attempt = self._restarts
                 self._restarts += 1
             resilience.inc("sched_restarts")
-            self._sleep(self._restart_policy.delay_s(attempt, self._rng))
+            delay = self._restart_policy.delay_s(attempt, self._rng)
+            with self._lock:
+                # Published for retry_after_hint: shed/drain hints during
+                # the outage promise at least the backoff remaining.
+                self._restart_eta = time.monotonic() + delay
+            self._sleep(delay)
             try:
                 inner = self._factory()
+                # Warm BEFORE serving: a rebuilt scheduler recompiles its
+                # XLA programs, and a cold first round blocks the fresh
+                # loop's thread exactly like the wedge this restart may be
+                # recovering from — the watchdog would re-flag it and burn
+                # the budget on compiles. Warming happens here, while the
+                # state is `restarting` and the monitor is quiet.
+                warm = getattr(inner, "warmup", None)
+                if callable(warm):
+                    warm()
                 inner.start()
             except Exception:  # noqa: BLE001 — rebuild failure burns one restart credit
                 _log.exception("scheduler rebuild failed (restart %d/%d)",
@@ -848,6 +1016,7 @@ class SupervisedScheduler:
                 except _CrashedAgain:
                     continue  # the fresh loop died mid-replay: go again
                 self._state = "degraded" if lost else "ready"
+                self._restart_eta = None
                 self._breaker.record_success()
                 _log.info(
                     "scheduler restarted (restart %d/%d): state=%s lost=%d",
@@ -937,8 +1106,67 @@ class SupervisedScheduler:
             resilience.inc("sched_replayed")
         return lost
 
+    def _shutdown_inner(self, sched) -> None:
+        """Shut an inner scheduler down with a bounded join when it
+        supports one (ContinuousBatchingScheduler/SchedulerPool do);
+        duck-typed inners without a timeout parameter get the plain
+        call. The bound is what keeps teardown of a WEDGED loop from
+        hanging the restart driver for the length of the hang it is
+        recovering from; with the watchdog disabled (`_stall_join_s` is
+        None) the join is unbounded — nothing can have flagged the loop
+        as wedged, so a healthy slow round must not be abandoned."""
+        try:
+            takes_timeout = "timeout" in inspect.signature(
+                sched.shutdown
+            ).parameters
+        except (TypeError, ValueError):  # builtins/uninspectable callables
+            takes_timeout = False
+        if takes_timeout and self._stall_join_s is not None:
+            sched.shutdown(timeout=self._stall_join_s)
+        else:
+            sched.shutdown()
+
+    def _watch_loop(self) -> None:
+        """The watchdog monitor: poll the live inner's heartbeat and
+        escalate a busy loop whose stamp has gone stale past the stall
+        threshold. One escalation per episode — the state gate (only
+        ready/degraded loops are judged) and the heartbeat identity check
+        keep the monitor from re-flagging a loop already being rebuilt or
+        flagging the fresh one with the corpse's stale reading."""
+        poll = max(0.02, min(0.25, self.stall_min_s / 4.0))
+        while not self._watch_stop.wait(poll):
+            with self._lock:
+                if self._closed:
+                    return
+                if self._state not in ("ready", "degraded"):
+                    continue
+                inner = self._inner
+            hb = getattr(inner, "heartbeat", None)
+            if hb is None or not hb.busy:
+                continue
+            age = hb.age()
+            threshold = stall_threshold(hb, self.stall_factor,
+                                        self.stall_min_s)
+            if age <= threshold:
+                continue
+            exc = SchedulerStalled(
+                f"decode loop made no progress for {age:.2f}s "
+                f"(stall threshold {threshold:.2f}s) with work in flight: "
+                f"escalating the wedge to a restart"
+            )
+            with self._lock:
+                if self._closed or self._state not in ("ready", "degraded"):
+                    continue
+                if self._inner is not inner:
+                    continue  # the wedged incarnation is already gone
+                self._stalls += 1
+                resilience.inc("sched_stalls")
+                _log.warning("watchdog: %s", exc)
+                self._notice_crash_locked(exc)
+
     def _die_locked(self) -> None:
         self._state = "dead"
+        self._restart_eta = None
         err = self._dead_error()
         _log.error("supervisor giving up: %s", err)
         for e in list(self._journal.values()):

@@ -91,7 +91,8 @@ class ResilientSQLBackend:
     infra failures: when the engine itself is down, requests shed with
     `CircuitOpen` instead of each burning a full retry ladder, and the
     pipeline degrades along its existing SQL-failure path. Chaos seams:
-    `sql:load` and `sql:exec` (utils/faults.py)."""
+    `sql:load`, `sql:exec`, and the duration-valued `sql:stall`
+    (utils/faults.py)."""
 
     def __init__(self, inner: SQLBackend, retry=None, breaker=None,
                  rng: Optional[random.Random] = None):
@@ -122,6 +123,10 @@ class ResilientSQLBackend:
             raise self._breaker.shed()
 
         def attempt() -> ResultTable:
+            # `sql:stall:p:secs` (duration-valued): a SQL engine that is
+            # up but SLOW — the check sleeps, then the query runs, so
+            # caller-side deadlines see real elapsed time.
+            FAULTS.check("sql:stall")
             FAULTS.check("sql:exec")
             return self.inner.execute(sql)
 

@@ -8,22 +8,36 @@ make the out-of-process boundaries fail ON DEMAND, reproducibly:
 
     LSOT_FAULTS=ollama:connect:0.5,sql:exec:1 LSOT_FAULTS_SEED=0 pytest -m chaos
 
-Spec grammar: comma-separated `site:point:probability` triples. The first
-two fields name an injection site (`ollama:connect`, `sql:exec`,
-`sql:load`, `sched:decode` — kills the loop at round issue, before any
-token of the round exists — and `sched:crash` — kills it at harvest,
-MID-BATCH, after tokens may already have streamed to clients: the
-supervisor's replay-without-duplicates seam; grep for `FAULTS.check` to
-enumerate); the probability is a float in (0, 1]. The RNG is seeded
-(`LSOT_FAULTS_SEED`, default 0), so the same spec + seed + call sequence
-replays the exact same fault schedule — chaos tests assert concrete
-outcomes, not distributions.
+Spec grammar: comma-separated `site:point:probability[:seconds]` entries.
+The first two fields name an injection site (`ollama:connect`,
+`sql:exec`, `sql:load`, `sched:decode` — kills the loop at round issue,
+before any token of the round exists — `sched:crash` — kills it at
+harvest, MID-BATCH, after tokens may already have streamed to clients:
+the supervisor's replay-without-duplicates seam — `sched:slot_stall` —
+marks a request's slot as a silently no-progress decode lane, the
+per-slot stall-retirement seam — plus the duration-valued HANG sites
+below; grep for `FAULTS.check` to enumerate); the probability is a float
+in (0, 1]. The RNG is seeded (`LSOT_FAULTS_SEED`, default 0), so the
+same spec + seed + call sequence replays the exact same fault schedule —
+chaos tests assert concrete outcomes, not distributions.
+
+**Duration-valued sites** (the optional 4th field, seconds > 0) model
+HANGS instead of failures: a firing check SLEEPS for that long and then
+returns instead of raising — the wedge that never raises is exactly what
+the watchdog layer (serve/watchdog.py) exists to catch. `sched:hang:1:5`
+wedges the decode loop 5 s at round issue (the supervisor's heartbeat
+monitor must escalate it to a `SchedulerStalled` restart);
+`ollama:stall:p:secs` and `sql:stall:p:secs` stall the out-of-process
+boundaries so dependency timeouts/deadlines are exercised, not assumed.
+Site names are always exactly two `:`-separated segments — the parser
+relies on it to tell `site:point:prob:secs` from a malformed entry.
 
 Injection points call `FAULTS.check("site:point")`, which raises
 `InjectedFault` (a ConnectionError subclass, so connect-phase retry
-classifiers treat it exactly like a real refused connection) with the
-configured probability. With no spec configured the check is one dict
-lookup on an empty dict — effectively free on the serving path.
+classifiers treat it exactly like a real refused connection) — or, for a
+duration-valued site, sleeps — with the configured probability. With no
+spec configured the check is one dict lookup on an empty dict —
+effectively free on the serving path.
 
 Determinism caveat: the registry draws from ONE seeded stream, so replay
 is exact only when the injection points are hit in a deterministic order
@@ -36,7 +50,8 @@ from __future__ import annotations
 import os
 import random
 import threading
-from typing import Dict
+import time
+from typing import Dict, Tuple
 
 from .observability import resilience
 
@@ -59,26 +74,46 @@ class FaultRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._probs: Dict[str, float] = {}
+        self._durations: Dict[str, float] = {}
         self._rng = random.Random(0)
         self._counts: Dict[str, int] = {}
+        # Injectable so hang-site tests assert the sleep without paying it.
+        self._sleep = time.sleep
 
     # ------------------------------------------------------------- config
 
-    @staticmethod
-    def parse(spec: str) -> Dict[str, float]:
+    @classmethod
+    def parse(cls, spec: str) -> Dict[str, float]:
         """`"ollama:connect:0.5,sql:exec:1"` -> {"ollama:connect": 0.5,
-        "sql:exec": 1.0}. Raises ValueError on malformed entries — a typo'd
-        chaos spec must fail the run, not silently inject nothing."""
+        "sql:exec": 1.0} (probabilities only; duration fields are dropped
+        — use parse_spec for both). Raises ValueError on malformed
+        entries — a typo'd chaos spec must fail the run, not silently
+        inject nothing."""
+        return cls.parse_spec(spec)[0]
+
+    @staticmethod
+    def parse_spec(spec: str) -> Tuple[Dict[str, float], Dict[str, float]]:
+        """Full parse: (probabilities, durations). An entry is
+        `site:point:prob` (raising site) or `site:point:prob:secs`
+        (duration-valued hang site: the check SLEEPS secs instead of
+        raising). Site names are exactly two segments."""
         probs: Dict[str, float] = {}
+        durations: Dict[str, float] = {}
         for entry in filter(None, (s.strip() for s in spec.split(","))):
-            parts = entry.rsplit(":", 1)
-            if len(parts) != 2 or ":" not in parts[0]:
+            fields = entry.split(":")
+            if len(fields) not in (3, 4):
                 raise ValueError(
-                    f"bad fault spec entry {entry!r} (want site:point:prob)"
+                    f"bad fault spec entry {entry!r} "
+                    f"(want site:point:prob[:secs])"
                 )
-            site, prob_s = parts
+            site = f"{fields[0]}:{fields[1]}"
+            if not fields[0] or not fields[1]:
+                raise ValueError(
+                    f"bad fault spec entry {entry!r} "
+                    f"(want site:point:prob[:secs])"
+                )
             try:
-                prob = float(prob_s)
+                prob = float(fields[2])
             except ValueError:
                 raise ValueError(
                     f"bad fault probability in {entry!r}"
@@ -88,14 +123,28 @@ class FaultRegistry:
                     f"fault probability must be in (0, 1], got {prob} "
                     f"in {entry!r}"
                 )
+            if len(fields) == 4:
+                try:
+                    secs = float(fields[3])
+                except ValueError:
+                    raise ValueError(
+                        f"bad hang duration in {entry!r}"
+                    ) from None
+                if secs <= 0.0:
+                    raise ValueError(
+                        f"hang duration must be positive, got {secs} "
+                        f"in {entry!r}"
+                    )
+                durations[site] = secs
             probs[site] = prob
-        return probs
+        return probs, durations
 
     def configure(self, spec: str, seed: int = 0) -> "FaultRegistry":
         """(Re)configure sites + reseed the stream; empty spec disables."""
-        probs = self.parse(spec)
+        probs, durations = self.parse_spec(spec)
         with self._lock:
             self._probs = probs
+            self._durations = durations
             self._rng = random.Random(seed)
             self._counts = {}
         return self
@@ -109,6 +158,7 @@ class FaultRegistry:
     def clear(self) -> None:
         with self._lock:
             self._probs = {}
+            self._durations = {}
             self._counts = {}
 
     @property
@@ -118,7 +168,10 @@ class FaultRegistry:
     # ----------------------------------------------------------- checking
 
     def check(self, site: str) -> None:
-        """Raise InjectedFault with the site's configured probability."""
+        """Raise InjectedFault with the site's configured probability —
+        or, for a duration-valued site (`site:point:prob:secs`), SLEEP
+        that long and return: the hang that never raises, which the
+        watchdog layer must detect from outside."""
         if not self._probs:  # fast path: injection off
             return
         with self._lock:
@@ -126,7 +179,12 @@ class FaultRegistry:
             if prob is None or self._rng.random() >= prob:
                 return
             self._counts[site] = self._counts.get(site, 0) + 1
+            secs = self._durations.get(site)
         resilience.inc("faults_injected")
+        if secs is not None:
+            # Outside the lock: a wedge must not block other sites' checks.
+            self._sleep(secs)
+            return
         raise InjectedFault(site)
 
     def counts(self) -> Dict[str, int]:

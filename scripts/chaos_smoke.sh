@@ -2,15 +2,19 @@
 # Chaos smoke: the fault-injection test lane under a FIXED spec + seed.
 #
 # Runs every `chaos`-marked test (scheduler crash typing + supervised
-# crash-restart-replay, admission shedding, retry/breaker behavior at the
-# Ollama and SQL boundaries, the chaos evalh report) with
-# LSOT_FAULTS/LSOT_FAULTS_SEED pinned so the injected fault schedule —
-# and therefore every assertion — replays exactly, then runs the
-# crash-restart scenario end to end through `evalh --chaos` (supervised
-# scheduler under sched:crash: zero hung, zero lost acknowledged
-# requests, restart/replay counts in the summary). These tests are NOT
-# marked slow: the default tier-1 run (`pytest -m 'not slow'`) includes
-# them; this script is the focused lane for iterating on the
+# crash-restart-replay, HANG detection — the watchdog escalating a wedged
+# decode loop injected via the duration-valued `sched:hang` site —
+# admission shedding, retry/breaker behavior at the Ollama and SQL
+# boundaries, the chaos evalh report) with LSOT_FAULTS/LSOT_FAULTS_SEED
+# pinned so the injected fault schedule — and therefore every assertion —
+# replays exactly, then runs the crash-restart AND hang-detection
+# scenarios end to end through `evalh --chaos` (supervised scheduler
+# under sched:crash: zero hung, zero lost acknowledged requests,
+# restart/replay counts in the summary; then the watchdog stage: a
+# wedged loop detected within the stall threshold, restarted, replayed —
+# zero silently-hung clients, bounded detection latency). These tests
+# are NOT marked slow: the default tier-1 run (`pytest -m 'not slow'`)
+# includes them; this script is the focused lane for iterating on the
 # fault-tolerance layer.
 #
 #   LSOT_FAULTS=... LSOT_FAULTS_SEED=... scripts/chaos_smoke.sh [pytest args]
@@ -23,10 +27,13 @@ export JAX_PLATFORMS=cpu
 
 python -m pytest tests -q -m chaos -p no:cacheprovider "$@"
 
-# Crash-restart scenario in the default lane: the supervised scheduler
-# must survive injected mid-batch loop deaths with zero lost acknowledged
-# requests (run_chaos asserts it; the JSON summary shows
-# restarts/replayed/lost).
+# Crash-restart + hang-detection scenarios in the default lane: the
+# supervised scheduler must survive injected mid-batch loop deaths with
+# zero lost acknowledged requests, and the watchdog must detect an
+# injected WEDGE (sched:hang — the loop sleeps, nothing raises) and
+# recover it with zero silently-hung clients (run_chaos asserts both;
+# the JSON summary shows restarts/replayed/lost and the watchdog stage's
+# stalls/detection bound).
 LSOT_FAULTS= python -m llm_based_apache_spark_optimization_tpu.evalh \
   --chaos "ollama:connect:0.5,sql:exec:1,sched:crash:0.2" \
   --chaos-seed "${LSOT_FAULTS_SEED}"

@@ -77,6 +77,41 @@ def test_bench_incremental_lines_and_leg_status():
         assert ln["value"] == final["value"]
 
 
+def test_watchdog_overhead_measured():
+    """The scheduler leg's liveness-tax record (serve/watchdog.py): the
+    busy-flag scan + one heartbeat stamp + one round_done per harvest
+    round, priced in ns so the artifact carries a measurement, not an
+    assumption."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+
+    out = bench._watchdog_overhead(n=2000)
+    assert out["stamp_ns"] > 0 and out["round_done_ns"] > 0
+    assert "busy_scan_ns" not in out  # no scheduler passed: stamp-only
+    assert out["per_round_ns"] == pytest.approx(
+        out["stamp_ns"] + out["round_done_ns"], rel=0.01)
+    # Sanity ceiling: a lock + a few float ops. Even a slow CI box should
+    # land far under 100µs per round — the hot path's rounds are ms-scale.
+    assert out["per_round_ns"] < 100_000
+
+    class FakeSched:
+        def __init__(self):
+            self.calls = 0
+
+        def _busy_now(self):
+            self.calls += 1
+            return True
+
+    fake = FakeSched()
+    out = bench._watchdog_overhead(n=500, sched=fake)
+    # With a scheduler, the busy scan is timed on IT and folded into the
+    # per-round total — the O(num_slots) sweep is part of the real tax.
+    assert fake.calls == 500 and out["busy_scan_ns"] > 0
+    assert out["per_round_ns"] == pytest.approx(
+        out["busy_scan_ns"] + out["stamp_ns"] + out["round_done_ns"],
+        rel=0.01)
+
+
 def test_probe_accel_outcomes():
     """The pre-accel tunnel probe (BENCH_r04/r05: two 700s core slices
     burned on a hung tunnel): success, nonzero exit, and a hang must each

@@ -268,6 +268,30 @@ def test_resilient_sql_retries_transient_then_succeeds():
     assert rb._breaker.state == "closed"
 
 
+def test_sql_stall_site_sleeps_then_query_proceeds():
+    """`sql:stall:p:secs` (duration-valued, utils/faults.py): the engine
+    is up but SLOW — the check sleeps and the query still succeeds, so
+    caller-side deadlines see real elapsed time instead of an instant
+    typed error."""
+    from llm_based_apache_spark_optimization_tpu.sql import ResilientSQLBackend
+
+    FAULTS.configure("sql:stall:1:0.5", 0)
+    slept = []
+    real_sleep = FAULTS._sleep
+    FAULTS._sleep = slept.append  # assert the stall without paying it
+    try:
+        inner = _FlakySQL(fail_first=0)
+        rb = ResilientSQLBackend(inner, retry=_fast_retry(),
+                                 rng=random.Random(0))
+        out = rb.execute("SELECT 1")
+    finally:
+        FAULTS._sleep = real_sleep
+    assert out.rows == [(1,)] and inner.calls == 1  # slow, not failed
+    assert slept == [0.5]
+    assert FAULTS.counts() == {"sql:stall": 1}
+    assert rb._breaker.state == "closed"  # a stall is not an infra failure
+
+
 def test_resilient_sql_deterministic_error_not_retried_or_counted():
     import sqlite3
 

@@ -408,8 +408,13 @@ def test_scheduler_backend_from_hf_checkpoint(tiny_model_module, tmp_path):
 @pytest.mark.slow
 def test_warmup_compiles_all_kbuckets_without_state_change(tiny_model_module):
     """warmup() builds every (bucket, k-bucket) prefill variant and runs
-    them against the OOB padding slot — no slot/cache state changes, and
-    subsequent generates stay engine-exact."""
+    them against the OOB padding slot — no VISIBLE slot/cache state
+    changes, and subsequent generates stay engine-exact. The all-inactive
+    decode round warmup() now also runs (compiling the decode program so
+    a cold compile can't read as a watchdog wedge) writes garbage at the
+    PARK row only — the last seq position, which no query can ever see
+    (the cache visibility invariant); every visible row must be
+    untouched."""
     import numpy as np
 
     cfg, params = tiny_model_module
@@ -417,7 +422,9 @@ def test_warmup_compiles_all_kbuckets_without_state_change(tiny_model_module):
     before_k = np.asarray(sched._cache[0])
     sched.warmup()
     assert {kb for (_, kb) in sched._prefill_fns} == set(sched._kbuckets)
-    np.testing.assert_array_equal(np.asarray(sched._cache[0]), before_k)
+    after_k = np.asarray(sched._cache[0])
+    np.testing.assert_array_equal(after_k[..., : sched._park, :],
+                                  before_k[..., : sched._park, :])
     golden = engine_golden(cfg, params, PROMPTS[:2], max_new=4)
     with sched:
         assert sched.generate(PROMPTS[:2], max_new_tokens=4) == golden
@@ -468,3 +475,41 @@ def test_scheduler_fused_matmuls_parity(tiny_model_module):
         with fused:
             out = fused.generate(prompts, max_new_tokens=8)
         assert out == golden, f"spec={spec}"
+
+
+@pytest.mark.chaos
+def test_slot_stall_retired_typed_batch_unaffected(tiny_model_module):
+    """Per-slot stall retirement (serve/watchdog layer, scheduler side):
+    a slot whose generation makes no progress for `slot_stall_rounds`
+    harvested rounds is retired typed SlotStalled (504-family) WITHOUT
+    restarting the loop — and the other slots' outputs are
+    token-identical to a run without the stalled request. Injected via
+    the `sched:slot_stall` chaos seam (submit-thread-scoped, so exactly
+    one request wedges)."""
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        SlotStalled,
+    )
+    from llm_based_apache_spark_optimization_tpu.utils.faults import FAULTS
+
+    cfg, params = tiny_model_module
+    with make_sched(cfg, params, num_slots=3) as ctl:
+        expected = ctl.generate([[1, 6], [1, 7]], max_new_tokens=8)
+
+    sched = make_sched(cfg, params, num_slots=3, slot_stall_rounds=3)
+    try:
+        with sched:
+            FAULTS.configure("sched:slot_stall:1", seed=0)
+            stalled = sched.submit([1, 5], max_new_tokens=8)
+            FAULTS.clear()
+            others = [sched.submit([1, 6], max_new_tokens=8),
+                      sched.submit([1, 7], max_new_tokens=8)]
+            outs = [f.result(timeout=120) for f in others]
+            with pytest.raises(SlotStalled) as exc_info:
+                stalled.result(timeout=120)
+            assert "no progress" in str(exc_info.value)
+            # No restart happened: the SAME loop keeps serving new work.
+            assert len(sched.generate([[1, 9]], max_new_tokens=4)[0]) == 4
+        assert outs == expected  # neighbours token-identical to control
+        assert sched.watchdog_stats["slots_retired_stalled"] == 1
+    finally:
+        FAULTS.clear()

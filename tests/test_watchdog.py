@@ -1,0 +1,438 @@
+"""Hang detection (serve/watchdog.py + the supervisor's monitor thread).
+
+Host-only unit tests: heartbeat semantics, duration-valued fault sites,
+the watchdog escalating a wedged (busy-but-stale) loop to a synthetic
+`SchedulerStalled` restart+replay, the restart-aware Retry-After hint,
+and the unspillable-constraint exposure counter. The REAL-scheduler hang
+scenario lives in tests/test_supervisor.py (chaos lane); the end-to-end
+`evalh --chaos` hang stage is asserted here via its report.
+"""
+
+import random
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+    RetryPolicy,
+    SchedulerCrashed,
+    SchedulerStalled,
+)
+from llm_based_apache_spark_optimization_tpu.serve.supervisor import (
+    SupervisedScheduler,
+)
+from llm_based_apache_spark_optimization_tpu.serve.watchdog import (
+    CombinedHeartbeat,
+    Heartbeat,
+    stall_threshold,
+)
+from llm_based_apache_spark_optimization_tpu.utils.faults import (
+    FAULTS,
+    FaultRegistry,
+    InjectedFault,
+)
+from llm_based_apache_spark_optimization_tpu.utils.observability import (
+    resilience,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# ---------------------------------------------------------------- heartbeat
+
+
+def test_heartbeat_age_busy_and_round_ewma():
+    hb = Heartbeat(alpha=0.5)
+    hb.stamp(busy=True)
+    assert hb.busy and hb.age() < 0.5
+    assert hb.expected_round_s() is None  # needs two rounds for a delta
+    hb.round_done()
+    assert hb.expected_round_s() is None
+    time.sleep(0.02)
+    hb.round_done()
+    ewma = hb.expected_round_s()
+    assert ewma is not None and ewma >= 0.02
+    assert hb.rounds == 2
+    hb.stamp(busy=False)
+    assert not hb.busy
+    snap = hb.snapshot()
+    assert snap["rounds"] == 2 and snap["busy"] is False
+    assert snap["expected_round_s"] == round(ewma, 4)
+
+
+def test_idle_gap_never_feeds_round_ewma():
+    """An idle period between bursts must not inflate the cadence EWMA
+    (and with it the stall threshold): the idle stamp resets the
+    round-interval origin, so the first round after an hour of quiet
+    contributes no sample — the EWMA keeps remembering the last busy
+    burst's cadence instead of ballooning to the idle gap."""
+    hb = Heartbeat(alpha=0.5)
+    hb.round_done()
+    time.sleep(0.01)
+    hb.round_done()
+    ewma = hb.expected_round_s()
+    assert ewma is not None and ewma < 0.1
+    hb.stamp(busy=False)  # idle between requests
+    time.sleep(0.25)      # the "hour of quiet", scaled down
+    hb.stamp(busy=True)
+    hb.round_done()       # first harvested round of the new burst
+    # The 0.25s gap never entered the EWMA (it would have dragged the
+    # 0.5-alpha average above 0.12s).
+    assert hb.expected_round_s() == ewma
+    time.sleep(0.01)
+    hb.round_done()       # intra-burst interval: feeds it again
+    assert hb.expected_round_s() < 0.1
+
+
+def test_stall_threshold_floor_and_factor():
+    hb = Heartbeat()
+    # No cadence yet: the floor rules.
+    assert stall_threshold(hb, factor=8.0, floor_s=2.0) == 2.0
+    hb.round_done()
+    time.sleep(0.02)
+    hb.round_done()
+    ewma = hb.expected_round_s()
+    assert stall_threshold(hb, factor=1000.0, floor_s=0.001) == \
+        pytest.approx(1000.0 * ewma)
+    assert stall_threshold(hb, factor=0.001, floor_s=5.0) == 5.0
+
+
+def test_combined_heartbeat_oldest_busy_replica_wins():
+    a, b = Heartbeat(), Heartbeat()
+    a.stamp(busy=False)
+    b.stamp(busy=True)
+    combo = CombinedHeartbeat([a, b])
+    assert combo.busy
+    time.sleep(0.02)
+    a.stamp(busy=False)  # the idle replica keeps stamping...
+    # ...but the busy one went quiet: its age must dominate.
+    assert combo.age() >= 0.02
+    assert combo.age() >= b.age() - 0.001
+    snap = combo.snapshot()
+    assert len(snap["replicas"]) == 2 and snap["busy"] is True
+    with pytest.raises(ValueError):
+        CombinedHeartbeat([])
+
+
+# ---------------------------------------------- duration-valued fault sites
+
+
+def test_fault_spec_duration_parse_and_errors():
+    probs, durs = FaultRegistry.parse_spec("sched:hang:1.0:5,sql:exec:1")
+    assert probs == {"sched:hang": 1.0, "sql:exec": 1.0}
+    assert durs == {"sched:hang": 5.0}
+    # The probability-only view drops durations but keeps every site.
+    assert FaultRegistry.parse("sched:hang:1.0:5") == {"sched:hang": 1.0}
+    for bad in ("a:b:0.5:0", "a:b:0.5:-1", "a:b:0.5:x", "a:b:0.5:1:2",
+                ":b:0.5", "a::0.5"):
+        with pytest.raises(ValueError):
+            FaultRegistry.parse_spec(bad)
+
+
+def test_duration_site_sleeps_instead_of_raising():
+    reg = FaultRegistry().configure("x:y:1:0.25,x:z:1", seed=0)
+    slept = []
+    reg._sleep = slept.append
+    reg.check("x:y")  # hang site: sleeps, returns
+    assert slept == [0.25]
+    assert reg.counts() == {"x:y": 1}
+    with pytest.raises(InjectedFault):
+        reg.check("x:z")  # raising site unchanged
+
+
+# -------------------------------------------------------- monitor escalation
+
+
+class WedgeableInner:
+    """Host-only scheduler fake with a controllable heartbeat: the test
+    wedges it by simply not stamping. Futures resolve when the test says
+    so (ManualInner's contract, test_supervisor.py)."""
+
+    def __init__(self):
+        self.heartbeat = Heartbeat()
+        self.submitted = []
+        self.shut = False
+        self.join_timeout = "unset"
+
+    def start(self):
+        self.heartbeat.stamp(busy=False)
+        return self
+
+    def shutdown(self, timeout=None):
+        self.shut = True
+        self.join_timeout = timeout
+        for rec in self.submitted:
+            if not rec["future"].done():
+                rec["future"].set_exception(
+                    RuntimeError("scheduler shut down mid-request"))
+
+    def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
+               on_token=None, constraint=None, deadline_s=None):
+        rec = {"ids": list(ids), "on_token": on_token, "future": Future()}
+        self.submitted.append(rec)
+        self.heartbeat.stamp(busy=True)  # work in flight, then... silence
+        return rec["future"]
+
+    def finish(self, i, result):
+        self.submitted[i]["future"].set_result(list(result))
+
+
+def _sup(factory, **kw):
+    kw.setdefault("max_restarts", 3)
+    kw.setdefault("restart_policy", RetryPolicy(
+        max_attempts=kw["max_restarts"] + 1, base_delay_s=0.001,
+        max_delay_s=0.01))
+    kw.setdefault("rng", random.Random(0))
+    return SupervisedScheduler(factory, **kw)
+
+
+def test_watchdog_escalates_wedged_loop_and_replays():
+    """A busy inner that stops stamping past the stall threshold is
+    escalated to a synthetic SchedulerStalled: the journal replays on the
+    rebuilt inner and the client future resolves — a hang recovers
+    exactly like a crash."""
+    instances = []
+
+    def factory():
+        inner = WedgeableInner()
+        instances.append(inner)
+        return inner
+
+    stalls_before = resilience.get("sched_stalls")
+    sup = _sup(factory, stall_factor=4.0, stall_min_s=0.05).start()
+    f = sup.submit([1, 2])  # stamps busy=True, then the loop goes silent
+    wait_for(lambda: len(instances) == 2, msg="stall escalation + rebuild")
+    assert instances[0].shut
+    wait_for(lambda: len(instances[1].submitted) == 1, msg="replay")
+    h = sup.health()
+    assert h["stalls"] == 1 and h["restarts"] == 1
+    assert isinstance(sup._crash_exc, SchedulerStalled)
+    assert isinstance(sup._crash_exc, SchedulerCrashed)  # same machinery
+    assert resilience.get("sched_stalls") == stalls_before + 1
+    instances[1].finish(0, [7, 8])
+    assert f.result(timeout=5) == [7, 8]
+    assert sup.health()["state"] == "ready"
+    wd = sup.watchdog_stats
+    assert wd["stalls_detected"] == 1
+    assert wd["stall_threshold_s"] >= 0.05
+    sup.shutdown()
+
+
+def test_zombie_tap_after_replay_is_dropped():
+    """An ABANDONED (wedged-then-unwedged) incarnation may still harvest
+    a round and call its per-attempt token tap AFTER the replay installed
+    a fresh attempt. Its late tokens were already re-delivered by the
+    replay's seeded re-decode, so they must reach neither the client
+    stream nor the journal's delivered-prefix accounting — only the
+    attempt whose future is still `entry.inner` speaks for the entry."""
+    instances = []
+
+    def factory():
+        inner = WedgeableInner()
+        instances.append(inner)
+        return inner
+
+    received = []
+    sup = _sup(factory, stall_factor=4.0, stall_min_s=0.05).start()
+    f = sup.submit([1, 2], on_token=received.append)
+    old_tap = instances[0].submitted[0]["on_token"]
+    old_tap(7)  # genuine pre-wedge delivery
+    assert received == [7]
+    wait_for(lambda: len(instances) == 2, msg="stall escalation + rebuild")
+    wait_for(lambda: len(instances[1].submitted) == 1, msg="replay")
+    new_tap = instances[1].submitted[0]["on_token"]
+    new_tap(7)  # the replay re-generates the delivered prefix: suppressed
+    assert received == [7]
+    # The zombie unwedges NOW and flushes its stale round: dropped whole.
+    old_tap(7)
+    old_tap(8)
+    assert received == [7]
+    new_tap(8)  # the live attempt's fresh token is delivered once
+    assert received == [7, 8]
+    instances[1].finish(0, [7, 8])
+    assert f.result(timeout=5) == [7, 8]
+    sup.shutdown()
+
+
+def test_watchdog_ignores_idle_staleness():
+    """An IDLE loop legitimately goes quiet between requests: a stale
+    heartbeat with busy=False must never escalate."""
+    instances = []
+
+    def factory():
+        inner = WedgeableInner()
+        instances.append(inner)
+        return inner
+
+    sup = _sup(factory, stall_factor=4.0, stall_min_s=0.05).start()
+    # start() stamped busy=False and nothing ever stamps again.
+    time.sleep(0.3)
+    assert sup.health()["state"] == "ready"
+    assert sup.health()["stalls"] == 0
+    assert len(instances) == 1
+    sup.shutdown()
+
+
+def test_watchdog_disabled_by_zero_floor():
+    instances = []
+
+    def factory():
+        inner = WedgeableInner()
+        instances.append(inner)
+        return inner
+
+    sup = _sup(factory, stall_factor=4.0, stall_min_s=0.0).start()
+    sup.submit([1])  # busy, then silent — but monitoring is off
+    time.sleep(0.2)
+    assert sup.health()["stalls"] == 0 and len(instances) == 1
+    assert sup._watch_thread is None
+    instances[0].finish(0, [1])
+    sup.shutdown()
+    # With the watchdog off nothing can have flagged the loop as wedged,
+    # so teardown must join UNBOUNDED — a healthy but slow round must
+    # never be abandoned just because the operator opted out of liveness
+    # enforcement.
+    assert instances[0].join_timeout is None
+
+
+def test_watchdog_enabled_bounds_teardown_join():
+    """With the watchdog ON, teardown passes the bounded join through to
+    schedulers that support one: a wedged loop must not hang the exit."""
+    instances = []
+
+    def factory():
+        inner = WedgeableInner()
+        instances.append(inner)
+        return inner
+
+    sup = _sup(factory, stall_factor=4.0, stall_min_s=5.0,
+               stall_join_s=0.7).start()
+    sup.shutdown()
+    assert instances[0].join_timeout == 0.7
+
+
+# ------------------------------------------------- restart-aware Retry-After
+
+
+def test_retry_after_hint_includes_restart_backoff_remaining():
+    """While the loop is down, the queue-depth × EWMA hint is stale (the
+    inner is dead, its queue frozen): the hint must promise at least the
+    restart backoff remaining instead."""
+    instances = []
+
+    def factory():
+        inner = WedgeableInner()
+        instances.append(inner)
+        return inner
+
+    entered, release = threading.Event(), threading.Event()
+
+    def blocking_sleep(_d):
+        entered.set()
+        release.wait(10)
+
+    rng = random.Random(3)
+    expected_delay = random.Random(3).uniform(0.0, 20.0)
+    assert expected_delay > 2.0  # the seed must give a visible backoff
+    sup = _sup(
+        factory,
+        restart_policy=RetryPolicy(max_attempts=4, base_delay_s=20.0,
+                                   max_delay_s=20.0),
+        rng=rng, sleep=blocking_sleep,
+        stall_factor=4.0, stall_min_s=0.05,
+    ).start()
+    f = sup.submit([1, 2])
+    assert entered.wait(5), "restart backoff never entered"
+    assert sup.health()["state"] == "restarting"
+    hint = sup.retry_after_hint()
+    # The fake inner has no hint (base 1.0); the backoff remaining must
+    # dominate — allowing for the wall time since the eta was stamped.
+    assert hint >= expected_delay - 1.0
+    assert hint <= 60.0
+    release.set()
+    wait_for(lambda: len(instances) == 2, msg="rebuild")
+    wait_for(lambda: len(instances[1].submitted) == 1, msg="replay")
+    instances[1].finish(0, [3])
+    assert f.result(timeout=5) == [3]
+    # Recovered: the eta is cleared and the hint falls back to the floor.
+    assert sup.health()["state"] == "ready"
+    assert sup.retry_after_hint() == 1.0
+    sup.shutdown()
+
+
+# ------------------------------------------------- unspillable constraints
+
+
+def test_unspillable_constraint_counted_at_submit():
+    """A pre-compiled constraint without a serializable spec cannot
+    survive a drain spill: /metrics gains an `unspillable_constraints`
+    exposure counter at SUBMIT time, before any drain makes it a lost
+    request. Spec'd constraints don't count."""
+    instances = []
+
+    def factory():
+        inner = WedgeableInner()
+        instances.append(inner)
+        return inner
+
+    sup = _sup(factory, stall_min_s=0.0).start()
+    before = resilience.get("unspillable_constraints")
+    sup.submit([1], constraint=object())  # no constraint_spec
+    sup.submit([2], constraint=object())
+    assert resilience.get("unspillable_constraints") == before + 2
+    sup.submit([3], constraint=object(),
+               constraint_spec={"table": "t", "columns": ["a"]})
+    sup.submit([4], constraint=object(), constraint_spec="spark_sql")
+    sup.submit([5])  # unconstrained
+    assert resilience.get("unspillable_constraints") == before + 2
+    for i, rec in enumerate(instances[0].submitted):
+        instances[0].finish(i, [1])
+    sup.shutdown()
+
+
+# --------------------------------------------------------- chaos hang stage
+
+
+@pytest.mark.chaos
+def test_chaos_hang_stage_detects_and_recovers():
+    """`evalh --chaos` stage 3: a duration-valued `sched:hang` wedges the
+    toy loop; the watchdog detects it within the threshold, restarts,
+    replays — zero silently-hung clients, bounded wall (asserted inside
+    the stage), and the run_chaos report carries the section."""
+    from llm_based_apache_spark_optimization_tpu.evalh.chaos import (
+        _run_hang_stage,
+    )
+
+    rep = _run_hang_stage(seed=0)
+    assert rep["unresolved"] == 0 and rep["mismatched"] == 0
+    assert rep["stalls_detected"] >= 1
+    assert rep["lost"] == 0
+    assert rep["state"] == "ready"
+    assert rep["faults_injected"].get("sched:hang", 0) >= 1
+
+
+@pytest.mark.chaos
+def test_run_chaos_report_carries_watchdog_section():
+    from llm_based_apache_spark_optimization_tpu.evalh.chaos import run_chaos
+
+    rep = run_chaos("unused:site:1", seed=0, rounds=1)
+    wd = rep["watchdog"]
+    assert wd["unresolved"] == 0 and wd["lost"] == 0
+    assert wd["stalls_detected"] >= 1
+    assert rep["hung"] == 0
