@@ -124,6 +124,7 @@ def make_tiny_service(
                     spill_path=_spill_path(app_cfg, name),
                     stall_factor=app_cfg.stall_factor,
                     stall_min_s=app_cfg.stall_min_s,
+                    warmup_grace_s=app_cfg.stall_warmup_s,
                     name=f"scheduler:{name}",
                 )
             else:
@@ -267,7 +268,8 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                               max_restarts=app_cfg.max_restarts,
                               journal_spill=_spill_path(app_cfg, src),
                               stall_factor=app_cfg.stall_factor,
-                              stall_min_s=app_cfg.stall_min_s)
+                              stall_min_s=app_cfg.stall_min_s,
+                              stall_warmup_s=app_cfg.stall_warmup_s)
                 common["speculative_draft"] = getattr(args, "speculative", 0)
                 common["quantize_int8"] = args.int8
                 common["quantize_int4"] = int4
@@ -323,6 +325,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                     spill_path=_spill_path(app_cfg, src),
                     stall_factor=app_cfg.stall_factor,
                     stall_min_s=app_cfg.stall_min_s,
+                    warmup_grace_s=app_cfg.stall_warmup_s,
                     name=f"scheduler-pool:{src}",
                 )
             else:
@@ -447,6 +450,19 @@ def main(argv=None) -> None:
     if args.port:
         cfg = type(cfg)(**{**cfg.__dict__, "port": args.port})
     cfg.ensure_dirs()
+    # Observability wiring (README "Observability"): trace sampling +
+    # export, the flight-recorder ring size, and request-log sampling all
+    # resolve through AppConfig so LSOT_TRACE_SAMPLE / LSOT_TRACE_EXPORT /
+    # LSOT_FLIGHT_ROUNDS / LSOT_REQUEST_LOG are documented knobs, not
+    # hidden env reads. This runs BEFORE any service/scheduler is built,
+    # so every recorder/registry constructed below picks the values up.
+    from ..serve import flightrecorder
+    from ..utils import observability
+    from ..utils.tracing import TRACER
+
+    TRACER.reconfigure(sample=cfg.trace_sample, export_dir=cfg.trace_export)
+    flightrecorder.reconfigure(rounds=cfg.flight_rounds)
+    observability.reconfigure_request_log(cfg.request_log)
 
     if args.backend == "checkpoint":
         if not args.sql_model_path:

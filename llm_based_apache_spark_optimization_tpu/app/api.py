@@ -27,8 +27,15 @@ from ..serve.resilience import (
 )
 from ..serve.service import GenerationService
 from ..sql.backend import SQLBackend
+from ..utils import tracing
+from ..utils.tracing import TRACER
 from .config import AppConfig
-from .health import add_health_routes, install_drain_gate
+from .health import (
+    add_debug_routes,
+    add_health_routes,
+    install_drain_gate,
+    metrics_response,
+)
 from .pipeline import Pipeline
 from .wsgi import App, Request, Response
 
@@ -81,14 +88,35 @@ def create_api_app(
     cfg = config or AppConfig.from_env()
     cfg.ensure_dirs()
     pipeline = Pipeline(service, sql_backend, history, cfg)
-    app = App(secret_key=cfg.secret_key)
+    # request_id_factory: the id is born at DISPATCH and echoed as
+    # X-Request-Id on every response this app produces — early 400s,
+    # 404/405s, and the wsgi last-resort 500 guard included (structural;
+    # a handler cannot forget the header).
+    app = App(secret_key=cfg.secret_key,
+              request_id_factory=tracing.new_request_id)
     # Lifecycle surface: /healthz (liveness), /readyz (supervisor-aware
-    # readiness), and the SIGTERM drain gate (app/health.py).
+    # readiness), the SIGTERM drain gate, and the observability debug
+    # routes (/debug/flightrecorder, /debug/traces) — app/health.py.
     add_health_routes(app, service)
+    add_debug_routes(app, service)
     install_drain_gate(app, service)
+
+    def _rid(req: Request) -> str:
+        """The dispatch-assigned correlation id (App.request_id_factory);
+        minted here only for a Request that bypassed dispatch (direct
+        handler calls in tests)."""
+        if not req.request_id:
+            req.request_id = tracing.new_request_id()
+        return req.request_id
 
     @app.route("/process-data/", methods=("POST",))
     def process_data(req: Request) -> Response:
+        """The id is born at dispatch and echoed on every response shape
+        by the App layer; the span tree only for the head-sampled
+        fraction (LSOT_TRACE_SAMPLE)."""
+        return _process_data(req, _rid(req))
+
+    def _process_data(req: Request, request_id: str) -> Response:
         try:
             data = req.json()
         except Exception:
@@ -101,14 +129,21 @@ def create_api_app(
             return Response.json({"error": "invalid file name"}, status=400)
         file_path = os.path.join(cfg.input_dir, file_name)
         if not os.path.exists(file_path):
-            return Response.json({"error": "CSV file not found at " + file_path})
+            return Response.json(
+                {"error": "CSV file not found at " + file_path})
+        trace = TRACER.begin(request_id=request_id, endpoint="/process-data/")
         try:
-            result = pipeline.run(file_path, input_text)
+            with tracing.use(trace):
+                with tracing.span("pipeline.run", file=file_name):
+                    result = pipeline.run(file_path, input_text,
+                                          request_id=request_id)
         except UNAVAILABLE_ERRORS as e:
             # Overload/outage is the SERVER's state, not a §2.2 pipeline
             # outcome: answer 429/503/504 so clients back off, instead of
             # the catch-all 500 that reads as a bug.
             return unavailable_response(e)
+        finally:
+            TRACER.finish(trace)
         if not result.ok:
             return Response.json({
                 "error": "SQL execution failed",
@@ -125,6 +160,11 @@ def create_api_app(
 
     @app.route("/api/generate", methods=("POST",))
     def api_generate(req: Request) -> Response:
+        """The dispatch layer echoes X-Request-Id on every response
+        shape — early 400s/404s and the 500 guard included."""
+        return _api_generate(req, _rid(req))
+
+    def _api_generate(req: Request, request_id: str) -> Response:
         """Direct generation endpoint, Ollama wire shape: body
         `{"model", "prompt", "system"?, "stream"?, "max_new_tokens"?,
         "constrain"?, "deadline_s"?, "idempotency_key"?}`.
@@ -231,15 +271,24 @@ def create_api_app(
                           f"available: {service.models()}"},
                 status=404,
             )
+        # Head-sampled trace for the request id born in the wrapper above
+        # — the correlation handle between a client report, the request
+        # log line, and an exported span tree.
+        trace = TRACER.begin(request_id=request_id, model=model,
+                             endpoint="/api/generate")
+        streaming = False
         try:
             if not data.get("stream", False):
-                res = service.generate(
-                    model, prompt, system=system, max_new_tokens=max_new,
-                    constrain=constrain, deadline_s=deadline_s,
-                    idempotency_key=idempotency_key,
-                )
+                with tracing.use(trace):
+                    res = service.generate(
+                        model, prompt, system=system, max_new_tokens=max_new,
+                        constrain=constrain, deadline_s=deadline_s,
+                        idempotency_key=idempotency_key,
+                        request_id=request_id,
+                    )
                 return Response.json({
                     "model": model, "response": res.response, "done": True,
+                    "request_id": request_id,
                 })
 
             # Pre-validate the request shape (oversize prompt / no decode
@@ -262,11 +311,14 @@ def create_api_app(
             inner = service.generate_stream(
                 model, prompt, system=system, max_new_tokens=max_new,
                 constrain=constrain, deadline_s=deadline_s,
+                request_id=request_id,
             )
             try:
-                first = next(inner)
+                with tracing.use(trace):
+                    first = next(inner)
             except StopIteration:
                 first = None
+            streaming = True  # the chunks() finally owns the trace now
 
             def chunks():
                 try:
@@ -274,20 +326,26 @@ def create_api_app(
                         if first is not None:
                             yield {"model": model, "response": first,
                                    "done": False}
-                        for piece in inner:
+                        # tracing.stepwise: inner advances under the
+                        # trace context, which is never held across our
+                        # own yields (the generator/contextvar hazard).
+                        for piece in tracing.stepwise(inner, trace):
                             yield {"model": model, "response": piece,
                                    "done": False}
                     except Exception as e:  # mid-stream failure: headers
                         # are already sent, so surface the error as a final
                         # line instead of severing the connection silently.
-                        yield {"model": model, "error": str(e), "done": True}
+                        yield {"model": model, "error": str(e), "done": True,
+                               "request_id": request_id}
                         return
-                    yield {"model": model, "done": True}
+                    yield {"model": model, "done": True,
+                           "request_id": request_id}
                 finally:
                     # Deterministic unwind on client disconnect: the
                     # service generator's finally cancels the scheduler
                     # request and records metrics.
                     inner.close()
+                    TRACER.finish(trace)
 
             return Response.ndjson_stream(chunks())
         except UNAVAILABLE_ERRORS as e:
@@ -302,6 +360,11 @@ def create_api_app(
             # Request-shape rejections (e.g. a prompt that leaves no decode
             # room in the serving window) are the client's error.
             return Response.json({"error": str(e)}, status=400)
+        finally:
+            if not streaming:
+                # Blocking/error paths finish (export) the sampled trace
+                # here; the streaming path hands ownership to chunks().
+                TRACER.finish(trace)
 
     @app.route("/models")
     def models(req: Request) -> Response:
@@ -316,7 +379,9 @@ def create_api_app(
         the observability surface the reference never had (SURVEY.md §5) —
         plus scheduler-layer stats (prefix-cache reuse, speculation
         acceptance) for backends that expose them, mirroring the web app's
-        /metrics."""
-        return Response.json(service.metrics_snapshot())
+        /metrics. `?format=prometheus` renders the same payload (plus the
+        fixed-bucket TTFT/TPOT/queue-wait histograms) in the exposition
+        text format a Prometheus scrape ingests."""
+        return metrics_response(service, req)
 
     return app

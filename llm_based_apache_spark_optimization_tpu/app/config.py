@@ -81,6 +81,33 @@ class AppConfig:
     # bucket blocks the loop exactly like a wedge).
     stall_factor: float = 16.0
     stall_min_s: float = 10.0
+    # Warmup-aware stall floor: for this long after start()/each restart
+    # — and only until the scheduler harvests its FIRST round — the
+    # watchdog floor is raised to this value, so first-boot cold XLA
+    # compiles (which block the loop thread exactly like a wedge) cannot
+    # be escalated as hangs. 0 disables (the pre-warmed deployment /
+    # library default).
+    stall_warmup_s: float = 120.0
+    # --- observability (utils/tracing.py, serve/flightrecorder.py,
+    # README "Observability").
+    # Head-sampled request tracing: the fraction of requests whose span
+    # tree (queue-wait, prefill, per-decode-round, SQL exec, ...) is
+    # recorded and exported. 0 = off (request ids still flow), 1 = every
+    # request. Safe always-on: unsampled requests pay one RNG draw.
+    trace_sample: float = 0.0
+    # Export directory for sampled traces: requests.jsonl (one line per
+    # request) + <request_id>.trace.json.gz (Chrome-trace format — loads
+    # in Perfetto and in utils/traceprof.Trace). "" = in-memory ring only
+    # (the /debug/traces endpoint still serves the last few).
+    trace_export: str = ""
+    # Scheduler flight-recorder ring size (per-harvested-round records
+    # kept for /debug/flightrecorder and the crash/stall/SIGTERM
+    # postmortem dump).
+    flight_rounds: int = 256
+    # Per-request JSON log-line sampling (the line MetricsRegistry.record
+    # emits at INFO): 1 = every request (historical behavior), 0 = off —
+    # the hot path skips the json.dumps + handler I/O entirely.
+    request_log: float = 1.0
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

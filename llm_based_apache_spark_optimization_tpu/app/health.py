@@ -44,7 +44,28 @@ import math
 from ..serve.service import GenerationService
 from .wsgi import App, Request, Response
 
-__all__ = ["add_health_routes", "install_drain_gate"]
+__all__ = ["add_debug_routes", "add_health_routes", "install_drain_gate",
+           "metrics_response"]
+
+
+def metrics_response(service: GenerationService, req: "Request") -> "Response":
+    """The shared `/metrics` body for BOTH frontends (app/api.py and
+    app/web.py): JSON by default, `?format=prometheus` renders the
+    exposition text, anything else is a 400 — one place for the format
+    contract, so the two routes cannot drift (content-type, compression,
+    auth all land here once)."""
+    fmt = req.query.get("format", "json")
+    if fmt == "prometheus":
+        from ..utils.prometheus import CONTENT_TYPE
+
+        return Response(
+            body=service.metrics_prometheus().encode(),
+            headers=[("Content-Type", CONTENT_TYPE)],
+        )
+    if fmt != "json":
+        return Response.json(
+            {"error": "'format' must be json or prometheus"}, status=400)
+    return Response.json(service.metrics_snapshot())
 
 #: readiness state → (HTTP status, include Retry-After)
 _READY_STATUS = {
@@ -78,6 +99,46 @@ def add_health_routes(app: App, service: GenerationService) -> None:
         headers = (_retry_after(service.retry_after_hint())
                    if status != 200 and hint else None)
         return Response.json(health, status=status, headers=headers)
+
+
+def add_debug_routes(app: App, service: GenerationService) -> None:
+    """Register the observability debug surface on an App (both
+    frontends, like the health routes):
+
+    - `GET /debug/flightrecorder[?last=N]` — the scheduler flight
+      recorder's live ring per model: per-harvested-round records
+      (occupancy, admitted/retired rids, emitted/speculation tokens,
+      round wall, cadence) merged with supervisor lifecycle events and
+      replica-labeled for pools (serve/flightrecorder.py). The same
+      records a crash/stall/SIGTERM postmortem dumps to disk — this
+      route answers "what is the scheduler doing RIGHT NOW".
+    - `GET /debug/traces[?last=N]` — the most recent head-sampled
+      request traces (utils/tracing.py): span trees with queue-wait /
+      prefill / per-round decode / SQL-exec timing, plus the tracer's
+      sampling config."""
+
+    @app.route("/debug/flightrecorder")
+    def flightrecorder(req: Request) -> Response:
+        try:
+            last = int(req.query.get("last", "0")) or None
+        except ValueError:
+            return Response.json({"error": "'last' must be an integer"},
+                                 status=400)
+        return Response.json({"models": service.flight_snapshot(last)})
+
+    @app.route("/debug/traces")
+    def traces(req: Request) -> Response:
+        from ..utils.tracing import TRACER
+
+        try:
+            last = int(req.query.get("last", "0")) or None
+        except ValueError:
+            return Response.json({"error": "'last' must be an integer"},
+                                 status=400)
+        return Response.json({
+            "tracer": TRACER.stats(),
+            "traces": service.recent_traces(last),
+        })
 
 
 def install_drain_gate(app: App, service: GenerationService) -> None:

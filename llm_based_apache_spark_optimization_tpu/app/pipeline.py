@@ -104,6 +104,7 @@ class Pipeline:
         file_path: str,
         input_text: str,
         status: StatusCb = _noop_status,
+        request_id: str = "",
     ) -> PipelineResult:
         """Execute the full pipeline for one staged CSV + NL question."""
         cfg = self.config
@@ -174,6 +175,11 @@ class Pipeline:
             # enforced end to end by deadline-capable backends — the
             # request fails typed instead of pinning a slot forever.
             deadline_s=cfg.deadline_s or None,
+            # Correlation: without this, an UNSAMPLED /process-data/
+            # request's structured log line would carry no request_id —
+            # the id the client got in X-Request-Id would grep to
+            # nothing.
+            request_id=request_id or None,
         )
         result.sql_query = res.response
         status("processing", ST_GEN_OK)
@@ -194,9 +200,13 @@ class Pipeline:
         status("processing", ST_SAVE_DB)
         if self.history is not None:
             try:
-                self.history.record(
-                    file_name, input_text, result.sql_query, result.output_file
-                )
+                from ..utils import tracing
+
+                with tracing.span("history.record"):
+                    self.history.record(
+                        file_name, input_text, result.sql_query,
+                        result.output_file,
+                    )
             except Exception:
                 # Reference parity: a history outage must not fail the request
                 # (Flask/app.py:44-45) — but we log instead of print-and-lose.

@@ -30,8 +30,14 @@ from jinja2 import Environment, FileSystemLoader, select_autoescape
 from ..history.store import HistoryStore
 from ..serve.service import GenerationService
 from ..sql.backend import SQLBackend
+from ..utils import tracing
 from .config import AppConfig
-from .health import add_health_routes, install_drain_gate
+from .health import (
+    add_debug_routes,
+    add_health_routes,
+    install_drain_gate,
+    metrics_response,
+)
 from .pipeline import ST_UPLOAD, Pipeline
 from .wsgi import App, Request, Response
 
@@ -80,10 +86,14 @@ def create_web_app(
     cfg = config or AppConfig.from_env()
     cfg.ensure_dirs()
     pipeline = Pipeline(service, sql_backend, history, cfg)
-    app = App(secret_key=cfg.secret_key)
+    # Same dispatch-level X-Request-Id echo as the headless API: every
+    # web response carries the correlation id too.
+    app = App(secret_key=cfg.secret_key,
+              request_id_factory=tracing.new_request_id)
     # Same lifecycle surface as the headless API (app/health.py): probes
     # and the SIGTERM drain gate are frontend-independent.
     add_health_routes(app, service)
+    add_debug_routes(app, service)
     install_drain_gate(app, service)
     board = StatusBoard()
     env = Environment(
@@ -114,8 +124,10 @@ def create_web_app(
     def metrics(req: Request) -> Response:
         """Per-model serving aggregates (SURVEY.md §5 observability), plus
         scheduler-layer stats (prefix-cache reuse, speculation acceptance)
-        for models served by backends that expose them."""
-        return Response.json(service.metrics_snapshot())
+        for models served by backends that expose them.
+        `?format=prometheus` renders the exposition text format (same
+        payload + fixed-bucket latency histograms) for scrape stacks."""
+        return metrics_response(service, req)
 
     @app.route("/static/styles.css")
     def styles(req: Request) -> Response:
@@ -139,32 +151,51 @@ def create_web_app(
         file_path.parent.mkdir(parents=True, exist_ok=True)
         file_path.write_bytes(upload.content)
 
+        # Head-sampled request trace, same as the API frontend: without
+        # the installed context the pipeline's sql.load/sql.exec spans
+        # would read tracing.current() == None and record nothing — a
+        # sampled web request would export a tree missing exactly the
+        # SQL/pipeline breakdown the README promises.
+        trace = tracing.TRACER.begin(request_id=req.request_id,
+                                     endpoint="/process-data/")
         try:
             try:
-                result = pipeline.run(
-                    str(file_path), input_text,
-                    status=lambda s, m: board.set(sid, s, m),
-                )
-            finally:
-                # The staged copy is only needed between this handler's write
-                # and the pipeline's read-back; without cleanup every upload
-                # would grow input_dir forever.
-                shutil.rmtree(file_path.parent, ignore_errors=True)
-        except Exception as e:
-            # Reference parity: the Flask handler routes ANY failure through
-            # the LLM error-analysis page (Flask/app.py:151-172) — but unlike
-            # the reference, fields that never got assigned render as empty
-            # strings instead of raising NameError (§2.2 known quirks).
-            from .pipeline import PipelineResult
+                try:
+                    with tracing.use(trace):
+                        with tracing.span("pipeline.run", file=file_name):
+                            result = pipeline.run(
+                                str(file_path), input_text,
+                                status=lambda s, m: board.set(sid, s, m),
+                                request_id=req.request_id,
+                            )
+                finally:
+                    # The staged copy is only needed between this handler's
+                    # write and the pipeline's read-back; without cleanup
+                    # every upload would grow input_dir forever.
+                    shutil.rmtree(file_path.parent, ignore_errors=True)
+            except Exception as e:
+                # Reference parity: the Flask handler routes ANY failure
+                # through the LLM error-analysis page (Flask/app.py:151-172)
+                # — but unlike the reference, fields that never got assigned
+                # render as empty strings instead of raising NameError (§2.2
+                # known quirks). The analysis call runs under the SAME
+                # request trace/decision window: outside it,
+                # service.generate would re-draw the head sample and export
+                # a second tree under a freshly minted id that greps to
+                # nothing.
+                from .pipeline import PipelineResult
 
-            result = PipelineResult(ok=False, input_file_name=file_name,
-                                    input_data=input_text)
-            result.error_message = str(e)
-            try:
-                result.error_solution = pipeline.explain_error(
-                    str(e), status=lambda s, m: board.set(sid, s, m))
-            except Exception:
-                result.error_solution = "(error analysis unavailable)"
+                result = PipelineResult(ok=False, input_file_name=file_name,
+                                        input_data=input_text)
+                result.error_message = str(e)
+                try:
+                    with tracing.use(trace):
+                        result.error_solution = pipeline.explain_error(
+                            str(e), status=lambda s, m: board.set(sid, s, m))
+                except Exception:
+                    result.error_solution = "(error analysis unavailable)"
+        finally:
+            tracing.TRACER.finish(trace)
         if not result.ok:
             board.set(sid, "done", "done")
             params = urlencode({

@@ -43,6 +43,10 @@ class Request:
         self.form: Dict[str, str] = {}
         self.files: Dict[str, UploadedFile] = {}
         self.session: Dict[str, Any] = {}
+        # Correlation id, assigned by App.__call__ when the app was built
+        # with a request_id_factory — handlers read it instead of minting
+        # their own, and the dispatch layer echoes it on EVERY response.
+        self.request_id: str = ""
         ctype = environ.get("CONTENT_TYPE", "")
         if ctype.startswith("multipart/form-data"):
             self._parse_multipart(ctype)
@@ -189,10 +193,18 @@ class App:
 
     SESSION_COOKIE = "session"
 
-    def __init__(self, secret_key: str = "dev"):
+    def __init__(self, secret_key: str = "dev",
+                 request_id_factory: Optional[Callable[[], str]] = None):
         self._routes: Dict[Tuple[str, str], Handler] = {}
         self._codec = SessionCodec(secret_key)
         self._before: List[Callable[[Request], Optional[Response]]] = []
+        # When set, every request gets an id at DISPATCH (req.request_id)
+        # and every response — before-gate answers, 404/405, handler
+        # results, and the last-resort 500 guard alike — carries it as
+        # X-Request-Id. Structural: a handler cannot forget the header,
+        # and the 500s a user reports by id are exactly the ones that
+        # must have one.
+        self._rid_factory = request_id_factory
 
     def route(self, path: str, methods: Tuple[str, ...] = ("GET",)):
         def deco(fn: Handler) -> Handler:
@@ -213,6 +225,8 @@ class App:
 
     def __call__(self, environ, start_response):
         req = Request(environ)
+        if self._rid_factory is not None:
+            req.request_id = self._rid_factory()
         cookie_header = environ.get("HTTP_COOKIE", "")
         had_cookie = False
         for part in cookie_header.split(";"):
@@ -248,6 +262,9 @@ class App:
                     {"error": "internal server error", "detail": str(e)}, status=500
                 )
         headers = list(resp.headers)
+        if req.request_id and not any(h[0] == "X-Request-Id"
+                                      for h in headers):
+            headers.append(("X-Request-Id", req.request_id))
         # Only set the cookie when this request changed the session: a
         # concurrent read-only poll (e.g. /status during a long
         # /process-data/) must not clobber the session another response

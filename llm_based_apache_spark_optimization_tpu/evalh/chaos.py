@@ -104,6 +104,7 @@ class _ToyScheduler:
     """
 
     def __init__(self, tokens_per_request: int = 6):
+        from ..serve.flightrecorder import FlightRecorder
         from ..serve.watchdog import Heartbeat
 
         self.tokens_per_request = tokens_per_request
@@ -117,6 +118,10 @@ class _ToyScheduler:
         # seam, and an injected `sched:hang` (the check SLEEPS) reads as
         # a stale busy heartbeat.
         self.heartbeat = Heartbeat()
+        # Flight recorder, like the real scheduler's: one record per
+        # 'decode round' (token), so the supervisor's postmortem dump on
+        # an injected crash/stall carries last-N rounds for the toy too.
+        self.flight = FlightRecorder(capacity=64)
 
     def start(self):
         if self._thread is None:
@@ -131,7 +136,7 @@ class _ToyScheduler:
             self._thread = None
 
     def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
-               on_token=None, constraint=None, deadline_s=None):
+               on_token=None, constraint=None, deadline_s=None, trace=None):
         from concurrent.futures import Future
 
         with self._lock:
@@ -169,6 +174,8 @@ class _ToyScheduler:
                     if on_token is not None:
                         on_token(t)
                     self.heartbeat.round_done()
+                    self.flight.record(round=self.heartbeat.rounds,
+                                       occupancy=1, emitted=1)
             except Exception as exc:  # noqa: BLE001 — loop death, like _run's guard
                 crash = SchedulerCrashed.from_exception(exc)
                 with self._lock:
@@ -191,6 +198,7 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
     expected tokens (replayed across however many restarts the injected
     schedule causes), and duplicate idempotency keys return ONE result."""
     import random
+    import time as time_mod
 
     from ..serve.resilience import RetryPolicy
     from ..serve.supervisor import SupervisedScheduler
@@ -205,13 +213,24 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
         rng=random.Random(seed),
     ).start()
     try:
-        futs, expect = [], []
+        futs, expect, firsts = [], [], []
         for i in range(requests):
             ids, rseed = [1 + i, 2 + i], i
             # Every third request is submitted TWICE under one key: the
             # journal must collapse the pair to a single generation.
             key = f"chaos-req-{i}" if i % 3 == 0 else None
-            ckw = {}
+            # TTFT across crash/replay churn: submit→first delivered
+            # token, the "where latency lives" figure chaos runs now
+            # report beside their outcome histogram.
+            t_sub = time_mod.monotonic()
+            first: list = []
+
+            def on_tok(tok, first=first, t_sub=t_sub):
+                if not first:
+                    first.append(time_mod.monotonic() - t_sub)
+
+            firsts.append(first)
+            ckw: Dict = {"on_token": on_tok}
             if i == 1:
                 # One CONSTRAINED request rides the chaos schedule: the
                 # journal carries both the (opaque, toy) compiled object
@@ -220,9 +239,9 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
                 # its unconstrained neighbours (zero lost below covers
                 # it). The toy scheduler ignores the constraint; what is
                 # under test is the SUPERVISOR's bookkeeping.
-                ckw = {"constraint": object(),
-                       "constraint_spec": {"table": "taxi",
-                                           "columns": ["VendorID"]}}
+                ckw.update({"constraint": object(),
+                            "constraint_spec": {"table": "taxi",
+                                                "columns": ["VendorID"]}})
             fut = sup.submit(ids, seed=rseed, idempotency_key=key, **ckw)
             futs.append(fut)
             expect.append(_ToyScheduler.expected(ids, 6, rseed))
@@ -241,6 +260,19 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
             elif got != want:
                 mismatched += 1
         health = sup.health()
+        # Latency decomposition across the crash churn: TTFT through
+        # restarts/replays + the toy loop's measured round cadence. Wall
+        # times are NOT deterministic — run_chaos lifts this dict out of
+        # the stage report so the seeded-replay comparison stays exact.
+        ttfts = sorted(f[0] for f in firsts if f)
+        hb = getattr(sup._inner, "heartbeat", None)
+        cadence = hb.expected_round_s() if hb is not None else None
+        latency = {
+            "ttft_p50_s": (round(ttfts[len(ttfts) // 2], 6)
+                           if ttfts else None),
+            "ttft_max_s": round(ttfts[-1], 6) if ttfts else None,
+            "round_cadence_s": round(cadence, 6) if cadence else None,
+        }
     finally:
         sup.shutdown()
     report = {
@@ -253,6 +285,7 @@ def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
         "unresolved": hung,
         "mismatched": mismatched,
         "state": health["state"],
+        "latency": latency,
     }
     assert hung == 0, (
         f"{hung} acknowledged request(s) never produced their result "
@@ -348,6 +381,9 @@ def _run_hang_stage(seed: int, hang_s: float = 0.35,
         "mismatched": mismatched,
         "state": health["state"],
         "faults_injected": counts,
+        # Detection + recovery wall: how long the clients actually waited
+        # for the wedge to be caught and replayed (bounded below).
+        "wall_s": round(wall, 3),
     }
     assert hung == 0, (
         f"{hung} client(s) silently hung across an injected decode-loop "
@@ -511,6 +547,10 @@ def run_chaos(
     hung += scheduler_report["unresolved"]
     hung += watchdog_report["unresolved"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
+    # Wall-clock figures are non-deterministic by nature: lifted OUT of
+    # the scheduler stage's report so the seeded-replay determinism
+    # contract (same spec+seed → same outcome fields) stays exact.
+    latency = scheduler_report.pop("latency", None)
     return {
         "spec": spec,
         "seed": seed,
@@ -519,6 +559,7 @@ def run_chaos(
         "hung": hung,
         "scheduler": scheduler_report,
         "watchdog": watchdog_report,
+        "latency": latency,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)
             for k in sorted(set(before) | set(after))

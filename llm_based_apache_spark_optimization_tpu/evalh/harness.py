@@ -38,6 +38,11 @@ class CaseResult:
     # Executability (metrics.executes): does the generated statement RUN on
     # the fixture backend at all — the rate constrained decoding lifts.
     executable: Optional[int] = None
+    # Latency decomposition (ISSUE-6 spans, scheduler-path backends):
+    # time to first token and queue wait — WHERE the latency lives, not
+    # just how much. 0.0 = not measured (fakes, the one-program engine).
+    ttft_s: float = 0.0
+    queue_wait_s: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +73,19 @@ class ModelReport:
     def aggregate_tok_per_s(self) -> float:
         total_t = self.wall_clock_s or sum(c.latency_s for c in self.cases)
         return sum(c.output_tokens for c in self.cases) / total_t if total_t else 0.0
+
+    @property
+    def avg_ttft_s(self) -> Optional[float]:
+        """Mean time-to-first-token over cases that measured one; None
+        when the backend has no first-token seam (fakes, engine)."""
+        vals = [c.ttft_s for c in self.cases if c.ttft_s]
+        return sum(vals) / len(vals) if vals else None
+
+    @property
+    def avg_queue_wait_s(self) -> Optional[float]:
+        """Mean scheduler queue wait over cases that measured one."""
+        vals = [c.queue_wait_s for c in self.cases if c.queue_wait_s]
+        return sum(vals) / len(vals) if vals else None
 
     @property
     def execution_match_rate(self) -> Optional[float]:
@@ -102,7 +120,8 @@ class ModelReport:
 
 
 def _score(case: EvalCase, generated: str, latency_s: float,
-           output_tokens: int, exec_backend=None) -> CaseResult:
+           output_tokens: int, exec_backend=None,
+           ttft_s: float = 0.0, queue_wait_s: float = 0.0) -> CaseResult:
     expected = case.expected_sql.strip()
     ex = gv = exe = None
     if expected:
@@ -128,6 +147,8 @@ def _score(case: EvalCase, generated: str, latency_s: float,
         execution_match=ex,
         grammar_valid=gv,
         executable=exe,
+        ttft_s=ttft_s,
+        queue_wait_s=queue_wait_s,
     )
 
 
@@ -159,6 +180,10 @@ def evaluate_model(
         results.append(_score(
             case, res.response.strip(), res.latency_s, res.output_tokens,
             exec_backend,
+            # Duck-typed (the Ollama adapter's result objects predate the
+            # decomposition fields): absent reads as not-measured.
+            ttft_s=getattr(res, "ttft_s", 0.0),
+            queue_wait_s=getattr(res, "queue_wait_s", 0.0),
         ))
     return ModelReport(model=model, cases=results)
 
@@ -226,6 +251,14 @@ def format_summary(reports: Dict[str, ModelReport]) -> str:
             f"Average Latency: {rep.avg_latency_s:.4f} sec",
             f"Aggregate Throughput: {rep.aggregate_tok_per_s:.1f} tok/s",
         ]
+        # Latency decomposition (scheduler-path backends): WHERE the
+        # latency lives, not just how much.
+        if rep.avg_ttft_s is not None:
+            lines.append(f"Average TTFT: {rep.avg_ttft_s:.4f} sec")
+        if rep.avg_queue_wait_s is not None:
+            lines.append(
+                f"Average Queue Wait: {rep.avg_queue_wait_s:.4f} sec"
+            )
         if rep.execution_match_rate is not None:
             lines.append(
                 f"Execution Match Rate: {rep.execution_match_rate:.2f}%"

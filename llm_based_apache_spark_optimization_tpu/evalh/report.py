@@ -45,6 +45,7 @@ def render_report(
     timestamp: Optional[str] = None,
     constrained_reports: Optional[Dict[str, ModelReport]] = None,
     constrained_speculation: Optional[Dict[str, dict]] = None,
+    round_cadence: Optional[Dict[str, float]] = None,
 ) -> str:
     """Render harness output as markdown mirroring the reference's report
     structure (per-query table -> aggregate table -> configs -> conclusion)."""
@@ -109,6 +110,40 @@ def render_report(
         + " | ".join(_fmt(reports[m].aggregate_tok_per_s, 1) for m in models)
         + " |",
     ]
+    # Latency decomposition (ISSUE-6 tracing spans, scheduler-path
+    # backends): TTFT / queue-wait / decode-round cadence say WHERE the
+    # avg-latency row's time went. Rows render only when something
+    # measured them — fake-backend tables keep their historical shape.
+    if any(reports[m].avg_ttft_s is not None for m in models):
+        lines.append(
+            "| Avg TTFT | "
+            + " | ".join(
+                (_fmt(v, 3) + " s") if (v := reports[m].avg_ttft_s)
+                is not None else "n/a"
+                for m in models
+            )
+            + " |"
+        )
+    if any(reports[m].avg_queue_wait_s is not None for m in models):
+        lines.append(
+            "| Avg queue wait | "
+            + " | ".join(
+                (_fmt(v, 4) + " s") if (v := reports[m].avg_queue_wait_s)
+                is not None else "n/a"
+                for m in models
+            )
+            + " |"
+        )
+    if round_cadence and any(round_cadence.get(m) for m in models):
+        lines.append(
+            "| Decode round cadence | "
+            + " | ".join(
+                (_fmt(v, 4) + " s") if (v := round_cadence.get(m))
+                else "n/a"
+                for m in models
+            )
+            + " |"
+        )
     if any(reports[m].execution_match_rate is not None for m in models):
         lines.append(
             "| Execution-match rate | "
@@ -327,6 +362,16 @@ def generate(
                     "tokens_per_round": round(toks / rounds, 3) if rounds
                     else 0.0,
                 }
+    # Decode-round cadence per model (the scheduler heartbeat's measured
+    # EWMA, serve/watchdog.py) — the denominator that tells whether a
+    # latency number is queueing or compute. None-valued for backends
+    # without a heartbeat (fakes, engine).
+    round_cadence: Dict[str, float] = {}
+    for m, stats in service.backend_stats().items():
+        hb = (stats.get("watchdog") or {}).get("heartbeat") or {}
+        ewma = hb.get("expected_round_s")
+        if ewma:
+            round_cadence[m] = ewma
     config_rows = []
     if with_configs:
         for key, cfg in CONFIGS.items():
@@ -349,6 +394,7 @@ def generate(
         quality_meaningful=quality_meaningful, timestamp=timestamp,
         constrained_reports=constrained_reports,
         constrained_speculation=constrained_speculation or None,
+        round_cadence=round_cadence or None,
     )
 
 

@@ -37,6 +37,13 @@ class Completion:
     # Time to first token, when the backend has a first-token seam (the
     # continuous-batching scheduler); 0.0 = not measured.
     ttft_s: float = 0.0
+    # Queue wait (submit -> slot admission) on the scheduler path: the
+    # backlog share of latency. 0.0 = not measured.
+    queue_wait_s: float = 0.0
+    # Request class ("constrained"/"speculative"/both/"") and serving
+    # replica — the label set the Prometheus histograms slice by.
+    rclass: str = ""
+    replica: str = ""
 
 
 def resolve_constraint(constrain, tokenizer, stop_ids):

@@ -129,6 +129,7 @@ from ..ops.sampling import SamplingParams, apply_token_mask, sample_runtime
 from ..parallel.sharding import shard_params, validate_tp
 from ..utils.faults import FAULTS, InjectedFault
 from ..utils.observability import resilience
+from .flightrecorder import FlightRecorder, merge_snapshots
 from .resilience import (
     Deadline,
     DeadlineExceeded,
@@ -217,6 +218,44 @@ class _Request:
     # device produces nothing useful for.
     stall_rounds: int = 0
     stall_inject: bool = False
+    # Observability (ISSUE 6): a scheduler-scope monotonic request id
+    # (flight-recorder attribution: which rids a round admitted/retired),
+    # the request's RequestTrace when it was head-sampled
+    # (utils/tracing.py — the worker thread records queue-wait / prefill /
+    # per-round decode spans into it), and the wall stamps those spans
+    # are cut from.
+    rid: int = 0
+    trace: Optional[object] = None
+    admitted_at: float = 0.0
+    ready_at: float = 0.0
+
+    def flush_spans(self, now: float) -> None:
+        """Record the request's scheduler-phase spans into its trace at
+        terminal time (retire/fail): queue-wait (submit→slot), prefill
+        (slot→decode-eligible), decode (eligible→terminal). One call per
+        request, only when traced — zero work on the unsampled path."""
+        tr = self.trace
+        if tr is None:
+            return
+        try:
+            if self.submitted_at and self.admitted_at:
+                tr.add_span("sched.queue_wait", self.submitted_at,
+                            self.admitted_at, rid=self.rid)
+            elif self.submitted_at:
+                # Never admitted (expired/cancelled while queued): its
+                # whole life WAS queue wait.
+                tr.add_span("sched.queue_wait", self.submitted_at, now,
+                            rid=self.rid)
+            if self.admitted_at:
+                t_ready = self.ready_at or now
+                tr.add_span("sched.prefill", self.admitted_at, t_ready,
+                            prompt_tokens=len(self.ids))
+            if self.ready_at:
+                tr.add_span("sched.decode", self.ready_at, now,
+                            output_tokens=len(self.generated),
+                            constrained=self.constraint is not None)
+        except Exception:  # noqa: BLE001 — tracing must never kill the loop
+            self.trace = None
 
     def emit(self, tok: int) -> None:
         if self.on_token is not None:
@@ -276,6 +315,19 @@ class ContinuousBatchingScheduler:
         # tell a wedged loop (hung XLA dispatch/tunnel — age grows while
         # busy) from a healthy or idle one. serve/watchdog.py.
         self.heartbeat = Heartbeat()
+        # Flight recorder (serve/flightrecorder.py): one record per
+        # HARVESTED round — occupancy, admitted/retired rids, emitted and
+        # speculation-accepted tokens, round wall, cadence — in a bounded
+        # ring. The postmortem black box a crash/stall/SIGTERM dump reads;
+        # live at /debug/flightrecorder. `replica` is relabeled by
+        # SchedulerPool so a pool's merged view attributes load.
+        self.flight = FlightRecorder()
+        # Scheduler-scope monotonic request ids for flight-recorder
+        # attribution (independent of the supervisor's journal rids).
+        self._rid_seq = 0
+        # Rids admitted since the last harvested round's record.
+        self._round_admitted: List[int] = []
+        self._round_retired: List[int] = []
         # Admission control: submits beyond this many queued-not-yet-slotted
         # requests shed with a typed Overloaded (HTTP 429 upstream) instead
         # of growing the backlog without bound — under sustained overload an
@@ -1134,6 +1186,11 @@ class ContinuousBatchingScheduler:
         # admission if it expired while queued, or at the next harvest once
         # in flight. None = no deadline.
         deadline_s: Optional[float] = None,
+        # Request-scoped tracing (utils/tracing.RequestTrace): when the
+        # request was head-sampled, the worker thread records queue-wait /
+        # prefill / per-round decode spans into this tree. None (the
+        # unsampled fast path) costs nothing anywhere in the loop.
+        trace=None,
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
@@ -1181,6 +1238,7 @@ class ContinuousBatchingScheduler:
             future=Future(), on_token=on_token, constraint=constraint,
             deadline=(Deadline.after(deadline_s)
                       if deadline_s is not None else None),
+            trace=trace,
         )
         req.future._lsot_request = req  # cancel() handle
         try:
@@ -1216,6 +1274,9 @@ class ContinuousBatchingScheduler:
                     # 1s floor until the first completion seeds the EWMA.
                     retry_after_s=self.retry_after_hint(),
                 )
+            self._rid_seq += 1
+            req.rid = self._rid_seq
+            req.future._lsot_replica = self.flight.replica
             req.submitted_at = time.perf_counter()
             self._queue.put(req)
         return req.future
@@ -1399,21 +1460,31 @@ class ContinuousBatchingScheduler:
         touch nothing."""
         self._constraint = compiled
         self._ctables = compiled.device_tables(self.cfg.vocab_size)
+        self.flight.event("grammar_swap",
+                          fingerprint=str(getattr(compiled, "fingerprint",
+                                                  ""))[:16])
 
     def _admit(self, slot: int, req: _Request) -> None:
         """Reserve `slot` and queue the prompt for chunked prefill, reusing
         any cached prefix blocks first (device-to-device copy, no forward)."""
         if req.cancelled:  # cancelled while queued: never occupy a slot
+            self._observe_terminal(req)
             req.future.set_result(req.generated)
             return
         if req.past_deadline():
             # Expired while queued: fail fast with the typed error before
             # ever occupying a slot — under overload, prefilling work whose
             # caller already gave up only steals device time from requests
-            # that can still make their deadlines.
+            # that can still make their deadlines. Terminal bookkeeping
+            # still runs: the trace gets its queue-wait span (the one span
+            # that explains a 504-from-queue) and the flight record lists
+            # the rid as retired.
             resilience.inc("deadline_expired")
+            self._observe_terminal(req, error="DeadlineExceeded")
             req.future.set_exception(req.deadline_error())
             return
+        req.admitted_at = time.perf_counter()
+        self._round_admitted.append(req.rid)
         self._slot_req[slot] = req
         # Park the slot's decode writes before its prompt starts streaming in
         # (it may still be frozen at the previous occupant's position).
@@ -1574,6 +1645,7 @@ class ContinuousBatchingScheduler:
             # visibility invariant absorbs and submit()'s overshoot bound
             # accounts for.
             req.ready = True
+            req.ready_at = time.perf_counter()
             tok = toks[i : i + 1]
             cinit = (req.constraint.init_state if req.constraint is not None
                      else 0)
@@ -1663,7 +1735,8 @@ class ContinuousBatchingScheduler:
             (self._cur, self._pos, self._counts, self._cstates, self._crem,
              toks) = out[nc:]
             n_emit = None
-        self._pending.append((issue_reqs, toks, n_emit, self._first_pending))
+        self._pending.append((issue_reqs, toks, n_emit, self._first_pending,
+                              time.perf_counter()))
         self._first_pending = []
 
     def _retire(self, slot: int, req: _Request, result: List[int]) -> None:
@@ -1671,14 +1744,31 @@ class ContinuousBatchingScheduler:
         on-device sampling knobs (a lingering temperature > 0 would defeat
         sample_runtime's all-greedy fast path for every later round)."""
         self._record_service_time(req)
+        self._observe_terminal(req)
         req.future.set_result(result)
         self._release_slot(slot)
 
     def _fail_slot(self, slot: int, req: _Request, exc: Exception) -> None:
         """Retire a slot with a typed FAILURE (deadline expiry): same slot
         release as _retire, but the future carries the error."""
+        self._observe_terminal(req, error=type(exc).__name__)
         req.future.set_exception(exc)
         self._release_slot(slot)
+
+    def _observe_terminal(self, req: _Request,
+                          error: Optional[str] = None) -> None:
+        """Per-request terminal bookkeeping BEFORE the future resolves
+        (the client reads these right after result()): flush the trace's
+        scheduler spans, stamp the measured queue wait on the future (the
+        Completion/metrics seam), and log the rid as retired for this
+        round's flight record."""
+        now = time.perf_counter()
+        req.flush_spans(now)
+        if req.trace is not None and error is not None:
+            req.trace.event("sched.error", error=error, rid=req.rid)
+        if req.admitted_at and req.submitted_at:
+            req.future._lsot_queue_wait = req.admitted_at - req.submitted_at
+        self._round_retired.append(req.rid)
 
     def _release_slot(self, slot: int) -> None:
         self._slot_req[slot] = None
@@ -1687,29 +1777,31 @@ class ContinuousBatchingScheduler:
             jnp.int32(slot)
         )
 
-    def _append_first(self, slot: int, req: _Request, first: int) -> None:
+    def _append_first(self, slot: int, req: _Request, first: int) -> int:
         """Apply a harvested prefill first-token: stop/budget checks run
         here, one round late (the slot may have decoded a garbage round
         meanwhile — absorbed by the visibility invariant and submit()'s
-        overshoot bound)."""
+        overshoot bound). Returns tokens appended (0/1) so the harvest's
+        flight record counts prefill firsts in its emitted tally."""
         if req is not self._slot_req[slot]:
-            return  # cleared by shutdown/crash path meanwhile
+            return 0  # cleared by shutdown/crash path meanwhile
         if req.cancelled:
             self._retire(slot, req, req.generated)
-            return
+            return 0
         if req.past_deadline():
             # In-flight expiry rides the cancel path's timing (next
             # harvest) but fails the future with the typed error.
             resilience.inc("deadline_expired")
             self._fail_slot(slot, req, req.deadline_error())
-            return
+            return 0
         if first in self.stop_ids or req.max_new < 1:
             self._retire(slot, req, [])
-            return
+            return 0
         req.generated.append(first)
         req.emit(first)
         if len(req.generated) >= req.max_new:
             self._retire(slot, req, req.generated)
+        return 1
 
     def _harvest_round(self) -> None:
         """Sync the OLDEST in-flight round: one device_get brings down its
@@ -1722,15 +1814,21 @@ class ContinuousBatchingScheduler:
         # without duplicating delivered tokens (chaos tests assert zero
         # lost, zero double-streamed).
         FAULTS.check("sched:crash")
-        issue_reqs, toks_dev, n_emit_dev, firsts = self._pending.popleft()
+        issue_reqs, toks_dev, n_emit_dev, firsts, t_issue = \
+            self._pending.popleft()
         toks, n_emit, first_vals = jax.device_get(
             (toks_dev, n_emit_dev, [t for (_, _, t) in firsts])
         )
         toks = np.asarray(toks)
+        t_harvest = time.perf_counter()
+        occupancy = sum(1 for r in issue_reqs if r is not None)
+        round_emitted = 0
+        spec_emitted = {"constrained": 0, "unconstrained": 0}
         # Firsts precede the round's chunk tokens in every stream: their
         # ready-scatter was dispatched before the round was issued.
         for (slot, req, _), fv in zip(firsts, first_vals):
-            self._append_first(slot, req, int(np.asarray(fv)[0]))
+            round_emitted += self._append_first(slot, req,
+                                                int(np.asarray(fv)[0]))
         # Per-slot progress this round: a slot "advanced" if it appended a
         # token or reached a terminal state. A slot that advanced nothing
         # in a HARVESTED round accrues a stall round (sweep after the
@@ -1760,6 +1858,9 @@ class ContinuousBatchingScheduler:
                 row = toks[i]
             else:
                 row = toks[i][: int(n_emit[i])]
+                cls = ("constrained" if req.constraint is not None
+                       else "unconstrained")
+                spec_emitted[cls] += int(n_emit[i])
                 if req.temperature <= 0.0 and int(n_emit[i]) > 0:
                     # Both counters move under the scheduler's lock so
                     # speculation_stats (HTTP/metrics threads) and
@@ -1791,6 +1892,20 @@ class ContinuousBatchingScheduler:
                 if len(req.generated) >= req.max_new:
                     done = True
                     break
+            appended = len(req.generated) - before
+            round_emitted += appended
+            if req.trace is not None:
+                # One span per harvested round for sampled requests: where
+                # decode time went, round by round — with the speculation
+                # acceptance and grammar-mask attrs a latency regression
+                # investigation starts from.
+                attrs = {"emitted": appended, "rid": req.rid}
+                if req.constraint is not None:
+                    attrs["grammar_mask"] = True
+                if n_emit is not None:
+                    attrs["spec_accepted"] = int(n_emit[i])
+                req.trace.add_span("sched.round", t_issue, t_harvest,
+                                   **attrs)
             if done:
                 self._retire(i, req, req.generated)
                 advanced.append(i)
@@ -1819,6 +1934,28 @@ class ContinuousBatchingScheduler:
                         f"tokens generated before the lane wedged)"
                     ))
         self.heartbeat.round_done()
+        # Flight-recorder round record (the postmortem black box): what
+        # this round DID — occupancy at issue, admission/retirement churn
+        # since the last record, tokens emitted (speculation split by
+        # class when on), round wall (issue→harvest, pipeline lag
+        # included), and the heartbeat's measured cadence. One bounded
+        # append; bench prices it.
+        ewma = self.heartbeat.expected_round_s()
+        rec = {
+            "round": self.heartbeat.rounds,
+            "occupancy": occupancy,
+            "queued": self._queue.qsize(),
+            "admitted": self._round_admitted,
+            "retired": self._round_retired,
+            "emitted": round_emitted,
+            "round_wall_s": round(t_harvest - t_issue, 6),
+            "cadence_s": round(ewma, 6) if ewma is not None else None,
+        }
+        if n_emit is not None:
+            rec["spec_emitted"] = spec_emitted
+        self.flight.record(**rec)
+        self._round_admitted = []
+        self._round_retired = []
 
     def _harvest_firsts(self) -> None:
         """Drain path: ready slots whose first token never rode a round."""
@@ -1839,6 +1976,10 @@ class ContinuousBatchingScheduler:
             # breaker-relevant) from a per-request failure (500).
             wrapped = SchedulerCrashed.from_exception(exc)
             self._crash = wrapped
+            # Black-box marker: the postmortem dump shows the crash beside
+            # the rounds that led up to it.
+            self.flight.event("crash", error=str(exc)[:200],
+                              error_type=type(exc).__name__)
             self._close(wrapped)
             raise
 
@@ -1970,6 +2111,16 @@ class SchedulerPool:
         self.schedulers = list(schedulers)
         self._rr = 0
         self._lock = threading.Lock()
+        # Attribute each replica's flight records: a pool's merged
+        # postmortem/debug view must say WHICH replica's rounds these were
+        # (the load-signal feed the multi-replica ROADMAP item needs).
+        # "r{i}" matches the single-scheduler recorder default ("r0") and
+        # the Prometheus exposition's per-replica label scheme, so the
+        # histogram and serving-gauge families join on `replica`.
+        for i, s in enumerate(self.schedulers):
+            fl = getattr(s, "flight", None)
+            if fl is not None:
+                fl.replica = f"r{i}"
 
     # Admission-arithmetic surface, so SchedulerBackend can wrap a pool the
     # same way it wraps one scheduler (replicas are homogeneous: same cfg,
@@ -2036,6 +2187,50 @@ class SchedulerPool:
             ),
         }
 
+    @property
+    def flight(self):
+        """First replica's recorder (single-scheduler duck typing);
+        flight_snapshot() is the merged pool view."""
+        return self.schedulers[0].flight
+
+    def flight_snapshot(self, last: Optional[int] = None) -> List[Dict]:
+        """All replicas' flight records merged in time order — each
+        record carries its replica label, so the pool view attributes
+        every round to the replica that ran it."""
+        return merge_snapshots(self.schedulers, last)
+
+    def flight_stats(self) -> Dict[str, Dict]:
+        """Per-replica ring occupancy for /metrics: without this seam the
+        backend's duck-typed `.flight` read would surface replica 0's
+        counters only, hiding r1..rN's fill/overwrite on a dp>1 pool."""
+        out: Dict[str, Dict] = {}
+        for i, s in enumerate(self.schedulers):
+            fl = getattr(s, "flight", None)
+            if fl is not None:
+                out[getattr(fl, "replica", f"r{i}")] = fl.stats()
+        return out
+
+    def replica_loads(self) -> List[Dict[str, object]]:
+        """Per-replica load attribution (queue depth, live slots, round
+        cadence, crash state, retry hint): the placement-score feed a
+        least-loaded router would consume — today's round-robin finally
+        has something to be compared against."""
+        out = []
+        for i, s in enumerate(self.schedulers):
+            hb = s.heartbeat.snapshot()
+            out.append({
+                "replica": getattr(s.flight, "replica", f"r{i}"),
+                "queued": s._queue.qsize(),
+                "active_slots": sum(
+                    1 for r in s._slot_req if r is not None
+                ),
+                "num_slots": s.num_slots,
+                "expected_round_s": hb.get("expected_round_s"),
+                "crashed": s._crash is not None,
+                "retry_after_s": round(s.retry_after_hint(), 3),
+            })
+        return out
+
     def start(self) -> "SchedulerPool":
         for s in self.schedulers:
             s.start()
@@ -2053,7 +2248,7 @@ class SchedulerPool:
 
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
-               on_token=None, constraint=None, deadline_s=None):
+               on_token=None, constraint=None, deadline_s=None, trace=None):
         # Skip replicas whose event loop has crashed: a dead scheduler must
         # not keep failing its round-robin share while healthy ones idle.
         # The try/except covers the race where a replica dies between the
@@ -2066,11 +2261,15 @@ class SchedulerPool:
             if sched._crash is not None:
                 continue
             try:
-                return sched.submit(
+                fut = sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
                     seed=seed, on_token=on_token, constraint=constraint,
-                    deadline_s=deadline_s,
+                    deadline_s=deadline_s, trace=trace,
                 )
+                # Replica attribution for the metrics label set: which
+                # replica actually served this submit.
+                fut._lsot_replica = getattr(sched.flight, "replica", "")
+                return fut
             except ValueError:
                 # Request-shape rejection (oversize prompt): identical on
                 # every replica — re-raise rather than spinning the ring.
@@ -2204,6 +2403,23 @@ class SchedulerBackend:
         wd = getattr(self.scheduler, "watchdog_stats", None)
         if wd is not None:
             out["watchdog"] = wd
+        # Flight-recorder occupancy (counts only — the records themselves
+        # live at /debug/flightrecorder, too hot-path-adjacent for every
+        # /metrics scrape to serialize). Prefer the flight_stats() seam:
+        # a SupervisedScheduler's own `.flight` is the sparse lifecycle
+        # ring, not the per-round ring an operator monitors.
+        fs = getattr(self.scheduler, "flight_stats", None)
+        if callable(fs):
+            out["flight_recorder"] = fs()
+        else:
+            fl = getattr(self.scheduler, "flight", None)
+            if fl is not None:
+                out["flight_recorder"] = fl.stats()
+        # Per-replica load attribution (SchedulerPool): queue depth ×
+        # cadence per replica, the placement-score feed.
+        loads = getattr(self.scheduler, "replica_loads", None)
+        if callable(loads):
+            out["replicas"] = loads()
         sup = self.health()
         if sup is not None:
             out["supervisor"] = sup
@@ -2232,6 +2448,7 @@ class SchedulerBackend:
         journal_spill: Optional[str] = None,
         stall_factor: float = 16.0,
         stall_min_s: float = 10.0,
+        stall_warmup_s: float = 0.0,
         **kwargs,
     ) -> "SchedulerBackend":
         """Deployment path for concurrent serving: HF checkpoint straight
@@ -2298,6 +2515,7 @@ class SchedulerBackend:
                 make_sched, max_restarts=max_restarts,
                 spill_path=journal_spill,
                 stall_factor=stall_factor, stall_min_s=stall_min_s,
+                warmup_grace_s=stall_warmup_s,
                 name=f"scheduler:{os.path.basename(ckpt_dir.rstrip('/'))}",
             ), tokenizer, **kwargs)
         return cls(make_sched(), tokenizer, **kwargs)
@@ -2326,6 +2544,7 @@ class SchedulerBackend:
         journal_spill: Optional[str] = None,
         stall_factor: float = 16.0,
         stall_min_s: float = 10.0,
+        stall_warmup_s: float = 0.0,
         **kwargs,
     ) -> "SchedulerBackend":
         """GGUF blob -> continuous-batching scheduler (C++ parse + dequant,
@@ -2380,9 +2599,26 @@ class SchedulerBackend:
                 make_sched, max_restarts=max_restarts,
                 spill_path=journal_spill,
                 stall_factor=stall_factor, stall_min_s=stall_min_s,
+                warmup_grace_s=stall_warmup_s,
                 name=f"scheduler:{os.path.basename(gguf_path)}",
             ), tokenizer, **kwargs)
         return cls(make_sched(), tokenizer, **kwargs)
+
+    def _rclass(self, constrain) -> str:
+        """The request-class label for the metrics histograms: grammar
+        constraining and speculation have distinct latency profiles, and
+        an operator pricing the NL→SQL hot path needs ITS numbers."""
+        parts = []
+        if constrain is not None:
+            parts.append("constrained")
+        if getattr(self.scheduler, "_spec_draft", 0):
+            parts.append("speculative")
+        return "+".join(parts)
+
+    def flight_snapshot(self, last: Optional[int] = None):
+        """Live flight-recorder view (per-round records; pool-merged and
+        replica-labeled for dp>1) — the /debug/flightrecorder payload."""
+        return merge_snapshots([self.scheduler], last)
 
     def check_budget(self, prompt: str,
                      max_new_tokens: Optional[int] = None,
@@ -2461,6 +2697,7 @@ class SchedulerBackend:
         BPE/sentencepiece boundaries, the cost is host-side microseconds
         per token against human-reading-rate output, and exactness vs the
         blocking path is the contract the tests pin."""
+        from ..utils import tracing
         from .backends import trim_stop_texts
 
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
@@ -2470,6 +2707,7 @@ class SchedulerBackend:
             # token counts (holdbacks merge many tokens into one chunk).
             stats_out["prompt_tokens"] = len(ids)
         toks: "queue.Queue[int]" = queue.Queue()
+        trace = tracing.current()
         t_submit = time.perf_counter()
         on_tok, first_at = _first_token_timer(toks.put)
         fut = self.scheduler.submit(
@@ -2478,6 +2716,7 @@ class SchedulerBackend:
             on_token=on_tok, **self._constraint_kwargs(constrain),
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
+            trace=trace,
         )
         out_ids: List[int] = []
         emitted = ""
@@ -2526,16 +2765,42 @@ class SchedulerBackend:
             # cancel so the slot stops decoding an abandoned request.
             if not fut.done():
                 self.scheduler.cancel(fut)
+                if trace is not None:
+                    # Traced abandon: the worker flushes the sched.* spans
+                    # at the retiring harvest, but the HTTP layer exports
+                    # the trace the moment this generator closes — without
+                    # a bounded wait the artifact for exactly the
+                    # abandoned/stuck streams being diagnosed would carry
+                    # stream.deliver and zero scheduler spans. One harvest
+                    # normally lands in milliseconds; the cap keeps a
+                    # wedged loop from hanging disconnect cleanup.
+                    try:
+                        fut.result(timeout=2.0)
+                    except Exception:  # noqa: BLE001 — export best-effort
+                        pass
+            if trace is not None:
+                # The delivery window: first submit to last chunk handed
+                # to the consumer — what the CLIENT experienced, beside
+                # the scheduler-side decode spans.
+                trace.add_span("stream.deliver", t_submit,
+                               time.perf_counter(), chunks=len(out_ids))
             if stats_out is not None:
                 stats_out["output_tokens"] = len(out_ids)
                 if first_at:
                     stats_out["ttft_s"] = first_at[0] - t_submit
+                qw = getattr(fut, "_lsot_queue_wait", 0.0)
+                if qw:
+                    stats_out["queue_wait_s"] = qw
+                stats_out["rclass"] = self._rclass(constrain)
+                stats_out["replica"] = getattr(fut, "_lsot_replica", "")
 
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
                  constrain=None, deadline_s: Optional[float] = None,
                  idempotency_key: Optional[str] = None):
         from .backends import Completion, trim_stop_texts
+
+        from ..utils import tracing
 
         ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
         t_submit = time.perf_counter()
@@ -2546,18 +2811,23 @@ class SchedulerBackend:
             # GenerationService gates on supports_idempotency before
             # forwarding, so a bare scheduler never sees the kwarg.
             kwargs["idempotency_key"] = idempotency_key
-        out = self.scheduler.submit(
+        fut = self.scheduler.submit(
             ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
             sampling=sampling or self.sampling, seed=seed, on_token=on_tok,
             **self._constraint_kwargs(constrain),
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
+            trace=tracing.current(),
             **kwargs,
-        ).result()
+        )
+        out = fut.result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
         return Completion(text=text, output_tokens=len(out),
                           prompt_tokens=len(ids),
-                          ttft_s=(first_at[0] - t_submit) if first_at else 0.0)
+                          ttft_s=(first_at[0] - t_submit) if first_at else 0.0,
+                          queue_wait_s=getattr(fut, "_lsot_queue_wait", 0.0),
+                          rclass=self._rclass(constrain),
+                          replica=getattr(fut, "_lsot_replica", ""))
 
     def complete_batch(
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
@@ -2595,5 +2865,8 @@ class SchedulerBackend:
             completions.append(Completion(
                 text=text, output_tokens=len(out), prompt_tokens=len(ids),
                 ttft_s=(fl[0] - t_submit) if fl else 0.0,
+                queue_wait_s=getattr(fut, "_lsot_queue_wait", 0.0),
+                rclass=self._rclass(constrain),
+                replica=getattr(fut, "_lsot_replica", ""),
             ))
         return completions

@@ -10,18 +10,21 @@ observability: per-request tok/s and latency counters).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import threading
 import time
 from typing import Dict, Optional
 
 from ..ops.sampling import SamplingParams
+from ..utils import tracing
 from ..utils.observability import (
     MetricsRegistry,
     RequestMetrics,
     resilience,
     trace_capture,
 )
+from ..utils.tracing import TRACER
 from .templates import TEMPLATES, Template
 
 
@@ -34,6 +37,13 @@ class GenerateResult:
     model: str
     latency_s: float
     output_tokens: int
+    # Per-request latency decomposition (scheduler-path backends; 0.0 =
+    # not measured): TTFT and queue wait — the evalh report's "where
+    # latency lives" columns read these.
+    ttft_s: float = 0.0
+    queue_wait_s: float = 0.0
+    # Trace-correlation id when the request ran under one.
+    request_id: str = ""
 
     @property
     def tok_per_s(self) -> float:
@@ -122,6 +132,41 @@ class GenerationService:
             if breakers:
                 snap["resilience"]["breakers"] = breakers
         return snap
+
+    def metrics_prometheus(self) -> str:
+        """The same payload in Prometheus exposition text
+        (`/metrics?format=prometheus`), plus the registry's fixed-bucket
+        TTFT/TPOT/queue-wait/latency histograms — which aggregate across
+        scrapes and replicas where windowed percentiles cannot."""
+        from ..utils.prometheus import render_prometheus
+
+        return render_prometheus(self.metrics_snapshot(),
+                                 self.metrics.histograms)
+
+    def flight_snapshot(self, last: Optional[int] = None) -> Dict[str, list]:
+        """Per-model flight-recorder records (backends exposing the
+        seam; replica-labeled, lifecycle events merged for supervised
+        schedulers) — the /debug/flightrecorder payload. Backends are
+        deduped by underlying scheduler like health()/drain(), so a
+        shared scheduler's ring is not reported twice."""
+        out: Dict[str, list] = {}
+        with self._lock:
+            entries = list(self._models.values())
+        seen = set()
+        for e in entries:
+            fn = getattr(e.backend, "flight_snapshot", None)
+            if not callable(fn):
+                continue
+            key = id(getattr(e.backend, "scheduler", e.backend))
+            if key in seen:
+                continue
+            seen.add(key)
+            out[e.name] = fn(last)
+        return out
+
+    def recent_traces(self, n: Optional[int] = None) -> list:
+        """Last head-sampled request traces (the /debug/traces payload)."""
+        return TRACER.recent(n)
 
     # ------------------------------------------------------------- lifecycle
 
@@ -292,17 +337,30 @@ class GenerationService:
         constrain=None,
         deadline_s: Optional[float] = None,
         idempotency_key: Optional[str] = None,
+        request_id: Optional[str] = None,
     ) -> GenerateResult:
         entry = self._entry(model)
         rendered = entry.template(system, prompt)
+        # Request-scoped tracing: honor the HTTP layer's sampling
+        # decision when one exists, else head-sample here — the shared
+        # entry-point dance (tracing.begin_or_ambient).
+        tr, own, rid = tracing.begin_or_ambient(request_id, model)
         t0 = time.perf_counter()
-        with trace_capture(f"generate-{model}"):
-            completion = entry.backend.complete(
-                rendered, max_new_tokens=max_new_tokens, sampling=sampling,
-                seed=seed, **self._constrain_kwargs(entry, constrain),
-                **self._deadline_kwargs(entry, deadline_s),
-                **self._idempotency_kwargs(entry, idempotency_key),
-            )
+        try:
+            with tracing.use(tr) if own is not None else contextlib.nullcontext():
+                with tracing.span("service.generate", model=model,
+                                  constrained=constrain is not None):
+                    with trace_capture(f"generate-{model}"):
+                        completion = entry.backend.complete(
+                            rendered, max_new_tokens=max_new_tokens,
+                            sampling=sampling, seed=seed,
+                            **self._constrain_kwargs(entry, constrain),
+                            **self._deadline_kwargs(entry, deadline_s),
+                            **self._idempotency_kwargs(entry,
+                                                       idempotency_key),
+                        )
+        finally:
+            TRACER.finish(own)
         latency = time.perf_counter() - t0
         with self._lock:
             s = self.stats[model]
@@ -315,12 +373,19 @@ class GenerationService:
             output_tokens=completion.output_tokens,
             latency_s=latency,
             ttft_s=getattr(completion, "ttft_s", 0.0),
+            queue_wait_s=getattr(completion, "queue_wait_s", 0.0),
+            rclass=getattr(completion, "rclass", ""),
+            replica=getattr(completion, "replica", ""),
+            request_id=rid,
         ))
         return GenerateResult(
             response=completion.text,
             model=model,
             latency_s=latency,
             output_tokens=completion.output_tokens,
+            ttft_s=getattr(completion, "ttft_s", 0.0),
+            queue_wait_s=getattr(completion, "queue_wait_s", 0.0),
+            request_id=rid,
         )
 
     def validate(
@@ -375,6 +440,7 @@ class GenerationService:
         seed: int = 0,
         constrain=None,
         deadline_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ):
         """Yield the completion as text chunks while it decodes (Ollama's
         `stream=true` surface). Backends without a `complete_stream` seam
@@ -384,16 +450,33 @@ class GenerationService:
         ckw = self._constrain_kwargs(entry, constrain)
         ckw.update(self._deadline_kwargs(entry, deadline_s))
         rendered = entry.template(system, prompt)
+        # Tracing: the BACKEND generator reads tracing.current() at its
+        # first step (the scheduler's complete_stream captures it before
+        # submit), which runs inside THIS generator's frame. The shared
+        # entry-point dance decides the sample (tracing.begin_or_ambient);
+        # when this call drew it (`own`), the context is entered only
+        # around backend ADVANCEMENT, never across our own yields — a
+        # contextvar set held across a yield leaks into the caller's
+        # frame between steps (generators don't isolate contextvars), so
+        # a library caller interleaving two sampled streams would record
+        # request B's spans into request A's tree.
+        tr, own, rid = tracing.begin_or_ambient(request_id, model)
+
+        def _ctx():
+            return tracing.use(tr) if own is not None \
+                else contextlib.nullcontext()
+
         t0 = time.perf_counter()
         out_tokens = prompt_tokens = 0
         stream_stats: dict = {}
         try:
             streamer = getattr(entry.backend, "complete_stream", None)
             if streamer is None:
-                completion = entry.backend.complete(
-                    rendered, max_new_tokens=max_new_tokens, sampling=sampling,
-                    seed=seed, **ckw,
-                )
+                with _ctx():
+                    completion = entry.backend.complete(
+                        rendered, max_new_tokens=max_new_tokens,
+                        sampling=sampling, seed=seed, **ckw,
+                    )
                 out_tokens, prompt_tokens = (completion.output_tokens,
                                              completion.prompt_tokens)
                 if completion.text:
@@ -409,13 +492,22 @@ class GenerationService:
                 )
                 try:
                     with trace_capture(f"generate-{model}"):
-                        for chunk in inner:
+                        # tracing.stepwise: the backend advances under
+                        # the trace context, which is never held across
+                        # our own yields (the generator/contextvar
+                        # hazard). Only needed when this call drew the
+                        # sample; the HTTP path advances plain.
+                        src = tracing.stepwise(inner, tr) \
+                            if own is not None else inner
+                        for chunk in src:
                             yield chunk
                 finally:
-                    # Deterministically unwind the backend generator (its
-                    # finally cancels the scheduler request and fills
-                    # stats_out) BEFORE the accounting below reads it — a
-                    # disconnect would otherwise leave it to the GC.
+                    # Deterministically unwind the backend generator
+                    # (its finally cancels the scheduler request and
+                    # fills stats_out) BEFORE the accounting below
+                    # reads it — a disconnect would otherwise leave it
+                    # to the GC. No trace context needed: the backend
+                    # captured its trace object at its first step.
                     inner.close()
         finally:
             # Record even when the client disconnects mid-stream (the WSGI
@@ -423,6 +515,7 @@ class GenerationService:
             # disconnect-heavy streaming must not vanish from the serving
             # metrics. The backend's own finally has filled stats_out by
             # the time the generator unwinds.
+            TRACER.finish(own)
             out_tokens = stream_stats.get("output_tokens", out_tokens)
             prompt_tokens = stream_stats.get("prompt_tokens", prompt_tokens)
             latency = time.perf_counter() - t0
@@ -437,6 +530,10 @@ class GenerationService:
                 output_tokens=out_tokens,
                 latency_s=latency,
                 ttft_s=stream_stats.get("ttft_s", 0.0),
+                queue_wait_s=stream_stats.get("queue_wait_s", 0.0),
+                rclass=stream_stats.get("rclass", ""),
+                replica=stream_stats.get("replica", ""),
+                request_id=rid,
             ))
 
     def generate_batch(

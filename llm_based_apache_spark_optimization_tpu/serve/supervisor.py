@@ -93,6 +93,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..ops.sampling import SamplingParams
 from ..utils.observability import resilience
+from .flightrecorder import FlightRecorder, append_jsonl, merge_snapshots
 from .resilience import (
     CircuitBreaker,
     Deadline,
@@ -139,6 +140,11 @@ class JournalEntry:
     # `constraint_resolver` (set by SchedulerBackend, which owns the
     # tokenizer the tables must be compiled against).
     constraint_spec: object = None
+    # Request-scoped trace (utils/tracing.RequestTrace) when the request
+    # was head-sampled: forwarded to every inner-scheduler attempt (the
+    # replayed incarnation records into the SAME tree), and its span tree
+    # rides the postmortem dump for requests caught in a crash/stall.
+    trace: object = None
 
 
 class SupervisedScheduler:
@@ -174,6 +180,8 @@ class SupervisedScheduler:
         stall_factor: float = 16.0,
         stall_min_s: float = 10.0,
         stall_join_s: Optional[float] = None,
+        warmup_grace_s: float = 0.0,
+        postmortem_path: Optional[str] = None,
     ):
         if max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
@@ -231,6 +239,34 @@ class SupervisedScheduler:
         else:
             self._stall_join_s = None
         self._stalls = 0
+        # Warmup-aware stall floor (ISSUE 6 satellite; the carried
+        # ROADMAP item "watchdog stall floor vs first-boot cold
+        # compiles"): for `warmup_grace_s` after start()/each restart —
+        # and only while the inner has harvested ZERO rounds — the
+        # watchdog's floor is raised to the grace value, so a first-boot
+        # cold XLA compile (which blocks the loop thread exactly like a
+        # wedge) cannot be escalated as one. The first harvested round
+        # proves the programs are warm and ends the grace early. 0
+        # disables (the library default — tight-threshold tests and
+        # pre-warmed deployments keep today's behavior); the app wires
+        # LSOT_STALL_WARMUP_S (default 120 s) through AppConfig.
+        self.warmup_grace_s = float(warmup_grace_s)
+        self._grace_until = 0.0
+        # Postmortem dump (the flight recorder's exit path): on
+        # crash/stall escalation and on drain, the supervisor writes its
+        # lifecycle events + the inner's last-N round records + the
+        # still-pending requests' span trees as JSONL here — next to the
+        # journal spill by default.
+        if postmortem_path is not None:
+            self.postmortem_path: Optional[str] = postmortem_path or None
+        elif spill_path:
+            self.postmortem_path = f"{spill_path}.postmortem.jsonl"
+        else:
+            self.postmortem_path = os.environ.get("LSOT_POSTMORTEM") or None
+        #: Lifecycle black box (serve/flightrecorder.py): restart/stall/
+        #: drain/dead markers, merged with the inner's per-round records
+        #: in flight_snapshot() and the postmortem dump.
+        self.flight = FlightRecorder(capacity=64, replica=name)
         # Expected-recovery instant (monotonic) while a restart backoff
         # sleep is pending: retry_after_hint() folds it in so shed/drain
         # hints during a stall stay honest (the inner's queue-depth ×
@@ -266,6 +302,8 @@ class SupervisedScheduler:
 
     def start(self) -> "SupervisedScheduler":
         self._inner.start()
+        self._grace_until = time.monotonic() + self.warmup_grace_s
+        self.flight.event("start")
         if self.stall_min_s > 0 and self._watch_thread is None \
                 and getattr(self._inner, "heartbeat", None) is not None:
             self._watch_stop.clear()
@@ -390,6 +428,7 @@ class SupervisedScheduler:
         idempotency_key: Optional[str] = None,
         idempotent: bool = True,
         constraint_spec=None,
+        trace=None,
     ) -> "Future[List[int]]":
         """Journal + submit. The returned future survives loop crashes: it
         resolves from whichever scheduler incarnation finishes the work.
@@ -468,6 +507,7 @@ class SupervisedScheduler:
                 on_token=on_token,
                 idempotent=idempotent,
                 future=Future(),
+                trace=trace,
             )
             self._next_rid += 1
             entry.future._lsot_entry = entry  # cancel() handle
@@ -563,9 +603,31 @@ class SupervisedScheduler:
         hb = self.heartbeat
         out["stalls_detected"] = self._stalls
         out["stall_threshold_s"] = (
-            round(stall_threshold(hb, self.stall_factor, self.stall_min_s), 3)
+            round(stall_threshold(hb, self.stall_factor,
+                                  self._effective_floor(hb)), 3)
             if hb is not None and self.stall_min_s > 0 else None
         )
+        # Operators reading a raised threshold need to know WHY: the
+        # warmup grace window is active until the first harvested round.
+        out["warmup_grace_active"] = self._warmup_grace_active()
+        return out
+
+    def flight_stats(self) -> Dict[str, object]:
+        """Ring occupancy for /metrics: the INNER scheduler's per-round
+        ring — the one sized by LSOT_FLIGHT_ROUNDS, whose fill/overwrite
+        counters an operator actually monitors — beside this supervisor's
+        small lifecycle ring. Without the split, `getattr(sched, 'flight')`
+        on a supervised backend resolves to the sparse 64-slot lifecycle
+        recorder and /metrics reports the wrong ring."""
+        out: Dict[str, object] = {"supervisor": self.flight.stats()}
+        inner = self._inner
+        fs = getattr(inner, "flight_stats", None)
+        if callable(fs):
+            out["scheduler"] = fs()
+        else:
+            fl = getattr(inner, "flight", None)
+            if fl is not None:
+                out["scheduler"] = fl.stats()
         return out
 
     # ----------------------------------------------------------------- drain
@@ -583,6 +645,11 @@ class SupervisedScheduler:
         with self._drain_lock:
             if self._drain_report is not None:
                 return self._drain_report
+            self.flight.event("drain", deadline_s=deadline_s)
+            # SIGTERM is a black-box moment too: dump what the scheduler
+            # was doing (and which requests were mid-flight) before the
+            # spill/shutdown churns the state.
+            self._postmortem_dump("drain")
             with self._lock:
                 self._draining = True
                 waiting = [e for e in self._journal.values() if not e.done]
@@ -869,10 +936,15 @@ class SupervisedScheduler:
         # prefix length cannot grow under the snapshot.
         entry.inner = None
         tap, cell = self._make_on_token(entry)
+        kwargs = {}
+        if entry.trace is not None:
+            # Forwarded only when sampled: duck-typed inners without the
+            # tracing seam (the chaos harness's toy replica) keep working.
+            kwargs["trace"] = entry.trace
         fut = self._inner.submit(
             entry.ids, max_new_tokens=entry.max_new, sampling=entry.sampling,
             seed=entry.seed, on_token=tap,
-            constraint=entry.constraint, deadline_s=deadline_s,
+            constraint=entry.constraint, deadline_s=deadline_s, **kwargs,
         )
         entry.inner = fut
         cell["fut"] = fut
@@ -915,6 +987,13 @@ class SupervisedScheduler:
     def _finish_locked(self, entry: JournalEntry, result: List[int]) -> None:
         entry.done = True
         self._journal.pop(entry.rid, None)
+        # Surface the serving attempt's measured queue wait / replica on
+        # the CLIENT-facing future (the inner future is an implementation
+        # detail that dies with the loop).
+        for attr in ("_lsot_queue_wait", "_lsot_replica"):
+            v = getattr(entry.inner, attr, None)
+            if v is not None:
+                setattr(entry.future, attr, v)
         if entry.idempotency_key is not None:
             if self._by_key.get(entry.idempotency_key) is entry:
                 del self._by_key[entry.idempotency_key]
@@ -950,6 +1029,10 @@ class SupervisedScheduler:
             return  # single-flight: one restart driver at a time
         self._breaker.record_failure()
         self._state = "restarting"
+        self.flight.event(
+            "stall" if isinstance(exc, SchedulerStalled) else "crash",
+            error=str(exc)[:200],
+        )
         _log.warning("scheduler loop crashed; supervisor restarting: %s", exc)
         threading.Thread(
             target=self._restart_and_replay, daemon=True,
@@ -961,6 +1044,13 @@ class SupervisedScheduler:
         rebuild with backoff under the restart budget, replay the journal.
         A crash DURING replay loops back to another rebuild; budget
         exhaustion fails everything typed and marks the supervisor dead."""
+        # The black-box moment: dump the postmortem BEFORE teardown churns
+        # anything — supervisor lifecycle + the dead loop's last-N rounds
+        # + the hung requests' span trees, next to the journal spill.
+        self._postmortem_dump(
+            "stall" if isinstance(self._crash_exc, SchedulerStalled)
+            else "crash"
+        )
         while True:
             old = self._inner
             try:
@@ -1017,7 +1107,14 @@ class SupervisedScheduler:
                     continue  # the fresh loop died mid-replay: go again
                 self._state = "degraded" if lost else "ready"
                 self._restart_eta = None
+                # The rebuilt loop recompiled nothing (warmup() above ran
+                # while the monitor was quiet), but re-open the grace
+                # window anyway: a pool rebuild or a changed shape can
+                # still compile lazily on the first real admission.
+                self._grace_until = time.monotonic() + self.warmup_grace_s
                 self._breaker.record_success()
+                self.flight.event("restart", attempt=self._restarts,
+                                  state=self._state, lost=lost)
                 _log.info(
                     "scheduler restarted (restart %d/%d): state=%s lost=%d",
                     self._restarts, self.max_restarts, self._state, lost,
@@ -1126,6 +1223,103 @@ class SupervisedScheduler:
         else:
             sched.shutdown()
 
+    def _effective_floor(self, hb) -> float:
+        """The watchdog floor, warmup-aware: during the post-(re)start
+        grace window — and only while the loop has harvested ZERO rounds
+        (the first harvest proves the XLA programs are warm) — the floor
+        is raised to `warmup_grace_s`, so a first-boot cold compile that
+        blocks the loop thread exactly like a wedge cannot be escalated
+        as one. Outside the window (or once disabled) it is stall_min_s,
+        unchanged."""
+        if self.warmup_grace_s <= 0:
+            return self.stall_min_s
+        if self._hb_cold(hb) and time.monotonic() < self._grace_until:
+            return max(self.stall_min_s, self.warmup_grace_s)
+        return self.stall_min_s
+
+    @staticmethod
+    def _hb_cold(hb) -> bool:
+        """Still in first-boot compile territory? Prefer the heartbeat's
+        `cold` property (CombinedHeartbeat: ANY replica at zero rounds —
+        the pool-summed `rounds` would let one warmed replica end the
+        grace while a sibling's cold compile still reads as a wedge);
+        fall back to rounds==0 for single heartbeats."""
+        cold = getattr(hb, "cold", None)
+        if cold is not None:
+            return bool(cold)
+        return getattr(hb, "rounds", 1) == 0
+
+    def _warmup_grace_active(self) -> bool:
+        hb = self.heartbeat
+        return (self.warmup_grace_s > 0 and hb is not None
+                and self._hb_cold(hb)
+                and time.monotonic() < self._grace_until)
+
+    def flight_snapshot(self, last: Optional[int] = None) -> List[Dict]:
+        """Merged black-box view: the live inner's per-round records
+        (pool-merged when the inner is a SchedulerPool) + this
+        supervisor's lifecycle events, in time order — the
+        /debug/flightrecorder payload for supervised backends."""
+        return merge_snapshots([self.flight, self._inner], last)
+
+    def _postmortem_dump(self, reason: str) -> Optional[str]:
+        """Write the black box to disk: supervisor lifecycle events, the
+        inner's last-N round records, and the span trees of every
+        still-pending (hung) request — one JSONL, next to the journal
+        spill. Returns the path (None when no postmortem path is
+        configured — the last rounds still go to the restart log either
+        way). Never raises: the postmortem writer must not turn a crash
+        into a second crash."""
+        try:
+            rounds = self.flight_snapshot()
+            with self._lock:
+                pending = [e for e in self._journal.values() if not e.done]
+            traces = []
+            for e in pending:
+                rec: Dict[str, object] = {
+                    "rid": e.rid, "delivered": len(e.generated),
+                    "max_new": e.max_new,
+                    "idempotency_key": e.idempotency_key,
+                }
+                if e.trace is not None:
+                    try:
+                        rec["trace"] = e.trace.to_dict()
+                    except Exception:  # noqa: BLE001 — a broken trace stays out
+                        pass
+                traces.append(rec)
+            # The restart log gets the tail even with no dump file: the
+            # "what was it doing" question must be answerable from logs
+            # alone on a diskless deployment.
+            tail = [r for r in rounds if "round" in r][-5:]
+            _log.warning(
+                "%s postmortem (%s): %d pending request(s), last rounds: %s",
+                self.name, reason, len(pending),
+                json.dumps(tail) if tail else "none recorded",
+            )
+            if not self.postmortem_path:
+                return None
+            # APPEND, never truncate (append_jsonl): every dump starts
+            # with its own "kind": "postmortem" header, so a routine
+            # SIGTERM-drain dump cannot clobber the stall/crash evidence
+            # written minutes earlier — the whole point of the black box.
+            # Readers take the records after the last header they care
+            # about.
+            header = {
+                "kind": "postmortem", "reason": reason,
+                "name": self.name, "ts": time.time(),
+                "state": self._state, "restarts": self._restarts,
+                "stalls": self._stalls, "pending": len(pending),
+            }
+            written = append_jsonl(self.postmortem_path, [
+                header,
+                *rounds,
+                *({"kind": "pending_request", **t} for t in traces),
+            ])
+            return self.postmortem_path if written else None
+        except Exception:  # noqa: BLE001 — diagnostics must never crash recovery
+            _log.exception("postmortem dump failed")
+            return None
+
     def _watch_loop(self) -> None:
         """The watchdog monitor: poll the live inner's heartbeat and
         escalate a busy loop whose stamp has gone stale past the stall
@@ -1146,7 +1340,7 @@ class SupervisedScheduler:
                 continue
             age = hb.age()
             threshold = stall_threshold(hb, self.stall_factor,
-                                        self.stall_min_s)
+                                        self._effective_floor(hb))
             if age <= threshold:
                 continue
             exc = SchedulerStalled(
@@ -1167,6 +1361,7 @@ class SupervisedScheduler:
     def _die_locked(self) -> None:
         self._state = "dead"
         self._restart_eta = None
+        self.flight.event("dead", restarts=self._restarts)
         err = self._dead_error()
         _log.error("supervisor giving up: %s", err)
         for e in list(self._journal.values()):

@@ -158,6 +158,15 @@ class CombinedHeartbeat:
     def rounds(self) -> int:
         return sum(h.rounds for h in self._hbs)
 
+    @property
+    def cold(self) -> bool:
+        """ANY replica still at zero harvested rounds: the pool is still
+        in first-boot compile territory. The summed `rounds` cannot gate
+        a warmup grace window — one warmed replica would end the grace
+        for siblings whose first cold XLA compile is still blocking
+        their loop (and reading as a wedge)."""
+        return any(h.rounds == 0 for h in self._hbs)
+
     def expected_round_s(self) -> Optional[float]:
         vals = [v for v in (h.expected_round_s() for h in self._hbs)
                 if v is not None]

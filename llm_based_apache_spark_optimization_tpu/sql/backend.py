@@ -108,15 +108,18 @@ class ResilientSQLBackend:
         self._rng = rng if rng is not None else random.Random()
 
     def load_csv(self, path: str, view_name: str = "temp_view") -> TableSchema:
+        from ..utils import tracing
         from ..utils.faults import FAULTS
 
         # No retry: load failures (missing file, malformed CSV) are
         # deterministic; the seam exists so chaos runs can fail the load
         # boundary too.
-        FAULTS.check("sql:load")
-        return self.inner.load_csv(path, view_name)
+        with tracing.span("sql.load", view=view_name):
+            FAULTS.check("sql:load")
+            return self.inner.load_csv(path, view_name)
 
     def execute(self, sql: str) -> ResultTable:
+        from ..utils import tracing
         from ..utils.faults import FAULTS
 
         if not self._breaker.allow():
@@ -130,19 +133,25 @@ class ResilientSQLBackend:
             FAULTS.check("sql:exec")
             return self.inner.execute(sql)
 
-        try:
-            out = self._retry.call(
-                attempt, retryable=is_transient_sql_error, rng=self._rng,
-            )
-        except Exception as e:
-            if is_transient_sql_error(e):
-                self._breaker.record_failure()
-            else:
-                # The engine answered (with an error): it is up.
-                self._breaker.record_success()
-            raise
-        self._breaker.record_success()
-        return out
+        # The span covers the whole retry ladder (what the REQUEST paid),
+        # not one attempt — retries are an attr, not separate spans.
+        with tracing.span("sql.exec"):
+            try:
+                out = self._retry.call(
+                    attempt, retryable=is_transient_sql_error, rng=self._rng,
+                )
+            except Exception as e:
+                if is_transient_sql_error(e):
+                    self._breaker.record_failure()
+                else:
+                    # The engine answered (with an error): it is up.
+                    self._breaker.record_success()
+                raise
+            self._breaker.record_success()
+            return out
 
     def write_csv(self, result: ResultTable, out_path: str) -> str:
-        return self.inner.write_csv(result, out_path)
+        from ..utils import tracing
+
+        with tracing.span("sql.write_csv"):
+            return self.inner.write_csv(result, out_path)

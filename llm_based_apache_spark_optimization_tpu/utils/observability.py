@@ -21,16 +21,47 @@ threads and the continuous-batching scheduler loop alike.
 
 from __future__ import annotations
 
+import bisect
 import contextlib
 import dataclasses
 import json
 import logging
 import os
+import random
 import threading
 import time
-from typing import Dict, Iterator, List, Optional
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 log = logging.getLogger("lsot.metrics")
+
+
+#: App-startup override (AppConfig.request_log → reconfigure_request_log);
+#: None falls through to the LSOT_REQUEST_LOG env read below.
+_LOG_SAMPLE_OVERRIDE: Optional[float] = None
+
+
+def _request_log_sample() -> float:
+    """LSOT_REQUEST_LOG: fraction of requests whose JSON log line is
+    emitted (default 1.0 = all, 0 disables). The line used to be
+    unconditional — string-formatting + I/O per request at high QPS."""
+    if _LOG_SAMPLE_OVERRIDE is not None:
+        return _LOG_SAMPLE_OVERRIDE
+    try:
+        return min(1.0, max(0.0, float(
+            os.environ.get("LSOT_REQUEST_LOG", "1") or 0.0
+        )))
+    except ValueError:
+        return 1.0
+
+
+def reconfigure_request_log(sample: float) -> None:
+    """App-startup wiring seam (AppConfig.request_log): set the log-line
+    sampling fraction for registries constructed after this call AND for
+    the module-level `registry` — so `AppConfig(request_log=0.0)` is
+    honored, not a silent no-op."""
+    global _LOG_SAMPLE_OVERRIDE
+    _LOG_SAMPLE_OVERRIDE = min(1.0, max(0.0, float(sample)))
+    registry._log_sample = _LOG_SAMPLE_OVERRIDE
 
 
 class StageTimer:
@@ -76,6 +107,19 @@ class RequestMetrics:
     # metric streaming exists for. 0.0 = not measured (backends without a
     # first-token seam: the one-XLA-program engine, fakes).
     ttft_s: float = 0.0
+    # Queue wait (submit -> slot admission) on the scheduler path: the
+    # share of latency that is BACKLOG, not compute. 0.0 = not measured.
+    queue_wait_s: float = 0.0
+    # Request class for the histogram label set: "" (plain), or any of
+    # "constrained"/"speculative"/"constrained+speculative" — the classes
+    # whose latency profiles an operator prices separately.
+    rclass: str = ""
+    # Which scheduler replica served it (SchedulerPool attribution);
+    # "" when there is no replica notion (engine, fakes).
+    replica: str = ""
+    # Trace-correlation handle (utils/tracing.py): echoed in the request
+    # log line so a log line and an exported trace join on one id.
+    request_id: str = ""
     stages: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
@@ -99,6 +143,102 @@ class RequestMetrics:
         }
         if self.ttft_s:
             out["ttft_s"] = round(self.ttft_s, 4)
+        if self.queue_wait_s:
+            out["queue_wait_s"] = round(self.queue_wait_s, 4)
+        if self.rclass:
+            out["class"] = self.rclass
+        if self.replica:
+            out["replica"] = self.replica
+        if self.request_id:
+            out["request_id"] = self.request_id
+        return out
+
+    @property
+    def tpot_s(self) -> float:
+        """Time per output token AFTER the first (the streaming cadence
+        metric): (latency - ttft) / (n - 1). Falls back to latency/n when
+        no TTFT was measured; 0.0 when nothing decoded."""
+        if self.output_tokens <= 0:
+            return 0.0
+        if self.ttft_s and self.output_tokens > 1:
+            return max(0.0, self.latency_s - self.ttft_s) / (
+                self.output_tokens - 1
+            )
+        return self.latency_s / self.output_tokens
+
+
+#: Fixed latency buckets (seconds) shared by the TTFT/TPOT/queue-wait/
+#: latency histograms: Prometheus-style cumulative `le` bounds spanning
+#: sub-ms CPU fakes to minute-long chip decodes. FIXED (not windowed
+#: percentiles) on purpose — histograms aggregate across scrapes and
+#: replicas; percentiles don't.
+LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Prometheus-shaped cumulative histogram: fixed `le` buckets +
+    sum + count. Thread-safe; observe() is a bisect + increments."""
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count")
+
+    def __init__(self, buckets: Sequence[float] = LATENCY_BUCKETS_S):
+        self._lock = threading.Lock()
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        idx = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        """Cumulative counts per upper bound (Prometheus `le` semantics:
+        bucket[le] counts observations <= le, ending at +Inf == count)."""
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        cum, out = 0, {}
+        for le, c in zip(self.buckets, counts):
+            cum += c
+            out[le] = cum
+        return {"buckets": out, "sum": s, "count": total}
+
+
+class HistogramSet:
+    """Named histograms keyed by a label tuple — the exposition feed for
+    `/metrics?format=prometheus`. Keys are (name, ((label, value), ...))
+    so one set holds e.g. lsot_ttft_seconds across model × replica ×
+    request-class without pre-registration."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hists: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Histogram] = {}
+
+    def observe(self, name: str, value: float, **labels: str) -> None:
+        key = (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram()
+        h.observe(value)
+
+    def snapshot(self) -> Dict[str, List[Dict]]:
+        """{name: [{labels: {...}, buckets/sum/count}, ...]} — the shape
+        utils/prometheus.py renders."""
+        with self._lock:
+            items = list(self._hists.items())
+        out: Dict[str, List[Dict]] = {}
+        for (name, labels), h in items:
+            out.setdefault(name, []).append(
+                {"labels": dict(labels), **h.snapshot()}
+            )
         return out
 
 
@@ -117,13 +257,25 @@ class MetricsRegistry:
     model for percentiles; counters are exact over the full lifetime.
     """
 
-    def __init__(self, window: int = 1024):
+    def __init__(self, window: int = 1024,
+                 request_log_sample: Optional[float] = None):
         self._window = window
         self._lock = threading.Lock()
         self._recent: Dict[str, List[RequestMetrics]] = {}
         self._count: Dict[str, int] = {}
         self._tokens: Dict[str, int] = {}
         self._time: Dict[str, float] = {}
+        # Fixed-bucket histograms beside the windowed percentiles:
+        # histograms AGGREGATE (across scrapes, replicas, processes) where
+        # a windowed p95 cannot — the Prometheus exposition renders these.
+        self.histograms = HistogramSet()
+        # Per-request log-line sampling (LSOT_REQUEST_LOG; satellite of
+        # ISSUE 6): the JSON line was emitted unconditionally at INFO,
+        # paying json.dumps + handler I/O per request at high QPS even
+        # when nobody was reading it.
+        self._log_sample = (request_log_sample if request_log_sample
+                            is not None else _request_log_sample())
+        self._log_rng = random.Random(0)
 
     def record(self, m: RequestMetrics) -> None:
         with self._lock:
@@ -134,7 +286,30 @@ class MetricsRegistry:
             self._count[m.model] = self._count.get(m.model, 0) + 1
             self._tokens[m.model] = self._tokens.get(m.model, 0) + m.output_tokens
             self._time[m.model] = self._time.get(m.model, 0.0) + m.distinct_wall_s
-        log.info("request %s", json.dumps(m.to_dict()))
+        # "r0" matches the single-scheduler flight-recorder default and
+        # the pool's "r{i}" scheme: one replica-label vocabulary across
+        # the histogram and serving-gauge families.
+        labels = {"model": m.model, "replica": m.replica or "r0",
+                  "class": m.rclass or "plain"}
+        self.histograms.observe("lsot_request_latency_seconds",
+                                m.latency_s, **labels)
+        # TPOT is the post-first-token cadence: undefined for a 1-token
+        # completion, where the latency/n fallback would record the FULL
+        # request latency (queue + prefill + TTFT) as a "per token" time
+        # and skew the histogram's tail by orders of magnitude.
+        if m.output_tokens > 1:
+            self.histograms.observe("lsot_tpot_seconds", m.tpot_s, **labels)
+        if m.ttft_s:
+            self.histograms.observe("lsot_ttft_seconds", m.ttft_s, **labels)
+        if m.queue_wait_s:
+            self.histograms.observe("lsot_queue_wait_seconds",
+                                    m.queue_wait_s, **labels)
+        # Level check BEFORE the json.dumps (the formatting was the cost,
+        # not the logging call), then the sampling knob.
+        if self._log_sample > 0.0 and log.isEnabledFor(logging.INFO):
+            if self._log_sample >= 1.0 or \
+                    self._log_rng.random() < self._log_sample:
+                log.info("request %s", json.dumps(m.to_dict()))
 
     def snapshot(self) -> Dict[str, Dict]:
         with self._lock:
@@ -159,6 +334,14 @@ class MetricsRegistry:
                 if ttfts:
                     out[model]["ttft_p50_s"] = round(_percentile(ttfts, 0.50), 4)
                     out[model]["ttft_p95_s"] = round(_percentile(ttfts, 0.95), 4)
+                # Queue-wait percentiles (scheduler-path requests): how
+                # much of the latency was backlog, not compute.
+                qws = sorted(r.queue_wait_s for r in recent if r.queue_wait_s)
+                if qws:
+                    out[model]["queue_wait_p50_s"] = round(
+                        _percentile(qws, 0.50), 4)
+                    out[model]["queue_wait_p95_s"] = round(
+                        _percentile(qws, 0.95), 4)
             return out
 
 

@@ -1,0 +1,187 @@
+"""Prometheus text exposition for the /metrics payload.
+
+`/metrics?format=prometheus` renders the SAME data the JSON endpoint
+serves — per-model request aggregates, serving-layer stats, process-wide
+resilience counters — in the exposition format (text/plain; version
+0.0.4) every scrape stack ingests, plus the fixed-bucket TTFT / TPOT /
+queue-wait / latency histograms `MetricsRegistry` now keeps beside its
+windowed percentiles (histograms aggregate across scrapes and replicas;
+windowed percentiles cannot). Both serving systems in the vLLM/TGI
+comparison (PAPERS.md) ship this surface as table stakes.
+
+Rendering rules (no client library — the format is 20 lines of spec):
+
+- metric names: `lsot_` + snake_case path; `# HELP`/`# TYPE` emitted once
+  per name, all samples of one name contiguous (the exposition grammar
+  requires it).
+- per-model scalar aggregates become gauges/counters labeled
+  `{model="..."}`; nested serving stats flatten with `_`-joined paths
+  (`lsot_serving_prefix_cache_hits`); booleans render 0/1; non-numeric
+  leaves are skipped (they stay JSON-only).
+- resilience counters: `lsot_resilience_events_total{event="retries"}`;
+  breaker states: `lsot_breaker_open{dependency="sql backend"}`.
+- histograms: standard `_bucket{le=...}` / `_sum` / `_count` triplets
+  with the model × replica × request-class label set.
+
+The golden test (tests/test_prometheus.py) scrapes a live fake-backend
+app and validates names/types/label sets with a minimal in-test parser —
+no new dependency.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional
+
+from .observability import HistogramSet
+
+__all__ = ["render_prometheus", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_]")
+
+#: JSON aggregate key -> (metric suffix, TYPE). Counters keep their
+#: monotonic semantics; windowed percentiles are gauges by nature.
+_MODEL_KEYS = {
+    "requests": ("requests_total", "counter"),
+    "output_tokens": ("output_tokens_total", "counter"),
+    "p50_latency_s": ("p50_latency_seconds", "gauge"),
+    "p95_latency_s": ("p95_latency_seconds", "gauge"),
+    "avg_decode_tok_s": ("decode_tokens_per_second", "gauge"),
+    "ttft_p50_s": ("ttft_p50_seconds", "gauge"),
+    "ttft_p95_s": ("ttft_p95_seconds", "gauge"),
+    "queue_wait_p50_s": ("queue_wait_p50_seconds", "gauge"),
+    "queue_wait_p95_s": ("queue_wait_p95_seconds", "gauge"),
+}
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _num(v) -> Optional[float]:
+    """Numeric leaf or None (strings/None/lists stay JSON-only).
+    bools render 0/1 — `busy`, breaker `open` flags."""
+    if isinstance(v, bool):
+        return 1.0 if v else 0.0
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        return float(v)
+    return None
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(v)
+
+
+class _Emitter:
+    """Groups samples by metric name so HELP/TYPE appear once and all
+    samples of a name are contiguous (the exposition grammar)."""
+
+    def __init__(self):
+        self._order: List[str] = []
+        self._meta: Dict[str, str] = {}
+        self._samples: Dict[str, List[str]] = {}
+
+    def add(self, name: str, labels: Dict[str, str], value: float,
+            mtype: str = "gauge", suffix: str = "") -> None:
+        name = _NAME_OK.sub("_", name)
+        if name not in self._meta:
+            self._order.append(name)
+            self._meta[name] = mtype
+            self._samples[name] = []
+        self._samples[name].append(
+            f"{name}{suffix}{_labels(labels)} {_fmt(value)}"
+        )
+
+    def render(self) -> str:
+        out: List[str] = []
+        for name in self._order:
+            out.append(f"# HELP {name} lsot serving metric {name}")
+            out.append(f"# TYPE {name} {self._meta[name]}")
+            out.extend(self._samples[name])
+        return "\n".join(out) + "\n"
+
+
+def _flatten_serving(emit: _Emitter, model: str, prefix: str, node) -> None:
+    """Nested serving stats -> gauges with `_`-joined names. List entries
+    (e.g. per-replica heartbeat snapshots, pool load views) are labeled
+    `replica` — the entry's own "replica" name when it carries one, else
+    "r{i}" — the SAME vocabulary the histogram families use, so the two
+    can be joined/grouped on the label."""
+    if isinstance(node, dict):
+        for k, v in node.items():
+            _flatten_serving(emit, model, f"{prefix}_{k}", v)
+        return
+    if isinstance(node, list):
+        for i, v in enumerate(node):
+            if isinstance(v, dict):
+                name = v.get("replica")
+                rep = name if isinstance(name, str) and name else f"r{i}"
+                for k, inner in v.items():
+                    n = _num(inner)
+                    if n is not None:
+                        emit.add(_NAME_OK.sub("_", f"{prefix}_{k}"),
+                                 {"model": model, "replica": rep}, n)
+        return
+    n = _num(node)
+    if n is not None:
+        emit.add(_NAME_OK.sub("_", prefix), {"model": model}, n)
+
+
+def render_prometheus(snapshot: Dict,
+                      histograms: Optional[HistogramSet] = None) -> str:
+    """Render `GenerationService.metrics_snapshot()` (+ the registry's
+    histogram set) as Prometheus exposition text."""
+    emit = _Emitter()
+    resilience = snapshot.get("resilience") or {}
+    for model, agg in snapshot.items():
+        if model == "resilience" or not isinstance(agg, dict):
+            continue
+        for key, (suffix, mtype) in _MODEL_KEYS.items():
+            n = _num(agg.get(key))
+            if n is not None:
+                emit.add(f"lsot_{suffix}", {"model": model}, n, mtype)
+        serving = agg.get("serving")
+        if isinstance(serving, dict):
+            _flatten_serving(emit, model, "lsot_serving", serving)
+    if resilience:
+        breakers = resilience.get("breakers") or {}
+        for event, count in resilience.items():
+            n = _num(count)
+            if n is not None:
+                emit.add("lsot_resilience_events_total", {"event": event},
+                         n, "counter")
+        for dep, state in breakers.items():
+            if isinstance(state, dict):
+                is_open = state.get("state") == "open"
+                fails = _num(state.get("failures"))
+            else:
+                is_open = state == "open"
+                fails = None
+            emit.add("lsot_breaker_open", {"dependency": dep},
+                     1.0 if is_open else 0.0)
+            if fails is not None:
+                emit.add("lsot_breaker_failures", {"dependency": dep}, fails)
+    if histograms is not None:
+        for name, series in sorted(histograms.snapshot().items()):
+            name = _NAME_OK.sub("_", name)
+            for s in series:
+                labels = dict(s.get("labels", {}))
+                for le, c in s["buckets"].items():
+                    emit.add(name, {**labels, "le": _fmt(float(le))},
+                             c, "histogram", suffix="_bucket")
+                emit.add(name, {**labels, "le": "+Inf"}, s["count"],
+                         "histogram", suffix="_bucket")
+                emit.add(name, labels, s["sum"], "histogram", suffix="_sum")
+                emit.add(name, labels, s["count"], "histogram",
+                         suffix="_count")
+    return emit.render()

@@ -1,0 +1,111 @@
+#!/usr/bin/env bash
+# Observability smoke: the ISSUE-6 layer end to end on a real booted app.
+#
+# Boots the app (tiny in-tree models behind continuous-batching
+# schedulers — the fake backend has no flight recorder to smoke) with
+# always-on head sampling, drives 3 traced requests over real HTTP, then
+# asserts the whole contract:
+#
+#   1. every response echoes an X-Request-Id;
+#   2. each sampled request exported a Chrome-trace file that PARSES in
+#      utils/traceprof.Trace (the same parser that reads jax.profiler
+#      device traces — Perfetto loads the same file);
+#   3. /debug/flightrecorder serves non-empty per-round records
+#      (occupancy, admitted/retired rids, round wall, cadence);
+#   4. /metrics?format=prometheus serves the exposition text with the
+#      TTFT/latency histogram families.
+#
+# The default test lane runs the same flow in-process
+# (tests/test_obs_smoke.py, not marked slow); this script is the focused
+# real-sockets lane, beside chaos_smoke.sh.
+#
+#   scripts/obs_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export LSOT_TRACE_SAMPLE="${LSOT_TRACE_SAMPLE:-1}"
+TRACE_DIR="$(mktemp -d)"
+export LSOT_TRACE_EXPORT="$TRACE_DIR"
+trap 'rm -rf "$TRACE_DIR"' EXIT
+
+python - <<'EOF'
+import json
+import os
+import threading
+import time
+import urllib.request
+
+from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+    make_tiny_service,
+)
+from llm_based_apache_spark_optimization_tpu.app.api import create_api_app
+from llm_based_apache_spark_optimization_tpu.app.config import AppConfig
+from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+from llm_based_apache_spark_optimization_tpu.sql import default_backend
+from llm_based_apache_spark_optimization_tpu.utils.tracing import TRACER
+from llm_based_apache_spark_optimization_tpu.utils.traceprof import Trace
+
+trace_dir = os.environ["LSOT_TRACE_EXPORT"]
+TRACER.reconfigure(sample=1.0, export_dir=trace_dir)
+cfg = AppConfig(history_db=":memory:", port=0)
+service = make_tiny_service(8, scheduler=True)
+app = create_api_app(service, default_backend, SQLiteHistory(":memory:"),
+                     cfg)
+server = app.serve(cfg.host, 0, background=True)
+url = f"http://{cfg.host}:{server.server_address[1]}"
+print(f"obs_smoke: app up at {url}")
+
+
+def post(path, body):
+    req = urllib.request.Request(
+        url + path, json.dumps(body).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def get(path):
+    with urllib.request.urlopen(url + path, timeout=60) as r:
+        return r.status, r.read().decode()
+
+
+rids = []
+for i in range(3):
+    status, headers, body = post(
+        "/api/generate", {"model": "duckdb-nsql", "prompt": f"smoke {i}"})
+    assert status == 200 and body["done"], body
+    rid = headers.get("X-Request-Id", "")
+    assert rid.startswith("req-"), headers
+    assert body["request_id"] == rid
+    rids.append(rid)
+print(f"obs_smoke: 3 traced requests OK ({rids})")
+
+# 2. the exported Chrome traces parse in traceprof (Perfetto-loadable).
+pt = Trace().load_dir(trace_dir)
+assert pt.op_time_s() > 0.0, "exported trace carries no span time"
+names = {n for n, _, _ in pt.top_ops(20)}
+assert "sched.decode" in names, f"scheduler spans missing: {names}"
+print(f"obs_smoke: trace round-trip OK (op_time {pt.op_time_s():.4f}s, "
+      f"lanes {sorted(names)[:5]}...)")
+
+# 3. the flight recorder served non-empty per-round records.
+status, body = get("/debug/flightrecorder")
+assert status == 200
+models = json.loads(body)["models"]
+rounds = [r for recs in models.values() for r in recs if "round" in r]
+assert rounds, f"flight recorder empty: { {k: len(v) for k, v in models.items()} }"
+assert {"occupancy", "round_wall_s"} <= set(rounds[-1])
+print(f"obs_smoke: flight recorder OK ({len(rounds)} round records)")
+
+# 4. Prometheus exposition with the histogram families.
+status, text = get("/metrics?format=prometheus")
+assert status == 200
+assert "# TYPE lsot_request_latency_seconds histogram" in text
+assert "lsot_ttft_seconds_bucket" in text
+print("obs_smoke: prometheus exposition OK")
+
+server.shutdown()
+service.close()
+print("obs_smoke: PASS")
+EOF

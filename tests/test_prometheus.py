@@ -1,0 +1,221 @@
+"""Prometheus exposition (utils/prometheus.py): histogram semantics, the
+renderer, and the golden scrape test over a live fake-backend app with a
+minimal in-test exposition parser (no client library dependency)."""
+
+import json
+import re
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.utils.observability import (
+    Histogram,
+    HistogramSet,
+)
+from llm_based_apache_spark_optimization_tpu.utils.prometheus import (
+    CONTENT_TYPE,
+    render_prometheus,
+)
+
+
+def test_histogram_cumulative_buckets():
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    # Prometheus le semantics: bucket counts are CUMULATIVE (<= bound).
+    assert snap["buckets"] == {0.1: 1, 1.0: 3, 10.0: 4}
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(56.05)
+
+
+def test_histogram_boundary_value_counts_le():
+    h = Histogram(buckets=(1.0, 2.0))
+    h.observe(1.0)  # exactly on the bound: le="1.0" must include it
+    assert h.snapshot()["buckets"][1.0] == 1
+
+
+def test_histogram_set_label_keys():
+    hs = HistogramSet()
+    hs.observe("lsot_ttft_seconds", 0.1, model="a", replica="0")
+    hs.observe("lsot_ttft_seconds", 0.2, model="a", replica="0")
+    hs.observe("lsot_ttft_seconds", 0.2, model="b", replica="0")
+    snap = hs.snapshot()
+    series = snap["lsot_ttft_seconds"]
+    assert len(series) == 2  # two label sets
+    a = next(s for s in series if s["labels"]["model"] == "a")
+    assert a["count"] == 2
+
+
+# ----------------------------------------------- minimal exposition parser
+
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? "
+    r"(?P<value>[^ ]+)$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_exposition(text):
+    """Minimal Prometheus text-format parser: returns
+    (types: {name: type}, samples: [(name, labels-dict, value)]).
+    Raises AssertionError on grammar violations the format forbids —
+    samples before their TYPE, interleaved families, bad lines."""
+    types = {}
+    samples = []
+    current_family = None
+    seen_families = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, mtype = rest.split(" ", 1)
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert mtype.strip() in ("counter", "gauge", "histogram",
+                                     "summary", "untyped")
+            types[name] = mtype.strip()
+            assert name not in seen_families, f"family {name} interleaved"
+            seen_families.add(name)
+            current_family = name
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        m = _SAMPLE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name = m.group("name")
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        family = name if name in types else base
+        assert family in types, f"sample {name} before its TYPE"
+        assert family == current_family, \
+            f"sample {name} outside its family block"
+        labels = dict(_LABEL.findall(m.group("labels") or ""))
+        value = float(m.group("value"))
+        samples.append((name, labels, value))
+    return types, samples
+
+
+def test_render_model_aggregates_and_resilience():
+    snap = {
+        "duckdb-nsql": {
+            "requests": 3, "output_tokens": 30,
+            "p50_latency_s": 0.5, "p95_latency_s": 0.9,
+            "avg_decode_tok_s": 60.0,
+            "serving": {"prefix_cache": {"hits": 2, "blocks_reused": 4},
+                        "watchdog": {"heartbeat": {"busy": False,
+                                                   "rounds": 7}}},
+        },
+        "resilience": {"retries": 2, "shed": 1,
+                       "breakers": {"sql backend": {"state": "open",
+                                                    "failures": 5}}},
+    }
+    text = render_prometheus(snap)
+    types, samples = parse_exposition(text)
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert types["lsot_requests_total"] == "counter"
+    assert by_name["lsot_requests_total"] == [
+        ({"model": "duckdb-nsql"}, 3.0)]
+    assert by_name["lsot_serving_prefix_cache_hits"] == [
+        ({"model": "duckdb-nsql"}, 2.0)]
+    # bools render 0/1
+    assert by_name["lsot_serving_watchdog_heartbeat_busy"] == [
+        ({"model": "duckdb-nsql"}, 0.0)]
+    assert ({"event": "retries"}, 2.0) in \
+        by_name["lsot_resilience_events_total"]
+    assert by_name["lsot_breaker_open"] == [
+        ({"dependency": "sql backend"}, 1.0)]
+
+
+def test_render_histograms_bucket_triplets():
+    hs = HistogramSet()
+    for v in (0.002, 0.03, 0.7):
+        hs.observe("lsot_ttft_seconds", v, model="m", replica="0",
+                   **{"class": "plain"})
+    text = render_prometheus({}, hs)
+    types, samples = parse_exposition(text)
+    assert types["lsot_ttft_seconds"] == "histogram"
+    buckets = [(l, v) for n, l, v in samples
+               if n == "lsot_ttft_seconds_bucket"]
+    # +Inf bucket present and equal to count; bucket counts monotone.
+    inf = next(v for l, v in buckets if l["le"] == "+Inf")
+    count = next(v for n, l, v in samples
+                 if n == "lsot_ttft_seconds_count")
+    assert inf == count == 3
+    finite = [(float(l["le"]), v) for l, v in buckets if l["le"] != "+Inf"]
+    finite.sort()
+    vals = [v for _, v in finite]
+    assert vals == sorted(vals)  # cumulative monotone
+    # label set rides every sample
+    assert all(l.get("model") == "m" for l, _ in buckets)
+
+
+# ------------------------------------------------------- golden app scrape
+
+
+def _fake_app():
+    from llm_based_apache_spark_optimization_tpu.app.api import (
+        create_api_app,
+    )
+    from llm_based_apache_spark_optimization_tpu.app.config import AppConfig
+    from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+    from llm_based_apache_spark_optimization_tpu.sql import default_backend
+
+    svc = GenerationService()
+    svc.register("duckdb-nsql", FakeBackend(lambda p: "SELECT 1"))
+    cfg = AppConfig(history_db=":memory:")
+    app = create_api_app(svc, default_backend, SQLiteHistory(":memory:"),
+                         cfg)
+    return svc, app
+
+
+def test_metrics_prometheus_golden_scrape():
+    """Satellite: scrape /metrics?format=prometheus from a live
+    fake-backend app and validate names/types/label sets with the
+    minimal parser — the exposition contract, end to end."""
+    svc, app = _fake_app()
+    client = app.test_client()
+    for _ in range(3):
+        svc.generate("duckdb-nsql", "q", system="s")
+    res = client.request("GET", "/metrics", query="format=prometheus")
+    assert res.status == 200
+    assert res.headers["Content-Type"] == CONTENT_TYPE
+    types, samples = parse_exposition(res.text)
+    names = {n for n, _, _ in samples}
+    # The aggregate gauges/counters for the registered model...
+    assert "lsot_requests_total" in names
+    assert "lsot_p50_latency_seconds" in names
+    req = next((l, v) for n, l, v in samples
+               if n == "lsot_requests_total" and l["model"] == "duckdb-nsql")
+    assert req[1] == 3.0
+    # ...and the fixed-bucket histograms with the full label set
+    # (model × replica × class), bucket/sum/count triplets complete.
+    assert types.get("lsot_request_latency_seconds") == "histogram"
+    hist_labels = next(
+        l for n, l, v in samples
+        if n == "lsot_request_latency_seconds_bucket"
+        and l.get("model") == "duckdb-nsql"
+    )
+    assert {"model", "replica", "class", "le"} <= set(hist_labels)
+    count = next(v for n, l, v in samples
+                 if n == "lsot_request_latency_seconds_count"
+                 and l.get("model") == "duckdb-nsql")
+    assert count == 3.0
+
+
+def test_metrics_json_default_unchanged():
+    svc, app = _fake_app()
+    client = app.test_client()
+    svc.generate("duckdb-nsql", "q")
+    res = client.request("GET", "/metrics")
+    assert res.status == 200
+    assert json.loads(res.body)["duckdb-nsql"]["requests"] == 1
+    bad = client.request("GET", "/metrics", query="format=xml")
+    assert bad.status == 400

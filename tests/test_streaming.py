@@ -272,6 +272,9 @@ def test_api_generate_endpoint_blocking_and_streaming(tmp_path):
                           "stream": True})
     assert r.status == 200
     lines = [json.loads(ln) for ln in r.body.decode().splitlines()]
+    # The terminal line gained the request_id correlation field (ISSUE 6)
+    # beside the Ollama wire shape.
+    assert lines[-1].pop("request_id").startswith("req-")
     assert lines[-1] == {"model": "duckdb-nsql", "done": True}
     assert "".join(l.get("response", "") for l in lines[:-1]) == "SELECT 42"
 

@@ -996,15 +996,20 @@ def test_chaos_evalh_reports_scheduler_recovery():
     b = run_chaos("sched:crash:0.2", seed=0, rounds=2)
 
     # Seeded replay: the OUTCOME-side fields are deterministic. The
-    # `replayed` count is not compared exactly — whether a request was
-    # journaled during a restart (replayed++) or submitted just after
-    # (direct) is a benign thread-timing artifact, not a fault-schedule
-    # property.
+    # `replayed` and `restarts` counts are not compared exactly — the
+    # seeded RNG fixes the DRAW SEQUENCE, but how many draws happen (and
+    # so how many crosses fire) depends on how much work each crash's
+    # replay re-decodes, which depends on the crash-vs-submission thread
+    # interleaving: a benign timing artifact, not a fault-schedule
+    # property. What IS pinned: zero lost, zero unresolved, zero
+    # mismatched, and that crashes + replays happened at all.
     def stable(rep):
-        return {k: v for k, v in rep["scheduler"].items() if k != "replayed"}
+        return {k: v for k, v in rep["scheduler"].items()
+                if k not in ("replayed", "restarts")}
 
     assert stable(a) == stable(b)
     assert a["scheduler"]["restarts"] >= 1
+    assert b["scheduler"]["restarts"] >= 1
     assert a["scheduler"]["replayed"] >= 1
     assert a["scheduler"]["lost"] == 0
     assert a["scheduler"]["unresolved"] == 0

@@ -1,0 +1,255 @@
+"""Request-scoped tracing (utils/tracing.py): span trees, head sampling,
+Chrome-trace export round-trip, and the scheduler/service integration."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.utils.tracing import (
+    RequestTrace,
+    Tracer,
+    new_request_id,
+)
+from llm_based_apache_spark_optimization_tpu.utils import tracing
+
+
+def test_request_ids_unique_and_prefixed():
+    ids = {new_request_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(i.startswith("req-") for i in ids)
+
+
+def test_span_tree_records_and_sorts():
+    t = RequestTrace("req-x", model="m")
+    with t.span("service.generate", model="m"):
+        t.add_span("sched.decode", time.perf_counter() - 0.5,
+                   time.perf_counter(), output_tokens=3)
+    t.event("sched.error", error="Boom")
+    doc = t.to_dict()
+    assert doc["request_id"] == "req-x" and doc["model"] == "m"
+    names = [s["name"] for s in doc["spans"]]
+    # Sorted by start: the decode span started before the enclosing
+    # service span's END-time recording order.
+    assert set(names) == {"service.generate", "sched.decode", "sched.error"}
+    decode = next(s for s in doc["spans"] if s["name"] == "sched.decode")
+    assert decode["dur_s"] == pytest.approx(0.5, abs=0.05)
+    assert decode["attrs"]["output_tokens"] == 3
+    assert json.dumps(doc)  # JSONL-exportable
+
+
+def test_spans_threadsafe_across_threads():
+    t = RequestTrace("req-t")
+
+    def worker(i):
+        for j in range(50):
+            t.add_span(f"lane{i}.s", 0.0, 1.0)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    [th.start() for th in threads]
+    [th.join() for th in threads]
+    assert len(t.to_dict()["spans"]) == 200
+
+
+def test_tracer_head_sampling():
+    t0 = Tracer(sample=0.0)
+    assert all(t0.begin() is None for _ in range(20))
+    t1 = Tracer(sample=1.0)
+    assert all(t1.begin() is not None for _ in range(5))
+    th = Tracer(sample=0.5, seed=0)
+    picks = [th.begin() is not None for _ in range(400)]
+    assert 100 < sum(picks) < 300  # genuinely sampled, not all/none
+
+
+def test_tracer_finish_none_safe_and_ring():
+    tr = Tracer(sample=1.0, ring=2)
+    assert tr.finish(None) is None
+    for i in range(4):
+        t = tr.begin(model=f"m{i}")
+        tr.finish(t)
+    recent = tr.recent()
+    assert len(recent) == 2  # ring bounded
+    assert tr.stats()["exported"] == 4
+
+
+def test_chrome_export_roundtrips_traceprof(tmp_path):
+    """Acceptance: the exported Chrome trace loads in utils/traceprof.Trace
+    (the same parser that reads jax.profiler device traces) — op time
+    positive, span names preserved, device_time bounded by wall."""
+    from llm_based_apache_spark_optimization_tpu.utils.traceprof import (
+        Trace,
+    )
+
+    tr = Tracer(sample=1.0, export_dir=str(tmp_path))
+    t = tr.begin(model="m")
+    with t.span("service.generate"):
+        time.sleep(0.01)
+    t.add_span("sql.exec", time.perf_counter() - 0.004, time.perf_counter())
+    tr.finish(t)
+    # Per-request gzipped chrome file + the JSONL append both exist.
+    assert (tmp_path / "requests.jsonl").exists()
+    assert list(tmp_path.glob("*.trace.json.gz"))
+    pt = Trace().load_dir(str(tmp_path))
+    assert pt.op_time_s() > 0.0
+    assert 0.0 < pt.device_time_s() <= pt.op_time_s() + 1e-9
+    names = {n for n, _, _ in pt.top_ops(10)}
+    assert {"service.generate", "sql.exec"} <= names
+
+
+def test_span_helper_noop_without_current_trace():
+    # No ambient trace: the span contextmanager must be a free no-op.
+    with tracing.span("anything", attr=1):
+        pass
+    assert tracing.current() is None
+
+
+def test_use_installs_and_restores():
+    t = RequestTrace("req-ctx")
+    assert tracing.current() is None
+    with tracing.use(t):
+        assert tracing.current() is t
+        with tracing.span("sql.exec"):
+            pass
+    assert tracing.current() is None
+    assert [s["name"] for s in t.to_dict()["spans"]] == ["sql.exec"]
+
+
+def test_use_none_marks_decision_no_redraw(monkeypatch):
+    """`use(None)` records made-but-UNSAMPLED: a downstream entry point
+    (the service under the HTTP layer) must honor it instead of drawing
+    a second sample — re-drawing would double the effective rate."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+    from llm_based_apache_spark_optimization_tpu.utils.tracing import TRACER
+
+    assert not tracing.decided()
+    with tracing.use(None):
+        assert tracing.decided()
+        assert tracing.current() is None
+        with tracing.span("never.recorded"):  # still a free no-op
+            pass
+    assert not tracing.decided()
+
+    svc = GenerationService()
+    svc.register("m", FakeBackend(lambda p: "SELECT 1"))
+    calls = []
+    monkeypatch.setattr(
+        TRACER, "begin",
+        lambda *a, **k: calls.append(1) or None)
+    # HTTP layer drew (unsampled) -> the service must NOT draw again...
+    with tracing.use(None):
+        svc.generate("m", "q")
+    assert calls == []
+    # ...but with no upstream decision, the service draws exactly once.
+    svc.generate("m", "q")
+    assert calls == [1]
+
+
+def test_stream_context_never_leaks_between_yields(monkeypatch):
+    """A library caller's sampled generate_stream must not leave its
+    trace installed in the CALLER's context while suspended at a yield —
+    generators share the thread's context, so a leaked set would record
+    a second, interleaved request's spans into the first one's tree."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+    from llm_based_apache_spark_optimization_tpu.utils.tracing import TRACER
+
+    svc = GenerationService()
+    svc.register("m", FakeBackend(lambda p: "SELECT 1"))
+    monkeypatch.setattr(TRACER, "sample", 1.0)  # library path draws
+    g1 = svc.generate_stream("m", "one")
+    next(g1)
+    # Suspended mid-stream: the caller's context must be clean.
+    assert tracing.current() is None
+    assert not tracing.decided()
+    g1.close()
+
+
+def test_service_records_spans_and_request_id():
+    """Driving the service directly under an ambient trace records the
+    service span into it, and the GenerateResult echoes the id."""
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+
+    svc = GenerationService()
+    svc.register("m", FakeBackend(lambda p: "SELECT 1"))
+    t = RequestTrace("req-svc")
+    with tracing.use(t):
+        res = svc.generate("m", "q", request_id="req-svc")
+    assert res.request_id == "req-svc"
+    assert "service.generate" in [s["name"] for s in t.to_dict()["spans"]]
+
+
+@pytest.fixture(scope="module")
+def tiny_model_module():
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+
+    return TINY, init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+
+
+def test_scheduler_records_request_spans(tiny_model_module):
+    """The worker thread records queue-wait / prefill / decode / per-round
+    spans into a submitted trace, and stamps the measured queue wait on
+    the future (the Completion/metrics seam)."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny_model_module
+    t = RequestTrace("req-sched")
+    with ContinuousBatchingScheduler(
+        cfg, params, num_slots=2, prompt_bucket=8, decode_chunk=4,
+        stop_ids=(-1,),
+    ) as sched:
+        fut = sched.submit([1, 2, 3], max_new_tokens=6, trace=t)
+        out = fut.result(timeout=120)
+    assert len(out) == 6
+    names = [s["name"] for s in t.to_dict()["spans"]]
+    assert "sched.queue_wait" in names
+    assert "sched.prefill" in names
+    assert "sched.decode" in names
+    assert "sched.round" in names
+    assert getattr(fut, "_lsot_queue_wait") >= 0.0
+    assert getattr(fut, "_lsot_replica") == "r0"
+    decode = next(s for s in t.to_dict()["spans"]
+                  if s["name"] == "sched.decode")
+    assert decode["attrs"]["output_tokens"] == 6
+
+
+def test_supervised_scheduler_forwards_trace(tiny_model_module):
+    """The supervisor forwards a sampled trace to the inner attempt and
+    copies the measured queue wait onto its own client-facing future."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.supervisor import (
+        SupervisedScheduler,
+    )
+
+    cfg, params = tiny_model_module
+
+    def make():
+        return ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, prompt_bucket=8, decode_chunk=4,
+            stop_ids=(-1,),
+        )
+
+    sup = SupervisedScheduler(make, stall_min_s=0).start()
+    try:
+        t = RequestTrace("req-sup")
+        fut = sup.submit([1, 2, 3], max_new_tokens=4, trace=t)
+        fut.result(timeout=120)
+        assert "sched.decode" in [s["name"] for s in t.to_dict()["spans"]]
+        assert getattr(fut, "_lsot_queue_wait") >= 0.0
+    finally:
+        sup.shutdown()
