@@ -52,6 +52,7 @@ def _spill_path(app_cfg, tag: str):
 def make_tiny_service(
     max_new_tokens: int, scheduler: bool = False, tp: int = 1,
     supervise: bool = True, speculative: int = 0,
+    kv_layout: str = "contiguous",
 ) -> GenerationService:
     import dataclasses
 
@@ -110,6 +111,7 @@ def make_tiny_service(
                     mcfg, mparams, num_slots=8, prompt_bucket=64, mesh=mesh,
                     max_queue_depth=app_cfg.max_queue_depth,
                     speculative_draft=speculative,
+                    kv_layout=kv_layout,
                 )
 
             if supervise:
@@ -243,6 +245,9 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     if kv_quant and getattr(args, "speculative", 0) > 0 and not args.scheduler:
         sys.exit("--kv-int8 cannot combine with --speculative: the "
                  "speculative verify loop streams the bf16 cache")
+    if kv_quant and getattr(args, "kv_layout", "contiguous") == "paged":
+        sys.exit("--kv-int8 cannot combine with --kv-layout=paged yet: "
+                 "pool pages store compute-dtype K/V")
     int4 = getattr(args, "int4", False)
     if int4 and args.int8:
         sys.exit("pick one of --int8 / --int4")
@@ -271,6 +276,11 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                               stall_min_s=app_cfg.stall_min_s,
                               stall_warmup_s=app_cfg.stall_warmup_s)
                 common["speculative_draft"] = getattr(args, "speculative", 0)
+                common["kv_layout"] = getattr(args, "kv_layout",
+                                              "contiguous")
+                budget_gb = getattr(args, "kv_hbm_gb", 0.0)
+                if budget_gb:
+                    common["kv_hbm_budget_bytes"] = int(budget_gb * 2**30)
                 common["quantize_int8"] = args.int8
                 common["quantize_int4"] = int4
                 common["quantize_unembed8"] = getattr(args, "int8_unembed",
@@ -305,6 +315,11 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                         cfg, params, num_slots=args.slots,
                         stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
                         kv_quant=kv_quant,
+                        kv_layout=getattr(args, "kv_layout", "contiguous"),
+                        kv_hbm_budget_bytes=(
+                            int(getattr(args, "kv_hbm_gb", 0.0) * 2**30)
+                            or None
+                        ),
                         speculative_draft=getattr(args, "speculative", 0),
                         max_queue_depth=app_cfg.max_queue_depth,
                     )
@@ -407,6 +422,18 @@ def main(argv=None) -> None:
                     help="int8 KV cache with per-slot scales: halves the "
                          "serving window's HBM footprint and decode cache "
                          "streaming (scheduler and engine backends)")
+    ap.add_argument("--kv-layout", choices=("contiguous", "paged"),
+                    default="contiguous",
+                    help="KV cache layout for the scheduler backend: "
+                         "'paged' serves from a shared page pool with "
+                         "per-slot page tables — concurrency scales with "
+                         "live tokens and schema-prefix cache hits share "
+                         "pages zero-copy (page size: LSOT_KV_PAGE_SIZE, "
+                         "default 64; pool size: --kv-hbm-gb)")
+    ap.add_argument("--kv-hbm-gb", type=float, default=0.0, metavar="GB",
+                    help="HBM budget for the paged KV pool (0 = the "
+                         "contiguous layout's own slots x max_seq "
+                         "footprint, i.e. same memory, more concurrency)")
     ap.add_argument("--int8-unembed", action="store_true",
                     help="per-row int8 embedding/unembedding tables — the "
                          "largest remaining bf16 decode stream after block "
@@ -473,7 +500,9 @@ def main(argv=None) -> None:
         service = (
             make_tiny_service(32, scheduler=args.scheduler, tp=args.tp,
                               supervise=args.supervise,
-                              speculative=getattr(args, "speculative", 0))
+                              speculative=getattr(args, "speculative", 0),
+                              kv_layout=getattr(args, "kv_layout",
+                                                "contiguous"))
             if args.backend == "tiny" else make_fake_service()
         )
     history = SQLiteHistory(cfg.history_db)

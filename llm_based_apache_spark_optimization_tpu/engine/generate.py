@@ -55,6 +55,8 @@ def make_generate_fn(
     attn_impl: Optional[str] = None,
     kv_quant: Optional[str] = None,
     constrained: bool = False,
+    kv_layout: str = "contiguous",
+    kv_page_size: Optional[int] = None,
 ):
     """Resolve the attention impl *outside* the cache boundary so a
     set_attention_impl() flip between calls maps to a different cache key
@@ -88,13 +90,45 @@ def make_generate_fn(
     fit the remaining budget, and advances the state by one
     [state, token] gather. No host round-trip, no per-token Python over
     the vocab, still ONE XLA program.
+
+    `kv_layout="paged"` swaps the decode loop's cache for the paged pool
+    (engine/paged_kv.py): prefill still runs the contiguous scan path over
+    a PROMPT-sized transient cache, one transpose-scatter packs it into
+    pool pages with identity per-row tables, and every decode step
+    reads/writes K/V through the page table — the same paged programs the
+    continuous-batching scheduler serves with, parity-tested here where
+    the loop is a single jit. Page size rides `kv_page_size` /
+    LSOT_KV_PAGE_SIZE.
     """
+    if kv_layout not in ("contiguous", "paged"):
+        raise ValueError(
+            f"kv_layout must be 'contiguous' or 'paged', got {kv_layout!r}"
+        )
+    page_size = 0
+    if kv_layout == "paged":
+        from .paged_kv import default_page_size
+
+        page_size = int(kv_page_size or default_page_size())
+        if kv_quant:
+            raise ValueError(
+                "kv_quant and kv_layout='paged' cannot combine yet: the "
+                "page pool stores compute-dtype K/V (int8 pages are a "
+                "follow-up)"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "kv_layout='paged' runs unsharded for now: the pool's "
+                "KV-head axis can shard like the contiguous cache, but "
+                "the paged programs are not mesh-threaded yet"
+            )
     return _make_generate_fn(
         cfg, max_new, sampling, stop_ids, mesh,
         attn_impl or attention_impl(mesh),
         attn_impl or decode_attention_impl(mesh),
         kv_quant,
         constrained,
+        kv_layout,
+        page_size,
     )
 
 
@@ -109,6 +143,8 @@ def _make_generate_fn(
     decode_impl: str,
     kv_quant: Optional[str] = None,
     constrained: bool = False,
+    kv_layout: str = "contiguous",
+    page_size: int = 0,
 ):
     """Build + jit a generate function for a fixed decode-budget cap and sampler.
 
@@ -169,7 +205,12 @@ def _make_generate_fn(
         # clamp (InferenceEngine always passes budget <= cap, but this fn is
         # exported for direct use).
         budget = jnp.minimum(budget, max_new)
-        cache = init_cache(cfg, b, t + max_new, dtype=params["final_norm"].dtype)
+        paged = kv_layout == "paged"
+        # Paged mode prefills a PROMPT-sized transient cache (packed into
+        # pool pages after the prefill forward); contiguous allocates the
+        # whole prompt+completion window up front.
+        cache = init_cache(cfg, b, t if paged else t + max_new,
+                           dtype=params["final_norm"].dtype)
         if mesh is not None:
             cache = constrain_cache(cache, mesh)
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
@@ -210,6 +251,16 @@ def _make_generate_fn(
             cache = quantize_cache(cache["k"], cache["v"])
             if mesh is not None:
                 cache = constrain_cache(cache, mesh)
+        elif paged:
+            # Prefill→decode handoff: pack the prompt K/V into pool pages
+            # with identity per-row tables; the while_loop below carries
+            # the pool, and forward's paged branch reads/writes through
+            # the table every step (the same paged decode program shape
+            # the scheduler serves with).
+            from .paged_kv import pack_prefill_pages
+
+            ppr = -(-(t + max_new) // page_size)
+            cache = pack_prefill_pages(cache, page_size, ppr)
 
         def cond(carry):
             done, step = carry[3], carry[5]
@@ -284,6 +335,8 @@ class InferenceEngine:
         speculative_ngram: int = 3,
         kv_quant: Optional[str] = None,
         fuse_matmuls: bool = False,
+        kv_layout: str = "contiguous",
+        kv_page_size: Optional[int] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -304,6 +357,27 @@ class InferenceEngine:
                 "speculative verify loop streams the bf16 cache"
             )
         self.kv_quant = kv_quant
+        # "paged": decode loops carry the shared page pool + per-row page
+        # tables instead of a contiguous cache (engine/paged_kv.py) —
+        # greedy-parity-tested against the contiguous layout, and the
+        # engine-side proof of the programs the scheduler serves with.
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'contiguous' or 'paged', got "
+                f"{kv_layout!r}"
+            )
+        if kv_layout == "paged" and kv_quant:
+            raise ValueError(
+                "kv_quant and kv_layout='paged' cannot combine yet: pool "
+                "pages store compute-dtype K/V"
+            )
+        if kv_layout == "paged" and mesh is not None:
+            raise ValueError(
+                "kv_layout='paged' runs unsharded for now (the paged "
+                "programs are not mesh-threaded yet)"
+            )
+        self.kv_layout = kv_layout
+        self.kv_page_size = kv_page_size
         # Prompt-lookup speculative decoding (engine/speculative.py): greedy
         # requests draft `speculative_draft` tokens per round by n-gram
         # lookup over prompt+history and verify them in one forward. 0
@@ -387,6 +461,7 @@ class InferenceEngine:
                 self.cfg, cap, self.stop_ids, self.mesh,
                 self.speculative_draft, self.speculative_ngram,
                 constrained=constraint is not None,
+                kv_layout=self.kv_layout, kv_page_size=self.kv_page_size,
             )
             args = [self.params, tokens, lengths, jnp.int32(max_new_tokens)]
             if constraint is not None:
@@ -405,6 +480,7 @@ class InferenceEngine:
                 self.cfg, cap, sampling, self.stop_ids, self.mesh,
                 kv_quant=self.kv_quant,
                 constrained=constraint is not None,
+                kv_layout=self.kv_layout, kv_page_size=self.kv_page_size,
             )
             args = [
                 self.params, tokens, lengths, jnp.int32(max_new_tokens),

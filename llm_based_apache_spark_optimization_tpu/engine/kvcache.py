@@ -49,6 +49,11 @@ def init_cache(
 
 
 def cache_bytes(cfg: LlamaConfig, batch: int, max_seq: int, itemsize: int = 2) -> int:
+    """Device bytes `init_cache` actually allocates — including the sublane
+    rounding above (the two used to disagree, under-reporting HBM for any
+    non-multiple-of-8 length; the paged pool sizing reuses this as the
+    fixed-budget baseline)."""
+    max_seq += -max_seq % 8
     return (
         2 * cfg.num_layers * batch * max_seq * cfg.num_kv_heads * cfg.head_dim * itemsize
     )

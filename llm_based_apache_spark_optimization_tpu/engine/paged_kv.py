@@ -1,0 +1,305 @@
+"""Paged KV cache: a shared device page pool + host-side page allocator.
+
+The contiguous layout (engine/kvcache.py) allocates every slot its
+worst-case window — `[L, slots, K, S_max, H]` — so concurrent slot count is
+bounded by `slots × S_max × layer bytes` no matter how many tokens are
+actually live, and the scheduler's prefix cache pays a gather-copy per hit.
+The paged layout breaks both bounds (the Ragged Paged Attention / vLLM
+PagedAttention design, PAPERS.md):
+
+    pool:        {"kp": [L, P, K, page_size, H], "vp": [L, P, K, page_size, H]}
+    page table:  [slots, pages_per_slot] int32 — per-slot logical->pool map
+
+- The pool is sized to an HBM budget (`pages_for_budget`), not to
+  slots × S_max: a request holds ceil(need / page_size) pages for
+  `need = bucketed prompt + max_new + overshoot` — mixed long/short traffic
+  stops paying max-bucket padding, and concurrent requests scale with live
+  tokens.
+- `PageAllocator` is pure host bookkeeping (free list + per-page refcounts):
+  page table updates are a few int32 scatters per admission, never a device
+  sync. Refcounts make prefix-cache hits ZERO-COPY — a hit maps the cached
+  prefix's pages into the new slot's table (refcount++) instead of
+  gather-copying K/V.
+- Copy-on-write: a shared page is never written in place. The only writer
+  of a shared page is a slot whose write range starts INSIDE one — a
+  non-page-aligned prefix boundary — and it first copies that one page
+  (`PageAllocator.cow` + a one-page device copy) and remaps. Everything
+  page-aligned stays zero-copy.
+- The unmapped sentinel is `num_pages` (one past the pool): jax drops
+  out-of-bounds scatter writes, so unmapped table entries make parked /
+  padding rows' K/V writes true no-ops, and gathers clip the sentinel to a
+  real page whose garbage the causal mask hides (the same
+  visibility-by-causality invariant engine/kvcache.py documents).
+
+Page size rides `LSOT_KV_PAGE_SIZE` (default 64): a multiple of 8 keeps
+pool pages sublane-aligned for the Pallas ragged-paged-attention kernel
+(ops/pallas/paged_attention.py), whose block grid DMAs one [K, page, H]
+page per cell through the scalar-prefetched page table.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..models.configs import LlamaConfig
+
+
+class PageAccountingError(RuntimeError):
+    """A refcount went negative or a freed page was freed again — the
+    allocator's invariants are broken and the pool can no longer be
+    trusted (this is a bug, not an operational condition)."""
+
+
+def default_page_size() -> int:
+    """LSOT_KV_PAGE_SIZE (default 64). Must be a positive multiple of 8 so
+    pool pages stay sublane-aligned for the TPU kernel's block grid."""
+    try:
+        ps = int(os.environ.get("LSOT_KV_PAGE_SIZE", "64"))
+    except ValueError:
+        ps = 64
+    if ps <= 0 or ps % 8:
+        raise ValueError(
+            f"LSOT_KV_PAGE_SIZE must be a positive multiple of 8, got {ps}"
+        )
+    return ps
+
+
+def page_bytes(cfg: LlamaConfig, page_size: int, itemsize: int = 2) -> int:
+    """Device bytes of ONE pool page across all layers (K and V)."""
+    return (
+        2 * cfg.num_layers * cfg.num_kv_heads * page_size * cfg.head_dim
+        * itemsize
+    )
+
+
+def pages_for_budget(
+    cfg: LlamaConfig, budget_bytes: int, page_size: int, itemsize: int = 2
+) -> int:
+    """Pool pages an HBM budget buys (the paged twin of
+    engine/kvcache.cache_bytes — same cfg, same itemsize convention)."""
+    return max(0, int(budget_bytes) // page_bytes(cfg, page_size, itemsize))
+
+
+def pages_for_tokens(n_tokens: int, page_size: int) -> int:
+    """Pages covering n_tokens positions (ceil)."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+def init_page_pool(
+    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+) -> Dict[str, jnp.ndarray]:
+    """Allocate the shared device page pool. Layout mirrors the contiguous
+    cache with the (batch, S) axes replaced by one page axis: per
+    (page, kv-head) the pool is a contiguous [page_size, H] tile — the
+    MXU/Pallas-friendly trailing (sublane, lane) shape."""
+    if page_size <= 0 or page_size % 8:
+        raise ValueError(
+            f"page_size must be a positive multiple of 8, got {page_size}"
+        )
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
+             cfg.head_dim)
+    return {"kp": jnp.zeros(shape, dtype), "vp": jnp.zeros(shape, dtype)}
+
+
+def pack_prefill_pages(
+    cache: Dict[str, jnp.ndarray], page_size: int, pages_per_row: int
+) -> Dict[str, jnp.ndarray]:
+    """Contiguous prefill cache {"k","v"} [L, B, K, S, H] -> paged cache
+    {"kp","vp","ptab"} with identity per-row tables (row b owns pool pages
+    [b*ppr, (b+1)*ppr)).
+
+    The engines' one-XLA-program loops use this as the prefill→decode
+    handoff: prefill runs the proven contiguous scan path over a
+    prompt-sized transient cache, one transpose-scatter packs its K/V into
+    pool pages, and the decode `lax.while_loop` carries the pool + tables
+    (models/llama.forward's paged branch). Pure jnp — runs inside jit."""
+    k = cache["k"]
+    n_layers, b, kh, s, h = k.shape
+    ppr = int(pages_per_row)
+    num_pages = b * ppr
+    s_pad = s + (-s % page_size)
+    np0 = s_pad // page_size
+    if np0 > ppr:
+        raise ValueError(
+            f"prefill cache ({s} positions = {np0} pages) exceeds "
+            f"pages_per_row={ppr}"
+        )
+    ptab = (
+        jnp.arange(b, dtype=jnp.int32)[:, None] * ppr
+        + jnp.arange(ppr, dtype=jnp.int32)[None, :]
+    )
+
+    def pack(arr):
+        a = jnp.pad(arr, ((0, 0), (0, 0), (0, 0), (0, s_pad - s), (0, 0)))
+        a = a.reshape(n_layers, b, kh, np0, page_size, h)
+        a = a.transpose(0, 1, 3, 2, 4, 5)  # [L, B, np0, K, PS, H]
+        pool = jnp.zeros(
+            (n_layers, num_pages, kh, page_size, h), arr.dtype
+        )
+        return pool.at[:, ptab[:, :np0]].set(a)
+
+    return {"kp": pack(cache["k"]), "vp": pack(cache["v"]), "ptab": ptab}
+
+
+class PageAllocator:
+    """Host-side page accounting: free list + per-page refcounts.
+
+    All methods are O(pages touched); nothing here talks to the device.
+    Thread-unsafe by design — the scheduler's worker thread is the only
+    caller (same single-writer discipline as every other slot structure).
+
+    Invariants (property-tested in tests/test_paged_kv.py):
+    - every page is either on the free list (refcount 0) or live
+      (refcount >= 1) — never both, never neither;
+    - `release` on a refcount-0 page raises (double free is a bug);
+    - a shared page (refcount > 1) is never handed out by `alloc`.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages <= 0:
+            raise ValueError(f"num_pages must be positive, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._free: "deque[int]" = deque(range(self.num_pages))
+        self._ref = [0] * self.num_pages
+        #: zero-copy shares taken (prefix publish + hit mappings): the
+        #: counter that proves hits SHARED pages instead of copying them.
+        self.shares = 0
+        #: copy-on-write page copies (non-page-aligned boundaries only).
+        self.cow_copies = 0
+
+    # ------------------------------------------------------------- queries
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages currently mapped by more than one owner."""
+        return sum(1 for r in self._ref if r > 1)
+
+    def refcount(self, page: int) -> int:
+        return self._ref[page]
+
+    def is_shared(self, page: int) -> bool:
+        return self._ref[page] > 1
+
+    def can_alloc(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    # ----------------------------------------------------------- mutations
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n fresh exclusive pages, or None (all-or-nothing: a request that
+        cannot fully fit must not hold a partial grab and deadlock against
+        another partial holder)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if len(self._free) < n:
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            if self._ref[p] != 0:
+                raise PageAccountingError(
+                    f"free-list page {p} has refcount {self._ref[p]}"
+                )
+            self._ref[p] = 1
+        return pages
+
+    def share(self, pages: List[int], count: bool = True) -> None:
+        """Take one additional reference on each page (zero-copy mapping:
+        prefix-cache publish and hit both land here). `count=False` for
+        TRANSIENT holds (e.g. pinning a matched entry across an allocation
+        that may fail, or a boundary page held only until its COW copy):
+        `shares` must count mappings that persist — it is the artifact's
+        "sharing, not copying" proof and must not inflate under retries."""
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise PageAccountingError(
+                    f"share of dead page {p} (refcount {self._ref[p]})"
+                )
+        for p in pages:
+            self._ref[p] += 1
+        if count:
+            self.shares += len(pages)
+
+    def note_shares(self, n: int) -> None:
+        """Promote n transient holds (share(count=False)) to counted
+        zero-copy mappings once they are known to persist."""
+        self.shares += n
+
+    def release(self, pages: List[int]) -> List[int]:
+        """Drop one reference per page; pages reaching refcount 0 return to
+        the free list. Returns the freed subset."""
+        for p in pages:
+            if self._ref[p] <= 0:
+                raise PageAccountingError(
+                    f"release of dead page {p} (refcount {self._ref[p]})"
+                )
+        freed = []
+        for p in pages:
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                self._free.append(p)
+                freed.append(p)
+        return freed
+
+    def cow(self, page: int) -> Optional[int]:
+        """Copy-on-write: exchange one reference on a SHARED page for a
+        fresh exclusive page (the caller must device-copy the old page's
+        content into the returned one before writing). Returns `page`
+        unchanged when it is already exclusive (no copy needed), None when
+        the pool has no free page for the copy."""
+        if self._ref[page] <= 0:
+            raise PageAccountingError(
+                f"cow of dead page {page} (refcount {self._ref[page]})"
+            )
+        if self._ref[page] == 1:
+            return page
+        fresh = self.alloc(1)
+        if fresh is None:
+            return None
+        self.release([page])
+        self.cow_copies += 1
+        return fresh[0]
+
+    def note_cow(self) -> None:
+        """Count a boundary-page copy performed OUTSIDE the refcount
+        exchange (admission copies a hit's partial boundary page into an
+        already-allocated fresh page — same event, different bookkeeping
+        path)."""
+        self.cow_copies += 1
+
+    def stats(self) -> Dict[str, int]:
+        """The /metrics + flight-recorder payload: a leaked page shows up
+        as pages_in_use that never returns to pages_free."""
+        return {
+            "page_size": self.page_size,
+            "pages_total": self.num_pages,
+            "pages_free": self.pages_free,
+            "pages_in_use": self.pages_in_use,
+            "pages_shared": self.pages_shared,
+            "zero_copy_shares": self.shares,
+            "cow_copies": self.cow_copies,
+        }
+
+    def check(self) -> None:
+        """Assert the free-list/refcount partition (test helper)."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise PageAccountingError("duplicate page on the free list")
+        for p in range(self.num_pages):
+            if (p in free) != (self._ref[p] == 0):
+                raise PageAccountingError(
+                    f"page {p}: refcount {self._ref[p]} vs free-list "
+                    f"membership {p in free}"
+                )

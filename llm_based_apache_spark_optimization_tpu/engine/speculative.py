@@ -77,16 +77,71 @@ from .kvcache import init_cache
 VERIFY_COST_ANCHORS = ((0, 1.0), (8, 1.6))
 VERIFY_COST_CALIBRATION = (
     "linear in draft length, anchored at D=0 (=1.0 by construction) and "
-    "D=8 (=1.6 measured: v5e, bench-1b, B=8)"
+    "D=8 (=1.6 measured: v5e, bench-1b, B=8, bf16); other shapes scale "
+    "the slope by (unembed marginal / weight-stream fixed) cost relative "
+    "to that anchor"
 )
 
 
-def verify_cost_ratio(draft_len: int) -> float:
-    """verify(T=draft_len+1) / decode(T=1) cost under the two-anchor linear
-    model above. Floors at 1.0: a verify round can never be cheaper than
+def _param_count(cfg) -> int:
+    """Approximate parameter count from the architecture shape — the
+    decode step's fixed cost is streaming these bytes."""
+    d, f, n_layers = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    nh, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    attn = d * nh * hd + 2 * d * kh * hd + nh * hd * d
+    mlp = 3 * d * f
+    emb = cfg.vocab_size * d * (1 if cfg.tie_embeddings else 2)
+    return emb + n_layers * (attn + mlp + 2 * d) + d
+
+
+def infer_weight_bits(params) -> int:
+    """Weight bits/param of a params tree: 4 for int4-packed trees, 8 for
+    int8 QTensor trees, else the leaf dtype width — the shape input
+    `verify_cost_ratio` prices the fixed weight stream with."""
+    import jax
+
+    blocks = params.get("blocks", params)
+    sample = blocks[0] if isinstance(blocks, (list, tuple)) else blocks
+    if isinstance(sample, dict):
+        if any(isinstance(v, dict) and "q4" in v for v in sample.values()):
+            return 4
+        if any(isinstance(v, dict) and "q8" in v for v in sample.values()):
+            return 8
+    return jax.tree.leaves(params)[0].dtype.itemsize * 8
+
+
+def verify_cost_ratio(draft_len: int, cfg=None, weight_bits: int = 16,
+                      tp: int = 1) -> float:
+    """verify(T=draft_len+1) / decode(T=1) cost under the anchored linear
+    model, scaled to the caller's MODEL SHAPE (ROADMAP carried-over item:
+    the old signature priced every config at the 1B bench shape).
+
+    The slope — marginal cost per extra window position — is dominated by
+    the unembed (a V×D dot and a V-wide f32 logit row per position; the
+    block matmuls are MXU-idle at small T), while the round's fixed cost
+    is streaming the weight bytes. So the slope scales with
+    (vocab·hidden) / weight_bytes relative to the anchor shape (bench-1b
+    bf16), where `weight_bits` prices int8/int4 trees (fewer fixed bytes →
+    a verify window is relatively MORE expensive → higher breakeven).
+    `tp` cancels to first order — each device streams 1/tp of the weights
+    AND computes 1/tp of the unembed — and is accepted so callers can
+    record their topology; only the collective overhead it adds is
+    unmodeled. Floors at 1.0: a verify round can never be cheaper than
     the vanilla step it replaces."""
+    del tp  # cancels: fixed and marginal costs shard identically
     (d0, r0), (d1, r1) = VERIFY_COST_ANCHORS
     slope = (r1 - r0) / (d1 - d0)
+    if cfg is not None:
+        from ..models.configs import BENCH_1B
+
+        def marg_over_fixed(c, bits):
+            return (c.vocab_size * c.hidden_size) / (
+                _param_count(c) * bits / 8
+            )
+
+        slope *= marg_over_fixed(cfg, weight_bits) / marg_over_fixed(
+            BENCH_1B, 16
+        )
     return max(1.0, r0 + slope * (draft_len - d0))
 
 
@@ -148,6 +203,8 @@ def make_speculative_generate_fn(
     ngram: int = 3,
     attn_impl: Optional[str] = None,
     constrained: bool = False,
+    kv_layout: str = "contiguous",
+    kv_page_size: Optional[int] = None,
 ):
     """Greedy generate with prompt-lookup speculation.
 
@@ -179,11 +236,33 @@ def make_speculative_generate_fn(
         )
     if ngram < 1:
         raise ValueError(f"ngram must be >= 1, got {ngram}")
+    if kv_layout not in ("contiguous", "paged"):
+        raise ValueError(
+            f"kv_layout must be 'contiguous' or 'paged', got {kv_layout!r}"
+        )
+    page_size = 0
+    decode = attn_impl or decode_attention_impl(mesh)
+    if kv_layout == "paged":
+        from .paged_kv import default_page_size
+
+        page_size = int(kv_page_size or default_page_size())
+        if mesh is not None:
+            raise ValueError(
+                "kv_layout='paged' runs unsharded for now (the paged "
+                "programs are not mesh-threaded yet)"
+            )
+        # The verify window is T=D+1 > 1 and the ragged-paged kernel is a
+        # T=1 decode specialization: paged verify forwards always take the
+        # reference gather path (same pin the scheduler's spec_decode
+        # makes), even under a forced-pallas attention mode.
+        decode = "xla"
     return _make_speculative_generate_fn(
         cfg, max_new, stop_ids, mesh, draft_len, ngram,
         attn_impl or attention_impl(mesh),
-        attn_impl or decode_attention_impl(mesh),
+        decode,
         constrained,
+        kv_layout,
+        page_size,
     )
 
 
@@ -198,6 +277,8 @@ def _make_speculative_generate_fn(
     prefill_impl: str,
     decode_impl: str,
     constrained: bool = False,
+    kv_layout: str = "contiguous",
+    page_size: int = 0,
 ):
     from .generate import _is_stop as _is_stop_ids
 
@@ -225,8 +306,13 @@ def _make_speculative_generate_fn(
         b, t = tokens.shape
         budget = jnp.minimum(budget, max_new)
         lengths = lengths.astype(jnp.int32)
-        # Cache spans prompt + completion + one verify window of overshoot.
-        cache = init_cache(cfg, b, t + max_new + d1, dtype=params["final_norm"].dtype)
+        paged = kv_layout == "paged"
+        # Cache spans prompt + completion + one verify window of overshoot
+        # (paged mode prefills a prompt-sized transient cache and packs it
+        # into pool pages covering the same span — verify windows write
+        # through the page table, spanning page boundaries freely).
+        cache = init_cache(cfg, b, t if paged else t + max_new + d1,
+                           dtype=params["final_norm"].dtype)
         if mesh is not None:
             cache = constrain_cache(cache, mesh)
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :], (b, t))
@@ -245,6 +331,11 @@ def _make_speculative_generate_fn(
             )
         first = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
         cstate = g_next[init_states, first] if constrained else None
+        if paged:
+            from .paged_kv import pack_prefill_pages
+
+            ppr = -(-(t + max_new + d1) // page_size)
+            cache = pack_prefill_pages(cache, page_size, ppr)
 
         # History = prompt tokens + generated, contiguous per row (generated
         # tokens land at hlen, after the row's REAL prompt; the pad gap up

@@ -238,12 +238,38 @@ def _update_cache_layer(
     return cache
 
 
+def _update_paged_cache_layer(
+    pool: jnp.ndarray,       # [L, P, K, PS, H] — shared page pool
+    new: jnp.ndarray,        # [B, T, K, H] fresh K or V
+    positions: jnp.ndarray,  # [B, T] i32 absolute positions
+    page_table: jnp.ndarray,  # [B, NP] i32 (num_pages = unmapped sentinel)
+    layer: int,
+) -> jnp.ndarray:
+    """Write a fresh K/V sliver through per-row page tables at a static
+    layer index (the paged twin of `_update_cache_layer`).
+
+    One scatter per layer: positions translate to (pool page, in-page
+    offset) pairs and jax's OOB-scatter-drop semantics make unmapped table
+    entries (the `num_pages` sentinel) true no-ops — parked scheduler
+    slots and prefill padding rows write nothing, with no branching."""
+    ps = pool.shape[3]
+    pos = positions.astype(jnp.int32)
+    idx = jnp.clip(pos // ps, 0, page_table.shape[1] - 1)
+    pages = jnp.take_along_axis(page_table, idx, axis=1)  # [B, T]
+    offs = pos % ps
+    # Advanced indices at non-adjacent dims (pool page, in-page offset)
+    # broadcast to the front: the update is [B, T, K, H] — exactly `new`.
+    return pool.at[layer, pages, :, offs].set(new.astype(pool.dtype))
+
+
 def forward(
     cfg: LlamaConfig,
     params: Params,
     tokens: jnp.ndarray,      # [B, T] int32
     positions: jnp.ndarray,   # [B, T] int32 — absolute position of each token
     cache: Optional[Dict[str, jnp.ndarray]] = None,  # {"k","v"}: [L, B, K, S, H]
+                              # or paged {"kp","vp": [L, P, K, PS, H],
+                              # "ptab": [B, NP] i32} (engine/paged_kv.py)
     logit_indices: Optional[jnp.ndarray] = None,  # [B] int32 — unembed only these T-indices
     attn_impl: str = "xla",  # "xla" | "pallas" | "ring"; resolve via ops.pallas.attention_impl
     mesh=None,  # required for attn_impl="ring" (context-parallel prefill)
@@ -277,10 +303,17 @@ def forward(
     start = positions[:, 0]
 
     quant_cache = cache is not None and "k8" in cache
+    paged_cache = cache is not None and "kp" in cache
     if cache is None:
         kv_size = t
     elif quant_cache:
         kv_size = cache["k8"].shape[3]
+    elif paged_cache:
+        # Virtual contiguous length: logical pages × page size. The table
+        # maps logical position p to pool page ptab[b, p // PS], offset
+        # p % PS; unmapped entries only ever sit past a row's live length,
+        # where causality masks them.
+        kv_size = cache["ptab"].shape[1] * cache["kp"].shape[3]
     else:
         kv_size = cache["k"].shape[3]
     # Default is the always-correct einsum path: a bare forward() cannot see
@@ -302,6 +335,18 @@ def forward(
             f"small-T path (T <= {_UNROLL_MAX_T}), or the pallas impl at "
             "T=1 (decode): the prefill scan streams bf16 caches (engine "
             "prefill fills bf16, then quantizes once — engine/generate.py)"
+        )
+    if paged_cache and not (
+        t <= _UNROLL_MAX_T and (impl == "xla" or (impl == "pallas"
+                                                  and t == 1))
+    ):
+        raise ValueError(
+            "a paged KV cache serves the unrolled small-T path only "
+            f"(T <= {_UNROLL_MAX_T}; decode + verify windows): prefill "
+            "runs a contiguous transient/row cache and packs or scatters "
+            "its K/V into pool pages (engine/generate.py, "
+            "serve/scheduler.py). The pallas ragged-paged kernel is a "
+            "T=1 decode specialization; other T take the reference path."
         )
     mask = (
         attention_mask(positions, kv_size, cfg.sliding_window)
@@ -451,6 +496,34 @@ def forward(
                     attn = gqa_attention_quantized(
                         q, new_cache["k8"][l], new_cache["ks"][l],
                         new_cache["v8"][l], new_cache["vs"][l], mask,
+                    )
+                x = post_attn(p, x, attn)
+            elif paged_cache:
+                # Paged pool: write the sliver through the page table (one
+                # scatter per layer; unmapped rows drop), then attend —
+                # the ragged-paged kernel gathers pool pages in the DMA
+                # index map (T=1), the reference path gathers them as a
+                # contiguous view (any small T, e.g. verify windows).
+                ptab = cache["ptab"]
+                new_cache["kp"] = _update_paged_cache_layer(
+                    new_cache["kp"], k, positions, ptab, l)
+                new_cache["vp"] = _update_paged_cache_layer(
+                    new_cache["vp"], v, positions, ptab, l)
+                if impl == "pallas":  # T == 1 (validated above)
+                    from ..ops.pallas import ragged_paged_attention
+
+                    attn = ragged_paged_attention(
+                        q, new_cache["kp"][l], new_cache["vp"][l], ptab,
+                        positions, cfg.sliding_window, kv_lens,
+                    )
+                else:
+                    from ..ops.pallas import gather_pages
+
+                    attn = gqa_attention(
+                        q,
+                        gather_pages(new_cache["kp"][l], ptab),
+                        gather_pages(new_cache["vp"][l], ptab),
+                        mask,
                     )
                 x = post_attn(p, x, attn)
             else:

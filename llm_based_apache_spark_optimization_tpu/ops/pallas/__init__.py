@@ -16,6 +16,11 @@ from .attention import (  # noqa: F401
     sharded_flash_gqa_attention,
     sharded_flash_gqa_attention_quantized,
 )
+from .paged_attention import (  # noqa: F401
+    gather_pages,
+    paged_attention_reference,
+    ragged_paged_attention,
+)
 from .dispatch import (  # noqa: F401
     attention_impl,
     decode_attention_impl,

@@ -59,6 +59,13 @@ from ..common import NEG_INF
 
 _LANES = 128  # VMEM lane width: scratch row-stats are kept lane-broadcast
 
+# jax renamed pltpu.TPUCompilerParams -> CompilerParams; accept both so the
+# kernels (and their interpret-mode CPU tests) run on either side of the
+# rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def _flash_block_update(
     q, k, v, qp_row, kvl, s_idx, blk,
@@ -312,7 +319,7 @@ def _run_decode_grid(kernel, q, streams, q_positions, kv_lens,
         # Batch cells are independent -> megacore can split them; the S
         # axis carries the online-softmax accumulators and must run in
         # order on one core.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -429,7 +436,7 @@ def flash_gqa_attention(
         # them; the q-block axis reuses the scratch accumulators (marked
         # arbitrary so one core sweeps a q-block's S-blocks in order), and
         # the S axis carries the online-softmax state.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary"),
         ),

@@ -43,6 +43,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# Renamed upstream (TPUCompilerParams -> CompilerParams); accept both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
 
 def unpack_nibbles(q4: jnp.ndarray) -> jnp.ndarray:
     """uint8 [..., in/2, out] -> int8 [..., in, out] of values in [-8, 7].
@@ -176,7 +181,7 @@ def int4_matmul(
         scratch_shapes=[pltpu.VMEM((rb, ob), jnp.float32)],
         # Row/out-blocks are independent (megacore splits them); the
         # in-block axis accumulates through scratch and must run in order.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

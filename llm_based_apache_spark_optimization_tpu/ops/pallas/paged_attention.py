@@ -1,0 +1,240 @@
+"""Ragged paged attention (decode) over the shared KV page pool.
+
+The paged twin of `attention.py`'s K-folded flash decode kernel: K/V live in
+a shared pool `[P, K, page, H]` (engine/paged_kv.py) and each batch row owns
+a page TABLE `[NP]` mapping its logical pages to pool pages — the layout
+from "Ragged Paged Attention: A High-Performance and Flexible LLM Inference
+Kernel for TPU" (PAPERS.md) and vLLM's PagedAttention.
+
+Kernel design:
+
+- Grid = (B, NP): the logical-page axis is innermost, so one core sweeps a
+  row's pages in order and the online-softmax accumulators (shared
+  `_flash_block_update`) live in VMEM scratch across the sweep. The KV-head
+  axis is folded into the cell exactly like the contiguous decode kernel —
+  a pool page already holds all K heads contiguously, so a page IS the
+  natural DMA block.
+- The page table rides SCALAR PREFETCH: the K/V BlockSpec index maps read
+  `table[b, i]` to pick which POOL page cell (b, i) streams — the gather
+  happens in the DMA engine's addressing, never as a materialized
+  [B, NP*page, ...] copy (that copy is exactly what the XLA reference path
+  below pays, and what this kernel exists to avoid).
+- Ragged bounding: `kv_lens[b]` clamps the logical page index at the row's
+  last live page — grid steps past it re-map the same pool page and Pallas
+  elides the repeated DMA, so a row at position p streams
+  ceil((p+1)/page) pages, not NP (parked rows with kv_lens=0 stream one
+  page and compute nothing). HBM traffic therefore scales with LIVE tokens
+  across a mixed-age batch — the whole point of the paged layout.
+- Unmapped table entries (the `num_pages` sentinel) are clipped to a real
+  pool page; they can only sit at logical positions the causal/kv_lens
+  mask already hides, so the garbage never reaches the output (asserted by
+  the parity tests against `paged_attention_reference`).
+
+`paged_attention_reference` is the always-correct XLA path (gather the
+row's pages into a contiguous view, run the einsum attention): the golden
+in parity tests, the CPU/interpret fallback in `models/llama.forward`, and
+the T>1 path (speculative verify windows) — the kernel itself is a T=1
+decode specialization, like its contiguous sibling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..common import NEG_INF
+from .attention import _CompilerParams, _flash_block_update, _LANES
+
+
+def _paged_decode_kernel(
+    kvlen_ref,  # [B] i32 SMEM (scalar prefetch) — live KV tokens per row
+    table_ref,  # [B, NP] i32 SMEM (scalar prefetch) — page tables
+    qpos_ref,   # [1, 1, GT] i32
+    q_ref,      # [1, K, GT, H]
+    k_ref,      # [1, K, PS, H] — pool page picked by the index map
+    v_ref,      # [1, K, PS, H]
+    o_ref,      # [1, K, GT, H]
+    m_ref,      # [K, GT, LANES] f32 scratch
+    l_ref,      # [K, GT, LANES] f32 scratch
+    acc_ref,    # [K, GT, H] f32 scratch
+    *,
+    scale: float,
+    sliding_window: Optional[int],
+    kv_len: int,
+):
+    i = pl.program_id(1)
+    ps = k_ref.shape[2]
+    kvl = kvlen_ref[pl.program_id(0)]
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    qp_row = qpos_ref[0, 0]       # [GT]
+
+    # Same skip rule as the contiguous decode kernel: pages whose first
+    # logical position exceeds every query position — or the row's live
+    # length — contribute nothing (their DMA was already elided by the
+    # clamped index map).
+    @pl.when((i * ps <= jnp.max(qp_row)) & (i * ps < kvl))
+    def _compute():
+        m_new, l_new, acc_new = _flash_block_update(
+            q_ref[0], k_ref[0], v_ref[0], qp_row, kvl, i, ps,
+            m_ref[:, :, :1], l_ref[:, :, :1], acc_ref[...],
+            scale=scale, sliding_window=sliding_window, kv_len=kv_len,
+        )
+        acc_ref[:] = acc_new
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_ref[:, :, :1]
+        out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sliding_window", "interpret")
+)
+def ragged_paged_attention(
+    q: jnp.ndarray,            # [B, 1, N, H] — decode only (T == 1)
+    k_pool: jnp.ndarray,       # [P, K, PS, H] — one layer's page pool
+    v_pool: jnp.ndarray,       # [P, K, PS, H]
+    page_table: jnp.ndarray,   # [B, NP] i32 — pool page per logical page
+    q_positions: jnp.ndarray,  # [B, 1] i32
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,  # [B] i32 — live tokens per row
+    *,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash decode attention reading K/V through per-row page tables.
+
+    Returns [B, 1, N, H] in q's dtype. Output depends only on the first
+    `kv_lens[b]` logical positions of each row (defaults to max(position)+1);
+    kv_lens=0 parks a row (zero output, one elided-DMA sweep)."""
+    b, t, n, h = q.shape
+    if t != 1:
+        raise ValueError(
+            f"ragged paged kernel is decode-only (T=1), got T={t}; verify "
+            f"windows take paged_attention_reference"
+        )
+    num_pages, kh, ps, _ = k_pool.shape
+    g = n // kh
+    np_tab = page_table.shape[1]
+    s_virt = np_tab * ps
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if not interpret and ps % 8:
+        raise ValueError(
+            f"pool pages must be sublane-aligned (page size multiple of 8) "
+            f"on TPU, got {ps}"
+        )
+    if kv_lens is None:
+        kv_lens = jnp.max(q_positions, axis=1) + 1
+    kv_lens = jnp.clip(kv_lens.astype(jnp.int32), 0, s_virt)
+    table = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+
+    # [B, 1, N, H] -> [B, K, G, H] (GT = G at T=1), like the contiguous
+    # decode grid.
+    q5 = q.reshape(b, kh, g, h)
+    qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))[:, None, :]
+
+    def kv_map(bi, i, kvl, tab):
+        # Clamp at the row's last LIVE logical page, then translate through
+        # its table: steps past the live region re-map the same pool page
+        # and the DMA is elided — the bandwidth saving, not just a compute
+        # skip.
+        last = jnp.maximum((kvl[bi] + ps - 1) // ps - 1, 0)
+        return (tab[bi, jnp.minimum(i, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, np_tab),
+        in_specs=[
+            pl.BlockSpec((1, 1, g), lambda bi, i, kvl, tab: (bi, 0, 0)),
+            pl.BlockSpec((1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, kh, ps, h), kv_map),
+            pl.BlockSpec((1, kh, ps, h), kv_map),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((kh, g, _LANES), jnp.float32),
+            pltpu.VMEM((kh, g, _LANES), jnp.float32),
+            pltpu.VMEM((kh, g, h), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, scale=h**-0.5,
+            sliding_window=sliding_window, kv_len=s_virt,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, g, h), q.dtype),
+        # Batch rows are independent (megacore splits them); the page axis
+        # carries the online-softmax accumulators in order on one core.
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(kv_lens, table, qpos, q5, k_pool, v_pool)
+    return out.reshape(b, kh, g, 1, h).transpose(0, 3, 1, 2, 4).reshape(
+        b, 1, n, h
+    )
+
+
+def gather_pages(
+    pool: jnp.ndarray,        # [P, K, PS, H] — one layer's page pool
+    page_table: jnp.ndarray,  # [B, NP] i32
+) -> jnp.ndarray:
+    """Materialize per-row contiguous K or V views [B, K, NP*PS, H] by
+    gathering pool pages through the table (unmapped sentinel entries clip
+    to a real page; their garbage sits at causally masked positions). This
+    COPY is what the Pallas kernel's DMA-level gather avoids — it exists
+    for the reference path, T>1 verify windows, and prefill row views."""
+    num_pages, kh, ps, h = pool.shape
+    b, np_tab = page_table.shape
+    safe = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+    g = pool[safe]                          # [B, NP, K, PS, H]
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, kh, np_tab * ps, h)
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,            # [B, T, N, H]
+    k_pool: jnp.ndarray,       # [P, K, PS, H]
+    v_pool: jnp.ndarray,       # [P, K, PS, H]
+    page_table: jnp.ndarray,   # [B, NP] i32
+    q_positions: jnp.ndarray,  # [B, T] i32
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,  # [B] i32
+) -> jnp.ndarray:
+    """XLA reference with the kernel's exact contract (golden in tests;
+    serves any T, so speculative verify windows run through it)."""
+    from ..attention import attention_mask, gqa_attention
+
+    k_full = gather_pages(k_pool, page_table)
+    v_full = gather_pages(v_pool, page_table)
+    s_virt = k_full.shape[2]
+    mask = attention_mask(q_positions, s_virt, sliding_window)
+    if kv_lens is not None:
+        kv_idx = jnp.arange(s_virt, dtype=jnp.int32)[None, None, :]
+        mask = mask & (kv_idx < jnp.clip(
+            kv_lens.astype(jnp.int32), 0, s_virt
+        )[:, None, None])
+        # Fully-parked rows (kv_lens=0) return zeros like the kernel, not
+        # a uniform softmax over NEG_INF scores.
+        out = gqa_attention(q, k_full, v_full, mask)
+        return jnp.where(
+            (kv_lens > 0)[:, None, None, None], out, jnp.zeros_like(out)
+        )
+    return gqa_attention(q, k_full, v_full, mask)

@@ -122,6 +122,14 @@ from jax import lax
 
 from ..constrain.masks import CompiledMask, trivial_tables
 from ..engine.kvcache import bucket_len, init_cache
+from ..engine.paged_kv import (
+    PageAllocator,
+    default_page_size,
+    init_page_pool,
+    page_bytes,
+    pages_for_budget,
+    pages_for_tokens,
+)
 from ..models.configs import LlamaConfig
 from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
@@ -228,6 +236,15 @@ class _Request:
     trace: Optional[object] = None
     admitted_at: float = 0.0
     ready_at: float = 0.0
+    # Paged KV (kv_layout="paged"): highest cache position (exclusive) this
+    # request's prefill+decode can ever write — admission allocated pages
+    # covering exactly [0, page_end), and the ready-time ensure-writable
+    # sweep COWs any published page the decode range intersects.
+    page_end: int = 0
+    # Already counted in page_waits: the admission loop retries a starved
+    # request every iteration, and the metric must count REQUESTS that
+    # waited, not retry attempts.
+    page_waited: bool = False
 
     def flush_spans(self, now: float) -> None:
         """Record the request's scheduler-phase spans into its trace at
@@ -298,6 +315,10 @@ class ContinuousBatchingScheduler:
         fuse_matmuls: bool = False,
         max_queue_depth: int = 0,
         slot_stall_rounds: int = 16,
+        kv_layout: str = "contiguous",
+        kv_page_size: Optional[int] = None,
+        kv_pages: Optional[int] = None,
+        kv_hbm_budget_bytes: Optional[int] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -350,6 +371,12 @@ class ContinuousBatchingScheduler:
             validate_tp(cfg, mesh.shape["tp"])
             params = shard_params(params, cfg, mesh)
         self.params = params
+        # Weight bits for the verify-cost model: immutable for this
+        # scheduler's lifetime, so probe the tree ONCE instead of per
+        # speculation_stats read (/metrics scrapes + bench deltas).
+        from ..engine.speculative import infer_weight_bits
+
+        self._weight_bits = infer_weight_bits(params)
         self.num_slots = num_slots
         self.max_seq = min(max_seq or cfg.max_seq_len, cfg.max_seq_len)
         self.decode_chunk = decode_chunk
@@ -362,6 +389,79 @@ class ContinuousBatchingScheduler:
         if kv_quant not in (None, "int8"):
             raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
         self.kv_quant = kv_quant
+        # Paged KV cache (kv_layout="paged", engine/paged_kv.py): the
+        # persistent window becomes a shared page pool sized to an HBM
+        # budget + per-slot page tables, instead of slots × S_max
+        # contiguous rows. Admission allocates ceil(need/page) pages for
+        # the request's ACTUAL envelope (bucketed prompt + budget +
+        # overshoot), so concurrency is bounded by live tokens, mixed
+        # long/short batches stop paying max-bucket padding, and
+        # prefix-cache hits map shared pages zero-copy (refcounts;
+        # copy-on-write only at a non-page-aligned boundary). Decode runs
+        # the ragged-paged-attention path (models/llama.forward paged
+        # branch; ops/pallas/paged_attention.py on TPU).
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'contiguous' or 'paged', got "
+                f"{kv_layout!r}"
+            )
+        self.kv_layout = kv_layout
+        self._paged = kv_layout == "paged"
+        if self._paged:
+            if kv_quant:
+                raise ValueError(
+                    "kv_quant and kv_layout='paged' cannot combine yet: "
+                    "pool pages store compute-dtype K/V (int8 pages are a "
+                    "follow-up)"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "kv_layout='paged' runs unsharded for now: the pool's "
+                    "KV-head axis can shard like the contiguous cache, "
+                    "but the paged programs are not mesh-threaded yet"
+                )
+            ps = int(kv_page_size or default_page_size())
+            if ps <= 0 or ps % 8:
+                raise ValueError(
+                    f"kv_page_size must be a positive multiple of 8, got "
+                    f"{ps}"
+                )
+            self._page_size = ps
+            # Logical pages per slot: enough table entries to address the
+            # whole window (a slot never MAPS them all unless its request
+            # actually needs max_seq).
+            self._pages_per_slot = pages_for_tokens(self.max_seq, ps)
+            if kv_pages:
+                num_pages = int(kv_pages)
+            elif kv_hbm_budget_bytes:
+                num_pages = pages_for_budget(
+                    cfg, kv_hbm_budget_bytes, ps, dtype.itemsize
+                )
+            else:
+                # Default budget = the contiguous layout's own footprint:
+                # same HBM, strictly more concurrency on mixed traffic.
+                num_pages = num_slots * self._pages_per_slot
+            if num_pages < self._pages_per_slot:
+                raise ValueError(
+                    f"page pool of {num_pages} pages cannot hold one "
+                    f"max-length request ({self._pages_per_slot} pages of "
+                    f"{ps} tokens for max_seq={self.max_seq}); raise "
+                    f"kv_pages / kv_hbm_budget_bytes or lower max_seq"
+                )
+            self._page_alloc = PageAllocator(num_pages, ps)
+            # Host-side per-slot page lists (the device table's mirror).
+            self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
+            # Paged prefix cache: content key (token prefix) -> pool page
+            # ids covering it. Entries hold REFERENCES (refcounts), not
+            # copies — publish and hit are both zero-copy.
+            self._prefix_pages: "OrderedDict[Tuple[int, ...], Tuple[int, ...]]" = (
+                OrderedDict()
+            )
+            # Requests admitted to a slot but waiting for pool pages
+            # (admission is all-or-nothing so partial holders can't
+            # deadlock); FIFO ahead of the main queue.
+            self._page_wait: "deque[_Request]" = deque()
+            self._page_wait_events = 0
         # Decode impl is cost-aware: the flash kernel's per-row kv_lens
         # bounding (parked slots stream nothing) only beats the einsum
         # path's zero-overhead full-cache read once the persistent
@@ -375,9 +475,14 @@ class ContinuousBatchingScheduler:
         from ..engine.kvcache import cache_bytes as _cache_bytes
 
         tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
-        cache_dev_bytes = _cache_bytes(
-            cfg, num_slots, self.max_seq, dtype.itemsize
-        ) // tp
+        if self._paged:
+            cache_dev_bytes = self._page_alloc.num_pages * page_bytes(
+                cfg, self._page_size, dtype.itemsize
+            )
+        else:
+            cache_dev_bytes = _cache_bytes(
+                cfg, num_slots, self.max_seq, dtype.itemsize
+            ) // tp
         if kv_quant:
             # Halving shifts the kernel/einsum crossover to the quantized
             # byte count. NOTE (advisor r4): the crossover threshold itself
@@ -389,16 +494,32 @@ class ContinuousBatchingScheduler:
             # the int8 cache (ops/pallas/dispatch.py has the recipe).
             cache_dev_bytes //= 2
         self._decode_impl = decode_attention_impl(mesh, cache_dev_bytes)
-        cache = init_cache(cfg, num_slots, self.max_seq, dtype=dtype)
         # The persistent cache is a TUPLE of arrays threaded through every
         # jitted op: (k, v) in bf16 mode, (k8, ks, v8, vs) with int8 KV
-        # (values + per-slot scales, ops/quant.quantize_kv).
-        if kv_quant:
-            from ..ops.quant import quantize_cache
-
-            arrs = _cache_tuple(quantize_cache(cache["k"], cache["v"]))
+        # (values + per-slot scales, ops/quant.quantize_kv), (kp, vp) pool
+        # arrays in paged mode (per-slot page tables ride beside them as
+        # self._ptab, a non-donated arg to every program).
+        if self._paged:
+            pool = init_page_pool(
+                cfg, self._page_alloc.num_pages, self._page_size, dtype=dtype
+            )
+            arrs = (pool["kp"], pool["vp"])
+            # Device page tables: [slots, pages_per_slot], the UNMAPPED
+            # sentinel is num_pages — one past the pool, so jax drops the
+            # scatter writes of parked/padding rows and gathers clip to a
+            # causally-masked real page.
+            self._ptab = jnp.full(
+                (num_slots, self._pages_per_slot),
+                self._page_alloc.num_pages, jnp.int32,
+            )
         else:
-            arrs = (cache["k"], cache["v"])
+            cache = init_cache(cfg, num_slots, self.max_seq, dtype=dtype)
+            if kv_quant:
+                from ..ops.quant import quantize_cache
+
+                arrs = _cache_tuple(quantize_cache(cache["k"], cache["v"]))
+            else:
+                arrs = (cache["k"], cache["v"])
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -468,6 +589,8 @@ class ContinuousBatchingScheduler:
         self._first_pending: list = []
         self._harvest_lag = 1  # rounds kept in flight before syncing
         self._park_fn, self._ready_fn, self._retire_fn = self._build_state_ops()
+        if self._paged:
+            self._ptab_row_fn, self._copy_page_fn = self._build_page_ops()
         # Prompt-chunk buckets: powers of two up to prompt_bucket, so a short
         # prompt pays a small forward instead of a full prompt_bucket one
         # (one compiled prefill program per bucket, built lazily).
@@ -564,7 +687,12 @@ class ContinuousBatchingScheduler:
         self._prefix_seen: "OrderedDict[Tuple[int, ...], None]" = OrderedDict()
         self._prefix_hits = 0
         self._prefix_blocks_reused = 0
-        self._slice_block_fn, self._restore_block_fn = self._build_block_ops()
+        # Contiguous mode materializes prefix blocks by device copy; paged
+        # mode shares pool pages by refcount instead and never needs the
+        # slice/restore copies.
+        self._slice_block_fn, self._restore_block_fn = (
+            (None, None) if self._paged else self._build_block_ops()
+        )
 
         # Recent per-request service time (EWMA of completed requests'
         # submit→retire wall): the backpressure estimate behind
@@ -693,16 +821,142 @@ class ContinuousBatchingScheduler:
 
         return slice_block, restore_block
 
+    def _build_page_ops(self):
+        """Jitted paged-KV bookkeeping ops (async scatters, ~bytes of
+        traffic):
+
+        set_row: replace one slot's device page-table row (admission,
+        retirement, copy-on-write remaps). Driven at the OOB slot index
+        during warmup — jax drops the scatter, a true no-op.
+        copy_page: one-page device copy for copy-on-write (a shared page
+        about to be partially overwritten at a non-page-aligned boundary
+        is copied into a fresh exclusive page first; the prefix-cache
+        entry keeps the original)."""
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def set_row(ptab, slot, row):
+            return ptab.at[slot].set(row)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def copy_page(kp, vp, dst, src):
+            head = (kp.shape[0], 1) + kp.shape[2:]
+            pk = lax.dynamic_slice(kp, (0, src, 0, 0, 0), head)
+            pv = lax.dynamic_slice(vp, (0, src, 0, 0, 0), head)
+            return (
+                lax.dynamic_update_slice(kp, pk, (0, dst, 0, 0, 0)),
+                lax.dynamic_update_slice(vp, pv, (0, dst, 0, 0, 0)),
+            )
+
+        return set_row, copy_page
+
+    # ---------------------------------------------------- paged-KV host side
+
+    def _sync_ptab_row(self, slot: int) -> None:
+        """Mirror a slot's host page list into the device table (async
+        scatter; unmapped tail entries carry the OOB sentinel)."""
+        row = np.full(
+            (self._pages_per_slot,), self._page_alloc.num_pages, np.int32
+        )
+        pages = self._slot_pages[slot]
+        row[: len(pages)] = pages
+        self._ptab = self._ptab_row_fn(
+            self._ptab, jnp.int32(slot), jnp.asarray(row)
+        )
+
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing page grab, evicting LRU prefix-cache entries
+        under pressure: cached prefixes are a perf win funded by SPARE
+        pages, never a reason to make a live request wait."""
+        while not self._page_alloc.can_alloc(n) and self._prefix_pages:
+            _, pages = self._prefix_pages.popitem(last=False)
+            self._page_alloc.release(list(pages))
+        return self._page_alloc.alloc(n)
+
+    def _free_slot_pages(self, slot: int) -> None:
+        """Retirement: drop the slot's page references (pages still held
+        by prefix-cache entries survive for future hits) and unmap its
+        device row."""
+        if self._slot_pages[slot]:
+            self._page_alloc.release(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+            self._sync_ptab_row(slot)
+
+    def _evict_entries_with(self, page: int) -> None:
+        """Drop every prefix-cache entry referencing `page` (the
+        copy-on-write fallback when the pool has no free page for the
+        copy: un-publishing makes the page exclusive again, so the write
+        can proceed in place without ever touching shared content)."""
+        for key in [k for k, v in self._prefix_pages.items() if page in v]:
+            self._page_alloc.release(list(self._prefix_pages.pop(key)))
+
+    def _ensure_writable(self, slot: int, start_tok: int, end_tok: int) -> None:
+        """Copy-on-write sweep before writing cache positions
+        [start_tok, end_tok): any SHARED page in the range is either
+        copied into a fresh exclusive page (content preserved, table
+        remapped — the prefix-cache entry keeps the original) or, if the
+        pool can't fund the copy, un-published until exclusive. Shared
+        pages are never written in place — the invariant the allocator
+        property tests pin. Page-aligned traffic never triggers this
+        (full prefix pages sit below every write range); the only
+        organic trigger is a non-page-aligned prefix boundary."""
+        ps = self._page_size
+        pages = self._slot_pages[slot]
+        hi = min(pages_for_tokens(end_tok, ps), len(pages))
+        for pi in range(start_tok // ps, hi):
+            pg = pages[pi]
+            if not self._page_alloc.is_shared(pg):
+                continue
+            fresh = self._alloc_pages(1)
+            if fresh is None:
+                # No page for a copy: un-publish instead. Slot-to-slot
+                # sharing only ever covers FULL prefix pages below any
+                # write range, so after entry eviction the page is ours.
+                self._evict_entries_with(pg)
+                if self._page_alloc.is_shared(pg):
+                    raise RuntimeError(
+                        f"page {pg} still shared inside a write range "
+                        f"after un-publishing (slot {slot})"
+                    )
+                continue
+            self._cache = self._copy_page_fn(
+                *self._cache, jnp.int32(fresh[0]), jnp.int32(pg)
+            )
+            self._page_alloc.note_cow()
+            self._page_alloc.release([pg])
+            pages[pi] = fresh[0]
+            self._sync_ptab_row(slot)
+
+    @property
+    def page_stats(self) -> Optional[Dict[str, int]]:
+        """Paged-KV observability (None when contiguous): pool occupancy
+        and sharing counters — `zero_copy_shares` rising with prefix hits
+        while `cow_copies` stays at boundary-only counts is the
+        "sharing, not copying" proof the bench artifact records; a leaked
+        page shows up as pages_in_use that never drains."""
+        if not self._paged:
+            return None
+        out = self._page_alloc.stats()
+        out["pages_per_slot"] = self._pages_per_slot
+        out["page_waits"] = self._page_wait_events
+        return out
+
     def _build_prefill(self, t_bucket: int, k: int):
         cfg, impl, mesh = self.cfg, self._impl, self.mesh
         quant, dtype = self.kv_quant, self._dtype
         nc = len(self._cache)
         spec = bool(self._spec_draft)
+        paged = self._paged
+        if paged:
+            ps, np_tab = self._page_size, self._pages_per_slot
+            num_pages = self._page_alloc.num_pages
 
         # Speculative mode appends the on-device draft history as one more
         # donated arg: the chunk's tokens scatter into hist rows at the
         # same positions their K/V land at (drafting needs the prompt text,
         # and it is already on device for the forward anyway).
+        # Paged mode appends the device page tables LAST (non-donated:
+        # tables are tiny and in-flight rounds must keep reading the
+        # version they were issued with).
         donate = tuple(range(1, 1 + nc)) + ((12 + nc,) if spec else ())
 
         @partial(jax.jit, donate_argnums=donate)
@@ -735,16 +989,40 @@ class ContinuousBatchingScheduler:
              seeds, cinits, cbudgets) = args[nc:nc + 10]
             g_need = args[nc + 10]
             hist = args[nc + 11] if spec else None
-            rows = [c[:, slots] for c in cache]  # [L, k, K, S(, H)] gathers
-            if quant:
-                row_cache = {
-                    "k": (rows[0].astype(dtype)
-                          * rows[1][..., None].astype(dtype)),
-                    "v": (rows[2].astype(dtype)
-                          * rows[3][..., None].astype(dtype)),
-                }
+            if paged:
+                ptab = args[-1]
+                # Per-row page tables: OOB padding slots get an all-sentinel
+                # row (mode="fill"), so BOTH their gather garbage is
+                # causally masked and their scatter-back below drops — a
+                # clamped gather would alias a real slot's pages and the
+                # scatter would corrupt them.
+                tab = jnp.take(
+                    ptab, slots, axis=0, mode="fill", fill_value=num_pages
+                )  # [k, NP]
+                safe = jnp.clip(tab, 0, num_pages - 1)
+
+                def rowview(pool):
+                    # [L, P, K, ps, H] -> contiguous per-row view
+                    # [L, k, K, NP*ps, H] for the chunk forward (the same
+                    # row gather the contiguous path pays via c[:, slots]).
+                    g = pool[:, safe]  # [L, k, NP, K, ps, H]
+                    return g.transpose(0, 1, 3, 2, 4, 5).reshape(
+                        pool.shape[0], safe.shape[0], pool.shape[2],
+                        np_tab * ps, pool.shape[4],
+                    )
+
+                row_cache = {"k": rowview(cache[0]), "v": rowview(cache[1])}
             else:
-                row_cache = {"k": rows[0], "v": rows[1]}
+                rows = [c[:, slots] for c in cache]  # [L, k, K, S(, H)]
+                if quant:
+                    row_cache = {
+                        "k": (rows[0].astype(dtype)
+                              * rows[1][..., None].astype(dtype)),
+                        "v": (rows[2].astype(dtype)
+                              * rows[3][..., None].astype(dtype)),
+                    }
+                else:
+                    row_cache = {"k": rows[0], "v": rows[1]}
             positions = (
                 starts[:, None] + jnp.arange(t_bucket, dtype=jnp.int32)[None, :]
             )
@@ -752,7 +1030,26 @@ class ContinuousBatchingScheduler:
                 cfg, params, tokens, positions, row_cache,
                 logit_indices=lengths - 1, attn_impl=impl, mesh=mesh,
             )
-            if quant:
+            if paged:
+                # Scatter ONLY this chunk's window through the page
+                # tables: the quant path's windowed-scatter template, with
+                # (page, offset) indices instead of (slot, position) —
+                # other pages of the row may be SHARED prefix pages that
+                # must never be written (the host's ensure-writable sweep
+                # guarantees the window's own pages are exclusive).
+                pos_idx = positions  # [k, t] = starts[:, None] + arange(t)
+                row_ar = jnp.arange(pos_idx.shape[0], dtype=jnp.int32)
+                wk = new["k"][:, row_ar[:, None], :, pos_idx]  # [k,t,L,K,H]
+                wv = new["v"][:, row_ar[:, None], :, pos_idx]
+                pages = jnp.take_along_axis(
+                    tab, jnp.clip(pos_idx // ps, 0, np_tab - 1), axis=1
+                )  # [k, t]; sentinel rows/entries drop their writes
+                offs = pos_idx % ps
+                cache = (
+                    cache[0].at[:, pages, :, offs].set(wk),
+                    cache[1].at[:, pages, :, offs].set(wv),
+                )
+            elif quant:
                 from ..ops.quant import quantize_cache
 
                 # Window gather BY THE SAME positions the forward wrote and
@@ -809,6 +1106,17 @@ class ContinuousBatchingScheduler:
         mesh = self.mesh
         pad_id = cfg.pad_id
         nc = len(self._cache)
+        paged = self._paged
+
+        def cache_in(cache, ptab):
+            if paged:
+                return {"kp": cache[0], "vp": cache[1], "ptab": ptab}
+            return _cache_dict(cache)
+
+        def cache_out(new_cache):
+            if paged:
+                return (new_cache["kp"], new_cache["vp"])
+            return _cache_tuple(new_cache)
 
         @partial(jax.jit,
                  donate_argnums=tuple(range(1, 3 + nc))
@@ -816,7 +1124,8 @@ class ContinuousBatchingScheduler:
         def decode(params, *args):
             cache = args[:nc]
             (cur, pos, active, temps, topps, topks, seeds,
-             counts, cstates, crem, g_next, g_need) = args[nc:]
+             counts, cstates, crem, g_next, g_need) = args[nc:nc + 12]
+            ptab = args[nc + 12] if paged else None
             # Per-layer slices outside the chunk scan: decode-matmul layout
             # conversions run once per round, not per token (split_blocks).
             params = split_blocks(params)
@@ -825,11 +1134,11 @@ class ContinuousBatchingScheduler:
                 cache, cur, pos, cstates, crem = carry
                 logits, new_cache = forward(
                     cfg, params, cur[:, None], pos[:, None],
-                    _cache_dict(cache), attn_impl=impl, mesh=mesh,
+                    cache_in(cache, ptab), attn_impl=impl, mesh=mesh,
                     # Parked slots (decoding garbage at the park position)
                     # stream ZERO KV blocks; live slots stream only up to
                     # their own position — without this every decode step
-                    # pays S_max bandwidth per slot (pallas impl only).
+                    # pays S_max bandwidth per slot (pallas/paged impls).
                     kv_lens=jnp.where(active, pos + 1, 0),
                 )
                 # Grammar masking: ONE table gather + compare per step, no
@@ -855,7 +1164,7 @@ class ContinuousBatchingScheduler:
                 cstates = jnp.where(active, g_next[cstates, nxt], cstates)
                 crem = jnp.where(active, crem - 1, crem)
                 pos = jnp.where(active, pos + 1, pos)
-                return (_cache_tuple(new_cache), nxt, pos, cstates, crem), nxt
+                return (cache_out(new_cache), nxt, pos, cstates, crem), nxt
 
             (cache, cur, pos, cstates, crem), toks = lax.scan(
                 step, (cache, cur, pos, cstates, crem), jnp.arange(chunk)
@@ -917,6 +1226,7 @@ class ContinuousBatchingScheduler:
         d1 = D + 1
         pad_id = cfg.pad_id
         nc = len(self._cache)
+        paged = self._paged
 
         @partial(jax.jit,
                  donate_argnums=tuple(range(1, nc + 5))
@@ -924,14 +1234,17 @@ class ContinuousBatchingScheduler:
         def spec_decode(params, *args):
             cache = args[:nc]
             (hist, hlen, cur, pos, active, temps, topps, topks, seeds,
-             counts, cstates, crem, g_next, g_need) = args[nc:]
+             counts, cstates, crem, g_next, g_need) = args[nc:nc + 14]
+            ptab = args[nc + 14] if paged else None
             params = split_blocks(params)
             drafts = ngram_draft(hist, hlen, D, ngram)           # [S, D]
             verify = jnp.concatenate([cur[:, None], drafts], 1)  # [S, D+1]
             jd = jnp.arange(d1, dtype=jnp.int32)[None, :]
             vpos = pos[:, None] + jd
             logits, new_cache = forward(
-                cfg, params, verify, vpos, _cache_dict(cache),
+                cfg, params, verify, vpos,
+                ({"kp": cache[0], "vp": cache[1], "ptab": ptab} if paged
+                 else _cache_dict(cache)),
                 attn_impl="xla", mesh=mesh,
             )
             # Per-position grammar masking: pstates[:, j] is the slot's
@@ -997,7 +1310,9 @@ class ContinuousBatchingScheduler:
             # Sampled slots consumed one stream index; greedy argmax
             # consumed none.
             counts = counts + jnp.where(active & ~greedy, 1, 0)
-            return (*_cache_tuple(new_cache), hist, hlen, cur, pos, counts,
+            out_cache = ((new_cache["kp"], new_cache["vp"]) if paged
+                         else _cache_tuple(new_cache))
+            return (*out_cache, hist, hlen, cur, pos, counts,
                     cstates, crem, emitted, n_emit)
 
         return spec_decode
@@ -1044,6 +1359,8 @@ class ContinuousBatchingScheduler:
             ]
             if self._spec_draft:
                 args.append(self._hist)
+            if self._paged:
+                args.append(self._ptab)
             out = self._prefill_fns[(t, kb)](self.params, *self._cache, *args)
             nc = len(self._cache)
             self._cache = out[:nc]
@@ -1079,6 +1396,18 @@ class ContinuousBatchingScheduler:
                 self._hist, self._hlen, oob,
                 jnp.full((1,), self.cfg.pad_id, jnp.int32), jnp.int32(0),
             )
+        if self._paged:
+            # Table-row scatter at the OOB slot (dropped) and a page-0
+            # self-copy (content no-op): compiles the paged bookkeeping
+            # ops so the first admission doesn't block the loop on them.
+            self._ptab = self._ptab_row_fn(
+                self._ptab, oob,
+                jnp.full((self._pages_per_slot,),
+                         self._page_alloc.num_pages, jnp.int32),
+            )
+            self._cache = self._copy_page_fn(
+                *self._cache, jnp.int32(0), jnp.int32(0)
+            )
 
     def _warm_decode(self) -> None:
         """Compile (and execute once) the decode program with every slot
@@ -1088,12 +1417,13 @@ class ContinuousBatchingScheduler:
         nc = len(self._cache)
         t = self._ctables
         inactive = np.zeros(self.num_slots, bool)
+        extra = (self._ptab,) if self._paged else ()
         if self._spec_draft:
             out = self._decode_fn(
                 self.params, *self._cache, self._hist, self._hlen,
                 self._cur, self._pos, jnp.asarray(inactive), self._temps,
                 self._topps, self._topks, self._seeds, self._counts,
-                self._cstates, self._crem, t["next"], t["need"],
+                self._cstates, self._crem, t["next"], t["need"], *extra,
             )
             self._cache = out[:nc]
             (self._hist, self._hlen, self._cur, self._pos, self._counts,
@@ -1103,7 +1433,7 @@ class ContinuousBatchingScheduler:
                 self.params, *self._cache, self._cur, self._pos,
                 jnp.asarray(inactive), self._temps, self._topps, self._topks,
                 self._seeds, self._counts, self._cstates, self._crem,
-                t["next"], t["need"],
+                t["next"], t["need"], *extra,
             )
             self._cache = out[:nc]
             (self._cur, self._pos, self._counts, self._cstates, self._crem,
@@ -1122,6 +1452,14 @@ class ContinuousBatchingScheduler:
         if self._thread is None:
             if self._crash is not None:
                 raise self._crash_error()
+            if self._paged:
+                # Re-sync every device table row from the host mirror: a
+                # previous _close released abandoned slots' pages host-side
+                # only, and a stale row would route the freed slots' parked
+                # writes into pages a future occupant owns. No-op cost on
+                # first start (rows are already the unmapped sentinel).
+                for i in range(self.num_slots):
+                    self._sync_ptab_row(i)
             self._stop_evt.clear()
             with self._submit_lock:
                 self._closed = False
@@ -1345,8 +1683,14 @@ class ContinuousBatchingScheduler:
                                     self._spec_tokens_con)
         # The verify cost scales with THIS scheduler's draft length
         # (ADVICE r5 #3: a D=4 deployment's breakeven is not D=8's) — the
-        # per-D linear model replaces the old single 1.6 constant.
-        ratio = verify_cost_ratio(self._spec_draft)
+        # per-D linear model replaces the old single 1.6 constant — and
+        # with its MODEL SHAPE (ROADMAP carried-over: the 1B-anchored
+        # slope mispriced 7B/int4 configs; unembed-marginal over
+        # weight-stream-fixed rescales it). Weight bits were probed once
+        # at construction.
+        ratio = verify_cost_ratio(
+            self._spec_draft, cfg=self.cfg, weight_bits=self._weight_bits,
+        )
 
         def acceptance(r: int, t: int) -> Dict[str, float]:
             tpr = t / r if r else 0.0
@@ -1409,11 +1753,13 @@ class ContinuousBatchingScheduler:
     def prefix_stats(self) -> Dict[str, int]:
         """Prefix-cache observability: requests that reused any blocks, total
         blocks reused (each one is a skipped pblock-token prefill), and the
-        current LRU size."""
+        current LRU size (paged mode: entries are zero-copy page
+        references; page_stats carries the sharing counters)."""
         return {
             "hits": self._prefix_hits,
             "blocks_reused": self._prefix_blocks_reused,
-            "cached_blocks": len(self._prefix_cache),
+            "cached_blocks": (len(self._prefix_pages) if self._paged
+                              else len(self._prefix_cache)),
         }
 
     @property
@@ -1464,13 +1810,97 @@ class ContinuousBatchingScheduler:
                           fingerprint=str(getattr(compiled, "fingerprint",
                                                   ""))[:16])
 
-    def _admit(self, slot: int, req: _Request) -> None:
+    def _admit_paged(self, slot: int, req: _Request) -> bool:
+        """Paged admission: allocate the request's page envelope and map
+        any cached prefix ZERO-COPY (shared pages by refcount; one-page
+        copy-on-write only when the matched prefix ends mid-page).
+        Returns False — with no side effects — when the pool cannot fund
+        the envelope right now (the loop parks the request in _page_wait
+        until retirements free pages; all-or-nothing, so partial holders
+        can never deadlock each other)."""
+        ps, pb = self._page_size, self._pblock
+        n = 0
+        if self._prefix_cache_blocks:
+            max_blocks = (len(req.ids) - 1) // pb
+            while n < max_blocks and \
+                    tuple(req.ids[: (n + 1) * pb]) in self._prefix_pages:
+                n += 1
+            # Same chunk-envelope cap as the contiguous path: a reuse
+            # offset shifts every chunk start, and the final chunk's
+            # bucket must still land inside the virtual row.
+            s_virt = self._pages_per_slot * ps
+            while n and self._chunk_end(n * pb, len(req.ids)) > s_virt:
+                n -= 1
+        reuse = n * pb
+        # The envelope admission must cover: every position chunked
+        # prefill writes, plus decode through budget + overshoot.
+        need_end = max(
+            self._chunk_end(reuse, len(req.ids)),
+            bucket_len(len(req.ids), self.prompt_bucket)
+            + req.max_new + self.overshoot,
+        )
+        need_pages = pages_for_tokens(need_end, ps)
+        full = reuse // ps
+        entry = (self._prefix_pages.get(tuple(req.ids[:reuse]))
+                 if reuse else None)
+        shared = list(entry[:full]) if entry else []
+        boundary_src = entry[full] if (entry and reuse % ps) else None
+        # Take the refs BEFORE allocating: _alloc_pages evicts LRU prefix
+        # entries under pressure, and the matched entry must survive it.
+        # count=False: these holds are transient until admission succeeds
+        # (released on the shortage path below, and the boundary hold only
+        # lives until its COW copy) — the shares counter must track
+        # mappings that PERSIST, not per-retry churn.
+        self._page_alloc.share(shared, count=False)
+        if boundary_src is not None:
+            self._page_alloc.share([boundary_src], count=False)
+        fresh = self._alloc_pages(need_pages - full)
+        if fresh is None:
+            self._page_alloc.release(shared)
+            if boundary_src is not None:
+                self._page_alloc.release([boundary_src])
+            if not req.page_waited:
+                # Count REQUESTS that waited, not per-round retries.
+                req.page_waited = True
+                self._page_wait_events += 1
+            return False
+        if boundary_src is not None:
+            # Copy-on-write at the non-page-aligned boundary: ONE page
+            # copy (vs the contiguous path's whole-prefix gather-copy);
+            # prefill resumes mid-page inside the private copy while the
+            # cache entry keeps the original.
+            self._cache = self._copy_page_fn(
+                *self._cache, jnp.int32(fresh[0]), jnp.int32(boundary_src)
+            )
+            self._page_alloc.note_cow()
+            self._page_alloc.release([boundary_src])
+        self._slot_pages[slot] = shared + fresh
+        self._sync_ptab_row(slot)
+        # The full-page mappings are now permanent for this request's
+        # lifetime: count them as the zero-copy shares they are (the
+        # boundary page was a COW copy, already counted as one).
+        self._page_alloc.note_shares(len(shared))
+        req.page_end = need_end
+        if reuse:
+            req.prefilled = reuse
+            self._prefix_hits += 1
+            self._prefix_blocks_reused += n
+            for j in range(n):  # LRU touch along the matched chain
+                key = tuple(req.ids[: (j + 1) * pb])
+                if key in self._prefix_pages:
+                    self._prefix_pages.move_to_end(key)
+        return True
+
+    def _admit(self, slot: int, req: _Request) -> bool:
         """Reserve `slot` and queue the prompt for chunked prefill, reusing
-        any cached prefix blocks first (device-to-device copy, no forward)."""
+        any cached prefix first (zero-copy page sharing in paged mode,
+        device-to-device block copy in contiguous mode). Returns False —
+        side-effect free — only in paged mode when the page pool cannot
+        hold the request yet."""
         if req.cancelled:  # cancelled while queued: never occupy a slot
             self._observe_terminal(req)
             req.future.set_result(req.generated)
-            return
+            return True
         if req.past_deadline():
             # Expired while queued: fail fast with the typed error before
             # ever occupying a slot — under overload, prefilling work whose
@@ -1482,7 +1912,9 @@ class ContinuousBatchingScheduler:
             resilience.inc("deadline_expired")
             self._observe_terminal(req, error="DeadlineExceeded")
             req.future.set_exception(req.deadline_error())
-            return
+            return True
+        if self._paged and not self._admit_paged(slot, req):
+            return False
         req.admitted_at = time.perf_counter()
         self._round_admitted.append(req.rid)
         self._slot_req[slot] = req
@@ -1492,7 +1924,7 @@ class ContinuousBatchingScheduler:
         self._cur, self._pos, self._cstates, self._crem = self._park_fn(
             self._cur, self._pos, self._cstates, self._crem, jnp.int32(slot)
         )
-        if self._prefix_cache_blocks:
+        if self._prefix_cache_blocks and not self._paged:
             pb = self._pblock
             # At least one prompt token must go through real prefill: the
             # final chunk's logit samples the first output token.
@@ -1527,6 +1959,7 @@ class ContinuousBatchingScheduler:
                 self._prefix_hits += 1
                 self._prefix_blocks_reused += n
         self._prefill_q.append((slot, req))
+        return True
 
     def _next_bucket(self, req: _Request) -> int:
         remaining = len(req.ids) - req.prefilled
@@ -1575,6 +2008,13 @@ class ContinuousBatchingScheduler:
         kb = next(b for b in self._kbuckets if b >= len(group))
         if (t, kb) not in self._prefill_fns:
             self._prefill_fns[(t, kb)] = self._build_prefill(t, kb)
+        if self._paged:
+            # Copy-on-write sweep over each chunk's write window: a page
+            # the publisher shared with the prefix cache last chunk must
+            # not be written in place this chunk (only non-page-aligned
+            # block boundaries ever trigger it).
+            for slot, req in group:
+                self._ensure_writable(slot, req.prefilled, req.prefilled + t)
 
         tokens, lengths, slots, starts = [], [], [], []
         temps, topps, topks, seeds, chunk_lens = [], [], [], [], []
@@ -1624,6 +2064,8 @@ class ContinuousBatchingScheduler:
         ]
         if self._spec_draft:
             call_args.append(self._hist)
+        if self._paged:
+            call_args.append(self._ptab)
         out = self._prefill_fns[(t, kb)](self.params, *self._cache, *call_args)
         nc = len(self._cache)
         self._cache, toks = out[:nc], out[-1]
@@ -1634,7 +2076,10 @@ class ContinuousBatchingScheduler:
             chunk_start = req.prefilled
             req.prefilled += chunk_lens[i]
             if self._prefix_cache_blocks:
-                self._publish_blocks(slot, req, chunk_start)
+                if self._paged:
+                    self._publish_blocks_paged(slot, req, chunk_start)
+                else:
+                    self._publish_blocks(slot, req, chunk_start)
             if req.prefilled < len(req.ids):
                 self._prefill_q.append((slot, req))
                 continue
@@ -1646,6 +2091,12 @@ class ContinuousBatchingScheduler:
             # accounts for.
             req.ready = True
             req.ready_at = time.perf_counter()
+            if self._paged:
+                # Decode writes [len(ids), page_end): the final chunk's
+                # publish may have shared the page holding the prompt
+                # tail — COW it before the slot goes decode-eligible, so
+                # decode never writes a shared page in place.
+                self._ensure_writable(slot, len(req.ids), req.page_end)
             tok = toks[i : i + 1]
             cinit = (req.constraint.init_state if req.constraint is not None
                      else 0)
@@ -1689,6 +2140,35 @@ class ContinuousBatchingScheduler:
             while len(self._prefix_cache) > self._prefix_cache_blocks:
                 self._prefix_cache.popitem(last=False)
 
+    def _publish_blocks_paged(self, slot: int, req: _Request,
+                              chunk_start: int) -> None:
+        """Paged publish: an entry is a REFERENCE to the publisher's pages
+        (refcount++), not a copy — zero data movement, same publish gate
+        and hash-chain content keys as the contiguous path. The publisher
+        itself COWs before its next write into a page it just shared
+        (_ensure_writable), so entry content is immutable from here on."""
+        pb, ps = self._pblock, self._page_size
+        for b0 in range(chunk_start // pb, req.prefilled // pb):
+            key = tuple(req.ids[: (b0 + 1) * pb])
+            if key in self._prefix_pages:
+                self._prefix_pages.move_to_end(key)
+                continue
+            if key not in self._prefix_seen:
+                # First sighting: remember the content, share nothing.
+                self._prefix_seen[key] = None
+                while len(self._prefix_seen) > 4 * self._prefix_cache_blocks:
+                    self._prefix_seen.popitem(last=False)
+                continue
+            covered = (b0 + 1) * pb
+            pages = tuple(
+                self._slot_pages[slot][: pages_for_tokens(covered, ps)]
+            )
+            self._page_alloc.share(list(pages))
+            self._prefix_pages[key] = pages
+            while len(self._prefix_pages) > self._prefix_cache_blocks:
+                _, old = self._prefix_pages.popitem(last=False)
+                self._page_alloc.release(list(old))
+
     def _issue_decode(self) -> None:
         """Dispatch one decode round asynchronously: state chains on device,
         nothing syncs here. The round's tokens are harvested `_harvest_lag`
@@ -1712,13 +2192,14 @@ class ContinuousBatchingScheduler:
             for i in range(self.num_slots)
         ]
         nc = len(self._cache)
+        extra = (self._ptab,) if self._paged else ()
         if self._spec_draft:
             t = self._ctables
             out = self._decode_fn(
                 self.params, *self._cache, self._hist, self._hlen,
                 self._cur, self._pos, jnp.asarray(active), self._temps,
                 self._topps, self._topks, self._seeds, self._counts,
-                self._cstates, self._crem, t["next"], t["need"],
+                self._cstates, self._crem, t["next"], t["need"], *extra,
             )
             self._cache = out[:nc]
             (self._hist, self._hlen, self._cur, self._pos, self._counts,
@@ -1729,7 +2210,7 @@ class ContinuousBatchingScheduler:
                 self.params, *self._cache, self._cur, self._pos,
                 jnp.asarray(active), self._temps, self._topps, self._topks,
                 self._seeds, self._counts, self._cstates, self._crem,
-                t["next"], t["need"],
+                t["next"], t["need"], *extra,
             )
             self._cache = out[:nc]
             (self._cur, self._pos, self._counts, self._cstates, self._crem,
@@ -1776,6 +2257,14 @@ class ContinuousBatchingScheduler:
             self._temps, self._topps, self._topks, self._cstates,
             jnp.int32(slot)
         )
+        if self._paged:
+            # In-flight overshoot rounds still write through the page-table
+            # version they were issued with; device program order puts
+            # those writes BEFORE any new occupant's prefill of the freed
+            # pages, so the garbage is overwritten before it can become
+            # visible (the same invariant the contiguous layout relies
+            # on for its per-row overshoot writes).
+            self._free_slot_pages(slot)
 
     def _append_first(self, slot: int, req: _Request, first: int) -> int:
         """Apply a harvested prefill first-token: stop/budget checks run
@@ -1953,6 +2442,12 @@ class ContinuousBatchingScheduler:
         }
         if n_emit is not None:
             rec["spec_emitted"] = spec_emitted
+        if self._paged:
+            # Page-pool occupancy per round: the flight-recorder column a
+            # leaked page shows up in (pages_in_use that never drains
+            # while occupancy does).
+            rec["kv_pages"] = self._page_alloc.pages_in_use
+            rec["kv_pages_free"] = self._page_alloc.pages_free
         self.flight.record(**rec)
         self._round_admitted = []
         self._round_retired = []
@@ -1993,10 +2488,20 @@ class ContinuousBatchingScheduler:
         for req in self._constraint_wait:  # waiting on a grammar swap
             req.future.set_exception(exc)
         self._constraint_wait.clear()
+        if self._paged:
+            for req in self._page_wait:  # waiting on pool pages
+                req.future.set_exception(exc)
+            self._page_wait.clear()
         for i, req in enumerate(self._slot_req):
             if req is not None:
                 req.future.set_exception(exc)
                 self._slot_req[i] = None
+                if self._paged and self._slot_pages[i]:
+                    # Host-side release only — no device work on a possibly
+                    # wedged path. The device table rows go stale; start()
+                    # re-syncs them before the loop serves again.
+                    self._page_alloc.release(self._slot_pages[i])
+                    self._slot_pages[i] = []
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -2013,6 +2518,7 @@ class ContinuousBatchingScheduler:
         of the stamp alone."""
         return bool(
             self._prefill_q or self._pending or self._constraint_wait
+            or (self._paged and self._page_wait)
             or any(r is not None for r in self._slot_req)
             or not self._queue.empty()
         )
@@ -2047,12 +2553,20 @@ class ContinuousBatchingScheduler:
                     req = wait.popleft()
                     self._install_constraint(req.constraint)
                 else:
-                    try:
-                        req = self._queue.get_nowait()
-                    except queue.Empty:
-                        break
-                    if req is None:
-                        continue
+                    if self._paged and self._page_wait:
+                        # Page-starved requests re-admit FIFO ahead of
+                        # the queue the moment retirements free pages;
+                        # they already passed grammar routing once, and
+                        # re-routing below keeps them correct if the
+                        # installed grammar changed meanwhile.
+                        req = self._page_wait.popleft()
+                    else:
+                        try:
+                            req = self._queue.get_nowait()
+                        except queue.Empty:
+                            break
+                        if req is None:
+                            continue
                     c = req.constraint
                     if c is not None and (not self._grammar_matches(c)
                                           or wait):
@@ -2060,7 +2574,14 @@ class ContinuousBatchingScheduler:
                             wait.append(req)
                             continue
                         self._install_constraint(c)
-                self._admit(self._free_slots()[0], req)
+                if not self._admit(self._free_slots()[0], req):
+                    # Paged: the pool cannot hold this request's envelope
+                    # until live slots retire — park it at the FRONT of
+                    # the page-wait line (admission order preserved) and
+                    # stop admitting; decode/harvest below keep the pipe
+                    # moving and will free pages.
+                    self._page_wait.appendleft(req)
+                    break
             # Fair interleave: at most one prompt chunk per decode round —
             # admission work is bounded, so active slots never wait longer
             # than one prompt_bucket forward.
@@ -2078,7 +2599,7 @@ class ContinuousBatchingScheduler:
                 self._harvest_firsts()
                 if self._prefill_q or self._constraint_wait or any(
                     r is not None for r in self._slot_req
-                ):
+                ) or (self._paged and self._page_wait):
                     continue  # harvests freed work — go admit/issue again
                 try:
                     req = self._queue.get(timeout=0.05)
@@ -2088,7 +2609,11 @@ class ContinuousBatchingScheduler:
                         c = req.constraint
                         if c is not None and not self._grammar_matches(c):
                             self._install_constraint(c)
-                        self._admit(self._free_slots()[0], req)
+                        if not self._admit(self._free_slots()[0], req):
+                            # Paged + fully idle: can only mean the pool
+                            # itself is smaller than one request envelope
+                            # after eviction — park it like the loop does.
+                            self._page_wait.appendleft(req)
                 except queue.Empty:
                     pass
 
@@ -2186,6 +2711,23 @@ class SchedulerPool:
                 s._slot_stalls for s in self.schedulers
             ),
         }
+
+    @property
+    def page_stats(self) -> Optional[Dict[str, int]]:
+        """Summed paged-KV pool stats across replicas (None when no
+        replica is paged) — each replica owns an independent pool, so
+        totals add."""
+        per = [s.page_stats for s in self.schedulers
+               if getattr(s, "page_stats", None)]
+        if not per:
+            return None
+        out: Dict[str, int] = {}
+        for st in per:
+            for k, v in st.items():
+                out[k] = out.get(k, 0) + int(v)
+        # Ratios/sizes don't sum: keep the first replica's page size.
+        out["page_size"] = per[0]["page_size"]
+        return out
 
     @property
     def flight(self):
@@ -2397,6 +2939,13 @@ class SchedulerBackend:
         spec = self.scheduler.speculation_stats
         if spec is not None:
             out["speculation"] = spec
+        # Paged-KV pool occupancy + sharing counters (kv_layout="paged"):
+        # pages_total/pages_free/pages_shared become Prometheus gauges via
+        # the nested-serving-stats renderer (utils/prometheus.py), so a
+        # leaked page is a flat-lining pages_free on a dashboard.
+        pages = getattr(self.scheduler, "page_stats", None)
+        if pages:
+            out["kv_pages"] = pages
         # Liveness view (serve/watchdog.py): heartbeat age/cadence, slots
         # retired for per-lane stalls, and — when supervised — whole-loop
         # stalls detected + the active stall threshold.
@@ -2439,6 +2988,10 @@ class SchedulerBackend:
         quantize_int4: bool = False,
         quantize_unembed8: bool = False,
         kv_quant: Optional[str] = None,
+        kv_layout: str = "contiguous",
+        kv_page_size: Optional[int] = None,
+        kv_pages: Optional[int] = None,
+        kv_hbm_budget_bytes: Optional[int] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         speculative_draft: int = 0,
@@ -2502,6 +3055,9 @@ class SchedulerBackend:
                 stop_ids=stop_ids if stop_ids is not None
                 else resolve_stop_ids(cfg, tokenizer),
                 mesh=sched_mesh, kv_quant=kv_quant,
+                kv_layout=kv_layout, kv_page_size=kv_page_size,
+                kv_pages=kv_pages,
+                kv_hbm_budget_bytes=kv_hbm_budget_bytes,
                 speculative_draft=speculative_draft,
                 max_queue_depth=max_queue_depth,
             )
@@ -2535,6 +3091,10 @@ class SchedulerBackend:
         quantize_int4: bool = False,
         quantize_unembed8: bool = False,
         kv_quant: Optional[str] = None,
+        kv_layout: str = "contiguous",
+        kv_page_size: Optional[int] = None,
+        kv_pages: Optional[int] = None,
+        kv_hbm_budget_bytes: Optional[int] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         speculative_draft: int = 0,
@@ -2586,6 +3146,9 @@ class SchedulerBackend:
                 stop_ids=stop_ids if stop_ids is not None
                 else resolve_stop_ids(cfg, tokenizer),
                 mesh=mesh, kv_quant=kv_quant,
+                kv_layout=kv_layout, kv_page_size=kv_page_size,
+                kv_pages=kv_pages,
+                kv_hbm_budget_bytes=kv_hbm_budget_bytes,
                 speculative_draft=speculative_draft,
                 max_queue_depth=max_queue_depth,
             )

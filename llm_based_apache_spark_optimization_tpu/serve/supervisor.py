@@ -395,6 +395,12 @@ class SupervisedScheduler:
     def speculation_stats(self):
         return getattr(self._inner, "speculation_stats", None)
 
+    @property
+    def page_stats(self):
+        """Paged-KV pool stats passthrough (None for contiguous inner
+        schedulers) — the /metrics kv_pages gauges survive supervision."""
+        return getattr(self._inner, "page_stats", None)
+
     def retry_after_hint(self) -> float:
         """The inner scheduler's queue-depth × service-time estimate —
         except while the loop is down (stalled/crashed, mid-restart):

@@ -205,3 +205,68 @@ def test_obs_overhead_measured_and_under_budget():
 
     out2 = bench._obs_overhead(n=500, sched=FakeSched())
     assert 0 < out2["pct_of_round"] < 1.0
+
+
+def test_paged_accounting_reconciles_no_silent_cap():
+    """ISSUE-7 satellite: the bench's paged-vs-contiguous accounting must
+    RECONCILE — pages used by the admitted mix never exceed the pool, the
+    ratio is exactly slots_paged/slots_contiguous, every per-request page
+    count re-derives from the same sizing functions the scheduler
+    allocates with, and admission stopped exactly when the next request
+    would not fit (no silent cap)."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+    from llm_based_apache_spark_optimization_tpu.engine.kvcache import (
+        bucket_len,
+        cache_bytes,
+    )
+    from llm_based_apache_spark_optimization_tpu.engine.paged_kv import (
+        page_bytes,
+        pages_for_tokens,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import TINY
+    from llm_based_apache_spark_optimization_tpu.models.configs import (
+        BENCH_1B,
+    )
+
+    for cfg, slots, max_seq, max_new, mix, ps, pb in (
+        (TINY, 4, 100, 8, [32, 16], 16, 8),
+        (BENCH_1B, 8, 1664, 128, [1024, 256], 64, 128),
+        (BENCH_1B, 4, 1664, 128, [1408], 64, 128),
+    ):
+        acct = bench._paged_accounting(
+            cfg, slots_contiguous=slots, max_seq=max_seq, max_new=max_new,
+            overshoot=16, mix_lens=mix, page_size=ps, prompt_bucket=pb,
+        )
+        # Budget is the contiguous layout's own footprint; pool derives
+        # from it through the same page-size math the scheduler uses.
+        assert acct["hbm_budget_bytes"] == cache_bytes(cfg, slots, max_seq)
+        assert acct["pages_total"] == \
+            acct["hbm_budget_bytes"] // page_bytes(cfg, ps)
+        # Reconciliation: used == sum(per-request), within the pool.
+        assert acct["pages_used"] == sum(acct["pages_per_request"])
+        assert acct["pages_used"] <= acct["pages_total"]
+        # Each per-request count re-derives from the mix.
+        for i, need in enumerate(acct["pages_per_request"]):
+            want = pages_for_tokens(
+                bucket_len(mix[i % len(mix)], pb) + max_new + 16, ps
+            )
+            assert need == want
+        # No silent cap: the NEXT request in the mix genuinely didn't fit.
+        assert acct["next_request_pages"] > 0
+        assert acct["pages_used"] + acct["next_request_pages"] > \
+            acct["pages_total"]
+        assert acct["slots_ratio"] == pytest.approx(
+            round(acct["slots_paged"] / slots, 2))
+        # Mixed-length traffic through the paged pool beats the
+        # worst-case-row layout (the ISSUE-7 acceptance direction).
+        if len(mix) > 1:
+            assert acct["slots_paged"] > slots
+
+    # Envelopes the real scheduler's submit() would reject are a LOUD
+    # error, never counted as admitted concurrency.
+    with pytest.raises(ValueError, match="unservable"):
+        bench._paged_accounting(
+            BENCH_1B, slots_contiguous=4, max_seq=1664, max_new=128,
+            overshoot=16, mix_lens=[1536], page_size=64, prompt_bucket=128,
+        )
