@@ -1428,6 +1428,21 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
                 )
             except Exception as e:  # noqa: BLE001 — keep the leg's numbers
                 out["speculative"]["constrained"] = {"error": str(e)[:200]}
+        if (os.environ.get("BENCH_SPEC_SAMPLED", "1") == "1"
+                and cfg.vocab_size >= 259):
+            # Sampled fixture traffic through the same speculative
+            # scheduler: the ISSUE-8 acceptance number. temperature>0
+            # requests ride the rejection-sampling verify path, and the
+            # SAMPLED class of the per-class speculation counters prices
+            # whether speculating on sampled traffic pays. Instrument
+            # pass, never fatal to the leg.
+            try:
+                out["speculative"]["sampled"] = _spec_sampled_pass(
+                    cfg, params, slots, max_seq, prompt_len, decode_chunk,
+                    kv_quant, draft, ratio,
+                )
+            except Exception as e:  # noqa: BLE001 — keep the leg's numbers
+                out["speculative"]["sampled"] = {"error": str(e)[:200]}
 
     if os.environ.get("BENCH_SCHED_PREFIX", "1") == "1" and kv_quant is None:
         # Warm-prefix pass: the reference's ACTUAL serving pattern is the
@@ -1495,24 +1510,29 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
     return out
 
 
-def _spec_constrained_pass(cfg, params, slots, max_seq, prompt_len,
-                           decode_chunk, kv_quant, draft, ratio) -> dict:
-    """Grammar-constrained speculative wave: fixture-shaped NL→SQL traffic
-    (byte-tokenized taxi DDL + expected SQL as the prompt, so prompt
-    lookup has real identifiers to copy) decoded under the schema-locked
-    grammar on a speculative scheduler. Returns the constrained class's
-    acceptance (tokens/round is the go/no-go number for --speculative on
-    the constrained hot path). Requires cfg.vocab_size >= the byte
-    tokenizer's 259 (every bench config satisfies this)."""
+def _spec_class_wave(cfg, params, slots, max_seq, prompt_len, decode_chunk,
+                     kv_quant, draft, ratio, *, stop_ids, class_path,
+                     submit_kw, min_new=1) -> dict:
+    """Shared machinery of the per-class speculative fixture waves
+    (`_spec_constrained_pass` / `_spec_sampled_pass`): copy-heavy
+    fixture-shaped prompts (byte-tokenized taxi DDL + the case's
+    expected SQL, so prompt lookup has real identifiers to copy), a
+    warm-then-timed full-contention wave, and a pre/post delta of ONE
+    class of the speculation counters. `class_path` walks
+    speculation_stats to the class (e.g. ("by_class", "constrained"));
+    `submit_kw(i)` yields the per-request submit kwargs that define the
+    class. The first two requests run OUTSIDE the timed window so
+    class-specific compiles (a constrained admission installs the
+    grammar tables, which retraces the decode program) never land
+    mid-wave."""
     import time as _t
     from concurrent.futures import ThreadPoolExecutor
 
-    from llm_based_apache_spark_optimization_tpu.constrain import (
-        get_constraint,
+    from llm_based_apache_spark_optimization_tpu.engine.kvcache import (
+        bucket_len,
     )
     from llm_based_apache_spark_optimization_tpu.evalh.fixtures import (
         FOUR_QUERY_SUITE,
-        TAXI_COLUMNS,
         TAXI_DDL_SYSTEM,
     )
     from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
@@ -1523,15 +1543,6 @@ def _spec_constrained_pass(cfg, params, slots, max_seq, prompt_len,
     )
 
     tok = ByteTokenizer()
-    # The scheduler must KNOW the stop id: constrained completions close
-    # with eos, and an unstopped slot would spin at the accepting state
-    # for the whole budget.
-    cm = get_constraint({"table": "taxi", "columns": list(TAXI_COLUMNS)},
-                        tok, (tok.eos_id,))
-    from llm_based_apache_spark_optimization_tpu.engine.kvcache import (
-        bucket_len,
-    )
-
     # Room check BEFORE constructing the scheduler (whose __init__
     # allocates the slots x max_seq KV cache): mirrors the speculative
     # overshoot property ((harvest_lag+1)*(D+1) + D, lag 1) and the
@@ -1539,43 +1550,42 @@ def _spec_constrained_pass(cfg, params, slots, max_seq, prompt_len,
     overshoot = 2 * (draft + 1) + draft
     pbucket = min(prompt_len, max(1, max_seq // 2))
     room = max_seq - 1 - overshoot - bucket_len(prompt_len, pbucket)
-    max_new = max(cm.min_new_tokens, min(64, room))
+    max_new = max(min_new, min(64, room))
     if max_new > room:
-        return {"skipped": f"no constrained decode room (need "
-                           f"{cm.min_new_tokens}, have {room})"}
+        return {"skipped": f"no decode room (need {min_new}, have {room})"}
     sched = ContinuousBatchingScheduler(
         cfg, params, num_slots=slots, max_seq=max_seq,
-        prompt_bucket=prompt_len, stop_ids=(tok.eos_id,),
+        prompt_bucket=prompt_len, stop_ids=stop_ids,
         decode_chunk=decode_chunk, kv_quant=kv_quant,
         speculative_draft=draft,
     )
-    # Fixture prompts: DDL head + the case's expected SQL, clamped to the
-    # prompt bucket — the serving pattern (schema in the prompt) that
-    # gives drafts identifiers to copy.
     prompts = []
     for case in FOUR_QUERY_SUITE * max(1, (2 * slots) // 4):
         text = (TAXI_DDL_SYSTEM + " " + case.expected_sql + "\nSQL: ")
         prompts.append(tok.encode(text, add_bos=True)[-prompt_len:])
+
+    def cls_stats() -> dict:
+        node = dict(sched.speculation_stats or {})
+        for key in class_path:
+            node = dict(node.get(key, {}) or {})
+        return node
+
     sched.warmup(prompt_len)
     with sched:
-        # Warm CONSTRAINED: the first constrained admission installs the
-        # schema grammar's [S, V] tables, which retraces the decode
-        # program — that compile must land outside the timed wave.
-        for f in [sched.submit(p, max_new_tokens=max_new, constraint=cm)
-                  for p in prompts[:2]]:
+        for f in [sched.submit(p, max_new_tokens=max_new, **submit_kw(i))
+                  for i, p in enumerate(prompts[:2])]:
             f.result()
-        pre = dict((sched.speculation_stats or {}).get("by_class", {})
-                   .get("constrained", {}))
+        pre = cls_stats()
         t0 = _t.perf_counter()
         with ThreadPoolExecutor(max_workers=len(prompts)) as pool:
             toks_out = sum(len(r) for r in pool.map(
-                lambda p: sched.submit(p, max_new_tokens=max_new,
-                                       constraint=cm).result(),
-                prompts,
+                lambda ip: sched.submit(
+                    ip[1], max_new_tokens=max_new, **submit_kw(ip[0])
+                ).result(),
+                enumerate(prompts),
             ))
         dt = _t.perf_counter() - t0
-        post = dict((sched.speculation_stats or {}).get("by_class", {})
-                    .get("constrained", {}))
+        post = cls_stats()
     rounds = post.get("verify_rounds", 0) - pre.get("verify_rounds", 0)
     toks_sp = post.get("tokens_emitted", 0) - pre.get("tokens_emitted", 0)
     tpr = toks_sp / rounds if rounds else 0.0
@@ -1587,6 +1597,67 @@ def _spec_constrained_pass(cfg, params, slots, max_seq, prompt_len,
         "tokens_per_round": round(tpr, 3),
         "est_speedup_vs_vanilla": round(tpr / ratio, 3),
     }
+
+
+def _spec_constrained_pass(cfg, params, slots, max_seq, prompt_len,
+                           decode_chunk, kv_quant, draft, ratio) -> dict:
+    """Grammar-constrained speculative wave: fixture NL→SQL traffic
+    decoded under the schema-locked grammar on a speculative scheduler.
+    Returns the constrained class's acceptance (tokens/round is the
+    go/no-go number for --speculative on the constrained hot path).
+    Requires cfg.vocab_size >= the byte tokenizer's 259 (every bench
+    config satisfies this)."""
+    from llm_based_apache_spark_optimization_tpu.constrain import (
+        get_constraint,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.fixtures import (
+        TAXI_COLUMNS,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import (
+        ByteTokenizer,
+    )
+
+    tok = ByteTokenizer()
+    # The scheduler must KNOW the stop id: constrained completions close
+    # with eos, and an unstopped slot would spin at the accepting state
+    # for the whole budget.
+    cm = get_constraint({"table": "taxi", "columns": list(TAXI_COLUMNS)},
+                        tok, (tok.eos_id,))
+    return _spec_class_wave(
+        cfg, params, slots, max_seq, prompt_len, decode_chunk, kv_quant,
+        draft, ratio, stop_ids=(tok.eos_id,),
+        class_path=("by_class", "constrained"),
+        submit_kw=lambda i: {"constraint": cm},
+        min_new=cm.min_new_tokens,
+    )
+
+
+def _spec_sampled_pass(cfg, params, slots, max_seq, prompt_len,
+                       decode_chunk, kv_quant, draft, ratio) -> dict:
+    """Sampled-traffic speculative wave (ISSUE 8): the same copy-heavy
+    fixture prompts decoded at temperature>0 through the
+    rejection-sampling verify path. Reports the SAMPLED class's
+    acceptance — tokens/round > 1 means drafted tokens are clearing the
+    accept test (u < target mass) and sampled traffic is getting real
+    multi-token rounds. Random weights put acceptance near the floor (a
+    draft's target mass is ~uniform); real checkpoints on copy-heavy
+    NL→SQL traffic are where the number climbs toward greedy's."""
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
+
+    # Moderate temperature: enough entropy to be genuinely sampled,
+    # sharp enough that copy-heavy drafts keep non-trivial target mass.
+    sp = SamplingParams(temperature=0.7)
+    out = _spec_class_wave(
+        cfg, params, slots, max_seq, prompt_len, decode_chunk, kv_quant,
+        draft, ratio, stop_ids=(-1,),
+        class_path=("by_sampling", "sampled"),
+        submit_kw=lambda i: {"sampling": sp, "seed": i},
+    )
+    if "skipped" not in out:
+        out["temperature"] = sp.temperature
+    return out
 
 
 def _detail(cfg, eng, prompts, prompt_len, max_new, batch, full_dt,

@@ -378,10 +378,11 @@ class InferenceEngine:
             )
         self.kv_layout = kv_layout
         self.kv_page_size = kv_page_size
-        # Prompt-lookup speculative decoding (engine/speculative.py): greedy
+        # Prompt-lookup speculative decoding (engine/speculative.py):
         # requests draft `speculative_draft` tokens per round by n-gram
         # lookup over prompt+history and verify them in one forward. 0
-        # disables. Sampled requests always take the vanilla loop.
+        # disables. Greedy requests verify by exact argmax; sampled
+        # requests verify by rejection sampling (unbiased).
         self.speculative_draft = speculative_draft
         self.speculative_ngram = speculative_ngram
         # Diagnostics from the last speculative generate: verify rounds vs
@@ -447,14 +448,16 @@ class InferenceEngine:
             tokens, lengths = shard_batch((tokens, lengths), self.mesh)
         cap = min(bucket_len(int(max_new_tokens), self.new_bucket),
                   self.cfg.max_seq_len - t)
-        if self.speculative_draft > 0 and sampling.is_greedy:
-            # Constrained greedy requests speculate too: the verify window
+        if self.speculative_draft > 0:
+            # Constrained requests speculate too: the verify window
             # evaluates the grammar mask at every draft position
             # (constrain.fsm_advance_chain threads per-position FSM states
             # through the chain), so drafted tokens cannot bypass the mask
-            # and the output stays token-identical to constrained vanilla
-            # decode. Sampled requests still take the vanilla loop
-            # (rejection-sampling drafts would be needed to stay unbiased).
+            # and greedy output stays token-identical to constrained
+            # vanilla decode. Sampled requests run rejection-sampling
+            # verification (engine/speculative.rejection_sample_chain):
+            # distribution-identical to the vanilla sampled loop, not
+            # token-identical — the RNG consumption pattern differs.
             from .speculative import make_speculative_generate_fn
 
             fn = make_speculative_generate_fn(
@@ -462,12 +465,17 @@ class InferenceEngine:
                 self.speculative_draft, self.speculative_ngram,
                 constrained=constraint is not None,
                 kv_layout=self.kv_layout, kv_page_size=self.kv_page_size,
+                sampling=sampling,
             )
-            args = [self.params, tokens, lengths, jnp.int32(max_new_tokens)]
+            args = [
+                self.params, tokens, lengths, jnp.int32(max_new_tokens),
+                # key: unused by the greedy verify, drives the
+                # accept/residual draws in sampled mode.
+                None if sampling.is_greedy else jax.random.key(seed),
+            ]
             if constraint is not None:
                 tabs = constraint.device_tables(self.cfg.vocab_size)
                 args += [
-                    None,  # key: unused by the greedy speculative loop
                     (tabs["next"], tabs["need"]),
                     jnp.full((tokens.shape[0],), constraint.init_state,
                              jnp.int32),

@@ -26,10 +26,15 @@ TPU-first shape of the idea:
   round's verify window starts at the first unverified position, so its
   cache writes overwrite exactly that garbage before attention can see it —
   the same visibility invariant engine/kvcache.py documents.
-- Greedy only: sampled requests need rejection-sampling to stay unbiased;
-  the product's SQL path is greedy (reference eval scores deterministic
-  SQL). `InferenceEngine.generate` falls back to the vanilla loop for
-  sampled requests.
+- Sampled requests (temperature > 0) get the SAME draft/verify speedup via
+  standard rejection sampling (`rejection_sample_chain`): each drafted
+  token is accepted with min(1, p/q) under the target distribution — a
+  delta q for these deterministic drafts, so accept iff a uniform draw
+  lands under the draft's target mass — and the first rejection resamples
+  from the normalized residual max(0, p − q). The emitted tokens are
+  exactly a sample from vanilla `sample_runtime`'s distribution (the
+  property tests' acceptance bar), while greedy requests keep the exact
+  argmax verify (token-identical to vanilla greedy, as before).
 
 Measured cost model (v5e, bench-1b, B=8, D=8): a verify round runs ~1.6x a
 vanilla decode step (same weight stream; wider unembed + draft/accept
@@ -54,7 +59,12 @@ from ..constrain.masks import fsm_advance_chain
 from ..models.configs import LlamaConfig
 from ..models.llama import _UNROLL_MAX_T, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
-from ..ops.sampling import apply_token_mask
+from ..ops.sampling import (
+    SamplingParams,
+    apply_token_mask,
+    filtered_runtime_logits,
+    sample,
+)
 from ..parallel.sharding import constrain_cache
 from .kvcache import init_cache
 
@@ -189,9 +199,121 @@ def ngram_draft(
         start = jnp.where(found, m + ngram, hlen)
         # dynamic_slice clamps start so the read stays in bounds; a clamped
         # window only shifts WHICH tokens get drafted — still just a draft.
-        return lax.dynamic_slice(h, (start,), (draft_len,))
+        out = lax.dynamic_slice(h, (start,), (draft_len,))
+        # Stale-memory guard: the copy window can cross hlen (an earliest
+        # match's continuation, or the no-match fallback at the tail),
+        # and beyond hlen sits whatever a PREVIOUS occupant of this
+        # history row left there (scheduler slots are reused across
+        # requests). Greedy verification never cared — drafts change
+        # round counts, never output — but SAMPLED rejection
+        # verification's realized tokens depend on the drafts (accept
+        # iff u < p(draft)), so reading stale memory would break
+        # (seed, request) reproducibility across batch compositions and
+        # scheduler incarnations — the crash-replay suppression
+        # contract. Pin past-hlen positions to token 0: any FIXED value
+        # is a valid junk draft.
+        pos = start + jnp.arange(draft_len, dtype=jnp.int32)
+        return jnp.where(pos < hlen, out, 0)
 
     return jax.vmap(row)(hist, hist_len.astype(jnp.int32))
+
+
+def rejection_sample_chain(
+    filt: jnp.ndarray,    # [B, D+1, V] filtered target logits (see below)
+    drafts: jnp.ndarray,  # [B, D] i32 deterministic prompt-lookup drafts
+    keys: jax.Array,      # [B] typed PRNG keys, one per row per round
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Standard speculative rejection sampling (Leviathan et al.; Chen et
+    al.) specialized to DETERMINISTIC drafts — the shared accept/resample
+    core of both one-XLA-program speculative loops (this module's
+    `lax.while_loop` and the scheduler's spec-decode program).
+
+    `filt` must be `ops.sampling.filtered_runtime_logits` output over the
+    verify window's logits, grammar-masked BEFORE filtering exactly where
+    vanilla decode masks (per-position budget-aware state rows):
+    `softmax(filt[:, j])` is then the EXACT distribution p_j(·) a vanilla
+    sampled step would draw token j from.
+
+    The general scheme accepts draft token x_i ~ q(·) with probability
+    min(1, p(x_i)/q(x_i)) and resamples the first rejection from the
+    normalized residual max(0, p - q). Prompt-lookup drafts are not
+    model-sampled — q is a DELTA at the drafted token d (q(d) = 1) — so
+    the scheme degenerates cleanly:
+
+      accept:    min(1, p(d)/1) = p(d) — accept iff u < p(d), i.e. iff
+                 the drafted token has enough TARGET mass. (p(d) = 0 for
+                 a grammar-masked draft, so invalid drafts auto-reject.)
+      residual:  max(0, p - δ_d) is p with d zeroed (p(d) <= 1 always),
+                 renormalized — which is exactly `categorical` over filt
+                 with d's logit dropped to NEG_INF. The residual stays
+                 grammar-renormalized for free: masked tokens were
+                 already at NEG_INF in filt.
+
+    Unbiasedness at one position: P(emit t) = p(d)·1[t=d] +
+    (1-p(d))·p(t)·1[t≠d]/(1-p(d)) = p(t). Chained over positions with
+    the standard longest-accepted-prefix rule, plus the bonus draw from
+    p_D itself when every draft accepts, the emitted tokens are exactly
+    a sample from the target process — property-tested against vanilla
+    `sample_runtime` output distributions in tests/test_speculative.py.
+    (The p(d)=1 corner where the residual would be empty is unreachable:
+    u ~ U[0,1) < 1 accepts with certainty there.)
+
+    Returns (acc [B], extra [B]): `acc` is the accepted draft prefix
+    length in [0, D], `extra` the token sampled at position `acc` — the
+    residual draw when acc < D, the bonus target sample when acc == D.
+    Callers emit drafts[:acc] + [extra], i.e. acc + 1 tokens (see
+    `emit_chain`)."""
+    from ..ops.common import NEG_INF
+
+    b, d1, v = filt.shape
+    d = d1 - 1
+    p = jax.nn.softmax(filt, axis=-1)
+    # Dead-row guard: a FULLY-masked position (possible only past the
+    # budget horizon) must reject with certainty. NEG_INF is a finite
+    # -1e30, so softmax over an all-masked row degenerates to UNIFORM
+    # (exp(0)/V), not NaN — without this clamp a past-horizon draft
+    # would accept with probability ~1/V and inflate acceptance
+    # counters with tokens the loops discard anyway. Partially-masked
+    # rows are unaffected: a masked token's mass underflows to exactly
+    # 0 against any finite max, so grammar-rejected drafts still
+    # auto-reject through p_draft == 0 alone.
+    alive = (jnp.max(filt, axis=-1) > NEG_INF * 0.5)     # [B, D+1]
+    p_draft = jnp.take_along_axis(
+        p[:, :d], drafts[..., None], axis=-1
+    )[..., 0] * alive[:, :d]                             # [B, D]
+    ks = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+    u = jax.vmap(lambda k: jax.random.uniform(k, (d,)))(ks[:, 0])
+    accept = (u < p_draft).astype(jnp.int32)
+    acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)   # [B] in [0, D]
+    final = jnp.take_along_axis(filt, acc[:, None, None], axis=1)[:, 0]
+    rej = jnp.take_along_axis(
+        jnp.concatenate([drafts, drafts[:, :1]], axis=1),  # pad col unused
+        acc[:, None], axis=1,
+    )[:, 0]
+    final = jnp.where(
+        (acc < d)[:, None] & (jnp.arange(v, dtype=jnp.int32)[None, :]
+                              == rej[:, None]),
+        NEG_INF, final,
+    )
+    extra = jax.vmap(jax.random.categorical)(ks[:, 1], final).astype(jnp.int32)
+    return acc, extra
+
+
+def emit_chain(drafts: jnp.ndarray, acc: jnp.ndarray, extra: jnp.ndarray,
+               pad_id: int) -> jnp.ndarray:
+    """Materialize `rejection_sample_chain`'s (acc, extra) contract as the
+    emitted window [B, D+1]: the accepted draft prefix, then the
+    residual/bonus token at position `acc`, pad beyond — the ONE place
+    the emission indexing lives for both one-XLA-program loops."""
+    b, d = drafts.shape
+    jd = jnp.arange(d + 1, dtype=jnp.int32)[None, :]
+    chain = jnp.concatenate(
+        [drafts, jnp.full((b, 1), pad_id, jnp.int32)], axis=1
+    )
+    return jnp.where(
+        jd < acc[:, None], chain,
+        jnp.where(jd == acc[:, None], extra[:, None], pad_id),
+    )
 
 
 def make_speculative_generate_fn(
@@ -205,14 +327,29 @@ def make_speculative_generate_fn(
     constrained: bool = False,
     kv_layout: str = "contiguous",
     kv_page_size: Optional[int] = None,
+    sampling: Optional["SamplingParams"] = None,
 ):
-    """Greedy generate with prompt-lookup speculation.
+    """Generate with prompt-lookup speculation (greedy or sampled).
 
     Same contract as `make_generate_fn` (bucketed cap, traced budget) plus a
     third output: `rounds` — the number of verify forwards the batch ran.
     rounds < total emitted tokens means speculation paid off; equality means
     every draft missed (the worst case, which still emits one token per
     round like vanilla decode, paying only the wider verify unembed).
+
+    `sampling` (static, default greedy): greedy mode verifies by exact
+    argmax — output token-identical to vanilla greedy decode. A
+    temperature>0 `sampling` runs rejection-sampling verification
+    (`rejection_sample_chain`): per round, each drafted token is accepted
+    iff a uniform draw lands under its mass in the target distribution
+    (temperature/top-k/top-p-filtered, grammar-masked when constrained),
+    and the round's final token is drawn from the residual (first
+    rejection) or the target itself (all accepted) — output
+    DISTRIBUTION-identical to the vanilla sampled loop, not
+    token-identical (the RNG consumption pattern differs). The traced
+    `key` argument is required in sampled mode; round r derives per-row
+    keys as fold_in(fold_in(key, r+1), row), so a (seed, request) pair is
+    reproducible whatever the drafts accepted.
 
     `constrained=True` returns a fn taking two extra traced arguments —
     `(next, need)` grammar tables from constrain.CompiledMask.device_tables
@@ -263,6 +400,7 @@ def make_speculative_generate_fn(
         constrained,
         kv_layout,
         page_size,
+        sampling or SamplingParams(),
     )
 
 
@@ -279,6 +417,7 @@ def _make_speculative_generate_fn(
     constrained: bool = False,
     kv_layout: str = "contiguous",
     page_size: int = 0,
+    sampling: SamplingParams = SamplingParams(),
 ):
     from .generate import _is_stop as _is_stop_ids
 
@@ -299,6 +438,8 @@ def _make_speculative_generate_fn(
 
     def _is_stop(tok):
         return _is_stop_ids(tok, stop_ids)
+
+    sampled = not sampling.is_greedy
 
     def gen(params, tokens, lengths, budget, key=None,
             grammar=None,       # (next [S,V] i32, need [S,V] i32) tables
@@ -329,7 +470,12 @@ def _make_speculative_generate_fn(
             first_logits = apply_token_mask(
                 first_logits, g_need[init_states] <= budget
             )
-        first = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+        if sampled:
+            # Vanilla-identical first draw: the same grammar-masked logits,
+            # the same static sampler, fold index 0 of the batch key.
+            first = sample(first_logits, sampling, jax.random.fold_in(key, 0))
+        else:
+            first = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
         cstate = g_next[init_states, first] if constrained else None
         if paged:
             from .paged_kv import pack_prefill_pages
@@ -386,17 +532,40 @@ def _make_speculative_generate_fn(
                 logits = apply_token_mask(
                     logits, g_need[pstates] <= pos_rem[:, :, None]
                 )
-            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, D+1]
-            # preds[j] is the TRUE greedy token after verify[j] iff all
-            # drafts before j were accepted; accept the longest such chain.
-            eq = (drafts == preds[:, :draft_len]).astype(jnp.int32)
-            if constrained:
-                # A grammar-rejected draft can never be accepted even if
-                # the (masked-out) model would have agreed: acceptance is
-                # capped at the valid prefix, so the committed chain only
-                # ever walks live FSM transitions.
-                eq = eq * (jd[:, :draft_len] < vlen[:, None]).astype(jnp.int32)
-            acc = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)  # [B] in [0, D]
+            if sampled:
+                # Rejection-sampling verification: the filtered target
+                # logits at every window position (softmax = the EXACT
+                # per-position distribution vanilla sample_runtime draws
+                # from — grammar-masked above, so grammar-rejected drafts
+                # carry zero target mass and auto-reject, capping
+                # acceptance at the valid prefix without a separate
+                # clamp). Per-row keys derive from (key, round, row), so
+                # the whole run is reproducible per (seed, batch).
+                filt = filtered_runtime_logits(
+                    logits, jnp.float32(sampling.temperature),
+                    jnp.float32(sampling.top_p), jnp.int32(sampling.top_k),
+                )
+                round_key = jax.random.fold_in(key, rounds + 1)
+                rkeys = jax.vmap(
+                    lambda i: jax.random.fold_in(round_key, i)
+                )(jnp.arange(b, dtype=jnp.int32))
+                acc, extra = rejection_sample_chain(filt, drafts, rkeys)
+                preds = emit_chain(drafts, acc, extra, pad_id)
+            else:
+                preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, D+1]
+                # preds[j] is the TRUE greedy token after verify[j] iff all
+                # drafts before j were accepted; accept the longest such
+                # chain.
+                eq = (drafts == preds[:, :draft_len]).astype(jnp.int32)
+                if constrained:
+                    # A grammar-rejected draft can never be accepted even
+                    # if the (masked-out) model would have agreed:
+                    # acceptance is capped at the valid prefix, so the
+                    # committed chain only ever walks live FSM
+                    # transitions.
+                    eq = eq * (jd[:, :draft_len]
+                               < vlen[:, None]).astype(jnp.int32)
+                acc = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)  # [B] in [0, D]
             emit_mask = jd <= acc[:, None]
             stops = _is_stop(preds)
             # Keep through the FIRST stop, nothing after it.

@@ -55,7 +55,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..common import NEG_INF
+from ..common import NEG_INF, shard_map as _shard_map
 
 _LANES = 128  # VMEM lane width: scratch row-stats are kept lane-broadcast
 
@@ -517,7 +517,7 @@ def sharded_flash_gqa_attention_quantized(
     )
     if kv_lens is None:
         kv_lens = jnp.max(q_positions.astype(jnp.int32), axis=1) + 1
-    return jax.shard_map(
+    return _shard_map(
         lambda q_, k_, ks_, v_, vs_, p_, l_: body(
             q_, k_, ks_, v_, vs_, p_, kv_lens=l_
         ),
@@ -567,7 +567,7 @@ def sharded_flash_gqa_attention(
     )
     if kv_lens is None:
         kv_lens = jnp.max(q_positions.astype(jnp.int32), axis=1) + 1
-    return jax.shard_map(
+    return _shard_map(
         lambda q_, k_, v_, p_, l_: body(q_, k_, v_, p_, kv_lens=l_),
         mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, P("dp", None), P("dp")),

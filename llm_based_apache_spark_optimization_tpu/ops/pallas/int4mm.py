@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..common import shard_map as _shard_map
+
 # Renamed upstream (TPUCompilerParams -> CompilerParams); accept both.
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
     pltpu, "TPUCompilerParams"
@@ -227,7 +229,7 @@ def sharded_int4_matmul(
     if partition == "col":
         wspec = P(None, "tp") if q4.ndim == 2 else P(None, None, "tp")
         out_spec = P("dp", "tp") if q4.ndim == 2 else P("dp", None, "tp")
-        return jax.shard_map(
+        return _shard_map(
             lambda x_, q_, s_: int4_matmul(x_, q_, s_),
             mesh=mesh,
             in_specs=(P("dp", None), wspec, wspec),
@@ -240,7 +242,7 @@ def sharded_int4_matmul(
     def row_body(x_, q_, s_):
         return jax.lax.psum(int4_matmul(x_, q_, s_), "tp")
 
-    return jax.shard_map(
+    return _shard_map(
         row_body,
         mesh=mesh,
         in_specs=(P("dp", "tp"), P("tp", None), P("tp", None)),

@@ -82,6 +82,52 @@ def sample(
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def filtered_runtime_logits(
+    logits: jnp.ndarray,       # [..., V] f32 (already grammar-masked if any)
+    temperature: jnp.ndarray,  # [...] f32 broadcastable to the leading dims
+    top_p: jnp.ndarray,        # [...] f32; >= 1 disables nucleus for that row
+    top_k: jnp.ndarray,        # [...] i32; 0 disables top-k for that row
+) -> jnp.ndarray:
+    """The filtered/temperature-scaled logits a runtime sampling step draws
+    from: `categorical(key, filtered_runtime_logits(...))` IS
+    `sample_runtime`'s stochastic path (it calls this), and
+    `softmax(filtered_runtime_logits(...))` is therefore the EXACT target
+    distribution p(·) — the object rejection-sampling speculation needs
+    explicitly (engine/speculative.rejection_sample_chain scores drafted
+    tokens against p and resamples rejections from p's residual). Keeping
+    one implementation is what makes the sampled+speculative output
+    distribution match vanilla sampling by construction rather than by
+    parallel-maintenance luck.
+
+    Accepts any leading shape (a decode step passes [B, V]; a speculative
+    verify window passes [B, D+1, V] with per-row knobs broadcast across
+    the window). Grammar masks must be applied BEFORE this call — exactly
+    where the decode programs apply them — so the top-k/top-p cutoffs see
+    the constrained distribution, same as vanilla decode.
+
+    Cost: one descending vocab sort over the leading shape (microseconds
+    on TPU for 32k-128k rows; callers gate all-greedy batches around it)."""
+    logits = logits.astype(jnp.float32)
+    t = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)[..., None]
+    scaled = logits / t
+    # ONE descending sort serves both cutoffs. Top-k keeps ranks < k;
+    # top-p keeps the smallest prefix of the k-filtered, renormalized
+    # distribution with mass >= p. Both keep-sets are prefixes of the
+    # sort order, so their intersection's size indexes the cutoff.
+    v = scaled.shape[-1]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    ranks = jnp.arange(v, dtype=jnp.int32)
+    tk = jnp.asarray(top_k, jnp.int32)[..., None]
+    keep_k = (tk <= 0) | (ranks < tk)
+    probs = jax.nn.softmax(jnp.where(keep_k, sorted_desc, NEG_INF), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    tp = jnp.asarray(top_p, jnp.float32)[..., None]
+    keep = keep_k & ((cum - probs) < tp)  # always keeps rank 0
+    kth = jnp.sum(keep, axis=-1)  # kept-prefix length per row
+    cutoff = jnp.take_along_axis(sorted_desc, (kth - 1)[..., None], axis=-1)
+    return jnp.where(scaled < cutoff, NEG_INF, scaled)
+
+
 def sample_runtime(
     logits: jnp.ndarray,       # [B, V] f32
     temperature: jnp.ndarray,  # [B] f32; <= 0 means greedy for that row
@@ -112,24 +158,10 @@ def sample_runtime(
     greedy_tok = greedy(logits)
 
     def sample_path(_):
-        t = jnp.maximum(temperature, 1e-6)[:, None]
-        scaled = logits / t
-        # ONE descending sort serves both cutoffs (this runs inside the
-        # decode scan — the sort is the step's dominant sampling cost).
-        # Top-k keeps ranks < k; top-p keeps the smallest prefix of the
-        # k-filtered, renormalized distribution with mass >= p. Both
-        # keep-sets are prefixes of the sort order, so their intersection's
-        # size indexes the cutoff.
-        v = scaled.shape[-1]
-        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
-        ranks = jnp.arange(v, dtype=jnp.int32)[None, :]
-        keep_k = (top_k[:, None] <= 0) | (ranks < top_k[:, None])
-        probs = jax.nn.softmax(jnp.where(keep_k, sorted_desc, NEG_INF), axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        keep = keep_k & ((cum - probs) < top_p[:, None])  # always keeps rank 0
-        kth = jnp.sum(keep, axis=-1)  # kept-prefix length per row
-        cutoff = jnp.take_along_axis(sorted_desc, (kth - 1)[:, None], axis=-1)
-        masked = jnp.where(scaled < cutoff, NEG_INF, scaled)
+        # The filtered target logits (shared with the speculative verify
+        # path — one implementation, one distribution); the sort inside
+        # runs only when SOME row actually samples.
+        masked = filtered_runtime_logits(logits, temperature, top_p, top_k)
         return jax.vmap(
             lambda k, row: jax.random.categorical(k, row)
         )(keys, masked).astype(jnp.int32)

@@ -73,17 +73,25 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
   on-device token history (prompt tokens scattered in by the prefill fn,
   emits appended by the round itself) and one T=D+1 forward verifies the
   whole batch. Greedy slots emit their accepted chain (1..D+1 tokens per
-  round, exactly vanilla-greedy output); temperature>0 slots emit 1
-  sampled token from the window's first logits. The verify window runs
-  the unrolled small-T einsum path, which also composes with the int8 KV
-  cache. Prefix-cache reuse is disabled in this mode (reused tokens never
-  reach the draft history). Grammar-constrained requests compose: the
-  draft chain advances the slot's FSM per position
-  (constrain.fsm_advance_chain), every verify logit row is masked with
-  its own position's state, acceptance caps at the grammar-valid prefix,
-  and the committed state rewinds past nothing — constrained+speculative
-  greedy output is token-identical to constrained vanilla decode, and
-  speculation_stats splits acceptance by constrained/unconstrained class.
+  round, exactly vanilla-greedy output); temperature>0 slots emit their
+  rejection-sampling chain (1..D+1 tokens per round: draft i accepted
+  with min(1, p/q) under the target distribution — a delta q for these
+  deterministic drafts — and the first rejection resampled from the
+  normalized residual, engine/speculative.rejection_sample_chain), so
+  sampled output is DISTRIBUTION-identical to vanilla sample_runtime
+  decode and every request class gets the draft/verify speedup on ONE
+  compiled program. The verify window runs the unrolled small-T einsum
+  path, which also composes with the int8 KV cache. Prefix-cache reuse
+  is disabled in this mode (reused tokens never reach the draft
+  history). Grammar-constrained requests compose: the draft chain
+  advances the slot's FSM per position (constrain.fsm_advance_chain),
+  every verify logit row is masked with its own position's state BEFORE
+  the accept test (so the sampled residual is grammar-renormalized and
+  grammar-rejected drafts carry zero target mass), acceptance caps at
+  the grammar-valid prefix, and the committed state rewinds past
+  nothing — constrained+speculative greedy output is token-identical to
+  constrained vanilla decode, and speculation_stats splits acceptance by
+  constrained/unconstrained AND greedy/sampled class.
 
 - **Async issue/harvest pipeline**: decode rounds, prompt chunks and
   admission scatters dispatch without waiting; per-slot state (cur/pos/
@@ -133,7 +141,12 @@ from ..engine.paged_kv import (
 from ..models.configs import LlamaConfig
 from ..models.llama import Params, forward, split_blocks
 from ..ops.pallas import attention_impl, decode_attention_impl
-from ..ops.sampling import SamplingParams, apply_token_mask, sample_runtime
+from ..ops.sampling import (
+    SamplingParams,
+    apply_token_mask,
+    filtered_runtime_logits,
+    sample_runtime,
+)
 from ..parallel.sharding import shard_params, validate_tp
 from ..utils.faults import FAULTS, InjectedFault
 from ..utils.observability import resilience
@@ -619,11 +632,12 @@ class ContinuousBatchingScheduler:
         # Speculative decoding (prompt-lookup, engine/speculative.py): when
         # speculative_draft=D > 0, decode rounds draft D tokens per slot
         # from an ON-DEVICE token history and verify them with one T=D+1
-        # forward — greedy slots emit 1..D+1 tokens per round (exact greedy
-        # chain), sampled slots emit exactly 1 (sampled from the window's
-        # first logits; rejection-sampling drafts would be needed to emit
-        # more unbiasedly). The verify window takes the unrolled small-T
-        # einsum path, which also composes with the int8 KV cache.
+        # forward — greedy slots emit 1..D+1 tokens per round (exact
+        # greedy chain), sampled slots emit 1..D+1 via rejection sampling
+        # (unbiased: the emitted tokens are distributed exactly as
+        # vanilla sample_runtime decode). The verify window takes the
+        # unrolled small-T einsum path, which also composes with the
+        # int8 KV cache.
         self._spec_draft = int(speculative_draft or 0)
         self._spec_ngram = spec_ngram
         if self._spec_draft:
@@ -653,19 +667,25 @@ class ContinuousBatchingScheduler:
             # the bench could never say whether speculation PAYS — breakeven
             # is ~1.6 accepted tokens per verify round (the measured cost of
             # a T=D+1 verify vs a T=1 step, engine/speculative.py). Counted
-            # at harvest on greedy slots only (sampled slots always emit 1).
-            # The *_con pair counts the CONSTRAINED subset of the totals:
-            # grammar-masked traffic has a different acceptance profile
-            # (forced keyword/identifier runs accept whole chains; branch
-            # points reject), and an operator deciding whether speculation
-            # pays for the NL→SQL hot path needs ITS tokens/round, not a
-            # blend with unconstrained traffic (speculation_stats splits
-            # the classes; /metrics carries both).
+            # at harvest for every emitting slot. The *_con pair counts the
+            # CONSTRAINED subset of the totals: grammar-masked traffic has
+            # a different acceptance profile (forced keyword/identifier
+            # runs accept whole chains; branch points reject), and an
+            # operator deciding whether speculation pays for the NL→SQL
+            # hot path needs ITS tokens/round, not a blend with
+            # unconstrained traffic. The *_samp pair counts the SAMPLED
+            # (temperature>0) subset the same way: rejection-sampling
+            # acceptance (u < target mass) runs systematically below
+            # greedy's argmax-match acceptance, and the sampled class's
+            # tokens/round is the go/no-go number for speculating on
+            # sampled traffic (speculation_stats splits both axes;
+            # /metrics carries all of it).
             self._spec_rounds = 0
             self._spec_tokens = 0
             self._spec_rounds_con = 0
             self._spec_tokens_con = 0
-            self._warned_sampled_spec = False
+            self._spec_rounds_samp = 0
+            self._spec_tokens_samp = 0
 
         # Prefix cache: block size = the smallest bucket, so chunk boundaries
         # always land on block boundaries. OrderedDict as LRU of
@@ -1192,11 +1212,31 @@ class ContinuousBatchingScheduler:
     def _build_spec_decode(self):
         """One speculative round for the whole slot batch: draft D tokens
         per slot by prompt lookup over the on-device history, verify with a
-        single T=D+1 forward, emit the accepted greedy chain (or 1 sampled
-        token for temperature>0 slots). Per-slot state — history, length,
+        single T=D+1 forward, emit the accepted chain. Greedy slots verify
+        by exact argmax (token-identical to vanilla greedy decode);
+        temperature>0 slots verify by REJECTION SAMPLING
+        (engine/speculative.rejection_sample_chain): draft token i is
+        accepted iff a uniform draw lands under its mass in the target
+        distribution (grammar-masked, temperature/top-k/top-p-filtered —
+        softmax of ops.sampling.filtered_runtime_logits, the same
+        distribution a vanilla sample_runtime step draws from), and the
+        round's final token comes from the normalized residual (first
+        rejection) or the target itself (all accepted) — so sampled slots
+        emit 1..D+1 tokens per round, distribution-identical to vanilla
+        sampling. Both classes ride this ONE compiled program: greedy vs
+        sampled is a per-row `temps <= 0` select, and an all-greedy round
+        skips the window-wide sort/softmax via lax.cond (mirroring
+        sample_runtime's fast path). Per-slot state — history, length,
         position, RNG counts, grammar FSM state and budget — advances on
         device; the host harvests (emitted [slots, D+1], n_emit [slots]) a
         lag late, exactly like vanilla rounds.
+
+        Sampled determinism: slot s's round keys derive as
+        fold_in(key(seed), counts) with counts advancing by one per
+        harvested sampled round, so a (seed, request) pair reproduces the
+        same tokens whatever other traffic shares the batch — the
+        contract crash-replay token suppression (serve/supervisor.py)
+        depends on.
 
         Grammar constraining composes per position: each slot's draft
         chain advances its FSM (constrain.fsm_advance_chain — drafts stop
@@ -1219,7 +1259,11 @@ class ContinuousBatchingScheduler:
         max_seq so a slot mid-chunked-prefill cannot have its freshly
         scattered prompt history punched by pad writes at a stale hlen."""
         from ..constrain.masks import fsm_advance_chain
-        from ..engine.speculative import ngram_draft
+        from ..engine.speculative import (
+            emit_chain,
+            ngram_draft,
+            rejection_sample_chain,
+        )
 
         cfg, mesh = self.cfg, self.mesh
         D, ngram = self._spec_draft, self._spec_ngram
@@ -1269,19 +1313,40 @@ class ContinuousBatchingScheduler:
             eq = ((drafts == preds[:, :D])
                   & (jd[:, :D] < vlen[:, None])).astype(jnp.int32)
             acc = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)         # [S]
+            greedy = temps <= 0.0
             keys = jax.vmap(
                 lambda s, c: jax.random.fold_in(jax.random.key(s), c)
             )(seeds, counts)
-            sampled0 = sample_runtime(logits[:, 0], temps, topps, topks, keys)
-            greedy = temps <= 0.0
-            n_emit = jnp.where(active, jnp.where(greedy, acc + 1, 1), 0)
-            emitted = jnp.where(
-                greedy[:, None], preds,
-                jnp.concatenate(
-                    [sampled0[:, None],
-                     jnp.full((preds.shape[0], D), pad_id, jnp.int32)], 1
-                ),
+            ns = preds.shape[0]
+
+            def rejection_path(_):
+                # Filtered target logits at EVERY verify position: the
+                # grammar mask was applied above (exactly where a vanilla
+                # round applies it), so softmax(filt[:, j]) is the
+                # distribution vanilla sample_runtime would draw token j
+                # from, grammar-rejected drafts carry zero target mass
+                # (auto-reject, capping acceptance at the valid prefix),
+                # and the rejection residual is grammar-renormalized for
+                # free. One [S, D+1, V] sort per round.
+                filt = filtered_runtime_logits(
+                    logits, temps[:, None], topps[:, None], topks[:, None],
+                )
+                return rejection_sample_chain(filt, drafts, keys)
+
+            # All-greedy rounds (the NL→SQL common case) skip the
+            # window-wide sort/softmax/draws entirely — the same fast
+            # path sample_runtime keys on, lifted to the whole window.
+            acc_s, extra = lax.cond(
+                jnp.all(greedy),
+                lambda _: (jnp.zeros((ns,), jnp.int32),
+                           jnp.zeros((ns,), jnp.int32)),
+                rejection_path, None,
             )
+            emitted_s = emit_chain(drafts, acc_s, extra, pad_id)
+            n_emit = jnp.where(
+                active, jnp.where(greedy, acc + 1, acc_s + 1), 0
+            )
+            emitted = jnp.where(greedy[:, None], preds, emitted_s)
             emitted = jnp.where(jd < n_emit[:, None], emitted, pad_id)
             # Inactive rows write past max_seq (clamped into the history's
             # spare tail), never at their stale hlen.
@@ -1296,10 +1361,12 @@ class ContinuousBatchingScheduler:
             )(emitted, n_emit, cur)
             # Commit the FSM to the state after the accepted prefix: the
             # last emitted token advances from ITS per-position state
-            # (for accepted drafts emitted[j] == drafts[j], so this lands
-            # exactly on the chain state). n_emit == 0 rows freeze —
+            # (for accepted drafts emitted[j] == drafts[j] in BOTH
+            # classes, so this lands exactly on the chain state; a
+            # sampled row's residual/bonus token advances from the state
+            # after its accepted prefix). n_emit == 0 rows freeze —
             # rejected drafts never move the committed state (rewind by
-            # construction). Sampled rows reduce to g_next[cstate, tok].
+            # construction).
             idx = jnp.maximum(n_emit - 1, 0)
             last_s = jnp.take_along_axis(pstates, idx[:, None], 1)[:, 0]
             last_t = jnp.take_along_axis(emitted, idx[:, None], 1)[:, 0]
@@ -1307,8 +1374,12 @@ class ContinuousBatchingScheduler:
             crem = crem - n_emit
             pos = pos + n_emit
             hlen = hlen + n_emit
-            # Sampled slots consumed one stream index; greedy argmax
-            # consumed none.
+            # Sampled slots consumed one stream index per ROUND (the
+            # round key fans out into the window's accept/residual draws
+            # inside rejection_sample_chain); greedy argmax consumed
+            # none. Round count per request is deterministic — drafting
+            # reads only the row's own history — so (seed, request)
+            # reproduces the same tokens under any batch mix.
             counts = counts + jnp.where(active & ~greedy, 1, 0)
             out_cache = ((new_cache["kp"], new_cache["vp"]) if paged
                          else _cache_tuple(new_cache))
@@ -1553,22 +1624,6 @@ class ContinuousBatchingScheduler:
                 f"({max_new_tokens}) + overshoot ({overshoot}) "
                 f"= {need} exceeds scheduler max_seq={self.max_seq}"
             )
-        if self._spec_draft and sampling.temperature > 0.0 \
-                and not self._warned_sampled_spec:
-            # Advisor r4: under speculation a sampled slot emits exactly 1
-            # token per T=D+1 verify round, and a verify round costs
-            # ~VERIFY_COST_RATIO of a vanilla decode step — so sampled
-            # traffic pays ~1.6x device time per token (and can never win
-            # anything back, since sampled slots accept no drafts). Warn
-            # once, loudly.
-            self._warned_sampled_spec = True
-            _log.warning(
-                "temperature>0 request admitted to a speculative scheduler "
-                "(draft=%d): sampled slots emit 1 token per verify round at "
-                "~1.6x a vanilla step's cost and never benefit from drafts. "
-                "Serve sampled traffic on a non-speculative scheduler.",
-                self._spec_draft,
-            )
         req = _Request(
             ids=list(ids), max_new=max_new_tokens,
             temperature=sampling.temperature, top_p=sampling.top_p,
@@ -1657,16 +1712,19 @@ class ContinuousBatchingScheduler:
     @property
     def speculation_stats(self) -> Optional[Dict[str, float]]:
         """Speculative-decoding acceptance (None when speculation is off):
-        verify rounds and tokens emitted by GREEDY slots, tokens/round
-        (1.0 = no draft ever accepted .. D+1 = every draft accepted), and
-        the estimated speedup vs vanilla decode given the measured ~1.6x
-        verify-round cost (engine/speculative.py breakeven math) — the
-        go/no-go number for --speculative on a given workload. `by_class`
-        splits the same acceptance figures by constrained vs unconstrained
-        requests: grammar-masked NL→SQL traffic accepts differently
-        (forced keyword/identifier runs vs free text), and the per-class
-        tokens/round is the number that says whether the constrained hot
-        path specifically is winning (/metrics carries the split)."""
+        verify rounds and tokens emitted across every emitting slot,
+        tokens/round (1.0 = no draft ever accepted .. D+1 = every draft
+        accepted), and the estimated speedup vs vanilla decode given the
+        measured ~1.6x verify-round cost (engine/speculative.py breakeven
+        math) — the go/no-go number for --speculative on a given
+        workload. `by_class` splits the same acceptance figures by
+        constrained vs unconstrained requests: grammar-masked NL→SQL
+        traffic accepts differently (forced keyword/identifier runs vs
+        free text). `by_sampling` splits them by greedy vs sampled
+        (temperature>0) requests: rejection-sampling acceptance (u <
+        target mass) runs systematically below greedy's argmax match, so
+        the sampled class prices its own speedup instead of hiding in a
+        blend (/metrics carries both splits)."""
         if not self._spec_draft:
             return None
         from ..engine.speculative import (
@@ -1681,6 +1739,8 @@ class ContinuousBatchingScheduler:
             rounds, toks = self._spec_rounds, self._spec_tokens
             rounds_con, toks_con = (self._spec_rounds_con,
                                     self._spec_tokens_con)
+            rounds_samp, toks_samp = (self._spec_rounds_samp,
+                                      self._spec_tokens_samp)
         # The verify cost scales with THIS scheduler's draft length
         # (ADVICE r5 #3: a D=4 deployment's breakeven is not D=8's) — the
         # per-D linear model replaces the old single 1.6 constant — and
@@ -1712,6 +1772,11 @@ class ContinuousBatchingScheduler:
                 "constrained": acceptance(rounds_con, toks_con),
                 "unconstrained": acceptance(rounds - rounds_con,
                                             toks - toks_con),
+            },
+            "by_sampling": {
+                "greedy": acceptance(rounds - rounds_samp,
+                                     toks - toks_samp),
+                "sampled": acceptance(rounds_samp, toks_samp),
             },
         }
 
@@ -2312,7 +2377,12 @@ class ContinuousBatchingScheduler:
         t_harvest = time.perf_counter()
         occupancy = sum(1 for r in issue_reqs if r is not None)
         round_emitted = 0
-        spec_emitted = {"constrained": 0, "unconstrained": 0}
+        # Two independent splits of the same per-round emission totals:
+        # constrained/unconstrained (grammar class) and greedy/sampled
+        # (sampling class — the rejection-sampling path's acceptance is
+        # separately observable in the flight recorder).
+        spec_emitted = {"constrained": 0, "unconstrained": 0,
+                        "greedy": 0, "sampled": 0}
         # Firsts precede the round's chunk tokens in every stream: their
         # ready-scatter was dispatched before the round was issued.
         for (slot, req, _), fv in zip(firsts, first_vals):
@@ -2346,12 +2416,15 @@ class ContinuousBatchingScheduler:
             if n_emit is None:
                 row = toks[i]
             else:
-                row = toks[i][: int(n_emit[i])]
+                ne = int(n_emit[i])
+                row = toks[i][:ne]
+                sampled_req = req.temperature > 0.0
                 cls = ("constrained" if req.constraint is not None
                        else "unconstrained")
-                spec_emitted[cls] += int(n_emit[i])
-                if req.temperature <= 0.0 and int(n_emit[i]) > 0:
-                    # Both counters move under the scheduler's lock so
+                spec_emitted[cls] += ne
+                spec_emitted["sampled" if sampled_req else "greedy"] += ne
+                if ne > 0:
+                    # All counters move under the scheduler's lock so
                     # speculation_stats (HTTP/metrics threads) and
                     # bench.py's pre/post delta bracketing always read a
                     # COHERENT (rounds, tokens) pair — unlocked, a reader
@@ -2359,12 +2432,16 @@ class ContinuousBatchingScheduler:
                     # (ADVICE.md r5 #2).
                     with self._submit_lock:
                         self._spec_rounds += 1
-                        self._spec_tokens += int(n_emit[i])
+                        self._spec_tokens += ne
                         if req.constraint is not None:
-                            # Per-class split: the constrained subset of
-                            # the totals (unconstrained = total - con).
+                            # Per-class splits: each pair is the named
+                            # subset of the totals (the complement class
+                            # is total - subset).
                             self._spec_rounds_con += 1
-                            self._spec_tokens_con += int(n_emit[i])
+                            self._spec_tokens_con += ne
+                        if sampled_req:
+                            self._spec_rounds_samp += 1
+                            self._spec_tokens_samp += ne
             if req.stall_inject:
                 # Injected lane wedge (`sched:slot_stall`): the device
                 # "produced nothing useful" for this slot this round.

@@ -3,6 +3,29 @@
 All tests run on CPU JAX with 8 virtual devices — the standard way to test
 pjit/mesh/collective code without real TPU chips (SURVEY.md §4). Must run
 before jax initializes, hence the env mutation at import time.
+
+Statistical-test convention (the `statistical` pytest marker): tests that
+check an EMPIRICAL distribution (rejection-sampling speculation vs vanilla
+sampling, tests/test_speculative.py) must be deterministic and non-flaky
+in tier-1, so they follow three rules:
+
+1. **Fixed seeds everywhere.** Every random draw derives from a literal
+   seed in the test (jax.random.key(N) / fold_in chains); reruns are
+   bit-identical, so a passing test stays passing — the tolerance
+   documents observed-vs-expected distance, it does not absorb run-to-run
+   noise.
+2. **Explicit tolerance with a stated basis.** Chi-square against the
+   closed-form distribution where one exists (threshold = a named
+   percentile of the chi-square at the test's degrees of freedom, e.g.
+   the 99.99th). Where only sampling can estimate both sides, bound the
+   total-variation distance by a NULL BASELINE: the same statistic
+   computed between two vanilla runs at disjoint fixed seeds and equal
+   sample count, plus a stated margin — never a bare magic constant.
+3. **Sample counts sized to the tolerance.** Pick N so the null
+   statistic sits well under the bound (binomial noise ~ sqrt(p/N));
+   if a test needs N large enough to be slow, it carries
+   `@pytest.mark.slow` too and a fast-lane sibling covers the same
+   property at reduced N.
 """
 
 import os

@@ -270,3 +270,43 @@ def test_paged_accounting_reconciles_no_silent_cap():
             BENCH_1B, slots_contiguous=4, max_seq=1664, max_new=128,
             overshoot=16, mix_lens=[1536], page_size=64, prompt_bucket=128,
         )
+
+
+def test_spec_sampled_pass_records_acceptance():
+    """ISSUE 8 bench leg: the sampled fixture-traffic pass reports the
+    SAMPLED class's acceptance, and on a copy-heavy model (zeroed
+    transformer blocks: the target distribution peaks sharply at the
+    repeated token, so rejection tests pass) sampled tokens/round clears
+    1.0 — drafted tokens really get accepted at temperature>0, not just
+    counted."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(Path(BENCH).parent))
+    from bench import _spec_sampled_pass
+
+    from llm_based_apache_spark_optimization_tpu.engine.speculative import (
+        verify_cost_ratio,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+
+    cfg = dataclasses.replace(TINY, max_seq_len=512)
+    params = dict(init_params(cfg, jax.random.key(0), dtype=jnp.float32))
+    params["blocks"] = {
+        k: (jnp.zeros_like(v) if k.startswith("w") else v)
+        for k, v in params["blocks"].items()
+    }
+    out = _spec_sampled_pass(
+        cfg, params, slots=2, max_seq=256, prompt_len=64, decode_chunk=8,
+        kv_quant=None, draft=4, ratio=verify_cost_ratio(4),
+    )
+    assert out["verify_rounds"] >= 1
+    assert out["tokens_emitted"] >= out["verify_rounds"]  # >= 1 tok/round
+    assert out["tokens_per_round"] > 1.0, out
+    assert out["temperature"] == 0.7
+    assert "est_speedup_vs_vanilla" in out

@@ -908,6 +908,133 @@ def test_supervised_real_scheduler_crash_zero_lost(tiny_model_module):
 @pytest.mark.chaos
 @pytest.mark.filterwarnings(
     "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_supervised_spec_scheduler_crash_replays_sampled(tiny_model_module):
+    """ISSUE 8 replay contract: a SAMPLED request riding a SPECULATIVE
+    scheduler decodes deterministically per (seed, request) — the
+    spec-decode program derives each slot's round keys as
+    fold_in(key(seed), counts), and drafting reads only the row's own
+    history — so the crash-restart replay re-derives the exact tokens
+    already streamed and suppresses them (zero duplicates), exactly as
+    it always did for greedy requests. Mixed greedy+sampled batch, one
+    injected `sched:crash`, zero lost."""
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny_model_module
+    sp = SamplingParams(temperature=0.9, top_k=8)
+    reqs = [([1, 5, 9, 5, 9], sp, 11), ([1, 6, 2, 6, 2], sp, 12),
+            ([1, 7], SamplingParams(), 0)]  # 2 sampled + 1 greedy
+
+    def build():
+        return ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+            speculative_draft=2,
+        )
+
+    with build() as control:
+        futs = [control.submit(ids, max_new_tokens=6, sampling=s, seed=sd)
+                for ids, s, sd in reqs]
+        expected = [f.result(timeout=120) for f in futs]
+
+    builds = []
+
+    def factory():
+        if builds:
+            FAULTS.clear()
+        builds.append(1)
+        return build()
+
+    FAULTS.configure("sched:crash:1", seed=0)
+    sup = SupervisedScheduler(
+        factory, max_restarts=3,
+        restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(0),
+    ).start()
+    streamed = [[] for _ in reqs]
+    futs = [
+        sup.submit(ids, max_new_tokens=6, sampling=s, seed=sd,
+                   on_token=streamed[i].append,
+                   idempotency_key=f"samp-{i}")
+        for i, (ids, s, sd) in enumerate(reqs)
+    ]
+    outs = [f.result(timeout=120) for f in futs]
+    assert outs == expected          # replay re-derived the exact tokens
+    assert streamed == expected      # streams saw each token exactly once
+    h = sup.health()
+    assert h["state"] == "ready" and h["lost"] == 0
+    assert h["restarts"] == 1 and len(builds) == 2
+    sup.shutdown()
+
+
+@pytest.mark.chaos
+def test_spill_recovers_sampled_speculative_identically(
+        tiny_model_module, tmp_path):
+    """Drain-spill serializes the request's sampling seed + knobs, and
+    recover() in a fresh supervisor re-derives IDENTICAL tokens for an
+    in-flight sampled+speculative request — the cross-process half of
+    the (seed, request) determinism contract."""
+    import os
+
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    cfg, params = tiny_model_module
+    sp = SamplingParams(temperature=0.9, top_k=8)
+    ids, seed = [1, 5, 9, 5, 9], 21
+
+    def build():
+        return ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, prompt_bucket=8, stop_ids=(-1,),
+            speculative_draft=2,
+        )
+
+    with build() as control:
+        expected = control.submit(
+            ids, max_new_tokens=24, sampling=sp, seed=seed,
+        ).result(timeout=120)
+
+    spill = str(tmp_path / "spill.jsonl")
+    sup1 = SupervisedScheduler(build, spill_path=spill).start()
+    fut = sup1.submit(ids, max_new_tokens=24, sampling=sp, seed=seed,
+                      idempotency_key="samp-spill")
+    sup1.drain(deadline_s=0)  # journal-and-exit NOW: request in flight
+    assert os.path.exists(spill)
+    recs = [json.loads(line) for line in open(spill) if line.strip()]
+    assert len(recs) == 1
+    rec = recs[0]
+    if "result" in rec:
+        # The request won the race to completion before the spill
+        # snapshot: the literal result record must already be exact.
+        assert rec["result"] == expected
+    else:
+        # In-flight: the record must carry the full sampling identity
+        # the re-derivation depends on.
+        assert rec["seed"] == seed
+        assert rec["temperature"] == sp.temperature
+        assert rec["top_k"] == sp.top_k
+        with pytest.raises(Draining):
+            fut.result(timeout=5)
+
+    sup2 = SupervisedScheduler(build, spill_path=spill).start()
+    assert sup2.recover() == 1
+    out = sup2.submit(ids, max_new_tokens=24, sampling=sp, seed=seed,
+                      idempotency_key="samp-spill").result(timeout=120)
+    assert out == expected  # regenerated across processes, token-identical
+    sup2.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
 def test_supervised_real_scheduler_hang_detected_and_replayed(
         tiny_model_module):
     """The hang acceptance scenario: a duration-valued `sched:hang` wedges
