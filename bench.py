@@ -1215,6 +1215,120 @@ def _obs_overhead(n: int = 50_000, sched=None) -> dict:
     return out
 
 
+def _bench_pool_routing(cfg, params, n_long: int = 4, n_short: int = 4,
+                        long_prompt: int = 24, short_prompt: int = 6,
+                        long_new: int = 48, short_new: int = 4,
+                        reps: int = 2) -> dict:
+    """Round-robin vs least-loaded pool placement under SKEWED prompt
+    lengths/budgets (ISSUE 9): two 1-slot replicas serve an alternating
+    long/short submit wave. Blind round-robin anti-correlates with the
+    arrival pattern — every long request lands on replica 0, serializing
+    ~long_new×n_long tokens behind one slot while replica 1 idles — and
+    the least-loaded router (queue-depth × service-time EWMA, token-
+    weighted tie-break) balances the token mass. Two committed figures:
+    `max_replica_share` (routing quality — provable anywhere, including
+    this CPU pass where both replicas contend for the same cores and
+    the wall barely moves with balance) and the tok/s `speedup`, which
+    is what the chip capture (disjoint submeshes, truly parallel
+    replicas) turns into a real throughput win on the workload shape
+    the reference actually serves (short lookups interleaved with long
+    schema-heavy generations). Fresh replicas per router so EWMAs and
+    caches can't leak between the passes."""
+    import time as _t
+
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerPool,
+    )
+
+    decode_chunk = 4
+    bucket = max(long_prompt, 16)
+    max_seq = min(bucket + long_new + 3 * decode_chunk + 8, cfg.max_seq_len)
+    rng = np.random.default_rng(5)
+    longs = _mk_prompts(cfg, n_long, long_prompt, rng)
+    shorts = _mk_prompts(cfg, n_short, short_prompt, rng)
+    # Alternating arrival: the pattern round-robin pairs worst with.
+    wave = []
+    for i in range(max(n_long, n_short)):
+        if i < n_long:
+            wave.append((longs[i], long_new))
+        if i < n_short:
+            wave.append((shorts[i], short_new))
+
+    def make_replica(i=0):
+        return ContinuousBatchingScheduler(
+            cfg, params, num_slots=1, max_seq=max_seq,
+            prompt_bucket=bucket, stop_ids=(-1,),
+            decode_chunk=decode_chunk, prefix_cache_blocks=0,
+        )
+
+    def drive(router):
+        pool = SchedulerPool([make_replica(), make_replica()],
+                             router=router)
+        for s in pool.schedulers:
+            s.warmup(long_prompt)
+            s.warmup(short_prompt)
+        best = None
+        with pool:
+            # Compile each replica's decode program and seed each EWMA
+            # SYMMETRICALLY (a pool-level warm call would seed only the
+            # replica it lands on and bias the router's first picks).
+            for s in pool.schedulers:
+                s.generate([wave[0][0]], max_new_tokens=2)
+            # Best-of-reps, like every other scheduler pass: wave walls
+            # at this size carry host-scheduling noise either router
+            # would absorb at production scale.
+            for _ in range(reps):
+                toks_by_replica: dict = {}
+                t0 = _t.perf_counter()
+                futs = [
+                    pool.submit(ids, max_new_tokens=mn)
+                    for ids, mn in wave
+                ]
+                total = 0
+                for fut in futs:
+                    n = len(fut.result())
+                    total += n
+                    rep = getattr(fut, "_lsot_replica", "")
+                    toks_by_replica[rep] = toks_by_replica.get(rep, 0) + n
+                wall = _t.perf_counter() - t0
+                if best is None or total / wall > best["tok_s"]:
+                    split = dict(sorted(toks_by_replica.items()))
+                    best = {
+                        "tok_s": total / wall,
+                        "wall_s": round(wall, 3),
+                        "tokens_by_replica": split,
+                        # Routing quality, independent of the host: the
+                        # hottest replica's share of the wave's tokens
+                        # (0.5 = perfectly balanced on 2 replicas; 1.0 =
+                        # everything stacked on one). On a shared-compute
+                        # CPU host the wall barely moves with balance
+                        # (both replicas contend for the same cores), so
+                        # THIS is the figure the CPU pass proves; the
+                        # tok/s delta is what the chip capture (disjoint
+                        # submeshes, truly parallel replicas) commits.
+                        "max_replica_share": round(
+                            max(split.values()) / max(1, total), 3),
+                    }
+        best["tok_s"] = round(best["tok_s"], 1)
+        return best
+
+    rr = drive("round_robin")
+    ll = drive("least_loaded")
+    return {
+        "requests": len(wave),
+        "long": {"n": n_long, "prompt": long_prompt, "max_new": long_new},
+        "short": {"n": n_short, "prompt": short_prompt,
+                  "max_new": short_new},
+        "round_robin": rr,
+        "least_loaded": ll,
+        "speedup": round(ll["tok_s"] / rr["tok_s"], 3) if rr["tok_s"]
+        else 0.0,
+    }
+
+
 def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
                      kv_quant=None, reps=None, n_req=None,
                      spec_draft=None) -> dict:
@@ -1443,6 +1557,18 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
                 )
             except Exception as e:  # noqa: BLE001 — keep the leg's numbers
                 out["speculative"]["sampled"] = {"error": str(e)[:200]}
+
+    if os.environ.get("BENCH_SCHED_POOL", "1") == "1" and kv_quant is None:
+        # Fleet-routing pass (ISSUE 9): round-robin vs least-loaded pool
+        # tok/s under skewed prompt lengths — the committed proof that
+        # load-aware placement beats the blind rotation on the workload
+        # shape it was built for. Instrument pass, never fatal to the
+        # leg. (Skipped under kv_quant to keep the 7b_sched slice lean,
+        # like the prefix pass.)
+        try:
+            out["fleet_routing"] = _bench_pool_routing(cfg, params)
+        except Exception as e:  # noqa: BLE001 — keep the leg's numbers
+            out["fleet_routing"] = {"error": str(e)[:200]}
 
     if os.environ.get("BENCH_SCHED_PREFIX", "1") == "1" and kv_quant is None:
         # Warm-prefix pass: the reference's ACTUAL serving pattern is the

@@ -309,30 +309,43 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 from ..ops.quant import quantize_params
 
                 params = quantize_params(params)
+            def make_replica(i):
+                # Per-replica factory: builds replica i against ITS
+                # submesh — the pool's targeted-restart driver calls it to
+                # rebuild exactly the crashed/stalled replica from the
+                # already-loaded (and already-quantized) params.
+                return ContinuousBatchingScheduler(
+                    cfg, params, num_slots=args.slots,
+                    stop_ids=resolve_stop_ids(cfg, tok),
+                    mesh=scheduler_meshes[i],
+                    kv_quant=kv_quant,
+                    kv_layout=getattr(args, "kv_layout", "contiguous"),
+                    kv_hbm_budget_bytes=(
+                        int(getattr(args, "kv_hbm_gb", 0.0) * 2**30)
+                        or None
+                    ),
+                    speculative_draft=getattr(args, "speculative", 0),
+                    max_queue_depth=app_cfg.max_queue_depth,
+                )
+
             def make_pool():
-                return SchedulerPool([
-                    ContinuousBatchingScheduler(
-                        cfg, params, num_slots=args.slots,
-                        stop_ids=resolve_stop_ids(cfg, tok), mesh=m,
-                        kv_quant=kv_quant,
-                        kv_layout=getattr(args, "kv_layout", "contiguous"),
-                        kv_hbm_budget_bytes=(
-                            int(getattr(args, "kv_hbm_gb", 0.0) * 2**30)
-                            or None
-                        ),
-                        speculative_draft=getattr(args, "speculative", 0),
-                        max_queue_depth=app_cfg.max_queue_depth,
-                    )
-                    for m in scheduler_meshes
-                ])
+                return SchedulerPool(
+                    [make_replica(i)
+                     for i in range(len(scheduler_meshes))],
+                    factory=make_replica,
+                    max_restarts=app_cfg.replica_max_restarts,
+                    router=app_cfg.pool_router,
+                )
 
             if supervise:
-                # The supervisor wraps the whole pool: a replica crash
-                # (NEW submits already fail over inside the pool) rebuilds
-                # the full pool and replays journaled work — in-flight
-                # requests on the healthy replicas ride the replay too
-                # (teardown crossfire, serve/supervisor.py), restoring
-                # full dp capacity instead of limping on survivors.
+                # The supervisor wraps the whole pool, but single-replica
+                # failures never reach the whole-pool path anymore: the
+                # fleet pool restarts the one bad replica (bounded
+                # backoff, LSOT_REPLICA_MAX_RESTARTS budget) while the
+                # supervisor re-places ONLY that replica's journaled
+                # requests onto the siblings. The supervisor's own
+                # restart/replay machinery remains the backstop for the
+                # fleet actually being gone (all replicas crashed/dead).
                 from ..serve.supervisor import SupervisedScheduler
 
                 pool = SupervisedScheduler(

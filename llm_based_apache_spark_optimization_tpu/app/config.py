@@ -70,6 +70,19 @@ class AppConfig:
     # written here at drain/exit and recovered (resubmitted) at the next
     # start, so retried idempotency keys find their results. "" = off.
     journal_spill: str = ""
+    # --- fleet serving (serve/scheduler.SchedulerPool; README "Fleet
+    # serving"). dp>1 scheduler deployments run a supervised fleet of
+    # replicas with per-replica lifecycle.
+    # Per-REPLICA restart budget: how many times the pool rebuilds one
+    # crashed/stalled replica (bounded backoff) before marking only THAT
+    # replica dead — siblings keep serving. Independent of max_restarts,
+    # which budgets whole-pool restarts at the supervisor.
+    replica_max_restarts: int = 5
+    # Placement router for the scheduler pool: "least_loaded" scores each
+    # replica by queue-depth × service-time EWMA (deadline-aware, skips
+    # restarting/draining replicas); "round_robin" keeps the pre-fleet
+    # blind rotation.
+    pool_router: str = "least_loaded"
     # --- liveness / hang detection (serve/watchdog.py; README "Liveness &
     # hangs"). The supervisor's watchdog escalates a BUSY decode loop
     # whose heartbeat age exceeds

@@ -8,6 +8,9 @@ definition, app/api.py + app/web.py):
   Always 200 while the process serves; a dead supervisor does NOT fail
   liveness (restarting the pod would throw away the journal a human might
   still want to inspect — readiness already pulls it out of rotation).
+  Fleet deployments (SchedulerPool) also carry per-replica lifecycle in
+  the body (`fleet`: {model: [{replica, state, restarts, stalls, ...}]}),
+  so one probe attributes a restart/drain to the replica it hit.
 - `GET /readyz` — READINESS: should this instance receive traffic?
   Aggregates the supervised schedulers' lifecycle
   (`ready | restarting | degraded | dead`, serve/supervisor.py) through
@@ -85,7 +88,16 @@ def add_health_routes(app: App, service: GenerationService) -> None:
 
     @app.route("/healthz")
     def healthz(req: Request) -> Response:
-        return Response.json({"status": "ok"})
+        # Liveness stays liveness: always 200 while the process serves.
+        # Fleet deployments (SchedulerPool replicas) additionally carry
+        # the per-replica lifecycle here — one probe answers WHICH
+        # replica is restarting/drained/dead, without flipping liveness
+        # (readiness already pulls degraded instances out of rotation).
+        body: dict = {"status": "ok"}
+        fleet = service.fleet_health()
+        if fleet:
+            body["fleet"] = fleet
+        return Response.json(body)
 
     @app.route("/readyz")
     def readyz(req: Request) -> Response:

@@ -47,7 +47,10 @@ def main(argv=None) -> None:
                          "LSOT_FAULTS-style spec (default "
                          "'ollama:connect:0.5,sql:exec:1,sched:crash:0.2' "
                          "— evalh.chaos.DEFAULT_SPEC), then a supervised "
-                         "scheduler through sched:crash loop deaths, and "
+                         "scheduler through sched:crash loop deaths, a "
+                         "watchdog hang stage, and a FLEET stage (one "
+                         "pool replica wedged via sched:wedge_r1: only "
+                         "that replica restarts, siblings untouched), and "
                          "report success-after-retry / shed / degraded "
                          "rates plus restart/replay/lost counts — asserts "
                          "zero hung requests and zero lost acknowledged "

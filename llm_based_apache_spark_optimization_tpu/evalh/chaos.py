@@ -34,6 +34,14 @@ The contract the report asserts, and `evalh --chaos` prints:
   the report's `watchdog` section shows stalls detected, detection
   latency (bounded by the configured threshold + one poll), and zero
   unresolved clients.
+- **targeted restart, not pool-wide**: a fourth stage wedges exactly ONE
+  replica of a supervised fleet pool via the replica-addressable
+  `sched:wedge_r1` site — the watchdog must attribute the stall to that
+  replica, restart only it (sibling restart counters stay zero, the
+  supervisor's whole-pool restart never fires), re-place its journaled
+  requests onto the siblings, and every client resolves token-identical
+  to a wedge-free control with zero lost acknowledged requests — the
+  report's `fleet` section.
 
 Deterministic: the injection RNG is seeded and every boundary is hit from
 the driving thread in a fixed order (the scheduler stage's single worker
@@ -103,15 +111,24 @@ class _ToyScheduler:
     the REAL scheduler through the same seam — tests/test_supervisor.py).
     """
 
-    def __init__(self, tokens_per_request: int = 6):
+    def __init__(self, tokens_per_request: int = 6,
+                 token_sleep_s: float = 0.002):
         from ..serve.flightrecorder import FlightRecorder
         from ..serve.watchdog import Heartbeat
 
         self.tokens_per_request = tokens_per_request
+        # A hair of per-token wall: keeps a burst of submits ahead of the
+        # decode drain, so the POOL's least-loaded placement over toy
+        # replicas is deterministic (outstanding counts, not thread
+        # scheduling, decide routing) — the fleet stage relies on it.
+        self.token_sleep_s = token_sleep_s
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._crash = None
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        # Queued + in-flight request count: the pool router's load signal
+        # (backlog_score mirrors the real scheduler's seam).
+        self._outstanding = 0
         # Liveness stamp, like the real scheduler's: stamped busy before
         # every emitted token, idle before blocking on the queue — so the
         # supervisor's watchdog monitors this replica through the same
@@ -142,11 +159,18 @@ class _ToyScheduler:
         with self._lock:
             if self._crash is not None:
                 raise self._crash
+            self._outstanding += 1
         fut = Future()
         self._queue.put((list(ids), min(max_new_tokens,
                                         self.tokens_per_request),
                          seed, on_token, fut))
         return fut
+
+    def backlog_score(self):
+        """The pool router's load signal (the real scheduler's seam):
+        no service-time EWMA for the toy, so the tie-break carries it."""
+        with self._lock:
+            return 0.0, self._outstanding
 
     @staticmethod
     def expected(ids, n, seed):
@@ -154,6 +178,8 @@ class _ToyScheduler:
         return [(sum(ids) * 31 + seed * 17 + i * 7) % 997 for i in range(n)]
 
     def _run(self):
+        import time as time_mod
+
         from ..serve.resilience import SchedulerCrashed
         from ..utils.faults import FAULTS
 
@@ -170,6 +196,14 @@ class _ToyScheduler:
                     self.heartbeat.stamp(busy=True)
                     FAULTS.check("sched:crash")  # mid-batch death seam
                     FAULTS.check("sched:hang")   # duration site: wedge here
+                    if FAULTS.active:
+                        # Replica-addressable fleet seam, mirroring the
+                        # real scheduler's: `sched:wedge_<label>` wedges
+                        # or crashes exactly THIS pool replica.
+                        FAULTS.check(
+                            f"sched:wedge_{self.flight.replica}")
+                    if self.token_sleep_s:
+                        time_mod.sleep(self.token_sleep_s)
                     out.append(t)
                     if on_token is not None:
                         on_token(t)
@@ -180,6 +214,7 @@ class _ToyScheduler:
                 crash = SchedulerCrashed.from_exception(exc)
                 with self._lock:
                     self._crash = crash
+                    self._outstanding = 0
                 fut.set_exception(crash)
                 while True:  # fail everything queued behind the corpse
                     try:
@@ -190,6 +225,8 @@ class _ToyScheduler:
                         nxt[-1].set_exception(crash)
             else:
                 fut.set_result(out)
+                with self._lock:
+                    self._outstanding = max(0, self._outstanding - 1)
 
 
 def _run_scheduler_stage(seed: int, requests: int = 12) -> Dict:
@@ -410,6 +447,153 @@ def _run_hang_stage(seed: int, hang_s: float = 0.35,
     return report
 
 
+def _run_fleet_stage(seed: int, wedge_s: float = 0.35,
+                     stall_min_s: float = 0.1, replicas: int = 3,
+                     requests: int = 9) -> Dict:
+    """Fleet chaos: wedge ONE replica of a supervised pool via the
+    replica-addressable `sched:wedge_r1` site and prove the
+    targeted-restart contract end to end — the watchdog attributes the
+    stale heartbeat to r1 specifically, ONLY r1 restarts (sibling
+    restart counters stay zero), r1's journaled requests re-place onto
+    the siblings, every client resolves with the deterministic expected
+    tokens (token-identical to a wedge-free control — the toy's output
+    is a pure function of (ids, seed), exactly like the real scheduler's
+    greedy decode), and zero acknowledged requests are lost. Runs in its
+    OWN injection scope; returns fault counts for the caller to merge."""
+    import random
+    import time
+
+    from ..serve.resilience import RetryPolicy
+    from ..serve.scheduler import SchedulerPool
+    from ..serve.supervisor import SupervisedScheduler
+    from ..utils.faults import FAULTS
+
+    FAULTS.configure(f"sched:wedge_r1:1:{wedge_s}", seed)
+    counts_at_clear: Dict[str, int] = {}
+
+    def replica_factory():
+        # The REBUILT replica runs clean (one wedge episode — the
+        # established chaos pattern): clear injection the moment the pool
+        # rebuilds r1, snapshotting the counts first.
+        counts_at_clear.update(FAULTS.counts())
+        FAULTS.clear()
+        return _ToyScheduler()
+
+    def make_pool():
+        return SchedulerPool(
+            [_ToyScheduler() for _ in range(replicas)],
+            factory=replica_factory,
+            max_restarts=5,
+            restart_policy=RetryPolicy(max_attempts=6, base_delay_s=0.001,
+                                       max_delay_s=0.01),
+            rng=random.Random(seed),
+            replica_join_s=0.2,
+        )
+
+    sup = SupervisedScheduler(
+        make_pool, max_restarts=5,
+        restart_policy=RetryPolicy(max_attempts=6, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(seed),
+        stall_factor=2.0, stall_min_s=stall_min_s,
+        stall_join_s=0.2,
+    ).start()
+    t0 = time.monotonic()
+    try:
+        futs, expect = [], []
+        for i in range(requests):
+            ids, rseed = [7 + i, 8 + i], 200 + i
+            futs.append(sup.submit(ids, seed=rseed))
+            expect.append(_ToyScheduler.expected(ids, 6, rseed))
+        hung = mismatched = 0
+        for fut, want in zip(futs, expect):
+            try:
+                got = fut.result(timeout=60)
+            except Exception:  # noqa: BLE001 — typed terminal counts lost here
+                got = None
+            if got is None:
+                hung += 1
+            elif got != want:
+                mismatched += 1
+        wall = time.monotonic() - t0
+        # The clients resolve off the SIBLINGS well before the wedged
+        # replica's bounded teardown + rebuild lands: wait for the
+        # targeted restart to complete before judging the counters.
+        deadline = time.monotonic() + 10.0
+        health = sup.health()
+        while time.monotonic() < deadline:
+            reps = {r["replica"]: r for r in health.get("replicas", [])}
+            r1 = reps.get("r1", {})
+            if (int(r1.get("restarts", 0)) >= 1
+                    and r1.get("state") in ("ready", "degraded")):
+                break
+            time.sleep(0.01)
+            health = sup.health()
+        counts = dict(counts_at_clear)
+        for site, n in FAULTS.counts().items():
+            counts[site] = counts.get(site, 0) + n
+    finally:
+        FAULTS.clear()
+        sup.shutdown()
+    per_replica = {r["replica"]: r for r in health.get("replicas", [])}
+    wedged = per_replica.get("r1", {})
+    sibling_restarts = sum(
+        int(r.get("restarts", 0)) for lbl, r in per_replica.items()
+        if lbl != "r1"
+    )
+    report = {
+        "replicas": replicas,
+        "requests": requests,
+        "wedge_s": wedge_s,
+        "stall_threshold_s": stall_min_s,
+        "wedged_replica": "r1",
+        "wedged_restarts": int(wedged.get("restarts", 0)),
+        "sibling_restarts": sibling_restarts,
+        "stalls_detected": health["stalls"],
+        "pool_restarts": health["restarts"],
+        "replayed": health["replayed"],
+        "lost": health["lost"],
+        "unresolved": hung,
+        "mismatched": mismatched,
+        "state": health["state"],
+        "faults_injected": counts,
+        "wall_s": round(wall, 3),
+    }
+    assert hung == 0, (
+        f"{hung} client(s) silently hung across a single wedged replica "
+        f"— the fleet failed to recover them"
+    )
+    assert mismatched == 0, (
+        f"{mismatched} re-placed request(s) diverged from the wedge-free "
+        f"control outputs"
+    )
+    assert health["lost"] == 0, (
+        f"{health['lost']} acknowledged request(s) lost across the "
+        f"targeted replica restart"
+    )
+    assert report["wedged_restarts"] >= 1, (
+        "the wedged replica was never restarted — the stall was not "
+        "attributed"
+    )
+    assert sibling_restarts == 0, (
+        f"{sibling_restarts} sibling restart(s): the wedge escalated "
+        f"beyond the one wedged replica (targeted restart regressed to "
+        f"pool-wide)"
+    )
+    assert health["restarts"] == 0, (
+        "the SUPERVISOR's whole-pool restart fired for a single-replica "
+        "wedge — targeted restart must keep siblings serving"
+    )
+    # Bounded recovery, like the hang stage: anywhere near
+    # requests × wedge_s means the wedge was waited out, not detected.
+    bound = 6 * wedge_s + 5.0
+    assert wall < bound, (
+        f"fleet stage took {wall:.2f}s (bound {bound:.2f}s): targeted "
+        f"detection or re-placement is not bounded"
+    )
+    return report
+
+
 def run_chaos(
     spec: Optional[str] = None,
     seed: int = 0,
@@ -542,10 +726,19 @@ def run_chaos(
     # spec-driven `resilience_delta` and `faults` tallies the main
     # stages reconcile against.
     watchdog_report = _run_hang_stage(seed)
+    # Stage 4 — fleet: a supervised POOL with one replica wedged via the
+    # replica-addressable `sched:wedge_r1` site. The watchdog must
+    # attribute the stall, restart ONLY that replica (sibling restart
+    # counters zero, no whole-pool restart), re-place its journaled
+    # requests onto the siblings, and every client must resolve with the
+    # wedge-free control outputs — zero lost acknowledged requests. Own
+    # injection scope, outside the snapshot pair, like stage 3.
+    fleet_report = _run_fleet_stage(seed)
     requests = rounds * len(FOUR_QUERY_SUITE)
     hung = requests - sum(outcomes.values())
     hung += scheduler_report["unresolved"]
     hung += watchdog_report["unresolved"]
+    hung += fleet_report["unresolved"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
     # Wall-clock figures are non-deterministic by nature: lifted OUT of
     # the scheduler stage's report so the seeded-replay determinism
@@ -559,6 +752,7 @@ def run_chaos(
         "hung": hung,
         "scheduler": scheduler_report,
         "watchdog": watchdog_report,
+        "fleet": fleet_report,
         "latency": latency,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)

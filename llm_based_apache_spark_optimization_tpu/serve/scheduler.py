@@ -52,9 +52,11 @@ Design (slot-based continuous batching, TPU/XLA-shaped):
 - Data parallelism (dp>1) is request-level BY DESIGN: the slot axis cannot
   shard (dynamic per-slot cache indexing), so dp means independent scheduler
   replicas — each with its own params copy and tp-submesh — behind one
-  `SchedulerPool` that round-robins admissions. That matches the workload:
-  serving throughput scales with independent replicas; there is no gradient
-  all-reduce to motivate a fused dp program (inference-only framework).
+  `SchedulerPool`, a supervised FLEET with least-loaded deadline-aware
+  placement and per-replica lifecycle (targeted restart/drain — see the
+  SchedulerPool docstring). That matches the workload: serving throughput
+  scales with independent replicas; there is no gradient all-reduce to
+  motivate a fused dp program (inference-only framework).
 - **int8 KV cache** (`kv_quant="int8"`): the persistent window stores int8
   values + per-slot f32 scales (ops/quant.quantize_kv) — half the HBM
   footprint and decode streaming. Decode runs the int8-streaming einsum
@@ -719,6 +721,18 @@ class ContinuousBatchingScheduler:
         # retry_after_hint(). None until the first completion — the static
         # 1s floor serves until there is something to estimate from.
         self._svc_ewma: Optional[float] = None
+        # Token-weighted backlog: sum of outstanding requests' max_new
+        # (queued + slotted; += at submit/requeue, -= at terminal), and a
+        # per-TOKEN service-time EWMA beside the per-request one. The
+        # pool's least-loaded router scores replicas by
+        # pending_tokens × sec/token / slots: the same service-time-EWMA
+        # family as the Retry-After math, refined to token resolution —
+        # request COUNTS tie constantly under a submit burst and say
+        # nothing about skewed prompt lengths; outstanding token mass is
+        # the signal that actually differs, and pricing it in seconds
+        # keeps the score comparable to a request's deadline.
+        self._pending_new_tokens = 0
+        self._stok_ewma: Optional[float] = None
 
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._prefill_q: "deque[Tuple[int, _Request]]" = deque()
@@ -1671,6 +1685,7 @@ class ContinuousBatchingScheduler:
             req.rid = self._rid_seq
             req.future._lsot_replica = self.flight.replica
             req.submitted_at = time.perf_counter()
+            self._pending_new_tokens += req.max_new
             self._queue.put(req)
         return req.future
 
@@ -1800,6 +1815,74 @@ class ContinuousBatchingScheduler:
         depth = self._queue.qsize() + 1  # the retry waits behind itself too
         return float(min(60.0, max(1.0, depth * ewma / max(1, self.num_slots))))
 
+    def backlog_score(self) -> Tuple[float, int]:
+        """Placement score for the pool's least-loaded router:
+        `(estimated backlog seconds, pending new tokens)`, compared
+        lexicographically. The seconds estimate is the Retry-After
+        hint's service-time-EWMA math refined to TOKEN resolution —
+        outstanding token mass × measured sec/token / slots — unclamped
+        (a router comparing replicas needs the raw estimate, not the
+        [1, 60] s client courtesy). Token-weighted on purpose: under a
+        submit burst, request COUNTS tie constantly and a per-request
+        EWMA degenerates into count-balancing, which on skewed prompt
+        lengths reproduces round-robin's pathology (all the long
+        requests stack one replica); token mass is the load that
+        actually differs, and pricing it in seconds keeps the score
+        comparable against a request's deadline. Until the first
+        completion seeds the EWMA the estimate is 0.0 and the raw token
+        tie-break carries the routing. Lock-free read like
+        retry_after_hint (atomic attribute reads; a hair-stale estimate
+        is still an estimate)."""
+        stok = self._stok_ewma
+        toks = int(self._pending_new_tokens)
+        secs = (toks * stok / max(1, self.num_slots)
+                if stok is not None else 0.0)
+        return float(secs), toks
+
+    def extract_queued(self) -> List[_Request]:
+        """Pull every queued-not-yet-admitted request OUT of this
+        scheduler (the pool's drain-one-replica re-placement seam).
+        Safe against the live worker: `queue.Queue` hands each item to
+        exactly one consumer, so a request is either extracted here or
+        admitted there, never both — requests the worker already pulled
+        finish on this replica during the drain grace. Wake sentinels
+        (None) are dropped; the loop's 50 ms poll re-arms them."""
+        out: List[_Request] = []
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                out.append(req)
+        if out:
+            with self._submit_lock:
+                self._pending_new_tokens = max(
+                    0, self._pending_new_tokens
+                    - sum(r.max_new for r in out)
+                )
+        return out
+
+    def requeue(self, req: _Request) -> None:
+        """Accept a request extracted from a sibling replica (pool
+        re-placement on drain/remove): fresh rid + replica restamp, then
+        straight into the queue. BYPASSES max_queue_depth on purpose —
+        the request was already admitted (acknowledged) once; shedding
+        acknowledged work because it had to move replicas would turn a
+        drain into data loss."""
+        with self._submit_lock:
+            if self._closed:
+                if self._crash is not None:
+                    raise self._crash_error()
+                raise RuntimeError("scheduler has shut down")
+            if self._thread is None:
+                raise RuntimeError("scheduler not started")
+            self._rid_seq += 1
+            req.rid = self._rid_seq
+            req.future._lsot_replica = self.flight.replica
+            self._pending_new_tokens += req.max_new
+            self._queue.put(req)
+
     def _record_service_time(self, req: _Request) -> None:
         """EWMA of submit→retire wall for COMPLETED requests (failures and
         cancels say nothing about healthy service time — a disconnect-heavy
@@ -1810,9 +1893,13 @@ class ContinuousBatchingScheduler:
         if req.submitted_at <= 0.0 or req.cancelled:
             return
         wall = time.perf_counter() - req.submitted_at
+        stok = wall / max(1, len(req.generated))
         with self._submit_lock:
             prev = self._svc_ewma
             self._svc_ewma = wall if prev is None else 0.2 * wall + 0.8 * prev
+            prev_t = self._stok_ewma
+            self._stok_ewma = (stok if prev_t is None
+                               else 0.2 * stok + 0.8 * prev_t)
 
     @property
     def prefix_stats(self) -> Dict[str, int]:
@@ -2249,6 +2336,13 @@ class ContinuousBatchingScheduler:
         # the whole sleep and the supervisor's watchdog must detect and
         # escalate it (SchedulerStalled → restart/replay).
         FAULTS.check("sched:hang")
+        if FAULTS.active:
+            # Replica-ADDRESSABLE seam (`sched:wedge_r1:p[:secs]`): wedge
+            # (duration form) or crash (raising form) exactly ONE pool
+            # replica by its label, leaving siblings untouched — the
+            # fleet chaos stage's targeted-restart trigger. Gated on
+            # FAULTS.active so the idle path never builds the site string.
+            FAULTS.check(f"sched:wedge_{self.flight.replica}")
         active = np.asarray(
             [r is not None and r.ready for r in self._slot_req]
         )
@@ -2315,6 +2409,10 @@ class ContinuousBatchingScheduler:
         if req.admitted_at and req.submitted_at:
             req.future._lsot_queue_wait = req.admitted_at - req.submitted_at
         self._round_retired.append(req.rid)
+        with self._submit_lock:
+            self._pending_new_tokens = max(
+                0, self._pending_new_tokens - req.max_new
+            )
 
     def _release_slot(self, slot: int) -> None:
         self._slot_req[slot] = None
@@ -2559,6 +2657,7 @@ class ContinuousBatchingScheduler:
         """Fail every in-flight and queued request; reject future submits."""
         with self._submit_lock:
             self._closed = True
+            self._pending_new_tokens = 0
         self._prefill_q.clear()  # their requests fail via the slot sweep below
         self._pending.clear()    # in-flight rounds: futures fail below
         self._first_pending = []
@@ -2695,34 +2794,166 @@ class ContinuousBatchingScheduler:
                     pass
 
 
+@dataclasses.dataclass
+class _ReplicaState:
+    """One replica's supervision state inside a SchedulerPool fleet.
+
+    `state` lifecycle: ready → (crash/stall) → restarting → ready |
+    degraded | dead, plus the runtime-ops states draining (drain_replica
+    in progress) / drained (drained, restartable) / removed
+    (remove_replica: permanently out of the fleet). Placement considers
+    only ready/degraded replicas; `degraded` means "restarted, not yet
+    proven by a clean completion" and clears on the next success placed
+    there."""
+
+    label: str
+    state: str = "ready"
+    restarts: int = 0
+    stalls: int = 0
+    placements: int = 0
+    restart_eta: Optional[float] = None
+    last_crash: Optional[str] = None
+
+    #: States a replica can take new work in.
+    PLACEABLE = ("ready", "degraded")
+
+
 class SchedulerPool:
-    """dp>1 for continuous batching: k independent scheduler replicas behind
-    one `submit()`.
+    """dp>1 for continuous batching: a supervised FLEET of independent
+    scheduler replicas behind one `submit()`.
 
     The slot axis can't shard over a mesh "dp" axis (slots are dynamically
     indexed per request), so data parallelism is request-level: each replica
     owns its own params placement — typically a disjoint tp-submesh of the
-    same slice — and the pool round-robins admissions across them. This is
-    the scale-out story SURVEY.md §2.4 calls "DP / request-level
-    parallelism", played by scheduler replicas instead of Ollama instances.
+    same slice. This is the scale-out story SURVEY.md §2.4 calls "DP /
+    request-level parallelism", played by scheduler replicas instead of
+    Ollama instances.
+
+    Fleet semantics (ISSUE 9 — what turns "a scheduler" into "a fleet"):
+
+    - **Load-aware placement.** `submit()` routes each request to the
+      least-loaded placeable replica, scored by the SAME queue-depth ×
+      service-time EWMA the Retry-After hint quotes shed clients
+      (`backlog_score()`: unclamped seconds estimate, token-weighted
+      backlog as the tie-break). Replicas that are restarting, draining,
+      dead, or crashed are skipped; replicas whose backlog estimate would
+      blow the request's own deadline are skipped too. A request is shed
+      typed — Overloaded/429 or DeadlineExceeded/504 — only when NO
+      replica can serve it, with the honest minimum Retry-After across
+      the fleet (one full replica no longer rejects while a sibling has
+      room). `router="round_robin"` keeps the pre-fleet blind rotation
+      (the bench's comparison baseline).
+    - **Per-replica lifecycle.** With a `factory` (index → fresh replica),
+      each replica carries its own supervision state (`_ReplicaState`):
+      a crash or watchdog-flagged stall escalates to a TARGETED restart —
+      bounded-backoff rebuild of that one replica under a per-replica
+      restart budget — while siblings keep serving uninterrupted. Budget
+      exhausted marks only that replica `dead`. The `on_replica_restart`/
+      `on_replica_drained` callbacks are the supervisor's replay seam:
+      a SupervisedScheduler wrapping this pool re-places ONLY the wedged
+      replica's journaled requests (serve/supervisor.py), so one bad
+      replica no longer restarts — and replays — the whole fleet.
+    - **Runtime drain/remove.** `drain_replica()` takes one replica out
+      of rotation at runtime: its queued-not-yet-admitted requests
+      re-place onto the least-loaded siblings (never shed), in-flight
+      work gets a bounded grace, then the replica shuts down. SIGTERM
+      semantics at the POOL level are unchanged — `shutdown()`/the
+      supervisor's drain still govern whole-process exit.
+    - **Observable.** Placement decisions and replica lifecycle events
+      land in a pool-level flight recorder (merged into
+      `flight_snapshot()`), per-replica health in `health()` /
+      `replica_loads()` (Prometheus picks the numeric fields up under
+      the shared `r{i}` label vocabulary), and per-replica stall
+      verdicts in `heartbeat.verdicts()` / `stalled_replicas()`.
     """
 
-    def __init__(self, schedulers: Sequence[ContinuousBatchingScheduler]):
+    #: Duck-typing flag the supervisor keys targeted restart/replay on.
+    @property
+    def supports_replica_restart(self) -> bool:
+        return self._factory is not None
+
+    def __init__(
+        self,
+        schedulers: Sequence[ContinuousBatchingScheduler],
+        factory: Optional[Callable] = None,
+        max_restarts: int = 5,
+        restart_policy=None,
+        rng=None,
+        sleep: Callable[[float], None] = time.sleep,
+        router: str = "least_loaded",
+        replica_join_s: float = 1.0,
+    ):
         if not schedulers:
             raise ValueError("SchedulerPool needs at least one scheduler")
+        if router not in ("least_loaded", "round_robin"):
+            raise ValueError(
+                f"router must be 'least_loaded' or 'round_robin', got "
+                f"{router!r}"
+            )
+        import random as _random
+
+        from .resilience import RetryPolicy
+
         self.schedulers = list(schedulers)
         self._rr = 0
         self._lock = threading.Lock()
+        self._closed = False
+        self.router = router
+        # Targeted-restart machinery: `factory` builds replacement replica
+        # i on demand — either `factory(i)` (per-replica meshes/placement)
+        # or `factory()` when it takes no required argument. None disables
+        # per-replica restart (a crashed replica is marked dead and
+        # skipped, the pre-fleet behavior).
+        self._factory = factory
+        self._factory_takes_index = False
+        if factory is not None:
+            import inspect
+
+            try:
+                params = inspect.signature(factory).parameters.values()
+                self._factory_takes_index = any(
+                    p.default is inspect.Parameter.empty
+                    and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                    for p in params
+                )
+            except (TypeError, ValueError):
+                self._factory_takes_index = False
+        self.max_restarts = int(max_restarts)
+        self._restart_policy = restart_policy or RetryPolicy(
+            max_attempts=self.max_restarts + 1, base_delay_s=0.1,
+            max_delay_s=5.0,
+        )
+        self._rng = rng if rng is not None else _random.Random()
+        self._sleep = sleep
+        # Bounded join for a wedged replica's teardown: a targeted restart
+        # must not block its driver for the length of the hang it is
+        # recovering from (the abandoned daemon zombie exits when it
+        # unwedges — same contract as the supervisor's teardown).
+        self._replica_join_s = float(replica_join_s)
+        # Replay seams for a wrapping SupervisedScheduler: called with the
+        # replica LABEL after a targeted restart swap / a drain shutdown,
+        # so the supervisor re-places exactly that replica's journaled
+        # requests onto the (now current) fleet.
+        self.on_replica_restart: Optional[Callable[[str], None]] = None
+        self.on_replica_drained: Optional[Callable[[str], None]] = None
         # Attribute each replica's flight records: a pool's merged
         # postmortem/debug view must say WHICH replica's rounds these were
         # (the load-signal feed the multi-replica ROADMAP item needs).
         # "r{i}" matches the single-scheduler recorder default ("r0") and
         # the Prometheus exposition's per-replica label scheme, so the
         # histogram and serving-gauge families join on `replica`.
+        self._states: List[_ReplicaState] = []
         for i, s in enumerate(self.schedulers):
+            label = f"r{i}"
             fl = getattr(s, "flight", None)
             if fl is not None:
-                fl.replica = f"r{i}"
+                fl.replica = label
+            self._states.append(_ReplicaState(label=label))
+        # Pool-level black box: placement decisions + replica lifecycle
+        # events (restart/drain/dead), merged into flight_snapshot() so
+        # the postmortem timeline shows WHERE every request went and what
+        # the fleet did about failures.
+        self._pool_flight = FlightRecorder(capacity=256, replica="pool")
 
     # Admission-arithmetic surface, so SchedulerBackend can wrap a pool the
     # same way it wraps one scheduler (replicas are homogeneous: same cfg,
@@ -2762,30 +2993,70 @@ class SchedulerPool:
         return self.schedulers[0].overshoot
 
     def retry_after_hint(self) -> float:
-        """Soonest-available replica's hint: a shed pool request retries
-        whichever replica drains first."""
-        live = [s for s in self.schedulers if s._crash is None]
-        if not live:
+        """Soonest-available replica's hint, restart-aware: min over
+        PLACEABLE replicas' queue-drain estimates, with a RESTARTING
+        replica contributing its restart-backoff remaining instead of
+        its stale EWMA over a frozen queue (the per-replica twin of the
+        PR-5 supervisor clamp — before this fix a restarting replica's
+        frozen estimate could drive the pool-wide minimum). Draining,
+        dead, and removed replicas contribute nothing: they are never
+        coming back for this client."""
+        now = time.monotonic()
+        hints: List[float] = []
+        for st, s in self._replica_items():
+            if st.state in _ReplicaState.PLACEABLE:
+                if getattr(s, "_crash", None) is not None:
+                    continue
+                hint = getattr(s, "retry_after_hint", None)
+                try:
+                    hints.append(hint() if callable(hint) else 1.0)
+                except Exception:  # noqa: BLE001 — a dying replica mid-read
+                    hints.append(1.0)
+            elif st.state == "restarting":
+                eta = st.restart_eta
+                rem = (eta - now) if eta is not None else 1.0
+                hints.append(float(min(60.0, max(1.0, rem))))
+        if not hints:
             return 1.0
-        return min(s.retry_after_hint() for s in live)
+        # Same [1, 60] s clamp as the per-scheduler estimate, so a
+        # duck-typed replica's raw hint can't quote sub-second retries.
+        return float(min(60.0, max(1.0, min(hints))))
 
     def warmup(self, prompt_len=None) -> None:
         for s in self.schedulers:
-            s.warmup(prompt_len)
+            warm = getattr(s, "warmup", None)
+            if callable(warm):
+                warm(prompt_len)
 
     @property
     def heartbeat(self) -> CombinedHeartbeat:
         """Monitor view over the replicas' heartbeats: one wedged replica
         reads stale (oldest busy age) even while its siblings stamp, so
-        the supervisor's watchdog covers pools with the same code path."""
-        return CombinedHeartbeat([s.heartbeat for s in self.schedulers])
+        the supervisor's watchdog covers pools with the same code path.
+        Labeled with the replica vocabulary, so `verdicts()` (and the
+        snapshot's replicas list) attribute staleness to the replica
+        that went quiet — the targeted-restart feed."""
+        hbs, labels = [], []
+        for st, s in zip(self._states, self.schedulers):
+            hb = getattr(s, "heartbeat", None)
+            if hb is not None:
+                hbs.append(hb)
+                labels.append(st.label)
+        if not hbs:
+            # All-duck-typed fleet with no liveness stamps: None, so the
+            # supervisor's `getattr(inner, "heartbeat", None)` callers
+            # degrade to no-monitoring instead of a ValueError from an
+            # empty CombinedHeartbeat.
+            return None
+        return CombinedHeartbeat(hbs, labels=labels)
 
     @property
     def watchdog_stats(self) -> Dict[str, object]:
+        hb = self.heartbeat
         return {
-            "heartbeat": self.heartbeat.snapshot(),
+            "heartbeat": hb.snapshot() if hb is not None else None,
             "slots_retired_stalled": sum(
-                s._slot_stalls for s in self.schedulers
+                getattr(s, "_slot_stalls", 0) for s in self.schedulers
             ),
         }
 
@@ -2815,8 +3086,10 @@ class SchedulerPool:
     def flight_snapshot(self, last: Optional[int] = None) -> List[Dict]:
         """All replicas' flight records merged in time order — each
         record carries its replica label, so the pool view attributes
-        every round to the replica that ran it."""
-        return merge_snapshots(self.schedulers, last)
+        every round to the replica that ran it. The pool's own recorder
+        (placement decisions, replica restart/drain/dead lifecycle) rides
+        the merge under the "pool" label."""
+        return merge_snapshots([self._pool_flight, *self.schedulers], last)
 
     def flight_stats(self) -> Dict[str, Dict]:
         """Per-replica ring occupancy for /metrics: without this seam the
@@ -2830,34 +3103,61 @@ class SchedulerPool:
         return out
 
     def replica_loads(self) -> List[Dict[str, object]]:
-        """Per-replica load attribution (queue depth, live slots, round
-        cadence, crash state, retry hint): the placement-score feed a
-        least-loaded router would consume — today's round-robin finally
-        has something to be compared against."""
+        """Per-replica load + lifecycle attribution (queue depth, live
+        slots, round cadence, supervision state, restart/stall/placement
+        counters, the live placement score): the feed the least-loaded
+        router consumes, exported per replica under the shared `r{i}`
+        label vocabulary (numeric fields become Prometheus gauges)."""
         out = []
-        for i, s in enumerate(self.schedulers):
-            hb = s.heartbeat.snapshot()
-            out.append({
-                "replica": getattr(s.flight, "replica", f"r{i}"),
-                "queued": s._queue.qsize(),
-                "active_slots": sum(
-                    1 for r in s._slot_req if r is not None
-                ),
-                "num_slots": s.num_slots,
-                "expected_round_s": hb.get("expected_round_s"),
-                "crashed": s._crash is not None,
-                "retry_after_s": round(s.retry_after_hint(), 3),
-            })
+        for st, s in self._replica_items():
+            hb = getattr(s, "heartbeat", None)
+            hb_snap = hb.snapshot() if hb is not None else {}
+            secs, toks = self._score(s)
+            q = getattr(s, "_queue", None)
+            slot_req = getattr(s, "_slot_req", None) or []
+            rec: Dict[str, object] = {
+                "replica": st.label,
+                "state": st.state,
+                "queued": q.qsize() if q is not None else 0,
+                "active_slots": sum(1 for r in slot_req if r is not None),
+                "num_slots": getattr(s, "num_slots", 0),
+                "expected_round_s": hb_snap.get("expected_round_s"),
+                "crashed": getattr(s, "_crash", None) is not None,
+                "restarts": st.restarts,
+                "stalls": st.stalls,
+                "placements": st.placements,
+                "backlog_s": round(secs, 4),
+                "pending_new_tokens": toks,
+            }
+            hint = getattr(s, "retry_after_hint", None)
+            if callable(hint) and st.state in _ReplicaState.PLACEABLE:
+                try:
+                    rec["retry_after_s"] = round(hint(), 3)
+                except Exception:  # noqa: BLE001 — a dying replica mid-read
+                    pass
+            out.append(rec)
         return out
 
     def start(self) -> "SchedulerPool":
-        for s in self.schedulers:
-            s.start()
+        with self._lock:
+            self._closed = False
+        for st, s in zip(self._states, self.schedulers):
+            if st.state != "removed":
+                s.start()
         return self
 
     def shutdown(self, timeout: Optional[float] = None) -> None:
-        for s in self.schedulers:
-            s.shutdown(timeout=timeout)
+        # _closed stops any in-flight replica-restart driver from swapping
+        # a fresh replica into a pool that is going away.
+        with self._lock:
+            self._closed = True
+        for st, s in zip(self._states, self.schedulers):
+            if s is None:
+                continue
+            try:
+                s.shutdown(timeout=timeout)
+            except Exception:  # noqa: BLE001 — one corpse must not wedge the rest
+                _log.exception("replica %s shutdown failed", st.label)
 
     def __enter__(self):
         return self.start()
@@ -2865,58 +3165,531 @@ class SchedulerPool:
     def __exit__(self, *exc):
         self.shutdown()
 
+    # ------------------------------------------------------------ placement
+
+    @staticmethod
+    def _score(s) -> Tuple[float, int]:
+        """A replica's placement score `(backlog seconds, pending
+        tokens)` — the scheduler's own Retry-After math via
+        `backlog_score()`, with a queue-depth-only fallback for
+        duck-typed replicas (the chaos harness's toy)."""
+        fn = getattr(s, "backlog_score", None)
+        if callable(fn):
+            try:
+                secs, toks = fn()
+                return float(secs), int(toks)
+            except Exception:  # noqa: BLE001 — a dying replica mid-read
+                return 0.0, 0
+        q = getattr(s, "_queue", None)
+        return 0.0, (q.qsize() if q is not None else 0)
+
+    def _replica_items(self, states: Optional[Sequence[str]] = None
+                       ) -> List[Tuple["_ReplicaState", object]]:
+        """Locked (state, scheduler) snapshot of the fleet, optionally
+        filtered by lifecycle state — the ONE place the
+        iterate-the-fleet lock discipline lives (retry_after_hint,
+        replica_loads, stalled_replicas, replica_health)."""
+        with self._lock:
+            return [(st, self.schedulers[i])
+                    for i, st in enumerate(self._states)
+                    if states is None or st.state in states]
+
+    def _placeable(self, exclude: Optional[set] = None) -> List[Tuple[int, "_ReplicaState", object]]:
+        """Replicas that can take new work right now: ready/degraded and
+        not crashed. Observing a crash here kicks the replica's targeted
+        restart (or marks it dead when the pool has no factory) — the
+        bare-pool self-healing path; under a supervisor the inner-future
+        failure notices it too."""
+        out = []
+        with self._lock:
+            items = [(i, st) for i, st in enumerate(self._states)
+                     if st.state in _ReplicaState.PLACEABLE
+                     and (exclude is None or i not in exclude)]
+            scheds = list(self.schedulers)
+        for i, st in items:
+            s = scheds[i]
+            crash = getattr(s, "_crash", None)
+            if crash is not None:
+                self._note_replica_crash(i, crash)
+                continue
+            out.append((i, st, s))
+        return out
+
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
                on_token=None, constraint=None, deadline_s=None, trace=None):
-        # Skip replicas whose event loop has crashed: a dead scheduler must
-        # not keep failing its round-robin share while healthy ones idle.
-        # The try/except covers the race where a replica dies between the
-        # _crash check and its submit() — fail over, don't fail the request.
+        """Least-loaded, deadline-aware placement (router="round_robin"
+        keeps the pre-fleet rotation): score every placeable replica,
+        skip the ones whose backlog would blow this request's deadline,
+        and fail over on Overloaded/crash races. A request is shed typed
+        only when NO replica can serve it — Overloaded (429) with the
+        fleet's minimum Retry-After when placeable replicas are all at
+        capacity, DeadlineExceeded (504) when every placeable replica's
+        backlog exceeds the deadline, Overloaded-with-backoff when the
+        whole fleet is mid-restart, and SchedulerCrashed only when the
+        fleet is truly gone."""
         last_overloaded: Optional[Overloaded] = None
-        for _ in range(len(self.schedulers)):
-            with self._lock:
-                sched = self.schedulers[self._rr % len(self.schedulers)]
-                self._rr += 1
-            if sched._crash is not None:
-                continue
+        deadline_blocked: Optional[float] = None
+        tried: set = set()
+        while True:
+            cands = self._placeable(exclude=tried)
+            if not cands:
+                break
+            if self.router == "round_robin":
+                with self._lock:
+                    pick = self._rr % len(cands)
+                    self._rr += 1
+                order = cands[pick:] + cands[:pick]
+                scored = [(self._score(s), i, st, s)
+                          for (i, st, s) in order]
+            else:
+                scored = sorted(
+                    ((self._score(s), i, st, s) for (i, st, s) in cands),
+                    key=lambda t: (t[0][0], t[0][1], t[1]),
+                )
+            if deadline_s is not None:
+                feasible = [t for t in scored if t[0][0] < deadline_s]
+                if not feasible:
+                    # Every remaining replica's backlog estimate already
+                    # exceeds the budget: admitting anywhere would burn
+                    # the deadline in queue. Shed 504 below (unless a
+                    # not-yet-tried replica frees up — there is none:
+                    # the estimate only grows with this submit).
+                    deadline_blocked = min(t[0][0] for t in scored)
+                    break
+                scored = feasible
+            (secs, toks), i, st, sched = scored[0]
             try:
                 fut = sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
                     seed=seed, on_token=on_token, constraint=constraint,
                     deadline_s=deadline_s, trace=trace,
                 )
-                # Replica attribution for the metrics label set: which
-                # replica actually served this submit.
-                fut._lsot_replica = getattr(sched.flight, "replica", "")
-                return fut
             except ValueError:
                 # Request-shape rejection (oversize prompt): identical on
                 # every replica — re-raise rather than spinning the ring.
                 raise
             except Overloaded as e:
                 # This replica's queue is full; another may have room. Shed
-                # (429) only when EVERY live replica is at capacity.
-                last_overloaded = e
+                # (429) only when EVERY placeable replica is at capacity.
+                if (last_overloaded is None
+                        or e.retry_after_s < last_overloaded.retry_after_s):
+                    last_overloaded = e
+                tried.add(i)
                 continue
             except RuntimeError:
                 # Failover only for genuine crashes that landed between the
-                # _crash check and submit(); lifecycle misuse ("not started",
-                # "has shut down" without a crash) is the caller's bug and
-                # its accurate error must propagate.
-                if sched._crash is None:
+                # placeable check and submit(); lifecycle misuse ("not
+                # started", "has shut down" without a crash) is the
+                # caller's bug and its accurate error must propagate.
+                crash = getattr(sched, "_crash", None)
+                if crash is None:
                     raise
+                self._note_replica_crash(i, crash)
+                tried.add(i)
                 continue
+            # Replica attribution for the metrics label set: which
+            # replica actually served this submit.
+            fut._lsot_replica = st.label
+            with self._lock:
+                st.placements += 1
+            if st.state == "degraded":
+                # A clean completion proves the restarted replica serves.
+                def _prove(f, st=st):
+                    if f.exception() is None:
+                        with self._lock:
+                            if st.state == "degraded":
+                                st.state = "ready"
+                fut.add_done_callback(_prove)
+            # Placement decision into the pool black box: where the
+            # request went and what the router saw (bounded ring append).
+            self._pool_flight.event(
+                "placement", to=st.label, router=self.router,
+                backlog_s=round(secs, 4), pending_new_tokens=toks,
+                considered=len(cands),
+            )
+            return fut
         if last_overloaded is not None:
-            raise last_overloaded
+            # Min Retry-After across the full fleet (restart-aware), not
+            # whichever replica happened to shed last.
+            raise Overloaded(
+                "every scheduler replica is at capacity",
+                retry_after_s=min(last_overloaded.retry_after_s,
+                                  self.retry_after_hint()),
+            )
+        if deadline_blocked is not None:
+            resilience.inc("deadline_infeasible")
+            raise DeadlineExceeded(
+                f"no replica can serve within the {deadline_s:.3f}s "
+                f"deadline: minimum fleet backlog estimate "
+                f"{deadline_blocked:.3f}s"
+            )
+        with self._lock:
+            restarting = any(st.state == "restarting" for st in self._states)
+        if restarting:
+            # The fleet is mid-restart with nothing placeable: retryable
+            # backpressure (the hint carries the backoff remaining), NOT a
+            # crash — a supervisor must not tear the whole pool down while
+            # its replicas are already being rebuilt.
+            raise Overloaded(
+                "every scheduler replica is restarting",
+                retry_after_s=self.retry_after_hint(),
+            )
         # Typed (not a bare RuntimeError): every replica holds a
-        # SchedulerCrashed, the pool just summarizes — and the supervisor
-        # classifies crashes by TYPE, so the pool-wide death must carry
-        # it (a message-string contract would silently break recovery on
-        # rewording). Subclasses RuntimeError: existing handlers keep
-        # working.
-        raise SchedulerCrashed("all scheduler replicas have crashed")
+        # SchedulerCrashed (or is dead/removed), the pool just summarizes
+        # — and the supervisor classifies crashes by TYPE, so the
+        # fleet-wide death must carry it (a message-string contract would
+        # silently break recovery on rewording). Subclasses RuntimeError:
+        # existing handlers keep working.
+        raise SchedulerCrashed(
+            "all scheduler replicas have crashed or left the fleet"
+        )
 
     cancel = staticmethod(ContinuousBatchingScheduler.cancel)
+
+    # --------------------------------------------------- replica lifecycle
+
+    def _resolve_idx(self, replica) -> int:
+        if isinstance(replica, int):
+            if not 0 <= replica < len(self._states):
+                raise ValueError(f"no replica index {replica}")
+            return replica
+        for i, st in enumerate(self._states):
+            if st.label == replica:
+                return i
+        raise ValueError(f"unknown replica {replica!r}")
+
+    def _note_replica_crash(self, idx: int, exc: BaseException) -> None:
+        """A replica's loop died: kick its targeted restart (factory
+        pools), or mark it dead and skip it forever (factory-less pools —
+        the pre-fleet behavior, now visible in health()). Idempotent per
+        episode."""
+        with self._lock:
+            st = self._states[idx]
+            if self._closed or st.state not in _ReplicaState.PLACEABLE:
+                return
+            st.last_crash = str(exc)[:200]
+            if self._factory is None:
+                st.state = "dead"
+                self._pool_flight.event("replica_dead", replica=st.label,
+                                        error=st.last_crash)
+                return
+            st.state = "restarting"
+        resilience.inc("replica_crashes")
+        self._pool_flight.event("replica_crash", replica=st.label,
+                                error=st.last_crash)
+        _log.warning("replica %s crashed; pool restarting it: %s",
+                     st.label, exc)
+        self._spawn_restart(idx)
+
+    def notice_replica_crash(self, replica, exc: BaseException) -> None:
+        """Public crash-notice seam (the supervisor calls it when one of
+        its journaled requests' inner futures fails typed with a crash):
+        kicks the replica's targeted restart, idempotent per episode."""
+        try:
+            idx = self._resolve_idx(replica)
+        except ValueError:
+            return
+        self._note_replica_crash(idx, exc)
+
+    def restart_replica(self, replica, reason: str = "manual") -> bool:
+        """Targeted restart of ONE replica (the watchdog's stall
+        escalation and the operator's manual kick): tear it down with a
+        bounded join — a WEDGED loop never joins; the zombie daemon is
+        abandoned — and rebuild it from the factory under the replica's
+        own bounded-backoff restart budget, while every sibling keeps
+        serving untouched. A `drained` replica restarts back into the
+        fleet (the re-add path). Returns False when the replica is
+        already restarting, mid-drain (the drain owns its fate),
+        removed, the pool is closed, or there is no factory."""
+        idx = self._resolve_idx(replica)
+        with self._lock:
+            st = self._states[idx]
+            if (self._closed or self._factory is None
+                    or st.state in ("restarting", "draining", "removed")):
+                return False
+            st.state = "restarting"
+            if reason == "stalled":
+                st.stalls += 1
+            st.last_crash = reason
+        if reason == "stalled":
+            resilience.inc("replica_stalls")
+        self._pool_flight.event("replica_restart_requested",
+                                replica=st.label, reason=reason)
+        _log.warning("replica %s restart requested (%s)", st.label, reason)
+        self._spawn_restart(idx)
+        return True
+
+    def _spawn_restart(self, idx: int) -> None:
+        threading.Thread(
+            target=self._restart_driver, args=(idx,), daemon=True,
+            name=f"lsot-pool-restart-{self._states[idx].label}",
+        ).start()
+
+    def _build_replica(self, idx: int):
+        return (self._factory(idx) if self._factory_takes_index
+                else self._factory())
+
+    def _restart_driver(self, idx: int) -> None:
+        """One thread per replica restart episode: bounded teardown of
+        the corpse, backoff under the per-replica budget, rebuild + warm
+        + swap. Budget exhausted (or rebuild failures burning it) marks
+        only THIS replica dead — siblings carry the fleet."""
+        st = self._states[idx]
+        while True:
+            old = self.schedulers[idx]
+            try:
+                if old is not None:
+                    old.shutdown(timeout=self._replica_join_s)
+            except Exception:  # noqa: BLE001 — a broken corpse must not stop the rebuild
+                _log.exception("replica %s teardown failed; continuing",
+                               st.label)
+            with self._lock:
+                if self._closed:
+                    return
+                if st.restarts >= self.max_restarts:
+                    st.state = "dead"
+                    st.restart_eta = None
+                    self._pool_flight.event("replica_dead",
+                                            replica=st.label,
+                                            restarts=st.restarts)
+                    _log.error(
+                        "replica %s dead: restart budget exhausted "
+                        "(%d/%d)", st.label, st.restarts, self.max_restarts,
+                    )
+                    return
+                attempt = st.restarts
+                st.restarts += 1
+            resilience.inc("replica_restarts")
+            delay = self._restart_policy.delay_s(attempt, self._rng)
+            with self._lock:
+                # Published for retry_after_hint: hints quoted while this
+                # replica is down promise at least the backoff remaining.
+                st.restart_eta = time.monotonic() + delay
+            self._sleep(delay)
+            try:
+                fresh = self._build_replica(idx)
+                # Warm BEFORE serving, like the supervisor's restart
+                # driver: a rebuilt scheduler's cold XLA compiles block
+                # its loop exactly like the wedge this restart may be
+                # recovering from.
+                warm = getattr(fresh, "warmup", None)
+                if callable(warm):
+                    warm()
+                fresh.start()
+            except Exception:  # noqa: BLE001 — rebuild failure burns one credit
+                _log.exception("replica %s rebuild failed (restart %d/%d)",
+                               st.label, attempt + 1, self.max_restarts)
+                continue
+            with self._lock:
+                if self._closed or st.state != "restarting":
+                    # Pool going away, or a drain/remove raced the
+                    # rebuild and owns the replica now: don't swap a
+                    # fresh scheduler into a slot someone else decided
+                    # the fate of.
+                    fresh.shutdown()
+                    return
+                fl = getattr(fresh, "flight", None)
+                if fl is not None:
+                    fl.replica = st.label
+                self.schedulers[idx] = fresh
+                # Degraded until a clean completion lands on it (the
+                # submit-path done-callback promotes it back to ready).
+                st.state = "degraded"
+                st.restart_eta = None
+            self._pool_flight.event("replica_restart", replica=st.label,
+                                    attempt=st.restarts)
+            _log.info("replica %s restarted (%d/%d)", st.label,
+                      st.restarts, self.max_restarts)
+            cb = self.on_replica_restart
+            if cb is not None:
+                try:
+                    cb(st.label)
+                except Exception:  # noqa: BLE001 — replay hook must not kill the driver
+                    _log.exception("on_replica_restart(%s) failed", st.label)
+            return
+
+    def drain_replica(self, replica, deadline_s: Optional[float] = None,
+                      remove: bool = False) -> Dict[str, object]:
+        """Runtime drain of ONE replica: stop placing on it, RE-PLACE its
+        queued-not-yet-admitted requests onto the least-loaded siblings
+        (acknowledged work is never shed by a drain), give in-flight
+        work up to `deadline_s` to finish (None = wait; <= 0 = none),
+        then shut the replica down with a bounded join. `remove=True`
+        marks it permanently out of the fleet; otherwise it parks as
+        `drained` and `restart_replica()` can bring it back. SIGTERM
+        semantics at the pool level are untouched — this is the
+        one-replica twin of the supervisor's drain."""
+        idx = self._resolve_idx(replica)
+        with self._lock:
+            st = self._states[idx]
+            if st.state in ("draining", "removed"):
+                return {"replica": st.label, "state": st.state,
+                        "replaced": 0}
+            st.state = "draining"
+            sched = self.schedulers[idx]
+        self._pool_flight.event("replica_drain", replica=st.label,
+                                deadline_s=deadline_s, remove=remove)
+        # Re-place queued work BEFORE waiting on in-flight: the queue
+        # would otherwise drain into the replica we are emptying.
+        replaced = 0
+        extract = getattr(sched, "extract_queued", None)
+        if callable(extract):
+            for req in extract():
+                target = None
+                cands = self._placeable()
+                if cands:
+                    target = min(
+                        ((self._score(s), i, s) for (i, _st, s) in cands),
+                        key=lambda t: (t[0][0], t[0][1], t[1]),
+                    )[2]
+                if target is not None and callable(
+                        getattr(target, "requeue", None)):
+                    target.requeue(req)
+                    replaced += 1
+                else:
+                    # No sibling can take it: leave it on the draining
+                    # replica — it serves out its queue inside the grace
+                    # (a lone-replica drain degenerates to a plain drain).
+                    sched.requeue(req)
+        if replaced:
+            self._pool_flight.event("replica_drain_replaced",
+                                    replica=st.label, replaced=replaced)
+        # Bounded grace for in-flight + whatever stayed queued.
+        busy = getattr(sched, "_busy_now", None)
+        deadline = (Deadline.after(deadline_s)
+                    if deadline_s is not None and deadline_s > 0 else None)
+        wait_all = deadline_s is None
+        finished = True
+        while callable(busy):
+            try:
+                if not busy():
+                    break
+            except Exception:  # noqa: BLE001 — a dying replica mid-read
+                break
+            if not wait_all and (deadline is None
+                                 or deadline.remaining() <= 0):
+                finished = False
+                break
+            time.sleep(0.01)
+        try:
+            sched.shutdown(timeout=self._replica_join_s)
+        except Exception:  # noqa: BLE001 — a wedged corpse must not fail the drain
+            _log.exception("replica %s drain shutdown failed", st.label)
+        with self._lock:
+            # Only finalize if the drain still owns the slot: a racing
+            # restart_replica is refused while state == "draining", so
+            # anything else here means someone else took over — don't
+            # mark a live replica drained out from under them.
+            if st.state == "draining":
+                st.state = "removed" if remove else "drained"
+        self._pool_flight.event("replica_drained", replica=st.label,
+                                replaced=replaced, finished=finished,
+                                removed=remove)
+        cb = self.on_replica_drained
+        if cb is not None:
+            try:
+                # The supervisor's re-placement seam: journaled requests
+                # still attributed to this replica (in-flight work the
+                # grace did not finish) re-place onto the fleet.
+                cb(st.label)
+            except Exception:  # noqa: BLE001 — replay hook best-effort
+                _log.exception("on_replica_drained(%s) failed", st.label)
+        return {"replica": st.label,
+                "state": "removed" if remove else "drained",
+                "replaced": replaced, "finished": finished}
+
+    def remove_replica(self, replica,
+                       deadline_s: Optional[float] = None) -> Dict[str, object]:
+        """Drain + permanently remove one replica from the fleet."""
+        return self.drain_replica(replica, deadline_s=deadline_s,
+                                  remove=True)
+
+    def stalled_replicas(self, factor: float, floor_s: float) -> List[str]:
+        """Labels of SERVING replicas whose busy heartbeat has gone stale
+        past their own stall threshold — the supervisor's watchdog feed
+        for targeted restarts. Replicas already restarting/draining/dead
+        are excluded (their stale corpses are being handled)."""
+        from .watchdog import stall_threshold
+
+        out: List[str] = []
+        for st, s in self._replica_items(_ReplicaState.PLACEABLE):
+            hb = getattr(s, "heartbeat", None)
+            if hb is None or not hb.busy:
+                continue
+            if hb.age() > stall_threshold(hb, factor, floor_s):
+                out.append(st.label)
+        return out
+
+    # ----------------------------------------------------------- health
+
+    def replica_health(self) -> List[Dict[str, object]]:
+        """Per-replica lifecycle for /healthz + /readyz + /metrics:
+        state, restart/stall budgets, crash flag, restart ETA."""
+        now = time.monotonic()
+        out = []
+        for st, s in self._replica_items():
+            rec: Dict[str, object] = {
+                "replica": st.label,
+                "state": st.state,
+                "restarts": st.restarts,
+                "max_restarts": self.max_restarts,
+                "stalls": st.stalls,
+                "crashed": getattr(s, "_crash", None) is not None,
+            }
+            if st.last_crash:
+                rec["last_crash"] = st.last_crash
+            if st.restart_eta is not None:
+                rec["restart_eta_s"] = round(max(0.0, st.restart_eta - now),
+                                             3)
+            out.append(rec)
+        return out
+
+    def health(self) -> Dict[str, object]:
+        """Aggregate fleet state, shaped like the supervisor's health()
+        payload (/readyz consumes either): `ready` — every replica
+        serving clean; `degraded` — serving, but some replica is
+        restarting/drained/dead or not yet proven after a restart;
+        `restarting` — NO replica serving but at least one rebuild in
+        flight; `dead` — the fleet is gone. Plus the per-replica list."""
+        reps = self.replica_health()
+        # Removed replicas LEFT the fleet (a deliberate scale-down): they
+        # stay visible in the replicas list but must not degrade the
+        # aggregate forever.
+        states = [r["state"] for r in reps if r["state"] != "removed"]
+        serving = [s for s in states if s in _ReplicaState.PLACEABLE]
+        if serving:
+            state = ("ready" if all(s == "ready" for s in states)
+                     else "degraded")
+        elif "restarting" in states:
+            state = "restarting"
+        else:
+            state = "dead"
+        return {
+            "state": state,
+            "replicas": reps,
+            "restarts": sum(int(r["restarts"]) for r in reps),
+            "stalls": sum(int(r["stalls"]) for r in reps),
+        }
+
+    @property
+    def prefix_stats(self) -> Dict[str, int]:
+        """Summed prefix-cache stats across replicas (SchedulerBackend
+        duck typing — each replica owns an independent cache)."""
+        out: Dict[str, int] = {"hits": 0, "blocks_reused": 0,
+                               "cached_blocks": 0}
+        for s in self.schedulers:
+            st = getattr(s, "prefix_stats", None)
+            if isinstance(st, dict):
+                for k in out:
+                    out[k] += int(st.get(k, 0))
+        return out
+
+    @property
+    def speculation_stats(self) -> Optional[Dict[str, float]]:
+        """First replica's acceptance view (replicas are homogeneous;
+        None when speculation is off) — SchedulerBackend duck typing."""
+        return getattr(self.schedulers[0], "speculation_stats", None)
 
     def generate(self, prompts, max_new_tokens: int = 256,
                  sampling: SamplingParams = SamplingParams(), seed: int = 0):

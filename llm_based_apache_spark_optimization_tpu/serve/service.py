@@ -217,6 +217,28 @@ class GenerationService:
             **totals,
         }
 
+    def fleet_health(self) -> Dict[str, list]:
+        """Per-replica lifecycle per model, for backends serving from a
+        replica fleet (SchedulerPool / a supervisor wrapping one):
+        {model: [{replica, state, restarts, ...}]}. Empty for single-
+        scheduler and engine backends. Surfaced on /healthz so one probe
+        shows WHICH replica is restarting/dead, and deduped by underlying
+        scheduler like health() (shared-weights aliasing)."""
+        out: Dict[str, list] = {}
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            sched = getattr(e.backend, "scheduler", None)
+            fn = getattr(sched, "replica_health", None)
+            if callable(fn):
+                try:
+                    reps = fn()
+                except Exception:  # noqa: BLE001 — a churning fleet mid-read
+                    continue
+                if reps:
+                    out[e.name] = reps
+        return out
+
     def supports_idempotency(self, model: str) -> bool:
         """Can `model`'s backend dedupe an idempotency key against a
         journal? The drain gate uses this to decide whether a keyed

@@ -145,6 +145,11 @@ class JournalEntry:
     # replayed incarnation records into the SAME tree), and its span tree
     # rides the postmortem dump for requests caught in a crash/stall.
     trace: object = None
+    # Fleet pools (targeted restart): how many times this entry has been
+    # re-placed onto a sibling after a single-replica crash — the bound
+    # that stops an entry ping-ponging across a fleet of dying replicas
+    # instead of escalating to the full-pool restart path.
+    replica_replays: int = 0
 
 
 class SupervisedScheduler:
@@ -193,6 +198,11 @@ class SupervisedScheduler:
         self.name = name
         self._factory = factory
         self._inner = factory()
+        # Fleet pools (SchedulerPool with a replica factory): wire the
+        # pool's replica-lifecycle callbacks at THIS layer — the journal
+        # lives here, so the pool tells us when a targeted restart/drain
+        # finished and we re-place exactly that replica's requests.
+        self._wire_fleet(self._inner)
         self.max_restarts = max_restarts
         self._restart_policy = restart_policy or RetryPolicy(
             max_attempts=max_restarts + 1, base_delay_s=0.1, max_delay_s=5.0
@@ -420,6 +430,29 @@ class SupervisedScheduler:
             base = max(base, eta - time.monotonic())
         return float(min(60.0, max(1.0, base)))
 
+    # Fleet passthroughs (inner SchedulerPool): runtime per-replica ops
+    # and the per-replica load/health views keep working through the
+    # supervision layer — the journal on THIS side re-places whatever a
+    # targeted restart or drain leaves behind (the wired callbacks).
+    def restart_replica(self, replica, reason: str = "manual") -> bool:
+        fn = getattr(self._inner, "restart_replica", None)
+        return bool(fn(replica, reason=reason)) if callable(fn) else False
+
+    def drain_replica(self, replica, deadline_s: Optional[float] = None,
+                      remove: bool = False) -> Dict[str, object]:
+        fn = getattr(self._inner, "drain_replica", None)
+        if not callable(fn):
+            raise ValueError("inner scheduler has no replica fleet")
+        return fn(replica, deadline_s=deadline_s, remove=remove)
+
+    def replica_loads(self) -> List[Dict[str, object]]:
+        fn = getattr(self._inner, "replica_loads", None)
+        return fn() if callable(fn) else []
+
+    def replica_health(self) -> List[Dict[str, object]]:
+        fn = getattr(self._inner, "replica_health", None)
+        return fn() if callable(fn) else []
+
     # ---------------------------------------------------------------- client
 
     def submit(
@@ -578,7 +611,7 @@ class SupervisedScheduler:
         escalation rides the crash path), with `stalls` counting how many
         times liveness — not an exception — triggered the recovery."""
         with self._lock:
-            return {
+            out = {
                 "state": self._state,
                 "draining": self._draining,
                 "restarts": self._restarts,
@@ -592,6 +625,16 @@ class SupervisedScheduler:
                 "last_crash": (str(self._crash_exc)
                                if self._crash_exc is not None else None),
             }
+        # Fleet pools: per-replica lifecycle beside the pool-level state —
+        # /readyz shows WHICH replica is restarting/dead, not just that
+        # something somewhere is.
+        rh = getattr(self._inner, "replica_health", None)
+        if callable(rh):
+            try:
+                out["replicas"] = rh()
+            except Exception:  # noqa: BLE001 — a churning pool mid-read
+                pass
+        return out
 
     @property
     def heartbeat(self):
@@ -974,6 +1017,13 @@ class SupervisedScheduler:
                     self._state = "ready"
                 return
             if self._is_crash(exc):
+                # Fleet pools: a SINGLE replica's crash gets a targeted
+                # restart and this entry re-places onto a sibling — the
+                # whole-pool teardown (which would restart every healthy
+                # replica and replay their work too) is reserved for the
+                # fleet actually being gone.
+                if self._try_fleet_replay_locked(entry, fut, exc):
+                    return
                 # The entry stays journaled: restart + replay owns it now.
                 self._notice_crash_locked(self._wrap_crash(exc))
                 return
@@ -1107,6 +1157,7 @@ class SupervisedScheduler:
                     inner.shutdown()
                     return
                 self._inner = inner
+                self._wire_fleet(inner)
                 try:
                     lost = self._replay_locked()
                 except _CrashedAgain:
@@ -1127,6 +1178,90 @@ class SupervisedScheduler:
                 )
                 return
 
+    def _replay_one_locked(self, e: JournalEntry,
+                           defer_on_overload: bool = False) -> str:
+        """Replay ONE journal entry onto the current inner: the shared
+        core of the full-restart replay pass and the fleet pools'
+        per-replica re-placement. Returns `"replayed"`, `"lost"` (failed
+        typed), `"skipped"` (done/cancelled), or `"deferred"` (kept
+        journaled for a later pass — only with `defer_on_overload`, the
+        fleet case where a shed now would drop acknowledged work that a
+        finishing replica rebuild is about to have room for). Raises
+        `_CrashedAgain` when the inner dies under the resubmit."""
+        if e.done:
+            return "skipped"
+        if e.cancelled:
+            # The consumer already gave up: resolve with what it got
+            # (the bare scheduler's cancel contract), don't re-decode.
+            self._finish_locked(e, list(e.generated))
+            return "skipped"
+        if e.deadline is not None and e.deadline.expired():
+            resilience.inc("deadline_expired")
+            resilience.inc("sched_lost")
+            self._lost += 1
+            self._fail_locked(e, DeadlineExceeded(
+                f"request deadline expired during scheduler restart "
+                f"with {len(e.generated)} of {e.max_new} tokens "
+                f"delivered"
+            ))
+            return "lost"
+        if not e.idempotent and e.generated:
+            # Tokens already reached a consumer that declared itself
+            # replay-unsafe: failing typed beats double-applying.
+            resilience.inc("sched_lost")
+            self._lost += 1
+            self._fail_locked(e, self._wrap_crash(
+                self._crash_exc
+                or SchedulerCrashed("scheduler loop crashed")
+            ))
+            return "lost"
+        try:
+            self._submit_entry_locked(e)
+        except DeadlineExceeded as exc:
+            resilience.inc("sched_lost")
+            self._lost += 1
+            self._fail_locked(e, exc)
+            return "lost"
+        except Overloaded as exc:
+            if defer_on_overload:
+                # Fleet re-placement with nowhere to place right now
+                # (e.g. a pool-of-one mid-rebuild): keep the entry
+                # journaled — the pool's on_replica_restart callback
+                # replays it once the rebuild lands.
+                return "deferred"
+            # A fresh loop's queue should hold the journal; a cap
+            # smaller than the backlog is a deployment error — fail
+            # typed rather than spin the restart thread.
+            resilience.inc("sched_lost")
+            self._lost += 1
+            self._fail_locked(e, exc)
+            return "lost"
+        except Exception as exc:  # noqa: BLE001 — crash classification
+            if self._is_crash(exc):
+                self._crash_exc = self._wrap_crash(exc)
+                self._breaker.record_failure()
+                raise _CrashedAgain() from exc
+            resilience.inc("sched_lost")
+            self._lost += 1
+            self._fail_locked(e, exc)
+            return "lost"
+        if not e.done and e.inner is not None and e.inner.done():
+            # The fresh loop killed this submit before its callback
+            # was even attached: the callback ran INLINE on this
+            # thread (RLock), where _notice_crash_locked's
+            # single-flight guard no-ops because WE are the restart
+            # driver. Detect it here — otherwise the entry would stay
+            # journaled forever with a dead inner future and its
+            # client would hang.
+            exc2 = e.inner.exception()
+            if exc2 is not None and self._is_crash(exc2):
+                self._crash_exc = self._wrap_crash(exc2)
+                self._breaker.record_failure()
+                raise _CrashedAgain()
+        self._replayed += 1
+        resilience.inc("sched_replayed")
+        return "replayed"
+
     def _replay_locked(self) -> int:
         """Resubmit journaled work in rid order. Returns how many
         acknowledged requests were LOST (failed typed instead of
@@ -1135,79 +1270,126 @@ class SupervisedScheduler:
         replay itself."""
         lost = 0
         for rid in sorted(self._journal):
-            e = self._journal[rid]
-            if e.done:
-                continue
-            if e.cancelled:
-                # The consumer already gave up: resolve with what it got
-                # (the bare scheduler's cancel contract), don't re-decode.
-                self._finish_locked(e, list(e.generated))
-                continue
-            if e.deadline is not None and e.deadline.expired():
-                resilience.inc("deadline_expired")
-                resilience.inc("sched_lost")
-                self._lost += 1
+            if self._replay_one_locked(self._journal[rid]) == "lost":
                 lost += 1
-                self._fail_locked(e, DeadlineExceeded(
-                    f"request deadline expired during scheduler restart "
-                    f"with {len(e.generated)} of {e.max_new} tokens "
-                    f"delivered"
-                ))
-                continue
-            if not e.idempotent and e.generated:
-                # Tokens already reached a consumer that declared itself
-                # replay-unsafe: failing typed beats double-applying.
-                resilience.inc("sched_lost")
-                self._lost += 1
-                lost += 1
-                self._fail_locked(e, self._wrap_crash(
-                    self._crash_exc
-                    or SchedulerCrashed("scheduler loop crashed")
-                ))
-                continue
-            try:
-                self._submit_entry_locked(e)
-            except DeadlineExceeded as exc:
-                resilience.inc("sched_lost")
-                self._lost += 1
-                lost += 1
-                self._fail_locked(e, exc)
-                continue
-            except Overloaded as exc:
-                # A fresh loop's queue should hold the journal; a cap
-                # smaller than the backlog is a deployment error — fail
-                # typed rather than spin the restart thread.
-                resilience.inc("sched_lost")
-                self._lost += 1
-                lost += 1
-                self._fail_locked(e, exc)
-                continue
-            except Exception as exc:  # noqa: BLE001 — crash classification
-                if self._is_crash(exc):
-                    self._crash_exc = self._wrap_crash(exc)
-                    self._breaker.record_failure()
-                    raise _CrashedAgain() from exc
-                resilience.inc("sched_lost")
-                self._lost += 1
-                lost += 1
-                self._fail_locked(e, exc)
-                continue
-            if not e.done and e.inner is not None and e.inner.done():
-                # The fresh loop killed this submit before its callback
-                # was even attached: the callback ran INLINE on this
-                # thread (RLock), where _notice_crash_locked's
-                # single-flight guard no-ops because WE are the restart
-                # driver. Detect it here — otherwise the entry would stay
-                # journaled forever with a dead inner future and its
-                # client would hang.
-                exc2 = e.inner.exception()
-                if exc2 is not None and self._is_crash(exc2):
-                    self._crash_exc = self._wrap_crash(exc2)
-                    self._breaker.record_failure()
-                    raise _CrashedAgain()
-            self._replayed += 1
-            resilience.inc("sched_replayed")
         return lost
+
+    # ----------------------------------------------------- fleet (pools)
+
+    def _fleet_inner(self):
+        """The inner when it is a fleet pool (SchedulerPool with a
+        replica factory): targeted restart + per-replica replay replace
+        the whole-pool teardown for single-replica failures."""
+        inner = self._inner
+        return inner if getattr(inner, "supports_replica_restart",
+                                False) else None
+
+    def _wire_fleet(self, inner) -> None:
+        """Point a fleet pool's replica-lifecycle callbacks at this
+        journal: after a targeted restart/drain completes, re-place
+        exactly that replica's outstanding requests."""
+        if getattr(inner, "supports_replica_restart", False):
+            inner.on_replica_restart = self._on_replica_restarted
+            inner.on_replica_drained = self._replay_replica
+
+    def _on_replica_restarted(self, label: str) -> None:
+        """A targeted replica rebuild just landed: re-open the warmup
+        grace window BEFORE replaying — the fresh replica's lazy XLA
+        compiles block its loop exactly like the wedge that triggered
+        the rebuild (the pool's driver warms it, but warmup covers one
+        prompt bucket; the replayed traffic's bucket can still compile
+        cold), and without the grace the watchdog would re-flag the
+        rebuild and burn the replica's budget on compiles — the same
+        cascade the full-restart path already guards against."""
+        with self._lock:
+            self._grace_until = time.monotonic() + self.warmup_grace_s
+        self._replay_replica(label)
+
+    @staticmethod
+    def _is_teardown_runtime(exc: Optional[BaseException]) -> bool:
+        return (isinstance(exc, RuntimeError)
+                and str(exc) == "scheduler shut down mid-request")
+
+    def _replay_replica(self, label: str,
+                        defer_on_overload: bool = False) -> int:
+        """Re-place the journaled requests still ATTRIBUTED to replica
+        `label` — inner futures that will never resolve (a wedged corpse
+        abandoned by a targeted restart), teardown crossfire
+        (RuntimeError from the replica's clean close), or a crash the
+        inline fleet path deferred — onto the current fleet in rid
+        order. Entries already re-placed carry a different (or live)
+        inner and are skipped, so the pass is idempotent. Returns how
+        many entries were resubmitted."""
+        replayed = 0
+        with self._lock:
+            if self._closed or self._state == "dead":
+                return 0
+            for rid in sorted(self._journal):
+                e = self._journal[rid]
+                if e.done:
+                    continue
+                if e.inner is None:
+                    # A DEFERRED fleet re-placement (the prior attempt
+                    # was invalidated and nothing could take the work
+                    # mid-rebuild): claim it regardless of label — it
+                    # has no attribution left, and this callback fires
+                    # exactly when capacity returned.
+                    pass
+                elif getattr(e.inner, "_lsot_replica", None) != label:
+                    continue
+                elif e.inner.done():
+                    exc = e.inner.exception()
+                    if not (self._is_teardown_runtime(exc)
+                            or self._is_crash(exc)):
+                        continue  # resolved for real: nothing to recover
+                try:
+                    if self._replay_one_locked(
+                            e, defer_on_overload=defer_on_overload) \
+                            == "replayed":
+                        replayed += 1
+                except _CrashedAgain:
+                    # The whole fleet is gone under the re-placement:
+                    # the standard full-pool crash path owns recovery.
+                    self._notice_crash_locked(self._wrap_crash(
+                        self._crash_exc
+                        or SchedulerCrashed("fleet replay crashed")
+                    ))
+                    return replayed
+        if replayed:
+            self.flight.event("replica_replay", replica=label,
+                              replayed=replayed)
+        return replayed
+
+    def _try_fleet_replay_locked(self, entry: JournalEntry, fut: Future,
+                                 exc: BaseException) -> bool:
+        """A journaled request's inner future failed with a crash while
+        the inner is a fleet pool: notify the pool (targeted restart of
+        the crashed replica) and re-place THIS entry on a sibling
+        immediately, instead of escalating to the whole-pool teardown.
+        Returns True when the entry was handled (re-placed, deferred for
+        the post-rebuild pass, or terminally failed) — False falls back
+        to the full crash path."""
+        inner = self._fleet_inner()
+        if (inner is None or self._closed
+                or self._state not in ("ready", "degraded")):
+            return False
+        label = getattr(fut, "_lsot_replica", None)
+        if label:
+            try:
+                inner.notice_replica_crash(label, exc)
+            except Exception:  # noqa: BLE001 — restart kick is best-effort
+                _log.exception("notice_replica_crash(%s) failed", label)
+        entry.replica_replays += 1
+        cap = len(getattr(inner, "schedulers", ())) + 1
+        if entry.replica_replays > max(2, cap):
+            # Ping-ponging across a fleet of dying replicas: stop playing
+            # whack-a-mole and let the full-pool restart own it.
+            return False
+        try:
+            self._replay_one_locked(entry, defer_on_overload=True)
+        except _CrashedAgain:
+            return False
+        return True
 
     def _shutdown_inner(self, sched) -> None:
         """Shut an inner scheduler down with a bounded join when it
@@ -1343,6 +1525,37 @@ class SupervisedScheduler:
                 inner = self._inner
             hb = getattr(inner, "heartbeat", None)
             if hb is None or not hb.busy:
+                continue
+            if getattr(inner, "supports_replica_restart", False) and \
+                    callable(getattr(inner, "stalled_replicas", None)):
+                # Fleet pools: judge each replica by ITS OWN heartbeat and
+                # escalate only the stale ones to TARGETED restarts —
+                # siblings keep serving. The wedged replica's journaled
+                # requests re-place immediately (deferred if nothing can
+                # take them yet; the post-rebuild callback finishes the
+                # job). The whole-pool SchedulerStalled escalation below
+                # is reserved for non-fleet inners.
+                try:
+                    stalled = inner.stalled_replicas(
+                        self.stall_factor, self._effective_floor(hb))
+                except Exception:  # noqa: BLE001 — a churning pool mid-read
+                    stalled = []
+                for label in stalled:
+                    with self._lock:
+                        if self._closed or self._state not in (
+                                "ready", "degraded"):
+                            break
+                        if self._inner is not inner:
+                            break
+                        self._stalls += 1
+                    resilience.inc("sched_stalls")
+                    self.flight.event("replica_stall", replica=label)
+                    _log.warning(
+                        "watchdog: replica %s busy-stale past its stall "
+                        "threshold; targeted restart", label,
+                    )
+                    if inner.restart_replica(label, reason="stalled"):
+                        self._replay_replica(label, defer_on_overload=True)
                 continue
             age = hb.age()
             threshold = stall_threshold(hb, self.stall_factor,

@@ -41,7 +41,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 __all__ = ["CombinedHeartbeat", "Heartbeat", "stall_threshold"]
 
@@ -137,12 +137,25 @@ class CombinedHeartbeat:
     siblings keep stamping, so `age()` is the OLDEST busy replica's age
     (falling back to the oldest overall when none is busy) and `busy` is
     any-replica-busy. `expected_round_s` is the slowest replica's cadence
-    — the threshold must tolerate the pool's worst healthy round."""
+    — the threshold must tolerate the pool's worst healthy round.
 
-    def __init__(self, heartbeats: Sequence[Heartbeat]):
+    `labels` attributes each heartbeat to its replica ("r{i}" by
+    default, the pool's label vocabulary): `snapshot()` carries them,
+    and `verdicts(factor, floor_s)` turns the combined view into a
+    per-replica stall judgment — the fleet supervisor needs to know
+    WHICH replica went stale, not just that the oldest busy one did."""
+
+    def __init__(self, heartbeats: Sequence[Heartbeat],
+                 labels: Optional[Sequence[str]] = None):
         if not heartbeats:
             raise ValueError("CombinedHeartbeat needs at least one heartbeat")
         self._hbs = list(heartbeats)
+        if labels is not None and len(labels) != len(self._hbs):
+            raise ValueError(
+                f"got {len(labels)} labels for {len(self._hbs)} heartbeats"
+            )
+        self.labels = (list(labels) if labels is not None
+                       else [f"r{i}" for i in range(len(self._hbs))])
 
     @property
     def busy(self) -> bool:
@@ -172,6 +185,29 @@ class CombinedHeartbeat:
                 if v is not None]
         return max(vals) if vals else None
 
+    def verdicts(self, factor: float, floor_s: float) -> List[Dict[str, object]]:
+        """Per-replica stall judgment: for each heartbeat, its label, its
+        own age/busy, its OWN threshold (each replica is judged by its
+        own measured cadence — a slow replica must not lower the bar for
+        a fast sibling, nor vice versa), and the verdict: `stalled` is
+        True only for a BUSY replica whose age exceeds its threshold.
+        This is what makes a pool stall attributable: the combined
+        `age()` can say the oldest busy replica is stale, but only the
+        verdict list says WHICH — the targeted-restart feed."""
+        out = []
+        for label, h in zip(self.labels, self._hbs):
+            age = h.age()
+            busy = h.busy
+            threshold = stall_threshold(h, factor, floor_s)
+            out.append({
+                "replica": label,
+                "busy": busy,
+                "age_s": round(age, 3),
+                "stall_threshold_s": round(threshold, 3),
+                "stalled": bool(busy and age > threshold),
+            })
+        return out
+
     def snapshot(self) -> Dict[str, object]:
         ewma = self.expected_round_s()
         return {
@@ -179,7 +215,10 @@ class CombinedHeartbeat:
             "busy": self.busy,
             "rounds": self.rounds,
             "expected_round_s": round(ewma, 4) if ewma is not None else None,
-            "replicas": [h.snapshot() for h in self._hbs],
+            "replicas": [
+                {"replica": label, **h.snapshot()}
+                for label, h in zip(self.labels, self._hbs)
+            ],
         }
 
 

@@ -15,8 +15,14 @@ before any token of the round exists — `sched:crash` — kills it at
 harvest, MID-BATCH, after tokens may already have streamed to clients:
 the supervisor's replay-without-duplicates seam — `sched:slot_stall` —
 marks a request's slot as a silently no-progress decode lane, the
-per-slot stall-retirement seam — plus the duration-valued HANG sites
-below; grep for `FAULTS.check` to enumerate); the probability is a float
+per-slot stall-retirement seam — `sched:wedge_r{i}` — the
+replica-ADDRESSABLE fleet seam: every scheduler checks
+`sched:wedge_<its replica label>` at round issue, so
+`sched:wedge_r1:1:0.5` wedges exactly pool replica r1 (duration form)
+or `sched:wedge_r1:1` crashes it (raising form) while its siblings run
+untouched — the targeted-restart chaos trigger — plus the
+duration-valued HANG sites below; grep for `FAULTS.check` to
+enumerate); the probability is a float
 in (0, 1]. The RNG is seeded (`LSOT_FAULTS_SEED`, default 0), so the
 same spec + seed + call sequence replays the exact same fault schedule —
 chaos tests assert concrete outcomes, not distributions.

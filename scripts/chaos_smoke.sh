@@ -7,12 +7,16 @@
 # admission shedding, retry/breaker behavior at the Ollama and SQL
 # boundaries, the chaos evalh report) with LSOT_FAULTS/LSOT_FAULTS_SEED
 # pinned so the injected fault schedule — and therefore every assertion —
-# replays exactly, then runs the crash-restart AND hang-detection
+# replays exactly, then runs the crash-restart, hang-detection AND fleet
 # scenarios end to end through `evalh --chaos` (supervised scheduler
 # under sched:crash: zero hung, zero lost acknowledged requests,
-# restart/replay counts in the summary; then the watchdog stage: a
+# restart/replay counts in the summary; the watchdog stage: a
 # wedged loop detected within the stall threshold, restarted, replayed —
-# zero silently-hung clients, bounded detection latency). These tests
+# zero silently-hung clients, bounded detection latency; and the FLEET
+# stage: one pool replica wedged via the replica-addressable
+# sched:wedge_r1 site — only that replica restarts, sibling restart
+# counters stay zero, its journaled requests re-place onto siblings,
+# outputs token-identical to a wedge-free control). These tests
 # are NOT marked slow: the default tier-1 run (`pytest -m 'not slow'`)
 # includes them; this script is the focused lane for iterating on the
 # fault-tolerance layer.
@@ -27,13 +31,16 @@ export JAX_PLATFORMS=cpu
 
 python -m pytest tests -q -m chaos -p no:cacheprovider "$@"
 
-# Crash-restart + hang-detection scenarios in the default lane: the
-# supervised scheduler must survive injected mid-batch loop deaths with
-# zero lost acknowledged requests, and the watchdog must detect an
+# Crash-restart + hang-detection + fleet scenarios in the default lane:
+# the supervised scheduler must survive injected mid-batch loop deaths
+# with zero lost acknowledged requests, the watchdog must detect an
 # injected WEDGE (sched:hang — the loop sleeps, nothing raises) and
-# recover it with zero silently-hung clients (run_chaos asserts both;
-# the JSON summary shows restarts/replayed/lost and the watchdog stage's
-# stalls/detection bound).
+# recover it with zero silently-hung clients, and a supervised FLEET
+# pool with one replica wedged must recover it with a TARGETED restart
+# — siblings untouched, zero lost (run_chaos asserts all three; the
+# JSON summary shows restarts/replayed/lost, the watchdog stage's
+# stalls/detection bound, and the fleet stage's per-replica restart
+# attribution).
 LSOT_FAULTS= python -m llm_based_apache_spark_optimization_tpu.evalh \
   --chaos "ollama:connect:0.5,sql:exec:1,sched:crash:0.2" \
   --chaos-seed "${LSOT_FAULTS_SEED}"

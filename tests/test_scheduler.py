@@ -513,3 +513,259 @@ def test_slot_stall_retired_typed_batch_unaffected(tiny_model_module):
         assert sched.watchdog_stats["slots_retired_stalled"] == 1
     finally:
         FAULTS.clear()
+
+
+# ----------------------------------------------------- fleet pool (ISSUE 9)
+
+
+class _FakeReplica:
+    """Host-only replica with the pool's placement surface: a scripted
+    backlog score, an Overloaded switch, and instant deterministic
+    results — every routing decision is inspectable without a device."""
+
+    def __init__(self, secs=0.0, toks=0, hint=1.0):
+        from concurrent.futures import Future  # noqa: F401 — used below
+
+        from llm_based_apache_spark_optimization_tpu.serve.flightrecorder import (
+            FlightRecorder,
+        )
+
+        self._crash = None
+        self.flight = FlightRecorder(capacity=8)
+        self.secs, self.toks, self.hint = secs, toks, hint
+        self.overloaded = False
+        self.submitted = []
+
+    def start(self):
+        return self
+
+    def shutdown(self, timeout=None):
+        pass
+
+    def backlog_score(self):
+        return self.secs, self.toks
+
+    def retry_after_hint(self):
+        return self.hint
+
+    def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
+               on_token=None, constraint=None, deadline_s=None, trace=None):
+        from concurrent.futures import Future
+
+        from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+            Overloaded,
+        )
+
+        if self.overloaded:
+            raise Overloaded("fake full", retry_after_s=self.hint)
+        self.submitted.append(list(ids))
+        fut = Future()
+        fut.set_result(list(ids))
+        return fut
+
+
+def _fake_pool(*replicas, **kw):
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        SchedulerPool,
+    )
+
+    return SchedulerPool(list(replicas), **kw)
+
+
+def test_pool_least_loaded_routes_to_lightest_replica():
+    """The router places on the replica with the smallest backlog
+    estimate (queue-depth × service-time EWMA math via backlog_score),
+    attributes the future, and records the placement decision in the
+    pool's flight recorder."""
+    heavy, light = _FakeReplica(secs=5.0), _FakeReplica(secs=0.25)
+    pool = _fake_pool(heavy, light)
+    fut = pool.submit([1, 2, 3])
+    assert fut.result() == [1, 2, 3]
+    assert light.submitted and not heavy.submitted
+    assert fut._lsot_replica == "r1"
+    placements = [r for r in pool.flight_snapshot()
+                  if r.get("kind") == "placement"]
+    assert placements and placements[-1]["to"] == "r1"
+    assert placements[-1]["router"] == "least_loaded"
+    # Equal seconds: the token-weighted backlog breaks the tie.
+    a, b = _FakeReplica(secs=1.0, toks=500), _FakeReplica(secs=1.0, toks=3)
+    pool2 = _fake_pool(a, b)
+    pool2.submit([4])
+    assert b.submitted and not a.submitted
+
+
+def test_pool_deadline_aware_skip_and_504_when_infeasible():
+    """A replica whose backlog would blow the request's deadline is
+    skipped even when it is the least loaded by index order; when EVERY
+    replica's backlog exceeds the deadline the pool sheds typed
+    DeadlineExceeded (504) instead of burning the budget in a queue."""
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        DeadlineExceeded,
+    )
+
+    backed_up, fresh = _FakeReplica(secs=10.0), _FakeReplica(secs=0.2)
+    pool = _fake_pool(backed_up, fresh)
+    pool.submit([1], deadline_s=1.0)
+    assert fresh.submitted and not backed_up.submitted
+    backed_up.secs = fresh.secs = 30.0
+    with pytest.raises(DeadlineExceeded, match="no replica can serve"):
+        pool.submit([2], deadline_s=1.0)
+    # Without a deadline the same backlog is simply the queue they join.
+    pool.submit([3])
+    assert len(backed_up.submitted) + len(fresh.submitted) == 2
+
+
+def test_pool_all_full_sheds_with_min_retry_after():
+    """One full replica no longer answers for the fleet: the pool sheds
+    Overloaded only when EVERY placeable replica is at capacity, and the
+    hint is the fleet's MINIMUM Retry-After, not whichever replica
+    happened to shed last."""
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        Overloaded,
+    )
+
+    a, b = _FakeReplica(hint=7.0), _FakeReplica(hint=3.0)
+    a.overloaded = True
+    pool = _fake_pool(a, b)
+    pool.submit([1])  # b has room: no shed
+    assert b.submitted
+    b.overloaded = True
+    with pytest.raises(Overloaded) as exc_info:
+        pool.submit([2])
+    assert exc_info.value.retry_after_s == pytest.approx(3.0)
+
+
+def test_pool_retry_after_hint_restart_aware():
+    """ISSUE 9 satellite: a RESTARTING replica's stale EWMA must not
+    drive the pool hint — it contributes its restart-backoff remaining
+    instead, and the hint is the min over placeable replicas."""
+    import time as _t
+
+    a, b = _FakeReplica(hint=9.0), _FakeReplica(hint=0.5)
+    pool = _fake_pool(a, b)
+    assert pool.retry_after_hint() == pytest.approx(1.0)  # clamped floor
+    b.hint = 4.0
+    assert pool.retry_after_hint() == pytest.approx(4.0)
+    # b restarting with 2 s of backoff left: its (stale) 4.0 estimate is
+    # ignored; the hint becomes min(a's 9.0, b's backoff 2.0) = ~2.0.
+    pool._states[1].state = "restarting"
+    pool._states[1].restart_eta = _t.monotonic() + 2.0
+    hint = pool.retry_after_hint()
+    assert 1.0 <= hint <= 2.05
+    # Dead replicas contribute nothing: only a's estimate remains.
+    pool._states[1].state = "dead"
+    assert pool.retry_after_hint() == pytest.approx(9.0)
+
+
+def test_pool_health_aggregates_replica_states():
+    a, b = _FakeReplica(), _FakeReplica()
+    pool = _fake_pool(a, b)
+    h = pool.health()
+    assert h["state"] == "ready"
+    assert [r["replica"] for r in h["replicas"]] == ["r0", "r1"]
+    pool._states[0].state = "restarting"
+    assert pool.health()["state"] == "degraded"
+    pool._states[1].state = "dead"
+    assert pool.health()["state"] == "restarting"
+    pool._states[0].state = "dead"
+    assert pool.health()["state"] == "dead"
+    # A deliberately REMOVED replica stays visible but must not degrade
+    # the aggregate of a healthy remainder forever.
+    pool._states[0].state = "removed"
+    pool._states[1].state = "ready"
+    h = pool.health()
+    assert h["state"] == "ready"
+    assert [r["state"] for r in h["replicas"]] == ["removed", "ready"]
+
+
+def test_pool_restart_refused_while_drain_owns_the_replica():
+    """A racing restart_replica must not hijack a replica mid-drain (the
+    drain's final state write would mark the freshly rebuilt scheduler
+    drained out from under it); removed replicas are gone for good."""
+    a, b = _FakeReplica(), _FakeReplica()
+    pool = _fake_pool(a, b, factory=lambda i: _FakeReplica())
+    pool._states[0].state = "draining"
+    assert pool.restart_replica("r0") is False
+    pool._states[0].state = "removed"
+    assert pool.restart_replica("r0") is False
+
+
+@pytest.mark.slow
+def test_pool_drain_replica_replaces_queued_work(tiny_model_module):
+    """Runtime drain of ONE replica: its queued requests re-place onto
+    the sibling (nothing shed, outputs stay engine-exact), in-flight
+    work finishes inside the grace, the replica parks `drained` and
+    placement skips it — while the pool keeps serving."""
+    from llm_based_apache_spark_optimization_tpu.serve import SchedulerPool
+
+    cfg, params = tiny_model_module
+    prompts = [[1, 5 + i] for i in range(6)]
+    golden = engine_golden(cfg, params, prompts, max_new=4)
+    pool = SchedulerPool(
+        [make_sched(cfg, params, num_slots=1),
+         make_sched(cfg, params, num_slots=1)],
+    )
+    with pool:
+        futs = [pool.submit(p, max_new_tokens=4) for p in prompts]
+        report = pool.drain_replica("r0", deadline_s=60.0)
+        outs = [f.result(timeout=120) for f in futs]
+        assert outs == golden
+        assert report["state"] == "drained"
+        assert pool.health()["state"] == "degraded"
+        # Placement skips the drained replica from here on.
+        fut = pool.submit(prompts[0], max_new_tokens=4)
+        assert fut._lsot_replica == "r1"
+        assert fut.result(timeout=120) == golden[0]
+    ev = [r for r in pool.flight_snapshot()
+          if r.get("kind") == "replica_drained"]
+    assert ev and ev[-1]["replica"] == "r0"
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_pool_targeted_restart_rebuilds_only_crashed_replica(
+        tiny_model_module):
+    """A crashed replica is rebuilt from the pool's factory (bounded
+    backoff, per-replica budget) while the sibling's restart counter
+    stays zero — and the rebuilt fleet serves engine-exact again."""
+    import random
+    import time as _t
+
+    from llm_based_apache_spark_optimization_tpu.serve import SchedulerPool
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        RetryPolicy,
+        SchedulerCrashed,
+    )
+
+    cfg, params = tiny_model_module
+    golden = engine_golden(cfg, params, PROMPTS[:2], max_new=4)
+    pool = SchedulerPool(
+        [make_sched(cfg, params), make_sched(cfg, params)],
+        factory=lambda i: make_sched(cfg, params),
+        max_restarts=2,
+        restart_policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(0),
+        replica_join_s=1.0,
+    )
+    with pool:
+        pool.schedulers[0]._crash = SchedulerCrashed("simulated device loss")
+        # Placement observes the crash, serves from the sibling, and
+        # kicks the targeted rebuild in the background.
+        out = pool.generate(PROMPTS[:2], max_new_tokens=4)
+        assert out == golden
+        deadline = _t.monotonic() + 30
+        while _t.monotonic() < deadline:
+            reps = {r["replica"]: r for r in pool.replica_health()}
+            if reps["r0"]["restarts"] >= 1 and \
+                    reps["r0"]["state"] in ("ready", "degraded"):
+                break
+            _t.sleep(0.02)
+        reps = {r["replica"]: r for r in pool.replica_health()}
+        assert reps["r0"]["restarts"] == 1
+        assert reps["r1"]["restarts"] == 0
+        # The rebuilt replica serves again (a clean completion promotes
+        # degraded back to ready).
+        out2 = pool.generate(PROMPTS[:2] * 2, max_new_tokens=4)
+        assert out2 == golden * 2

@@ -130,6 +130,35 @@ def test_combined_heartbeat_oldest_busy_replica_wins():
         CombinedHeartbeat([])
 
 
+def test_combined_heartbeat_labels_and_per_replica_verdicts():
+    """ISSUE 9 satellite: the pool view says WHICH replica went stale,
+    not just that the oldest busy one did — snapshot replicas carry
+    labels, and verdicts() judges each replica against its OWN
+    threshold (busy + age > threshold ⇒ stalled)."""
+    a, b = Heartbeat(), Heartbeat()
+    combo = CombinedHeartbeat([a, b], labels=["r0", "r1"])
+    a.stamp(busy=True)   # healthy busy replica, keeps stamping below
+    b.stamp(busy=True)   # wedged: goes quiet from here on
+    time.sleep(0.03)
+    a.stamp(busy=True)   # fresh again
+    verdicts = combo.verdicts(factor=2.0, floor_s=0.02)
+    by = {v["replica"]: v for v in verdicts}
+    assert set(by) == {"r0", "r1"}
+    assert by["r1"]["stalled"] is True and by["r1"]["busy"] is True
+    assert by["r0"]["stalled"] is False  # just stamped: age under floor
+    assert by["r0"]["stall_threshold_s"] >= 0.02
+    # An IDLE stale replica is never a stall verdict (nothing to wedge).
+    b.stamp(busy=False)
+    time.sleep(0.03)
+    verdicts = combo.verdicts(factor=2.0, floor_s=0.02)
+    assert {v["replica"]: v for v in verdicts}["r1"]["stalled"] is False
+    # Labels ride the snapshot's replicas list too (the /metrics shape).
+    snap = combo.snapshot()
+    assert [r["replica"] for r in snap["replicas"]] == ["r0", "r1"]
+    with pytest.raises(ValueError, match="labels"):
+        CombinedHeartbeat([a, b], labels=["only-one"])
+
+
 # ---------------------------------------------- duration-valued fault sites
 
 
