@@ -271,6 +271,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                               deadline_s=app_cfg.deadline_s or None,
                               supervise=supervise,
                               max_restarts=app_cfg.max_restarts,
+                              max_entry_replays=app_cfg.max_entry_replays,
                               journal_spill=_spill_path(app_cfg, src),
                               stall_factor=app_cfg.stall_factor,
                               stall_min_s=app_cfg.stall_min_s,
@@ -281,6 +282,10 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 budget_gb = getattr(args, "kv_hbm_gb", 0.0)
                 if budget_gb:
                     common["kv_hbm_budget_bytes"] = int(budget_gb * 2**30)
+                common["kv_overcommit"] = app_cfg.kv_overcommit
+                common["kv_spill"] = app_cfg.kv_spill
+                common["kv_watermark_low"] = app_cfg.kv_watermark_low
+                common["kv_watermark_high"] = app_cfg.kv_watermark_high
                 common["quantize_int8"] = args.int8
                 common["quantize_int4"] = int4
                 common["quantize_unembed8"] = getattr(args, "int8_unembed",
@@ -324,6 +329,10 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                         int(getattr(args, "kv_hbm_gb", 0.0) * 2**30)
                         or None
                     ),
+                    kv_overcommit=app_cfg.kv_overcommit,
+                    kv_spill=app_cfg.kv_spill,
+                    kv_watermark_low=app_cfg.kv_watermark_low,
+                    kv_watermark_high=app_cfg.kv_watermark_high,
                     speculative_draft=getattr(args, "speculative", 0),
                     max_queue_depth=app_cfg.max_queue_depth,
                 )
@@ -350,6 +359,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
 
                 pool = SupervisedScheduler(
                     make_pool, max_restarts=app_cfg.max_restarts,
+                    max_entry_replays=app_cfg.max_entry_replays,
                     spill_path=_spill_path(app_cfg, src),
                     stall_factor=app_cfg.stall_factor,
                     stall_min_s=app_cfg.stall_min_s,

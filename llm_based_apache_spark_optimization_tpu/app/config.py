@@ -70,6 +70,26 @@ class AppConfig:
     # written here at drain/exit and recovered (resubmitted) at the next
     # start, so retried idempotency keys find their results. "" = off.
     journal_spill: str = ""
+    # --- paged-KV memory pressure (kv_layout="paged"; README "Operating
+    # under memory pressure"). Overcommit admission: reserve
+    # min(budget, max(ratio × budget, observed-generation EWMA)) pages at
+    # admission instead of the worst-case envelope; 1.0 = exact-envelope
+    # (today's behavior). Decode tops pages up per harvest; a failed
+    # top-up preempts a victim whose resume is token-identical
+    # (recompute, or spilled host page copies with kv_spill).
+    kv_overcommit: float = 1.0
+    kv_spill: bool = False
+    # Free-page watermarks (fractions of the pool): under LOW, the
+    # scheduler proactively evicts LRU prefix-cache pages until HIGH
+    # recovers — pressure is relieved before an allocation fails. 0 = off.
+    kv_watermark_low: float = 0.0
+    kv_watermark_high: float = 0.0
+    # Poison-request quarantine (serve/supervisor.py): a journal entry
+    # replayed after more than this many crashed scheduler incarnations
+    # retires typed `Quarantined` instead of burning the restart budget
+    # lap after lap. Keep it BELOW max_restarts or the budget dies first;
+    # 0 disables.
+    max_entry_replays: int = 3
     # --- fleet serving (serve/scheduler.SchedulerPool; README "Fleet
     # serving"). dp>1 scheduler deployments run a supervised fleet of
     # replicas with per-replica lifecycle.

@@ -171,12 +171,32 @@ class PageAllocator:
         self.shares = 0
         #: copy-on-write page copies (non-page-aligned boundaries only).
         self.cow_copies = 0
+        #: Pages WITHHELD from allocation (still on the free list, still
+        #: refcount 0 — the partition invariant is untouched): the
+        #: `kv:pressure` chaos seam shrinks the effective pool through
+        #: this, so allocation failure under pressure is injectable
+        #: without faking device state. 0 outside pressure episodes.
+        self.withheld = 0
+        #: Pressure-relief lifecycle counters (ISSUE 10): victims
+        #: preempted mid-decode, prefix-cache entries evicted by the
+        #: watermark sweep, and pages spilled to / restored from host
+        #: copies under LSOT_KV_SPILL.
+        self.preemptions = 0
+        self.evictions = 0
+        self.spilled_pages = 0
+        self.restored_pages = 0
 
     # ------------------------------------------------------------- queries
 
     @property
     def pages_free(self) -> int:
         return len(self._free)
+
+    @property
+    def pages_available(self) -> int:
+        """Free pages actually grantable right now: the free list minus
+        the pressure-withheld reserve. What `alloc`/`can_alloc` consult."""
+        return max(0, len(self._free) - self.withheld)
 
     @property
     def pages_in_use(self) -> int:
@@ -194,17 +214,52 @@ class PageAllocator:
         return self._ref[page] > 1
 
     def can_alloc(self, n: int) -> bool:
-        return len(self._free) >= n
+        return self.pages_available >= n
 
     # ----------------------------------------------------------- mutations
+
+    def withhold(self, n: int) -> None:
+        """Reserve `n` free-list pages against allocation (the
+        `kv:pressure` fault seam: the pool LOOKS n pages smaller until
+        the pressure episode ends). Withheld pages never leave the free
+        list, so the free-list/refcount partition — and `check()` — hold
+        throughout; only `pages_available` shrinks. `withhold(0)` lifts
+        the pressure."""
+        if n < 0:
+            raise ValueError(f"withhold({n})")
+        self.withheld = min(int(n), self.num_pages)
+
+    def note_preempt(self) -> None:
+        """Count a mid-decode victim preemption (the scheduler released
+        the victim's pages through `release` — this is the event tally
+        /metrics and the bench pressure pass read)."""
+        self.preemptions += 1
+
+    def note_evictions(self, n: int) -> None:
+        """Count prefix-cache entries evicted by the WATERMARK sweep
+        (proactive pressure relief, distinct from `_alloc_pages`'s
+        on-demand eviction which the scheduler does not tally — the
+        watermark's whole point is firing before demand does)."""
+        self.evictions += int(n)
+
+    def note_spill(self, n: int) -> None:
+        """Count pages copied to host at preemption (LSOT_KV_SPILL=1)."""
+        self.spilled_pages += int(n)
+
+    def note_restore(self, n: int) -> None:
+        """Count spilled pages copied back at resume. A completed
+        spill-resume cycle leaves spilled == restored for that request —
+        the reconciliation the property tests pin."""
+        self.restored_pages += int(n)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n fresh exclusive pages, or None (all-or-nothing: a request that
         cannot fully fit must not hold a partial grab and deadlock against
-        another partial holder)."""
+        another partial holder). Withheld pages (kv:pressure) are not
+        grantable."""
         if n < 0:
             raise ValueError(f"alloc({n})")
-        if len(self._free) < n:
+        if self.pages_available < n:
             return None
         pages = [self._free.popleft() for _ in range(n)]
         for p in pages:
@@ -288,8 +343,13 @@ class PageAllocator:
             "pages_free": self.pages_free,
             "pages_in_use": self.pages_in_use,
             "pages_shared": self.pages_shared,
+            "pages_withheld": self.withheld,
             "zero_copy_shares": self.shares,
             "cow_copies": self.cow_copies,
+            "preemptions": self.preemptions,
+            "evictions": self.evictions,
+            "spilled_pages": self.spilled_pages,
+            "restored_pages": self.restored_pages,
         }
 
     def check(self) -> None:

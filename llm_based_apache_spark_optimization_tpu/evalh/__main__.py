@@ -48,9 +48,13 @@ def main(argv=None) -> None:
                          "'ollama:connect:0.5,sql:exec:1,sched:crash:0.2' "
                          "— evalh.chaos.DEFAULT_SPEC), then a supervised "
                          "scheduler through sched:crash loop deaths, a "
-                         "watchdog hang stage, and a FLEET stage (one "
+                         "watchdog hang stage, a FLEET stage (one "
                          "pool replica wedged via sched:wedge_r1: only "
                          "that replica restarts, siblings untouched), and "
+                         "a KV-PRESSURE stage (the real paged scheduler "
+                         "under a kv:pressure storm: victims preempt and "
+                         "resume token-identical to a pressure-free "
+                         "control), and "
                          "report success-after-retry / shed / degraded "
                          "rates plus restart/replay/lost counts — asserts "
                          "zero hung requests and zero lost acknowledged "
@@ -66,7 +70,8 @@ def main(argv=None) -> None:
     args = ap.parse_args(argv)
 
     if args.chaos is not None:
-        # Pure-host run (fake daemon + SQLite): no jax platform needed.
+        # Mostly host-only (fake daemon + SQLite + toy schedulers); the
+        # kv-pressure stage alone builds a tiny jax scheduler on CPU.
         from .chaos import run_chaos
 
         print(json.dumps(

@@ -57,6 +57,7 @@ __all__ = [
     "DeadlineExceeded",
     "Draining",
     "Overloaded",
+    "Quarantined",
     "RetryPolicy",
     "SchedulerCrashed",
     "SchedulerStalled",
@@ -108,6 +109,16 @@ class CircuitOpen(RuntimeError):
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = retry_after_s
+
+
+class Quarantined(RuntimeError):
+    """A poison request: its replay has ridden down LSOT_MAX_ENTRY_REPLAYS
+    crashed scheduler incarnations, so the supervisor retires it typed
+    instead of letting one request burn the whole fleet's restart budget
+    crash by crash (serve/supervisor.py). Client-visible (a generic 500
+    at the API layer — the request itself is the suspect, not the
+    server's capacity, so none of the retry-me 429/503/504 shapes fit);
+    the `quarantined` resilience counter tallies it for operators."""
 
 
 class SchedulerCrashed(RuntimeError):

@@ -117,6 +117,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import queue
 import threading
 import time
@@ -260,6 +261,32 @@ class _Request:
     # request every iteration, and the metric must count REQUESTS that
     # waited, not retry attempts.
     page_waited: bool = False
+    # Victim preemption (ISSUE 10): `resume_pref` is how many COMMITTED
+    # generated tokens are folded into the prefill prefix for the next
+    # admission — resume re-runs prefill over prompt + generated-so-far
+    # (recompute mode), and the continuation appends to the same
+    # `generated` list, so clients never see a token twice. `rng_count`
+    # mirrors the slot's on-device RNG stream index at the last harvest
+    # (tokens sampled so far for vanilla decode, 1 + sampled rounds for
+    # speculative) — restoring it at resume is what makes a preempted
+    # SAMPLED request's continuation token-identical to an unpreempted
+    # control (the fold_in(key(seed), count) contract). `spilled` holds
+    # host-side page copies under LSOT_KV_SPILL=1 (restore mode skips the
+    # re-prefill entirely).
+    resume_pref: int = 0
+    preempted: int = 0
+    rng_count: int = 0
+    spilled: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    @property
+    def full_ids(self) -> List[int]:
+        """The prefill prefix: the prompt, plus — after a preemption —
+        the committed generated tokens recompute must re-run (position
+        of generated token j is len(ids) + j in BOTH incarnations, so
+        every envelope/top-up formula can use absolute positions)."""
+        if not self.resume_pref:
+            return self.ids
+        return self.ids + self.generated[: self.resume_pref]
 
     def flush_spans(self, now: float) -> None:
         """Record the request's scheduler-phase spans into its trace at
@@ -334,6 +361,10 @@ class ContinuousBatchingScheduler:
         kv_page_size: Optional[int] = None,
         kv_pages: Optional[int] = None,
         kv_hbm_budget_bytes: Optional[int] = None,
+        kv_overcommit: Optional[float] = None,
+        kv_spill: Optional[bool] = None,
+        kv_watermark_low: Optional[float] = None,
+        kv_watermark_high: Optional[float] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -464,6 +495,54 @@ class ContinuousBatchingScheduler:
                     f"kv_pages / kv_hbm_budget_bytes or lower max_seq"
                 )
             self._page_alloc = PageAllocator(num_pages, ps)
+            # Graceful degradation under page pressure (ISSUE 10).
+            # Overcommit admission: reserve min(budget, max(ratio × budget,
+            # EWMA of observed generation lengths)) generation tokens at
+            # admission instead of the full max_new worst case — 1.0 (the
+            # default) reproduces the exact-envelope admission bit for
+            # bit; below 1.0, decode tops pages up at each harvest and a
+            # failed top-up preempts a victim (fewest generated tokens
+            # first, never the allocating slot) whose deterministic
+            # resume re-prefills prompt+generated (or restores spilled
+            # host page copies under kv_spill).
+            if kv_overcommit is None:
+                kv_overcommit = float(
+                    os.environ.get("LSOT_KV_OVERCOMMIT", "1.0"))
+            if not 0.0 < kv_overcommit <= 1.0:
+                raise ValueError(
+                    f"kv_overcommit must be in (0, 1], got {kv_overcommit}"
+                )
+            self._kv_overcommit = float(kv_overcommit)
+            if kv_spill is None:
+                kv_spill = os.environ.get("LSOT_KV_SPILL", "0").strip() \
+                    .lower() in ("1", "true", "yes", "on")
+            self._kv_spill = bool(kv_spill)
+            # Watermark-driven eviction: when pool free pages fall under
+            # low × pages, the loop proactively evicts LRU prefix-cache
+            # entries until free recovers to high × pages — steady-state
+            # pressure is relieved BEFORE an allocation fails, so traffic
+            # rarely needs a preemption at all. low = 0 disables (the
+            # default: the on-demand eviction inside _alloc_pages remains,
+            # exactly as before).
+            if kv_watermark_low is None:
+                kv_watermark_low = float(
+                    os.environ.get("LSOT_KV_WATERMARK_LOW", "0.0"))
+            if kv_watermark_high is None:
+                kv_watermark_high = float(
+                    os.environ.get("LSOT_KV_WATERMARK_HIGH", "0.0"))
+            if not 0.0 <= kv_watermark_low <= 1.0 or \
+                    not 0.0 <= kv_watermark_high <= 1.0 or \
+                    kv_watermark_high < kv_watermark_low:
+                raise ValueError(
+                    f"kv watermarks must satisfy 0 <= low <= high <= 1, "
+                    f"got low={kv_watermark_low} high={kv_watermark_high}"
+                )
+            self._wm_low_pages = int(kv_watermark_low * num_pages)
+            self._wm_high_pages = max(
+                self._wm_low_pages, int(kv_watermark_high * num_pages))
+            # EWMA of COMPLETED requests' generation lengths: the
+            # "expected generation" admission reserves under overcommit.
+            self._gen_ewma: Optional[float] = None
             # Host-side per-slot page lists (the device table's mirror).
             self._slot_pages: List[List[int]] = [[] for _ in range(num_slots)]
             # Paged prefix cache: content key (token prefix) -> pool page
@@ -598,14 +677,24 @@ class ContinuousBatchingScheduler:
         self._ctables = trivial_tables(cfg.vocab_size)
         self._constraint_wait: "deque[_Request]" = deque()
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
+        # Per-slot occupancy epoch, bumped at every admission, retirement
+        # and preemption: in-flight rounds/firsts are stamped with it at
+        # issue, and the harvest drops rows whose epoch is stale — the
+        # request-identity check alone cannot catch a request preempted
+        # and re-admitted into the SAME slot between issue and harvest.
+        self._slot_epoch: List[int] = [0] * num_slots
         # In-flight rounds awaiting harvest: (issue-time slot->req list,
-        # toks device array, firsts list of (slot, req, first_tok device)).
-        self._pending: "deque[Tuple[List[Optional[_Request]], jax.Array, list]]" = deque()
+        # issue-time slot-epoch snapshot, toks device array, n_emit device
+        # array or None, firsts list of (slot, req, first_tok device,
+        # epoch), issue wall stamp).
+        self._pending: "deque[Tuple[List[Optional[_Request]], List[int], jax.Array, object, list, float]]" = deque()
         self._first_pending: list = []
         self._harvest_lag = 1  # rounds kept in flight before syncing
-        self._park_fn, self._ready_fn, self._retire_fn = self._build_state_ops()
+        (self._park_fn, self._ready_fn, self._retire_fn,
+         self._resume_fn) = self._build_state_ops()
         if self._paged:
-            self._ptab_row_fn, self._copy_page_fn = self._build_page_ops()
+            (self._ptab_row_fn, self._copy_page_fn,
+             self._restore_page_fn) = self._build_page_ops()
         # Prompt-chunk buckets: powers of two up to prompt_bucket, so a short
         # prompt pays a small forward instead of a full prompt_bucket one
         # (one compiled prefill program per bucket, built lazily).
@@ -664,7 +753,8 @@ class ContinuousBatchingScheduler:
                 cfg.pad_id, jnp.int32,
             )
             self._hlen = jnp.zeros(num_slots, jnp.int32)
-            self._spec_ready_fn = self._build_spec_ready()
+            self._spec_ready_fn, self._spec_resume_fn = \
+                self._build_spec_ready()
             # Acceptance accounting (VERDICT r4 next #5): without a counter
             # the bench could never say whether speculation PAYS — breakeven
             # is ~1.6 accepted tokens per verify round (the measured cost of
@@ -815,7 +905,30 @@ class ContinuousBatchingScheduler:
                 crem.at[slot].set(cbudget - 1),
             )
 
-        return park_slot, ready_slot, retire_slot
+        @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+        def resume_slot(cur, pos, temps, topps, topks, seeds, counts,
+                        cstates, crem, slot, tok, pos_val, temp, topp, topk,
+                        seed, count0, cstate0, crem0):
+            # Arm a PREEMPTION-RESUMED slot from host scalars: no fresh
+            # sample — `tok` is the last COMMITTED token (already
+            # delivered), fed again at its own position so decode
+            # continues exactly where the victim stopped. counts/cstate/
+            # crem restore the committed RNG stream index, the replayed
+            # FSM state, and the remaining grammar budget — the whole
+            # determinism contract in one scatter.
+            return (
+                cur.at[slot].set(tok),
+                pos.at[slot].set(pos_val),
+                temps.at[slot].set(temp),
+                topps.at[slot].set(topp),
+                topks.at[slot].set(topk),
+                seeds.at[slot].set(seed),
+                counts.at[slot].set(count0),
+                cstates.at[slot].set(cstate0),
+                crem.at[slot].set(crem0),
+            )
+
+        return park_slot, ready_slot, retire_slot, resume_slot
 
     def _build_block_ops(self):
         """Jitted device-to-device prefix-block copy ops.
@@ -881,7 +994,16 @@ class ContinuousBatchingScheduler:
                 lax.dynamic_update_slice(vp, pv, (0, dst, 0, 0, 0)),
             )
 
-        return set_row, copy_page
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def restore_pages(kp, vp, idx, kstack, vstack):
+            # Spill-resume (LSOT_KV_SPILL): write the host page copies
+            # [L, n, K, page, H] back into freshly allocated pool pages
+            # in ONE scatter (one dispatch + one transfer per resume, not
+            # per page; retraces per distinct page count, bounded by
+            # pages_per_slot).
+            return kp.at[:, idx].set(kstack), vp.at[:, idx].set(vstack)
+
+        return set_row, copy_page, restore_pages
 
     # ---------------------------------------------------- paged-KV host side
 
@@ -960,18 +1082,289 @@ class ContinuousBatchingScheduler:
             pages[pi] = fresh[0]
             self._sync_ptab_row(slot)
 
+    # ------------------------------------------- pressure relief (ISSUE 10)
+
+    def _reserve_new(self, req: _Request) -> int:
+        """Generation tokens the admission envelope RESERVES for `req`.
+
+        Exact mode (kv_overcommit = 1.0, the default): the full remaining
+        budget — bit-for-bit the pre-overcommit envelope. Overcommitted:
+        min(budget, max(ratio × budget, expected remaining generation)),
+        where expected = EWMA of completed requests' generation lengths
+        minus what this request already generated — the vLLM-style bet
+        that most requests stop far short of max_new, with the ratio as
+        the guaranteed floor. Decode tops up at each harvest; a failed
+        top-up preempts (the overcommit's safety valve)."""
+        remaining = max(0, req.max_new - len(req.generated))
+        r = self._kv_overcommit
+        if r >= 1.0 or remaining == 0:
+            return remaining
+        floor = -int(-remaining * r // 1)  # ceil
+        expect = 0
+        if self._gen_ewma is not None:
+            expect = max(0, -int(-self._gen_ewma // 1)
+                         - len(req.generated))
+        return min(remaining, max(1, floor, expect))
+
+    def _sample_pressure(self) -> None:
+        """Chaos seam: the value-valued `kv:pressure` site withholds part
+        of the pool (a fraction when the value < 1, absolute pages
+        otherwise) for every loop iteration it fires — allocation and
+        top-up failures become injectable, which is how the chaos stage
+        forces a deterministic preemption storm. Pressure lifts the
+        moment the site stops firing."""
+        if not FAULTS.active:
+            if self._page_alloc.withheld:
+                self._page_alloc.withhold(0)
+            return
+        v = FAULTS.value("kv:pressure")
+        if v is None:
+            self._page_alloc.withhold(0)
+            return
+        total = self._page_alloc.num_pages
+        self._page_alloc.withhold(
+            int(v * total) if v < 1.0 else int(v)
+        )
+
+    def _watermark_sweep(self) -> None:
+        """Proactive LRU eviction of prefix-cache pages: when available
+        pages fall under the LOW watermark, evict entries until the HIGH
+        watermark recovers (or the cache is empty) — pressure is relieved
+        BEFORE an allocation fails, so steady-state traffic rarely needs
+        a preemption. Disabled at low = 0 (the on-demand eviction inside
+        _alloc_pages still backstops allocation)."""
+        if not self._wm_low_pages or \
+                self._page_alloc.pages_available >= self._wm_low_pages:
+            return
+        evicted = 0
+        while self._prefix_pages and \
+                self._page_alloc.pages_available < self._wm_high_pages:
+            _, pages = self._prefix_pages.popitem(last=False)
+            self._page_alloc.release(list(pages))
+            evicted += 1
+        if evicted:
+            self._page_alloc.note_evictions(evicted)
+            resilience.inc("kv_evictions")
+            self.flight.event("kv_evict", entries=evicted,
+                              free=self._page_alloc.pages_free)
+
+    def _sweep_page_wait(self) -> None:
+        """Deadline enforcement for page-starved requests: a request
+        parked on pool pages past its deadline fails fast with the typed
+        DeadlineExceeded (504) instead of waiting forever — page-wait
+        starvation is queue wait, and the same _observe_terminal path
+        feeds the queue-wait span + histogram. Cancelled waiters resolve
+        with whatever they had (the cancel contract)."""
+        if not self._page_wait:
+            return
+        keep: "deque[_Request]" = deque()
+        while self._page_wait:
+            req = self._page_wait.popleft()
+            if req.cancelled:
+                self._observe_terminal(req)
+                req.future.set_result(req.generated)
+            elif req.past_deadline():
+                resilience.inc("deadline_expired")
+                self._observe_terminal(req, error="DeadlineExceeded")
+                req.future.set_exception(req.deadline_error())
+            else:
+                keep.append(req)
+        self._page_wait = keep
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Victim preemption: release the slot's pages and park the
+        request for a DETERMINISTIC resume. Recompute mode re-runs
+        prefill over prompt + committed tokens at re-admission; spill
+        mode (LSOT_KV_SPILL=1) copies the committed pages to host first
+        and restores them instead of recomputing. Either way the client
+        keeps every delivered token and the continuation is
+        token-identical to an unpreempted control: greedy trivially,
+        sampled because `rng_count` restores the per-slot
+        fold_in(key(seed), count) stream index, constrained because the
+        FSM state is re-derived from the committed tokens."""
+        req = self._slot_req[slot]
+        if self._kv_spill and req.generated:
+            plen = len(req.ids) + len(req.generated)
+            npg = min(pages_for_tokens(plen, self._page_size),
+                      len(self._slot_pages[slot]))
+            idx = np.asarray(self._slot_pages[slot][:npg], np.int32)
+            # Syncs in-flight rounds; their uncommitted writes beyond the
+            # committed positions ride along as garbage the resumed
+            # decode overwrites before any read can see it (the same
+            # write-before-read invariant every freed-page reuse relies
+            # on).
+            kparts, vparts = jax.device_get(
+                (self._cache[0][:, idx], self._cache[1][:, idx])
+            )
+            req.spilled = (kparts, vparts)
+            self._page_alloc.note_spill(int(npg))
+        req.resume_pref = len(req.generated)
+        req.preempted += 1
+        req.ready = False
+        req.prefilled = 0
+        self._slot_req[slot] = None
+        self._slot_epoch[slot] += 1
+        if self._prefill_q:
+            # Purge the victim's queued prefill entries NOW: a mid-prefill
+            # victim re-admitted into the SAME slot would otherwise leave
+            # a stale (slot, req) pair that _prefill_step's identity check
+            # cannot tell from the fresh one — the chunk would prefill
+            # twice and `prefilled` would advance two chunks for one
+            # chunk's KV.
+            self._prefill_q = deque(
+                (s, r) for (s, r) in self._prefill_q if r is not req
+            )
+        # Same hygiene as retirement: a lingering temperature > 0 would
+        # defeat the all-greedy fast path for every later round.
+        self._temps, self._topps, self._topks, self._cstates = \
+            self._retire_fn(self._temps, self._topps, self._topks,
+                            self._cstates, jnp.int32(slot))
+        self._free_slot_pages(slot)
+        self._page_alloc.note_preempt()
+        resilience.inc("kv_preemptions")
+        self.flight.event(
+            "preempt", slot=slot, rid=req.rid,
+            generated=len(req.generated), spill=req.spilled is not None,
+        )
+        # Victims resume ahead of never-admitted waiters: they were
+        # admitted first and already hold delivered tokens.
+        self._page_wait.appendleft(req)
+
+    def _preempt_for(self, n_pages: int, protect: int) -> Optional[List[int]]:
+        """Fund a failed mid-decode allocation by preempting victims —
+        fewest generated tokens first (cheapest recompute), never the
+        allocating slot — until the grab succeeds or no victim remains."""
+        while True:
+            got = self._alloc_pages(n_pages)
+            if got is not None:
+                return got
+            victims = [
+                (len(r.generated), i)
+                for i, r in enumerate(self._slot_req)
+                if r is not None and i != protect
+            ]
+            if not victims:
+                return None
+            victims.sort()
+            self._preempt_slot(victims[0][1])
+
+    def _topup_pages(self) -> None:
+        """Keep every decoding slot's mapped pages ahead of the device's
+        write frontier: at each harvest the committed position is
+        len(ids) + len(generated), and in-flight + next-issued rounds can
+        write at most `overshoot` further before the next harvest tops up
+        again — so covering committed + overshoot here means the device
+        NEVER writes through an unmapped (silently dropped) table entry.
+        Exact-envelope admission (kv_overcommit = 1.0) prepaid the whole
+        budget, so this pass allocates nothing there. A failed top-up
+        preempts a victim; if even that cannot fund it (pressure
+        withholding the pool), the needing slot preempts ITSELF — parked
+        with a deterministic resume beats silent KV loss."""
+        overshoot, ps = self.overshoot, self._page_size
+        for i in range(self.num_slots):
+            req = self._slot_req[i]
+            if req is None or not req.ready:
+                continue
+            target = len(req.ids) + len(req.generated) + overshoot
+            need = pages_for_tokens(target, ps) - len(self._slot_pages[i])
+            if need <= 0:
+                continue
+            got = self._alloc_pages(need)
+            if got is None:
+                got = self._preempt_for(need, i)
+            if got is None:
+                self._preempt_slot(i)
+                continue
+            self._slot_pages[i].extend(got)
+            self._sync_ptab_row(i)
+            req.page_end = max(req.page_end,
+                               len(self._slot_pages[i]) * ps)
+
+    def _resume_ready(self, slot: int, req: _Request,
+                      mode: str = "recompute") -> None:
+        """Arm a preemption-resumed slot: the last COMMITTED token is fed
+        again at its own position (its KV rewrite is value-identical),
+        the RNG stream index restores from the host mirror, and the
+        grammar FSM state is re-derived by replaying the committed tokens
+        through the compiled tables — after this scatter the slot's
+        device state equals the unpreempted control's at the same commit
+        frontier, which is the whole token-identical-resume contract."""
+        ids = req.full_ids
+        plen = len(ids)
+        cstate0 = 0
+        if req.constraint is not None:
+            cstate0 = req.constraint.walk(req.generated)
+            if cstate0 is None:
+                # Committed tokens came out of the masked decode, so a
+                # dead replay means corrupted state — fail typed, never
+                # resume into a wrong grammar row.
+                raise RuntimeError(
+                    f"resume FSM replay left the grammar after "
+                    f"{len(req.generated)} committed tokens (rid {req.rid})"
+                )
+        crem0 = max(0, req.max_new - len(req.generated))
+        (self._cur, self._pos, self._temps, self._topps, self._topks,
+         self._seeds, self._counts, self._cstates,
+         self._crem) = self._resume_fn(
+            self._cur, self._pos, self._temps, self._topps, self._topks,
+            self._seeds, self._counts, self._cstates, self._crem,
+            jnp.int32(slot), jnp.int32(req.generated[-1]),
+            jnp.int32(plen - 1),
+            jnp.float32(req.temperature), jnp.float32(req.top_p),
+            jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
+            jnp.int32(req.rng_count), jnp.int32(cstate0),
+            jnp.int32(crem0),
+        )
+        if self._spec_draft:
+            row = np.full((self._hist.shape[1],), self.cfg.pad_id,
+                          np.int32)
+            row[:plen] = ids
+            self._hist, self._hlen = self._spec_resume_fn(
+                self._hist, self._hlen, jnp.int32(slot),
+                jnp.asarray(row), jnp.int32(plen),
+            )
+        req.ready = True
+        req.ready_at = time.perf_counter()
+        # Decode re-writes [plen - 1, page_end): COW any page the
+        # re-prefill's publish shared before the slot goes
+        # decode-eligible (spill resumes never published — no-op there).
+        self._ensure_writable(slot, max(0, plen - 1), req.page_end)
+        self.flight.event("resume", slot=slot, rid=req.rid,
+                          generated=len(req.generated), mode=mode)
+
+    def _restore_spilled(self, slot: int, req: _Request) -> None:
+        """Spill-resume (LSOT_KV_SPILL=1): write the host page copies
+        back into the freshly allocated pages and arm the slot directly —
+        no re-prefill forward at all."""
+        kparts, vparts = req.spilled
+        n = kparts.shape[1]
+        idx = jnp.asarray(self._slot_pages[slot][:n], jnp.int32)
+        self._cache = self._restore_page_fn(
+            *self._cache, idx, jnp.asarray(kparts), jnp.asarray(vparts),
+        )
+        self._page_alloc.note_restore(int(n))
+        req.spilled = None
+        req.prefilled = len(req.full_ids)
+        self._resume_ready(slot, req, mode="spill")
+
     @property
     def page_stats(self) -> Optional[Dict[str, int]]:
         """Paged-KV observability (None when contiguous): pool occupancy
         and sharing counters — `zero_copy_shares` rising with prefix hits
         while `cow_copies` stays at boundary-only counts is the
         "sharing, not copying" proof the bench artifact records; a leaked
-        page shows up as pages_in_use that never drains."""
+        page shows up as pages_in_use that never drains. The pressure
+        block (preemptions/evictions/spilled/withheld + watermarks) is
+        the graceful-degradation dashboard."""
         if not self._paged:
             return None
         out = self._page_alloc.stats()
         out["pages_per_slot"] = self._pages_per_slot
         out["page_waits"] = self._page_wait_events
+        out["overcommit"] = self._kv_overcommit
+        out["spill"] = int(self._kv_spill)
+        out["watermark_low_pages"] = self._wm_low_pages
+        out["watermark_high_pages"] = self._wm_high_pages
         return out
 
     def _build_prefill(self, t_bucket: int, k: int):
@@ -1075,9 +1468,16 @@ class ContinuousBatchingScheduler:
                 row_ar = jnp.arange(pos_idx.shape[0], dtype=jnp.int32)
                 wk = new["k"][:, row_ar[:, None], :, pos_idx]  # [k,t,L,K,H]
                 wv = new["v"][:, row_ar[:, None], :, pos_idx]
+                page_idx = pos_idx // ps
                 pages = jnp.take_along_axis(
-                    tab, jnp.clip(pos_idx // ps, 0, np_tab - 1), axis=1
+                    tab, jnp.clip(page_idx, 0, np_tab - 1), axis=1
                 )  # [k, t]; sentinel rows/entries drop their writes
+                # Positions past the virtual row (a resumed prompt's final
+                # chunk bucket can overhang it) must DROP, not clip: the
+                # clipped lookup would alias the row's LAST mapped page
+                # and overwrite real KV at matching offsets.
+                pages = jnp.where(page_idx < np_tab, pages,
+                                  jnp.int32(num_pages))
                 offs = pos_idx % ps
                 cache = (
                     cache[0].at[:, pages, :, offs].set(wk),
@@ -1221,7 +1621,20 @@ class ContinuousBatchingScheduler:
         def spec_ready(hist, hlen, slot, tok, plen):
             return hist.at[slot, plen].set(tok[0]), hlen.at[slot].set(plen + 1)
 
-        return spec_ready
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def spec_resume(hist, hlen, slot, row, plen):
+            # Preemption resume: rewrite the slot's WHOLE history row
+            # (prompt + committed generated tokens, pad beyond) and set
+            # hlen to the committed length — the ngram draft source is
+            # then byte-identical to the unpreempted control's, which the
+            # sampled-speculative determinism contract needs. Serves both
+            # recompute (prefill re-scattered the same tokens; this
+            # overwrite is a content no-op that also scrubs any stale
+            # previous-occupant tail) and spill-restore (no prefill ran,
+            # so this IS the history rebuild).
+            return hist.at[slot].set(row), hlen.at[slot].set(plen)
+
+        return spec_ready, spec_resume
 
     def _build_spec_decode(self):
         """One speculative round for the whole slot batch: draft D tokens
@@ -1476,10 +1889,25 @@ class ContinuousBatchingScheduler:
             jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
             jnp.uint32(0), jnp.int32(0), jnp.int32(1),
         )
+        (self._cur, self._pos, self._temps, self._topps, self._topks,
+         self._seeds, self._counts, self._cstates,
+         self._crem) = self._resume_fn(
+            self._cur, self._pos, self._temps, self._topps, self._topks,
+            self._seeds, self._counts, self._cstates, self._crem,
+            oob, jnp.int32(self.cfg.pad_id), jnp.int32(self._park),
+            jnp.float32(0.0), jnp.float32(1.0), jnp.int32(0),
+            jnp.uint32(0), jnp.int32(1), jnp.int32(0), jnp.int32(1),
+        )
         if self._spec_draft:
             self._hist, self._hlen = self._spec_ready_fn(
                 self._hist, self._hlen, oob,
                 jnp.full((1,), self.cfg.pad_id, jnp.int32), jnp.int32(0),
+            )
+            self._hist, self._hlen = self._spec_resume_fn(
+                self._hist, self._hlen, oob,
+                jnp.full((self._hist.shape[1],), self.cfg.pad_id,
+                         jnp.int32),
+                jnp.int32(0),
             )
         if self._paged:
             # Table-row scatter at the OOB slot (dropped) and a page-0
@@ -1900,6 +2328,15 @@ class ContinuousBatchingScheduler:
             prev_t = self._stok_ewma
             self._stok_ewma = (stok if prev_t is None
                                else 0.2 * stok + 0.8 * prev_t)
+            if self._paged:
+                # Observed generation length: what overcommit admission
+                # reserves instead of the worst-case budget. Completed
+                # requests only (a cancelled fraction says nothing about
+                # how long requests RUN).
+                g = float(len(req.generated))
+                prev_g = self._gen_ewma
+                self._gen_ewma = (g if prev_g is None
+                                  else 0.2 * g + 0.8 * prev_g)
 
     @property
     def prefix_stats(self) -> Dict[str, int]:
@@ -1971,29 +2408,46 @@ class ContinuousBatchingScheduler:
         until retirements free pages; all-or-nothing, so partial holders
         can never deadlock each other)."""
         ps, pb = self._page_size, self._pblock
+        ids = req.full_ids  # prompt + committed tokens after a preemption
+        plen = len(ids)
         n = 0
-        if self._prefix_cache_blocks:
-            max_blocks = (len(req.ids) - 1) // pb
+        # Spill resumes restore page CONTENT into fresh exclusive pages —
+        # a shared prefix mapping would be overwritten, so they skip the
+        # prefix cache entirely (the pages already hold the prefix).
+        if self._prefix_cache_blocks and req.spilled is None:
+            max_blocks = (plen - 1) // pb
             while n < max_blocks and \
-                    tuple(req.ids[: (n + 1) * pb]) in self._prefix_pages:
+                    tuple(ids[: (n + 1) * pb]) in self._prefix_pages:
                 n += 1
             # Same chunk-envelope cap as the contiguous path: a reuse
             # offset shifts every chunk start, and the final chunk's
             # bucket must still land inside the virtual row.
             s_virt = self._pages_per_slot * ps
-            while n and self._chunk_end(n * pb, len(req.ids)) > s_virt:
+            while n and self._chunk_end(n * pb, plen) > s_virt:
                 n -= 1
         reuse = n * pb
         # The envelope admission must cover: every position chunked
-        # prefill writes, plus decode through budget + overshoot.
-        need_end = max(
-            self._chunk_end(reuse, len(req.ids)),
-            bucket_len(len(req.ids), self.prompt_bucket)
-            + req.max_new + self.overshoot,
-        )
+        # prefill writes, plus decode through the RESERVED generation
+        # budget + overshoot. Exact mode (kv_overcommit=1.0) reserves the
+        # full remaining budget — today's envelope bit for bit;
+        # overcommit reserves the expected generation and decode tops up
+        # at each harvest (_topup_pages). Clamped to the per-slot virtual
+        # row: a RESUME's prompt (original + committed tokens) re-rounds
+        # to the next prompt bucket, which can push the raw formula past
+        # max_seq even though every real write stays below it (submit's
+        # bound) — unclamped, the allocation could outgrow the device
+        # table row. Fresh admissions never hit the clamp (submit's bound
+        # keeps their envelope inside the row), so exact-envelope
+        # accounting is untouched.
+        s_virt = self._pages_per_slot * ps
+        need_end = min(s_virt, max(
+            self._chunk_end(reuse, plen),
+            bucket_len(plen, self.prompt_bucket)
+            + self._reserve_new(req) + self.overshoot,
+        ))
         need_pages = pages_for_tokens(need_end, ps)
         full = reuse // ps
-        entry = (self._prefix_pages.get(tuple(req.ids[:reuse]))
+        entry = (self._prefix_pages.get(tuple(ids[:reuse]))
                  if reuse else None)
         shared = list(entry[:full]) if entry else []
         boundary_src = entry[full] if (entry and reuse % ps) else None
@@ -2038,7 +2492,7 @@ class ContinuousBatchingScheduler:
             self._prefix_hits += 1
             self._prefix_blocks_reused += n
             for j in range(n):  # LRU touch along the matched chain
-                key = tuple(req.ids[: (j + 1) * pb])
+                key = tuple(ids[: (j + 1) * pb])
                 if key in self._prefix_pages:
                     self._prefix_pages.move_to_end(key)
         return True
@@ -2067,15 +2521,30 @@ class ContinuousBatchingScheduler:
             return True
         if self._paged and not self._admit_paged(slot, req):
             return False
-        req.admitted_at = time.perf_counter()
+        if not req.admitted_at:
+            # Resumes keep their ORIGINAL admission stamp: the queue-wait
+            # span/histogram measure submit → first slot, not decode time
+            # an earlier incarnation already spent.
+            req.admitted_at = time.perf_counter()
         self._round_admitted.append(req.rid)
         self._slot_req[slot] = req
+        # Per-slot incarnation epoch: rounds and prefill first-tokens
+        # harvested later carry the epoch they were issued under, so a
+        # slot preempted and re-occupied (even by the SAME request —
+        # identity checks can't see that) never commits a stale round's
+        # tokens.
+        self._slot_epoch[slot] += 1
         # Park the slot's decode writes before its prompt starts streaming in
         # (it may still be frozen at the previous occupant's position).
         # Async scatter — no host sync.
         self._cur, self._pos, self._cstates, self._crem = self._park_fn(
             self._cur, self._pos, self._cstates, self._crem, jnp.int32(slot)
         )
+        if self._paged and req.spilled is not None:
+            # Spill resume: restore the host page copies and arm the slot
+            # directly — no re-prefill, no first-token sample.
+            self._restore_spilled(slot, req)
+            return True
         if self._prefix_cache_blocks and not self._paged:
             pb = self._pblock
             # At least one prompt token must go through real prefill: the
@@ -2114,7 +2583,7 @@ class ContinuousBatchingScheduler:
         return True
 
     def _next_bucket(self, req: _Request) -> int:
-        remaining = len(req.ids) - req.prefilled
+        remaining = len(req.full_ids) - req.prefilled
         return next(
             (b for b in self._buckets if b >= remaining), self.prompt_bucket
         )
@@ -2144,18 +2613,27 @@ class ContinuousBatchingScheduler:
         the smallest power-of-two bucket covering what's left of the prompt;
         only same-bucket entries batch together (one compiled program per
         (bucket, k-bucket) pair, built lazily)."""
-        slot0, req0 = self._prefill_q.popleft()
-        t = self._next_bucket(req0)
-        group = [(slot0, req0)]
+        group: List[Tuple[int, _Request]] = []
         deferred = []
+        t = 0
         while self._prefill_q and len(group) < self._prefill_kmax:
             s, r = self._prefill_q.popleft()
-            if self._next_bucket(r) == t:
+            if self._slot_req[s] is not r:
+                # Preempted while queued for prefill (its pages are gone
+                # and the slot may belong to someone else): the request
+                # re-admits from _page_wait, this stale entry just drops.
+                continue
+            if not group:
+                t = self._next_bucket(r)
+                group.append((s, r))
+            elif self._next_bucket(r) == t:
                 group.append((s, r))
             else:
                 deferred.append((s, r))
         for item in reversed(deferred):  # keep arrival order for next passes
             self._prefill_q.appendleft(item)
+        if not group:
+            return
 
         kb = next(b for b in self._kbuckets if b >= len(group))
         if (t, kb) not in self._prefill_fns:
@@ -2178,7 +2656,8 @@ class ContinuousBatchingScheduler:
         # the host boundary, never a [k, vocab] array.
         cinits, cbudgets = [], []
         for slot, req in group:
-            chunk_ids = req.ids[req.prefilled : req.prefilled + t]
+            full = req.full_ids
+            chunk_ids = full[req.prefilled : req.prefilled + t]
             tokens.append(chunk_ids + [self.cfg.pad_id] * (t - len(chunk_ids)))
             lengths.append(len(chunk_ids))
             chunk_lens.append(len(chunk_ids))
@@ -2188,8 +2667,12 @@ class ContinuousBatchingScheduler:
             topps.append(req.top_p)
             topks.append(req.top_k)
             seeds.append(req.seed & 0xFFFFFFFF)
-            final = req.prefilled + len(chunk_ids) >= len(req.ids)
-            con = req.constraint is not None and final
+            final = req.prefilled + len(chunk_ids) >= len(full)
+            # Resumed rows discard the prefill's sampled token (the next
+            # input is the last COMMITTED token, re-armed by
+            # _resume_ready), so they ride the unconstrained sentinel.
+            con = (req.constraint is not None and final
+                   and not req.resume_pref)
             cinits.append(req.constraint.init_state if con else 0)
             cbudgets.append(req.max_new if con else 1)
         # Padding rows: OOB slot index (writes dropped), positions [0, t)
@@ -2227,13 +2710,22 @@ class ContinuousBatchingScheduler:
         for i, (slot, req) in enumerate(group):
             chunk_start = req.prefilled
             req.prefilled += chunk_lens[i]
+            full = req.full_ids
             if self._prefix_cache_blocks:
                 if self._paged:
                     self._publish_blocks_paged(slot, req, chunk_start)
                 else:
                     self._publish_blocks(slot, req, chunk_start)
-            if req.prefilled < len(req.ids):
+            if req.prefilled < len(full):
                 self._prefill_q.append((slot, req))
+                continue
+            if req.resume_pref:
+                # Preemption resume (recompute mode): the KV is rebuilt;
+                # arm the slot from the COMMITTED state — the prefill's
+                # sampled token is discarded (the continuation's first
+                # token comes from the next decode round, exactly where
+                # the unpreempted control would produce it).
+                self._resume_ready(slot, req)
                 continue
             # No sync: arm the slot with the still-on-device first token and
             # attach it to the next round's harvest. Stop-token / budget
@@ -2263,12 +2755,18 @@ class ContinuousBatchingScheduler:
                 jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
                 jnp.int32(cinit), jnp.int32(req.max_new),
             )
+            # The host mirror of the slot's on-device RNG stream index
+            # (ready_slot set counts = 1: the prefill sample consumed
+            # fold index 0) — what a later preemption restores.
+            req.rng_count = 1
             if self._spec_draft:
                 self._hist, self._hlen = self._spec_ready_fn(
                     self._hist, self._hlen, jnp.int32(slot), tok,
                     jnp.int32(len(req.ids)),
                 )
-            self._first_pending.append((slot, req, tok))
+            self._first_pending.append(
+                (slot, req, tok, self._slot_epoch[slot])
+            )
 
     def _publish_blocks(self, slot: int, req: _Request, chunk_start: int) -> None:
         """Publish the chunk's completed prefix blocks (chunk_start is always
@@ -2300,8 +2798,9 @@ class ContinuousBatchingScheduler:
         itself COWs before its next write into a page it just shared
         (_ensure_writable), so entry content is immutable from here on."""
         pb, ps = self._pblock, self._page_size
+        ids = req.full_ids
         for b0 in range(chunk_start // pb, req.prefilled // pb):
-            key = tuple(req.ids[: (b0 + 1) * pb])
+            key = tuple(ids[: (b0 + 1) * pb])
             if key in self._prefix_pages:
                 self._prefix_pages.move_to_end(key)
                 continue
@@ -2375,7 +2874,8 @@ class ContinuousBatchingScheduler:
             (self._cur, self._pos, self._counts, self._cstates, self._crem,
              toks) = out[nc:]
             n_emit = None
-        self._pending.append((issue_reqs, toks, n_emit, self._first_pending,
+        self._pending.append((issue_reqs, list(self._slot_epoch), toks,
+                              n_emit, self._first_pending,
                               time.perf_counter()))
         self._first_pending = []
 
@@ -2408,6 +2908,12 @@ class ContinuousBatchingScheduler:
             req.trace.event("sched.error", error=error, rid=req.rid)
         if req.admitted_at and req.submitted_at:
             req.future._lsot_queue_wait = req.admitted_at - req.submitted_at
+        elif req.submitted_at:
+            # Never admitted (expired/cancelled while queued or parked on
+            # pool pages): its whole life WAS queue wait — page-wait
+            # starvation must show up in the queue-wait span + histogram,
+            # not vanish because the request never reached a slot.
+            req.future._lsot_queue_wait = now - req.submitted_at
         self._round_retired.append(req.rid)
         with self._submit_lock:
             self._pending_new_tokens = max(
@@ -2416,6 +2922,7 @@ class ContinuousBatchingScheduler:
 
     def _release_slot(self, slot: int) -> None:
         self._slot_req[slot] = None
+        self._slot_epoch[slot] += 1
         self._temps, self._topps, self._topks, self._cstates = self._retire_fn(
             self._temps, self._topps, self._topks, self._cstates,
             jnp.int32(slot)
@@ -2429,7 +2936,8 @@ class ContinuousBatchingScheduler:
             # on for its per-row overshoot writes).
             self._free_slot_pages(slot)
 
-    def _append_first(self, slot: int, req: _Request, first: int) -> int:
+    def _append_first(self, slot: int, req: _Request, first: int,
+                      epoch: Optional[int] = None) -> int:
         """Apply a harvested prefill first-token: stop/budget checks run
         here, one round late (the slot may have decoded a garbage round
         meanwhile — absorbed by the visibility invariant and submit()'s
@@ -2437,6 +2945,8 @@ class ContinuousBatchingScheduler:
         flight record counts prefill firsts in its emitted tally."""
         if req is not self._slot_req[slot]:
             return 0  # cleared by shutdown/crash path meanwhile
+        if epoch is not None and epoch != self._slot_epoch[slot]:
+            return 0  # preempted + re-admitted: a fresh arm supersedes this
         if req.cancelled:
             self._retire(slot, req, req.generated)
             return 0
@@ -2447,7 +2957,7 @@ class ContinuousBatchingScheduler:
             self._fail_slot(slot, req, req.deadline_error())
             return 0
         if first in self.stop_ids or req.max_new < 1:
-            self._retire(slot, req, [])
+            self._retire(slot, req, req.generated)
             return 0
         req.generated.append(first)
         req.emit(first)
@@ -2466,10 +2976,10 @@ class ContinuousBatchingScheduler:
         # without duplicating delivered tokens (chaos tests assert zero
         # lost, zero double-streamed).
         FAULTS.check("sched:crash")
-        issue_reqs, toks_dev, n_emit_dev, firsts, t_issue = \
+        issue_reqs, epochs, toks_dev, n_emit_dev, firsts, t_issue = \
             self._pending.popleft()
         toks, n_emit, first_vals = jax.device_get(
-            (toks_dev, n_emit_dev, [t for (_, _, t) in firsts])
+            (toks_dev, n_emit_dev, [t for (_, _, t, _) in firsts])
         )
         toks = np.asarray(toks)
         t_harvest = time.perf_counter()
@@ -2483,9 +2993,10 @@ class ContinuousBatchingScheduler:
                         "greedy": 0, "sampled": 0}
         # Firsts precede the round's chunk tokens in every stream: their
         # ready-scatter was dispatched before the round was issued.
-        for (slot, req, _), fv in zip(firsts, first_vals):
+        for (slot, req, _, fep), fv in zip(firsts, first_vals):
             round_emitted += self._append_first(slot, req,
-                                                int(np.asarray(fv)[0]))
+                                                int(np.asarray(fv)[0]),
+                                                epoch=fep)
         # Per-slot progress this round: a slot "advanced" if it appended a
         # token or reached a terminal state. A slot that advanced nothing
         # in a HARVESTED round accrues a stall round (sweep after the
@@ -2498,8 +3009,18 @@ class ContinuousBatchingScheduler:
         advanced: List[int] = []
         no_progress: List[Tuple[int, _Request]] = []
         for i, req in enumerate(issue_reqs):
-            if req is None or req is not self._slot_req[i]:
-                continue  # inactive at issue, or already retired
+            if req is None or req is not self._slot_req[i] \
+                    or epochs[i] != self._slot_epoch[i]:
+                continue  # inactive at issue, retired, or preempted since
+            # Mirror the slot's on-device RNG stream advance for this
+            # COMMITTED round (what a preemption resume restores): vanilla
+            # rounds consume one fold index per chunk token for every
+            # active slot; speculative rounds consume one per SAMPLED
+            # round (greedy argmax draws nothing).
+            if n_emit is None:
+                req.rng_count += self.decode_chunk
+            elif req.temperature > 0.0:
+                req.rng_count += 1
             if req.cancelled:
                 self._retire(i, req, req.generated)
                 advanced.append(i)
@@ -2597,6 +3118,13 @@ class ContinuousBatchingScheduler:
                         f"live ({len(req.generated)} of {req.max_new} "
                         f"tokens generated before the lane wedged)"
                     ))
+        if self._paged:
+            # Overcommit's safety valve: retirements above just freed
+            # pages; extend every live slot's mapping past the committed
+            # frontier + overshoot BEFORE the next round can write
+            # through an unmapped entry. Allocation failure preempts here
+            # (never silently drops KV).
+            self._topup_pages()
         self.heartbeat.round_done()
         # Flight-recorder round record (the postmortem black box): what
         # this round DID — occupancy at issue, admission/retirement churn
@@ -2620,9 +3148,12 @@ class ContinuousBatchingScheduler:
         if self._paged:
             # Page-pool occupancy per round: the flight-recorder column a
             # leaked page shows up in (pages_in_use that never drains
-            # while occupancy does).
+            # while occupancy does). kv_pressure is the injected withheld
+            # reserve (kv:pressure chaos site) — the column a preemption
+            # storm postmortem reads next to the preempt/resume events.
             rec["kv_pages"] = self._page_alloc.pages_in_use
             rec["kv_pages_free"] = self._page_alloc.pages_free
+            rec["kv_pressure"] = self._page_alloc.withheld
         self.flight.record(**rec)
         self._round_admitted = []
         self._round_retired = []
@@ -2632,9 +3163,9 @@ class ContinuousBatchingScheduler:
         if not self._first_pending:
             return
         firsts, self._first_pending = self._first_pending, []
-        vals = jax.device_get([t for (_, _, t) in firsts])
-        for (slot, req, _), fv in zip(firsts, vals):
-            self._append_first(slot, req, int(np.asarray(fv)[0]))
+        vals = jax.device_get([t for (_, _, t, _) in firsts])
+        for (slot, req, _, fep), fv in zip(firsts, vals):
+            self._append_first(slot, req, int(np.asarray(fv)[0]), epoch=fep)
 
     def _run(self) -> None:
         try:
@@ -2707,6 +3238,17 @@ class ContinuousBatchingScheduler:
             # iterations stamp busy=False every <=50ms (the queue.get
             # timeout below), so an idle loop never looks wedged.
             self.heartbeat.stamp(busy=self._busy_now())
+            if self._paged:
+                # Pressure-relief upkeep, every iteration (cheap int
+                # math when nothing is happening): sample the
+                # kv:pressure chaos site, evict prefix pages down to the
+                # high watermark when free pages dip under the low one,
+                # and fail page-starved waiters whose deadline burned
+                # (they would otherwise wait forever while slots stay
+                # busy).
+                self._sample_pressure()
+                self._watermark_sweep()
+                self._sweep_page_wait()
             # Admit pending requests into every free slot, then issue one
             # prompt chunk and one decode round — all asynchronously — and
             # harvest the oldest round once the pipeline is `_harvest_lag`
@@ -3073,8 +3615,14 @@ class SchedulerPool:
         for st in per:
             for k, v in st.items():
                 out[k] = out.get(k, 0) + int(v)
-        # Ratios/sizes don't sum: keep the first replica's page size.
-        out["page_size"] = per[0]["page_size"]
+        # Ratios/sizes/knobs/thresholds don't sum: keep the first
+        # replica's values (homogeneous fleets; heterogeneous knobs show
+        # per replica in replica_loads — a summed watermark compared
+        # against summed free pages would misread per-pool pressure).
+        for k in ("page_size", "overcommit", "spill",
+                  "watermark_low_pages", "watermark_high_pages"):
+            if k in per[0]:
+                out[k] = per[0][k]
         return out
 
     @property
@@ -3135,6 +3683,21 @@ class SchedulerPool:
                     rec["retry_after_s"] = round(hint(), 3)
                 except Exception:  # noqa: BLE001 — a dying replica mid-read
                     pass
+            # Paged-KV pressure gauges under the shared r{i} label
+            # vocabulary (numeric fields become per-replica Prometheus
+            # gauges): which replica is preempting/evicting, and how
+            # close each pool is to its watermarks.
+            pstats = getattr(s, "page_stats", None)
+            if pstats:
+                rec["kv_pages_free"] = pstats["pages_free"]
+                rec["kv_pages_withheld"] = pstats["pages_withheld"]
+                rec["kv_preemptions"] = pstats["preemptions"]
+                rec["kv_evictions"] = pstats["evictions"]
+                rec["kv_spilled_pages"] = pstats["spilled_pages"]
+                rec["kv_watermark_low_pages"] = \
+                    pstats["watermark_low_pages"]
+                rec["kv_watermark_high_pages"] = \
+                    pstats["watermark_high_pages"]
             out.append(rec)
         return out
 
@@ -3842,12 +4405,17 @@ class SchedulerBackend:
         kv_page_size: Optional[int] = None,
         kv_pages: Optional[int] = None,
         kv_hbm_budget_bytes: Optional[int] = None,
+        kv_overcommit: Optional[float] = None,
+        kv_spill: Optional[bool] = None,
+        kv_watermark_low: Optional[float] = None,
+        kv_watermark_high: Optional[float] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         speculative_draft: int = 0,
         max_queue_depth: int = 0,
         supervise: bool = False,
         max_restarts: int = 5,
+        max_entry_replays: int = 0,
         journal_spill: Optional[str] = None,
         stall_factor: float = 16.0,
         stall_min_s: float = 10.0,
@@ -3908,6 +4476,9 @@ class SchedulerBackend:
                 kv_layout=kv_layout, kv_page_size=kv_page_size,
                 kv_pages=kv_pages,
                 kv_hbm_budget_bytes=kv_hbm_budget_bytes,
+                kv_overcommit=kv_overcommit, kv_spill=kv_spill,
+                kv_watermark_low=kv_watermark_low,
+                kv_watermark_high=kv_watermark_high,
                 speculative_draft=speculative_draft,
                 max_queue_depth=max_queue_depth,
             )
@@ -3919,6 +4490,7 @@ class SchedulerBackend:
 
             return cls(SupervisedScheduler(
                 make_sched, max_restarts=max_restarts,
+                max_entry_replays=max_entry_replays,
                 spill_path=journal_spill,
                 stall_factor=stall_factor, stall_min_s=stall_min_s,
                 warmup_grace_s=stall_warmup_s,
@@ -3945,12 +4517,17 @@ class SchedulerBackend:
         kv_page_size: Optional[int] = None,
         kv_pages: Optional[int] = None,
         kv_hbm_budget_bytes: Optional[int] = None,
+        kv_overcommit: Optional[float] = None,
+        kv_spill: Optional[bool] = None,
+        kv_watermark_low: Optional[float] = None,
+        kv_watermark_high: Optional[float] = None,
         max_seq: Optional[int] = None,
         decode_chunk: int = 8,
         speculative_draft: int = 0,
         max_queue_depth: int = 0,
         supervise: bool = False,
         max_restarts: int = 5,
+        max_entry_replays: int = 0,
         journal_spill: Optional[str] = None,
         stall_factor: float = 16.0,
         stall_min_s: float = 10.0,
@@ -3999,6 +4576,9 @@ class SchedulerBackend:
                 kv_layout=kv_layout, kv_page_size=kv_page_size,
                 kv_pages=kv_pages,
                 kv_hbm_budget_bytes=kv_hbm_budget_bytes,
+                kv_overcommit=kv_overcommit, kv_spill=kv_spill,
+                kv_watermark_low=kv_watermark_low,
+                kv_watermark_high=kv_watermark_high,
                 speculative_draft=speculative_draft,
                 max_queue_depth=max_queue_depth,
             )
@@ -4010,6 +4590,7 @@ class SchedulerBackend:
 
             return cls(SupervisedScheduler(
                 make_sched, max_restarts=max_restarts,
+                max_entry_replays=max_entry_replays,
                 spill_path=journal_spill,
                 stall_factor=stall_factor, stall_min_s=stall_min_s,
                 warmup_grace_s=stall_warmup_s,

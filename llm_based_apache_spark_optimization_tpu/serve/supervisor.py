@@ -100,6 +100,7 @@ from .resilience import (
     DeadlineExceeded,
     Draining,
     Overloaded,
+    Quarantined,
     RetryPolicy,
     SchedulerCrashed,
     SchedulerStalled,
@@ -150,6 +151,13 @@ class JournalEntry:
     # that stops an entry ping-ponging across a fleet of dying replicas
     # instead of escalating to the full-pool restart path.
     replica_replays: int = 0
+    # Poison-request quarantine: how many crashed/stalled incarnations
+    # this entry has been replayed after. Past the supervisor's
+    # `max_entry_replays` (LSOT_MAX_ENTRY_REPLAYS) the entry retires
+    # typed `Quarantined` instead of riding down — and re-crashing —
+    # incarnation after incarnation until the fleet's restart budget is
+    # gone.
+    crash_replays: int = 0
 
 
 class SupervisedScheduler:
@@ -187,9 +195,12 @@ class SupervisedScheduler:
         stall_join_s: Optional[float] = None,
         warmup_grace_s: float = 0.0,
         postmortem_path: Optional[str] = None,
+        max_entry_replays: int = 0,
     ):
         if max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
+        if max_entry_replays < 0:
+            raise ValueError("max_entry_replays must be >= 0")
         if name is None:
             with SupervisedScheduler._instances_lock:
                 SupervisedScheduler._instances += 1
@@ -225,6 +236,15 @@ class SupervisedScheduler:
         self._restarts = 0
         self._replayed = 0
         self._lost = 0
+        # Poison-request quarantine (ISSUE 10): an entry replayed after
+        # more than this many crashed/stalled incarnations retires typed
+        # `Quarantined` instead of burning the restart budget crash by
+        # crash — one poison request must not take the fleet down with
+        # it. 0 disables (the library default; the app wires
+        # LSOT_MAX_ENTRY_REPLAYS). Set it BELOW max_restarts, or the
+        # budget dies first and the quarantine never fires.
+        self.max_entry_replays = int(max_entry_replays)
+        self._quarantined = 0
         # Watchdog (serve/watchdog.py): a monitor thread compares the
         # inner loop's heartbeat age against
         # max(stall_min_s, stall_factor × measured round cadence) and
@@ -618,6 +638,7 @@ class SupervisedScheduler:
                 "max_restarts": self.max_restarts,
                 "replayed": self._replayed,
                 "lost": self._lost,
+                "quarantined": self._quarantined,
                 "stalls": self._stalls,
                 "journal_depth": sum(
                     1 for e in self._journal.values() if not e.done
@@ -1183,7 +1204,9 @@ class SupervisedScheduler:
         """Replay ONE journal entry onto the current inner: the shared
         core of the full-restart replay pass and the fleet pools'
         per-replica re-placement. Returns `"replayed"`, `"lost"` (failed
-        typed), `"skipped"` (done/cancelled), or `"deferred"` (kept
+        typed), `"quarantined"` (poison entry retired typed after too
+        many crashed incarnations), `"skipped"` (done/cancelled), or
+        `"deferred"` (kept
         journaled for a later pass — only with `defer_on_overload`, the
         fleet case where a shed now would drop acknowledged work that a
         finishing replica rebuild is about to have room for). Raises
@@ -1215,6 +1238,33 @@ class SupervisedScheduler:
                 or SchedulerCrashed("scheduler loop crashed")
             ))
             return "lost"
+        # Poison-request quarantine: every call here means the entry's
+        # previous incarnation ended in a crash/stall/teardown — an entry
+        # that keeps riding down incarnations is the prime suspect for
+        # CAUSING them (a deterministically-crashing input replays into a
+        # crash every time, burning one restart credit per lap). Past the
+        # budget, retire it typed instead of replaying it again; the
+        # remaining journal replays normally and the fleet keeps its
+        # restart credits for organic failures.
+        e.crash_replays += 1
+        if self.max_entry_replays and \
+                e.crash_replays > self.max_entry_replays:
+            self._quarantined += 1
+            resilience.inc("quarantined")
+            self.flight.event("quarantine", rid=e.rid,
+                              replays=e.crash_replays - 1)
+            _log.warning(
+                "journal entry rid=%d quarantined after %d crashed "
+                "incarnations (max_entry_replays=%d)",
+                e.rid, e.crash_replays - 1, self.max_entry_replays,
+            )
+            self._fail_locked(e, Quarantined(
+                f"request quarantined: {e.crash_replays - 1} scheduler "
+                f"incarnations crashed while it was in flight "
+                f"(LSOT_MAX_ENTRY_REPLAYS={self.max_entry_replays}); "
+                f"not replaying it again"
+            ))
+            return "quarantined"
         try:
             self._submit_entry_locked(e)
         except DeadlineExceeded as exc:
@@ -1227,7 +1277,11 @@ class SupervisedScheduler:
                 # Fleet re-placement with nowhere to place right now
                 # (e.g. a pool-of-one mid-rebuild): keep the entry
                 # journaled — the pool's on_replica_restart callback
-                # replays it once the rebuild lands.
+                # replays it once the rebuild lands. The entry never
+                # reached an incarnation, so the quarantine tally above
+                # must not count this attempt (sustained overload would
+                # otherwise quarantine a healthy acknowledged request).
+                e.crash_replays -= 1
                 return "deferred"
             # A fresh loop's queue should hold the journal; a cap
             # smaller than the backlog is a deployment error — fail

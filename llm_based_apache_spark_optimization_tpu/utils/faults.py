@@ -38,6 +38,15 @@ boundaries so dependency timeouts/deadlines are exercised, not assumed.
 Site names are always exactly two `:`-separated segments — the parser
 relies on it to tell `site:point:prob:secs` from a malformed entry.
 
+**Value-valued sites** reuse the same 4th field as a plain NUMBER the
+injection point interprets itself, read through `FAULTS.value(site)`
+(fires with the configured probability, returns the value, never raises
+or sleeps). The one in-tree value site is `kv:pressure:p:v` — the paged
+KV scheduler shrinks its effective page pool by `v` (a fraction of the
+pool when v < 1, an absolute page count otherwise) for every loop
+iteration the site fires, forcing the allocation failures that drive
+victim preemption (serve/scheduler.py; `evalh --chaos` pressure stage).
+
 Injection points call `FAULTS.check("site:point")`, which raises
 `InjectedFault` (a ConnectionError subclass, so connect-phase retry
 classifiers treat it exactly like a real refused connection) — or, for a
@@ -192,6 +201,26 @@ class FaultRegistry:
             self._sleep(secs)
             return
         raise InjectedFault(site)
+
+    def value(self, site: str):
+        """Value-valued check: with the site's configured probability,
+        return its 4th-field number (never raises, never sleeps) — the
+        injection point applies its own semantics (e.g. `kv:pressure`
+        shrinks the effective page pool by the value). Returns None when
+        the site is unconfigured, has no value field, or the draw does
+        not fire. Counts like check() so chaos reports can still prove
+        the site fired."""
+        if not self._probs:  # fast path: injection off
+            return None
+        with self._lock:
+            prob = self._probs.get(site)
+            secs = self._durations.get(site)
+            if prob is None or secs is None \
+                    or self._rng.random() >= prob:
+                return None
+            self._counts[site] = self._counts.get(site, 0) + 1
+        resilience.inc("faults_injected")
+        return secs
 
     def counts(self) -> Dict[str, int]:
         """Injected faults per site since configure()."""

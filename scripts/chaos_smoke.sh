@@ -31,16 +31,18 @@ export JAX_PLATFORMS=cpu
 
 python -m pytest tests -q -m chaos -p no:cacheprovider "$@"
 
-# Crash-restart + hang-detection + fleet scenarios in the default lane:
-# the supervised scheduler must survive injected mid-batch loop deaths
-# with zero lost acknowledged requests, the watchdog must detect an
-# injected WEDGE (sched:hang — the loop sleeps, nothing raises) and
-# recover it with zero silently-hung clients, and a supervised FLEET
-# pool with one replica wedged must recover it with a TARGETED restart
-# — siblings untouched, zero lost (run_chaos asserts all three; the
-# JSON summary shows restarts/replayed/lost, the watchdog stage's
-# stalls/detection bound, and the fleet stage's per-replica restart
-# attribution).
+# Crash-restart + hang-detection + fleet + KV-PRESSURE scenarios in the
+# default lane: the supervised scheduler must survive injected mid-batch
+# loop deaths with zero lost acknowledged requests, the watchdog must
+# detect an injected WEDGE (sched:hang — the loop sleeps, nothing
+# raises) and recover it with zero silently-hung clients, a supervised
+# FLEET pool with one replica wedged must recover it with a TARGETED
+# restart — siblings untouched, zero lost — and the real paged scheduler
+# under a kv:pressure storm must preempt ≥1 victim and complete every
+# request token-identical to a pressure-free control (run_chaos asserts
+# all four; the JSON summary shows restarts/replayed/lost, the watchdog
+# stage's stalls/detection bound, the fleet stage's per-replica restart
+# attribution, and the kv_pressure stage's preemption tally).
 LSOT_FAULTS= python -m llm_based_apache_spark_optimization_tpu.evalh \
   --chaos "ollama:connect:0.5,sql:exec:1,sched:crash:0.2" \
   --chaos-seed "${LSOT_FAULTS_SEED}"

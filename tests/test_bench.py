@@ -351,3 +351,47 @@ def test_pool_routing_pass_balances_skewed_load():
     assert out["least_loaded"]["max_replica_share"] <= \
         out["round_robin"]["max_replica_share"] - 0.1
     assert "speedup" in out
+
+
+def test_kv_pressure_pass_overcommit_sustains_more_concurrency():
+    """ISSUE 10 bench leg: at a FIXED page pool, overcommit admission
+    sustains STRICTLY more concurrent requests than exact-envelope
+    admission on the mixed-length fixture (the pool's live-token benefit
+    reclaimed), with the preemption rate recorded as the cost — and the
+    figures reconcile: both legs serve every request (tok_s > 0) and the
+    peak occupancy never exceeds the slot count (no fabricated
+    concurrency)."""
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(Path(BENCH).parent))
+    from bench import _bench_kv_pressure
+
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+
+    params = init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+    # Generation-heavy envelopes (budget 40 vs prompts 24/8) at a pool of
+    # two worst-case envelopes: exact admission fits 2, overcommit at
+    # 0.25 fits 3+ and grows them mid-decode.
+    out = _bench_kv_pressure(
+        TINY, params, slots=4, max_new=40, prompt_bucket=8,
+        decode_chunk=4, mix_lens=[24, 8], page_size=8, pool_pages=16,
+        max_seq=96, overcommit=0.25,
+    )
+    assert out["requests"] == 8
+    for leg in ("exact", "overcommitted"):
+        assert out[leg]["tok_s"] > 0
+        assert 0 < out[leg]["peak_occupancy"] <= 4
+    # The acceptance bar: strictly more sustained concurrency at the
+    # same HBM.
+    assert out["overcommitted"]["peak_occupancy"] > \
+        out["exact"]["peak_occupancy"]
+    # Exact-envelope admission can never need a mid-decode top-up, so it
+    # can never preempt; the overcommit leg's preemption rate is the
+    # recorded cost (>= 0 — the pool may satisfy every top-up).
+    assert out["exact"]["preemptions"] == 0
+    assert out["preemption_rate"] >= 0.0
+    assert "tok_s_ratio" in out

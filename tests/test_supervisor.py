@@ -1399,3 +1399,148 @@ def test_chaos_evalh_reports_fleet_stage():
     assert a["pool_restarts"] == 0
     assert a["lost"] == 0 and a["unresolved"] == 0 and a["mismatched"] == 0
     assert a["stalls_detected"] >= 1
+
+
+# --------------------------------------- poison-request quarantine (ISSUE 10)
+
+
+class _PoisonToy:
+    """Host-only scheduler whose loop CRASHES deterministically whenever
+    it starts decoding the poison prompt [6, 6, 6] — the injected
+    poison-request scenario: every incarnation that replays it dies, so
+    without quarantine one request burns the whole restart budget."""
+
+    POISON = [6, 6, 6]
+
+    def __init__(self):
+        import queue as qm
+
+        from llm_based_apache_spark_optimization_tpu.serve.watchdog import (
+            Heartbeat,
+        )
+
+        self._queue: "qm.Queue" = qm.Queue()
+        self._crash = None
+        self._lock = threading.Lock()
+        self._thread = None
+        self.heartbeat = Heartbeat()
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown(self, timeout=None):
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout)
+            self._thread = None
+
+    def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
+               on_token=None, constraint=None, deadline_s=None, trace=None):
+        with self._lock:
+            if self._crash is not None:
+                raise self._crash
+        fut = Future()
+        self._queue.put((list(ids), seed, on_token, fut))
+        return fut
+
+    @staticmethod
+    def expected(ids, seed):
+        return [(sum(ids) * 13 + seed * 7 + i) % 997 for i in range(4)]
+
+    def _run(self):
+        import queue as qm
+
+        while True:
+            self.heartbeat.stamp(busy=False)
+            item = self._queue.get()
+            if item is None:
+                return
+            ids, seed, on_token, fut = item
+            try:
+                self.heartbeat.stamp(busy=True)
+                if ids == self.POISON:
+                    raise RuntimeError("poison request wedges the device")
+                out = self.expected(ids, seed)
+                for t in out:
+                    if on_token is not None:
+                        on_token(t)
+            except Exception as exc:  # noqa: BLE001 — loop death
+                crash = SchedulerCrashed.from_exception(exc)
+                with self._lock:
+                    self._crash = crash
+                fut.set_exception(crash)
+                while True:  # fail everything queued behind the corpse
+                    try:
+                        nxt = self._queue.get_nowait()
+                    except qm.Empty:
+                        return
+                    if nxt is not None:
+                        nxt[-1].set_exception(crash)
+            else:
+                fut.set_result(out)
+
+
+@pytest.mark.chaos
+def test_poison_request_quarantined_after_max_entry_replays():
+    """ISSUE-10 satellite: a journal entry whose replay has crashed
+    max_entry_replays incarnations retires typed `Quarantined` (client-
+    visible) instead of burning the restart budget lap after lap — the
+    fleet stays alive, siblings' work completes, and the `quarantined`
+    counter + health field move."""
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        Quarantined,
+    )
+
+    before = resilience.get("quarantined")
+    sup = SupervisedScheduler(
+        _PoisonToy, max_restarts=10, max_entry_replays=2,
+        restart_policy=RetryPolicy(max_attempts=11, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(0),
+    ).start()
+    try:
+        # The good request queues FIRST (FIFO: it completes before the
+        # poison kills the loop), so only the poison rides the crashes.
+        good = sup.submit([1, 2, 3], seed=5)
+        poison = sup.submit([6, 6, 6], idempotency_key="poison")
+        assert good.result(timeout=30) == _PoisonToy.expected([1, 2, 3], 5)
+        with pytest.raises(Quarantined):
+            poison.result(timeout=30)
+        wait_for(lambda: sup.health()["state"] == "ready",
+                 msg="post-quarantine recovery")
+        health = sup.health()
+        # 2 replays allowed -> 3 crashed incarnations -> 3 restarts, far
+        # under the budget of 10 the poison would otherwise exhaust.
+        assert health["quarantined"] == 1
+        assert health["restarts"] == 3
+        assert health["lost"] == 0
+        assert resilience.get("quarantined") == before + 1
+        # The fleet still serves after the quarantine.
+        after = sup.submit([4, 4], seed=9)
+        assert after.result(timeout=30) == _PoisonToy.expected([4, 4], 9)
+    finally:
+        sup.shutdown()
+
+
+def test_quarantine_disabled_by_default():
+    """max_entry_replays=0 (the library default) keeps today's behavior:
+    the poison rides the journal until the restart budget dies — proving
+    the knob, not the accident, controls the cutoff."""
+    sup = SupervisedScheduler(
+        _PoisonToy, max_restarts=2,
+        restart_policy=RetryPolicy(max_attempts=3, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(0),
+    ).start()
+    try:
+        poison = sup.submit([6, 6, 6])
+        with pytest.raises(SchedulerCrashed):
+            poison.result(timeout=30)
+        wait_for(lambda: sup.health()["state"] == "dead",
+                 msg="budget exhaustion")
+        assert sup.health()["quarantined"] == 0
+    finally:
+        sup.shutdown()
