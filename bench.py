@@ -88,7 +88,13 @@ PEAKS = {
 }
 
 
+#: Every _emit'd artifact line, in order (last = richest). The --compare
+#: gate reads the final line after a fresh run.
+_EMITTED: "list[dict]" = []
+
+
 def _emit(obj: dict) -> None:
+    _EMITTED.append(obj)
     print(json.dumps(obj), flush=True)
 
 
@@ -162,6 +168,10 @@ _LEGS = (
     ("7b4", "7b_int4", "BENCH_7B4", 600),
     ("7b_sched", "7b_sched", "BENCH_7B_SCHED", 780),
     ("fuse", "fused", "BENCH_FUSED", 600),
+    # Kernel-level microbench lane (paged-attention read, fused page
+    # write vs XLA scatter, mask gather — ns/op per leg): the numbers a
+    # hot-path PR cites without waiting on a chip tunnel.
+    ("micro", "kernels", "BENCH_MICRO", 300),
 )
 
 
@@ -314,7 +324,7 @@ def _param_bytes(params) -> int:
 
 def _paged_accounting(cfg, *, slots_contiguous, max_seq, max_new,
                       overshoot, mix_lens, page_size=64, itemsize=2,
-                      prompt_bucket=128):
+                      prompt_bucket=128, kv_quant=None):
     """Slots-at-fixed-HBM: how many concurrent requests of a mixed-length
     traffic sample the PAGED layout admits inside the HBM the contiguous
     layout spends on `slots_contiguous` worst-case rows. Pure host math
@@ -333,8 +343,13 @@ def _paged_accounting(cfg, *, slots_contiguous, max_seq, max_new,
         pages_for_tokens,
     )
 
+    # kv_quant prices the pool's KV dtype (engine/paged_kv.page_bytes):
+    # an int8 pool's pages cost ~half a compute-dtype page, so the SAME
+    # contiguous-bf16 HBM budget buys ~2x the pages — the slots-at-fixed-
+    # HBM lever ISSUE 11 ships (int8 strictly more slots than bf16,
+    # asserted by the tier-1 reconciliation test).
     budget = cache_bytes(cfg, slots_contiguous, max_seq, itemsize)
-    pages_total = budget // page_bytes(cfg, page_size, itemsize)
+    pages_total = budget // page_bytes(cfg, page_size, itemsize, kv_quant)
     needs = []
     for ln in mix_lens:
         need_tokens = bucket_len(ln, prompt_bucket) + max_new + overshoot
@@ -373,6 +388,7 @@ def _paged_accounting(cfg, *, slots_contiguous, max_seq, max_new,
         "overshoot": overshoot,
         "prompt_bucket": prompt_bucket,
         "max_seq": max_seq,
+        "kv_quant": kv_quant or "",
         "slots_ratio": (round(len(admitted) / slots_contiguous, 2)
                         if slots_contiguous else 0.0),
     }
@@ -440,6 +456,10 @@ def inner_leg(leg: str) -> int:
         return 0
     if leg == "7b_sched":
         _emit({"7b_sched": _bench_7b_sched(device_kind)})
+        return 0
+    if leg == "micro":
+        # Needs no params tree — pure kernel shapes.
+        _emit({"kernels": _bench_micro(device_kind)})
         return 0
 
     cfg = REGISTRY[os.environ.get("BENCH_CONFIG", "bench-1b")]
@@ -800,7 +820,18 @@ def _bench_long_paged(cfg, params, p, n) -> dict:
         overshoot=overshoot, mix_lens=mix, page_size=ps,
         prompt_bucket=pb,
     )
-    out = {"accounting": acct}
+    # Slots-at-fixed-HBM for the INT8 pool (ISSUE 11 acceptance): the
+    # same contiguous-bf16 budget, priced at int8 page bytes — strictly
+    # more admitted slots than the bf16 pool (tier-1 reconciles).
+    acct8 = _paged_accounting(
+        cfg, slots_contiguous=slots_c, max_seq=max_seq, max_new=max_new,
+        overshoot=overshoot, mix_lens=mix, page_size=ps,
+        prompt_bucket=pb, kv_quant="int8",
+    )
+    out = {"accounting": acct, "accounting_int8": acct8,
+           "int8_slots_vs_bf16": (round(
+               acct8["slots_paged"] / acct["slots_paged"], 2)
+               if acct["slots_paged"] else 0.0)}
 
     # Real mixed workload: shared schema prefix (hits from request 3 on —
     # publish gate), then per-request divergence; lengths alternate
@@ -860,6 +891,27 @@ def _bench_long_paged(cfg, params, p, n) -> dict:
         out["tok_s_ratio"] = round(
             out["paged"]["tok_s"] / out["contiguous"]["tok_s"], 2
         )
+    # The INT8 pool through a real scheduler at the SAME HBM budget: the
+    # kv-dtype-aware sizing grants ~2x the pages, so strictly more slots
+    # fit (mirrors accounting_int8 with live traffic; 1 rep — the pass
+    # exists to prove capacity, the tok/s story is the paged pass above,
+    # which is why the throughput key is tok_s_1rep: a 1-rep number must
+    # NOT enter the --compare gate's tracked tok_s metrics, or ordinary
+    # cold-compile variance reads as a regression).
+    sched_q = ContinuousBatchingScheduler(
+        cfg, params, num_slots=max(1, min(acct8["slots_paged"],
+                                          4 * slots_c)),
+        max_seq=max_seq, prompt_bucket=pb, decode_chunk=decode_chunk,
+        stop_ids=(-1,), kv_layout="paged", kv_page_size=ps,
+        kv_quant="int8",
+        kv_hbm_budget_bytes=cache_bytes(cfg, slots_c, max_seq),
+    )
+    out["paged_int8"] = {
+        "slots": sched_q.num_slots,
+        "tok_s_1rep": round(drive(sched_q, reps=1), 1),
+        "kv_pages": dict(sched_q.page_stats),
+    }
+    del sched_q
     # Graceful-degradation leg (ISSUE 10): overcommit-vs-exact admission
     # at a pool sized to TWO worst-case envelopes of a generation-heavy
     # mixed fixture — the shape where reserving max_new up front forfeits
@@ -964,6 +1016,150 @@ def _bench_kv_pressure(cfg, params, *, slots, max_new, prompt_bucket,
     }
     if exact["tok_s"]:
         out["tok_s_ratio"] = round(over["tok_s"] / exact["tok_s"], 2)
+    return out
+
+
+def _bench_micro(device_kind: str = "") -> dict:
+    """Kernel-level microbench lane (ISSUE 11 satellite, FlashInfer-Bench
+    posture): ns/op for each hot-path kernel leg vs its XLA twin, so a
+    hot-path PR cites before/after numbers in-PR instead of waiting on a
+    chip-tunnel window. Legs:
+
+    - paged_read:        ragged paged attention kernel vs the gather+einsum
+                         reference (the PR-7 read side)
+    - page_write:        fused Pallas page-write kernel vs the XLA
+                         scatter-through-table (this PR's write side)
+    - page_write_int8:   the quantizing variants of the same pair
+    - mask_gather:       the grammar need-table gather + compare + mask
+                         (the per-step constrained-decode cost)
+
+    Numbers are honest per-platform: off-TPU the Pallas kernels run in
+    interpreter mode and will lose to XLA — the committed artifact records
+    device_kind so a CPU lane is never misread as a chip capture. Shapes
+    ride BENCH_MICRO_* (tiny defaults keep the tier-1 reconciliation test
+    cheap); reps ride BENCH_MICRO_REPS."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.ops.pallas import (
+        fused_page_write,
+        fused_page_write_quantized,
+        paged_attention_reference,
+        paged_write_reference,
+        paged_write_reference_quantized,
+        ragged_paged_attention,
+    )
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        apply_token_mask,
+    )
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    reps = int(os.environ.get("BENCH_MICRO_REPS", "20" if on_tpu else "3"))
+    b = int(os.environ.get("BENCH_MICRO_BATCH", "8"))
+    kh = int(os.environ.get("BENCH_MICRO_KV_HEADS", "4"))
+    g = int(os.environ.get("BENCH_MICRO_GROUP", "4"))
+    h = int(os.environ.get("BENCH_MICRO_HEAD_DIM", "64"))
+    ps = int(os.environ.get("BENCH_MICRO_PAGE", "16"))
+    np_tab = int(os.environ.get("BENCH_MICRO_PAGES_PER_ROW", "8"))
+    n_layers = int(os.environ.get("BENCH_MICRO_LAYERS", "2"))
+    n_states = int(os.environ.get("BENCH_MICRO_STATES", "64"))
+    vocab = int(os.environ.get("BENCH_MICRO_VOCAB", "512"))
+    pool_pages = b * np_tab + 1
+    n = kh * g
+    rng = np.random.default_rng(5)
+
+    def ns_per_op(fn, *args):
+        out = fn(*args)  # warmup + compile
+        jax.block_until_ready(out)
+        t0 = _t.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return int((_t.perf_counter() - t0) / reps * 1e9)
+
+    kp = jnp.asarray(rng.normal(size=(pool_pages, kh, ps, h)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(pool_pages, kh, ps, h)), jnp.float32)
+    tab = jnp.asarray(
+        np.stack([rng.permutation(pool_pages - 1)[:np_tab]
+                  for _ in range(b)]), jnp.int32)
+    q = jnp.asarray(rng.normal(size=(b, 1, n, h)), jnp.float32)
+    pos = jnp.asarray(
+        rng.integers(ps, np_tab * ps, size=(b, 1)), jnp.int32)
+    kvl = pos[:, 0] + 1
+
+    out: dict = {
+        "device_kind": device_kind, "reps": reps,
+        "shape": {"b": b, "kv_heads": kh, "group": g, "head_dim": h,
+                  "page": ps, "pages_per_row": np_tab,
+                  "layers": n_layers},
+        "paged_read": {
+            "kernel_ns": ns_per_op(
+                ragged_paged_attention, q, kp, vp, tab, pos, None, kvl),
+            "xla_ns": ns_per_op(
+                jax.jit(lambda *a: paged_attention_reference(*a)),
+                q, kp, vp, tab, pos, None, kvl),
+        },
+    }
+
+    # Write side: one decode sliver per row through the table, stacked
+    # [L, P, ...] pools like the serving path writes them.
+    kp_l = jnp.asarray(
+        rng.normal(size=(n_layers, pool_pages, kh, ps, h)), jnp.float32)
+    vp_l = jnp.asarray(
+        rng.normal(size=(n_layers, pool_pages, kh, ps, h)), jnp.float32)
+    knew = jnp.asarray(rng.normal(size=(b, 1, kh, h)), jnp.float32)
+    vnew = jnp.asarray(rng.normal(size=(b, 1, kh, h)), jnp.float32)
+
+    @jax.jit
+    def xla_write(kp_, vp_, k_, v_, pos_, tab_):
+        return (paged_write_reference(kp_, k_, pos_, tab_, 0),
+                paged_write_reference(vp_, v_, pos_, tab_, 0))
+
+    out["page_write"] = {
+        "fused_ns": ns_per_op(
+            lambda *a: fused_page_write(*a, 0), kp_l, vp_l, knew, vnew,
+            pos, tab),
+        "xla_ns": ns_per_op(xla_write, kp_l, vp_l, knew, vnew, pos, tab),
+    }
+
+    kq = jnp.zeros((n_layers, pool_pages, kh, ps, h), jnp.int8)
+    ksq = jnp.ones((n_layers, pool_pages, kh, ps), jnp.float32)
+    vq = jnp.zeros((n_layers, pool_pages, kh, ps, h), jnp.int8)
+    vsq = jnp.ones((n_layers, pool_pages, kh, ps), jnp.float32)
+
+    out["page_write_int8"] = {
+        "fused_ns": ns_per_op(
+            lambda *a: fused_page_write_quantized(*a, 0),
+            kq, ksq, vq, vsq, knew, vnew, pos, tab),
+        "xla_ns": ns_per_op(
+            jax.jit(lambda *a: paged_write_reference_quantized(*a, 0)),
+            kq, ksq, vq, vsq, knew, vnew, pos, tab),
+    }
+
+    # Grammar mask gather: the per-step constrained-decode cost — one
+    # need-table row gather + budget compare + mask apply per slot.
+    need = jnp.asarray(
+        rng.integers(1, 8, size=(n_states, vocab)), jnp.int32)
+    states = jnp.asarray(rng.integers(0, n_states, size=(b,)), jnp.int32)
+    rem = jnp.asarray(rng.integers(1, 32, size=(b,)), jnp.int32)
+    logits = jnp.asarray(rng.normal(size=(b, vocab)), jnp.float32)
+
+    @jax.jit
+    def mask_gather(lg, nd, st, rm):
+        return apply_token_mask(lg, nd[st] <= rm[:, None])
+
+    out["mask_gather"] = {
+        "xla_ns": ns_per_op(mask_gather, logits, need, states, rem),
+    }
+
+    for leg in ("paged_read", "page_write", "page_write_int8"):
+        ref = out[leg].get("xla_ns", 0)
+        ker = out[leg].get("kernel_ns", out[leg].get("fused_ns", 0))
+        if ker:
+            out[leg]["xla_over_kernel"] = round(ref / ker, 2)
     return out
 
 
@@ -1986,7 +2182,121 @@ def _detail(cfg, eng, prompts, prompt_len, max_new, batch, full_dt,
     return out
 
 
+# --------------------------------------------------------------------------
+# Regression gate: bench.py --compare LAST.json [NEW.json]
+# --------------------------------------------------------------------------
+
+#: Higher-is-better metric keys the compare gate tracks wherever they
+#: appear in an artifact: decode/aggregate throughputs and speculative
+#: acceptance. Matched by full path, so "scheduler.tok_s" only ever
+#: compares against "scheduler.tok_s".
+_COMPARE_KEYS = ("value", "tok_s", "decode_tok_s", "tokens_per_round")
+
+
+def _collect_compare_metrics(obj, path="") -> "dict[str, float]":
+    """Flatten an artifact to {dotted.path: value} for every numeric leaf
+    whose key is a tracked metric (lists index numerically)."""
+    out: "dict[str, float]" = {}
+    if isinstance(obj, dict):
+        items = obj.items()
+    elif isinstance(obj, list):
+        items = ((str(i), v) for i, v in enumerate(obj))
+    else:
+        return out
+    for k, v in items:
+        p = f"{path}.{k}" if path else str(k)
+        if isinstance(v, (dict, list)):
+            out.update(_collect_compare_metrics(v, p))
+        elif k in _COMPARE_KEYS and isinstance(v, (int, float)):
+            out[p] = float(v)
+    return out
+
+
+def compare_artifacts(old: dict, new: dict,
+                      tolerance: float = 0.10) -> "list[str]":
+    """Regressions: tracked metrics present in BOTH artifacts where the
+    new value dropped more than `tolerance` below the old. Metrics only
+    one side has (new legs, skipped legs) are not regressions — the gate
+    flags decay, not coverage drift. A metric that COLLAPSED to zero in
+    the new artifact (e.g. a failed leg that emitted {"value": 0.0,
+    "error": ...}) is decay, not a skipped leg — it must fail the gate,
+    which is why the new side keeps non-positive values."""
+    olds = _collect_compare_metrics(old)
+    news = _collect_compare_metrics(new)
+    regressions = []
+    for p, ov in sorted(olds.items()):
+        nv = news.get(p)
+        if ov <= 0 or nv is None or nv >= (1.0 - tolerance) * ov:
+            continue
+        regressions.append(
+            f"{p}: {ov:g} -> {nv:g} ({(nv / ov - 1.0) * 100:+.1f}%)"
+        )
+    return regressions
+
+
+def compare_main(argv: "list[str]") -> int:
+    """`bench.py --compare LAST.json [NEW.json]`: the FlashInfer-Bench
+    regression gate — exits NON-ZERO when any tracked decode-throughput
+    or speculative-acceptance metric regresses more than
+    BENCH_COMPARE_TOL (default 10%) vs the LAST committed artifact.
+
+    With one file, runs the bench NOW (outer orchestration, probe/CPU
+    fallback included) and gates its final artifact; with two files,
+    pure offline compare — a CI lane needs no chip at all. Artifacts are
+    the bench's own stdout JSONL (last line = richest)."""
+    args = [a for a in argv[1:] if a != "--compare"]
+    if not args:
+        print("usage: bench.py --compare LAST.json [NEW.json]",
+              file=sys.stderr)
+        return 2
+    tol = float(os.environ.get("BENCH_COMPARE_TOL", "0.10"))
+    with open(args[0]) as f:
+        old = _last_json(f.read())
+    if old is None:
+        print(f"bench[compare]: no JSON artifact in {args[0]}",
+              file=sys.stderr)
+        return 2
+    if len(args) > 1:
+        with open(args[1]) as f:
+            new = _last_json(f.read())
+        if new is None:
+            print(f"bench[compare]: no JSON artifact in {args[1]}",
+                  file=sys.stderr)
+            return 2
+    else:
+        rc = inner() if os.environ.get("BENCH_INNER") == "1" else outer()
+        if rc != 0 or not _EMITTED:
+            print("bench[compare]: fresh run produced no artifact",
+                  file=sys.stderr)
+            return rc or 2
+        new = _EMITTED[-1]
+    # Same-environment guard: a CPU-fallback artifact (dead tunnel, probe
+    # timeout) gated against a chip baseline reads as a ~99% "regression"
+    # when the real problem is infrastructure. Both artifacts carry the
+    # platform they measured on — a mismatch is an environment problem,
+    # reported as its own exit code so CI can tell outage from decay.
+    oplat, nplat = old.get("platform"), new.get("platform")
+    if oplat and nplat and oplat != nplat:
+        print(f"bench[compare]: environment mismatch — baseline measured "
+              f"on {oplat!r}, new artifact on {nplat!r} (CPU fallback / "
+              f"dead tunnel?); refusing to gate throughput across "
+              f"platforms", file=sys.stderr)
+        return 3
+    regressions = compare_artifacts(old, new, tol)
+    if regressions:
+        print(f"bench[compare]: {len(regressions)} regression(s) past "
+              f"{tol:.0%}:", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        return 1
+    print(f"bench[compare]: no tracked metric regressed past {tol:.0%}",
+          file=sys.stderr)
+    return 0
+
+
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        sys.exit(compare_main(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":
         sys.exit(inner())
     sys.exit(outer())

@@ -241,13 +241,24 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
             scheduler_meshes = [mesh]
 
-    kv_quant = "int8" if getattr(args, "kv_int8", False) else None
-    if kv_quant and getattr(args, "speculative", 0) > 0 and not args.scheduler:
-        sys.exit("--kv-int8 cannot combine with --speculative: the "
-                 "speculative verify loop streams the bf16 cache")
-    if kv_quant and getattr(args, "kv_layout", "contiguous") == "paged":
-        sys.exit("--kv-int8 cannot combine with --kv-layout=paged yet: "
-                 "pool pages store compute-dtype K/V")
+    # --kv-int8, or the LSOT_KV_QUANT env knob (README "Quantized
+    # pages"); the CLI flag wins. Composes with --kv-layout=paged (int8
+    # page pool: ~2x live tokens per HBM byte). Rejections name the knob
+    # the user actually set, and a bad env value dies here with a clean
+    # message instead of a traceback deep in the engine.
+    if getattr(args, "kv_int8", False):
+        kv_quant, kv_quant_src = "int8", "--kv-int8"
+    else:
+        env_q = AppConfig.from_env().kv_quant or None
+        if env_q not in (None, "int8"):
+            sys.exit(f"LSOT_KV_QUANT must be '' or 'int8', got {env_q!r}")
+        kv_quant, kv_quant_src = env_q, "LSOT_KV_QUANT=int8"
+    if kv_quant and getattr(args, "speculative", 0) > 0 \
+            and not args.scheduler \
+            and getattr(args, "kv_layout", "contiguous") != "paged":
+        sys.exit(f"{kv_quant_src} cannot combine with --speculative on "
+                 "the contiguous layout: the speculative verify loop "
+                 "streams the bf16 cache (use --kv-layout=paged)")
     int4 = getattr(args, "int4", False)
     if int4 and args.int8:
         sys.exit("pick one of --int8 / --int4")

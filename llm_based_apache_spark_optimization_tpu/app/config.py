@@ -79,6 +79,13 @@ class AppConfig:
     # (recompute, or spilled host page copies with kv_spill).
     kv_overcommit: float = 1.0
     kv_spill: bool = False
+    # KV-cache storage dtype ("" = compute dtype, "int8" = quantized KV —
+    # README "Quantized pages"): the env twin of the --kv-int8 CLI flag
+    # (the flag wins when both are set). With kv_layout="paged" the pool
+    # stores int8 pages + per-position scales, so the same HBM budget
+    # holds ~2x the live tokens; page accounting, watermarks and
+    # overcommit all price the true int8 page bytes.
+    kv_quant: str = ""
     # Free-page watermarks (fractions of the pool): under LOW, the
     # scheduler proactively evicts LRU prefix-cache pages until HIGH
     # recovers — pressure is relieved before an allocation fails. 0 = off.

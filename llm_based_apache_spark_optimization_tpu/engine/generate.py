@@ -109,18 +109,12 @@ def make_generate_fn(
         from .paged_kv import default_page_size
 
         page_size = int(kv_page_size or default_page_size())
-        if kv_quant:
-            raise ValueError(
-                "kv_quant and kv_layout='paged' cannot combine yet: the "
-                "page pool stores compute-dtype K/V (int8 pages are a "
-                "follow-up)"
-            )
-        if mesh is not None:
-            raise ValueError(
-                "kv_layout='paged' runs unsharded for now: the pool's "
-                "KV-head axis can shard like the contiguous cache, but "
-                "the paged programs are not mesh-threaded yet"
-            )
+        # kv_quant="int8" + paged (ISSUE 11): the pool stores int8 pages
+        # + per-position scales — quantized inside pack_prefill_pages,
+        # dequantized in the ragged read kernel's DMA'd tiles / the
+        # int8-streaming reference path. A mesh shards the pool's KV-head
+        # axis over tp like the contiguous cache (constrain_cache's paged
+        # branch); page tables replicate.
     return _make_generate_fn(
         cfg, max_new, sampling, stop_ids, mesh,
         attn_impl or attention_impl(mesh),
@@ -242,7 +236,23 @@ def _make_generate_fn(
         # accepts pre-sliced params — a forced ring impl scans instead.
         dec_params = params if decode_impl == "ring" else split_blocks(params)
 
-        if kv_quant:
+        if paged:
+            # Prefill→decode handoff: pack the prompt K/V into pool pages
+            # with identity per-row tables; the while_loop below carries
+            # the pool, and forward's paged branch reads/writes through
+            # the table every step (the same paged decode program shape
+            # the scheduler serves with). kv_quant="int8" quantizes
+            # INSIDE the pack (int8 pages + per-position scales) — the
+            # same prefill-bf16-then-quantize-once handoff as the
+            # contiguous int8 path, per page.
+            from .paged_kv import pack_prefill_pages
+
+            ppr = -(-(t + max_new) // page_size)
+            cache = pack_prefill_pages(cache, page_size, ppr,
+                                       kv_quant=kv_quant)
+            if mesh is not None:
+                cache = constrain_cache(cache, mesh)
+        elif kv_quant:
             # One-pass cache quantization between prefill and decode: the
             # loop carries int8 values + f32 per-slot scales and every step
             # streams ~half the cache bytes (ops/quant.quantize_kv).
@@ -251,16 +261,6 @@ def _make_generate_fn(
             cache = quantize_cache(cache["k"], cache["v"])
             if mesh is not None:
                 cache = constrain_cache(cache, mesh)
-        elif paged:
-            # Prefill→decode handoff: pack the prompt K/V into pool pages
-            # with identity per-row tables; the while_loop below carries
-            # the pool, and forward's paged branch reads/writes through
-            # the table every step (the same paged decode program shape
-            # the scheduler serves with).
-            from .paged_kv import pack_prefill_pages
-
-            ppr = -(-(t + max_new) // page_size)
-            cache = pack_prefill_pages(cache, page_size, ppr)
 
         def cond(carry):
             done, step = carry[3], carry[5]
@@ -347,34 +347,30 @@ class InferenceEngine:
 
             params = maybe_fuse(params, mesh)
         # "int8": decode streams an int8 KV cache (half the cache bytes;
-        # make_generate_fn docstring). Greedy/sampled both supported; the
-        # speculative path has no int8-KV variant, and silently dropping a
-        # requested memory/bandwidth mode would misattribute results — so
-        # the combination is rejected up front.
-        if kv_quant and speculative_draft:
+        # make_generate_fn docstring). Greedy/sampled both supported. The
+        # CONTIGUOUS speculative path has no int8-KV variant (its verify
+        # loop streams the bf16 cache), and silently dropping a requested
+        # memory/bandwidth mode would misattribute results — so that
+        # combination stays rejected; the PAGED pool's verify windows run
+        # the int8-streaming reference gather, so int8 + paged +
+        # speculative composes.
+        if kv_quant and speculative_draft and kv_layout != "paged":
             raise ValueError(
-                "kv_quant and speculative_draft cannot combine: the "
-                "speculative verify loop streams the bf16 cache"
+                "kv_quant and speculative_draft cannot combine on the "
+                "contiguous layout: the speculative verify loop streams "
+                "the bf16 cache (use kv_layout='paged')"
             )
         self.kv_quant = kv_quant
         # "paged": decode loops carry the shared page pool + per-row page
         # tables instead of a contiguous cache (engine/paged_kv.py) —
         # greedy-parity-tested against the contiguous layout, and the
         # engine-side proof of the programs the scheduler serves with.
+        # Composes with kv_quant="int8" (int8 pages + per-position
+        # scales) and with a mesh (pool KV heads shard over tp).
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(
                 f"kv_layout must be 'contiguous' or 'paged', got "
                 f"{kv_layout!r}"
-            )
-        if kv_layout == "paged" and kv_quant:
-            raise ValueError(
-                "kv_quant and kv_layout='paged' cannot combine yet: pool "
-                "pages store compute-dtype K/V"
-            )
-        if kv_layout == "paged" and mesh is not None:
-            raise ValueError(
-                "kv_layout='paged' runs unsharded for now (the paged "
-                "programs are not mesh-threaded yet)"
             )
         self.kv_layout = kv_layout
         self.kv_page_size = kv_page_size
@@ -465,6 +461,7 @@ class InferenceEngine:
                 self.speculative_draft, self.speculative_ngram,
                 constrained=constraint is not None,
                 kv_layout=self.kv_layout, kv_page_size=self.kv_page_size,
+                kv_quant=self.kv_quant,
                 sampling=sampling,
             )
             args = [

@@ -35,6 +35,15 @@ Page size rides `LSOT_KV_PAGE_SIZE` (default 64): a multiple of 8 keeps
 pool pages sublane-aligned for the Pallas ragged-paged-attention kernel
 (ops/pallas/paged_attention.py), whose block grid DMAs one [K, page, H]
 page per cell through the scalar-prefetched page table.
+
+`kv_quant="int8"` (ISSUE 11) stores the pool as int8 values plus one f32
+scale per (layer, page, kv-head, position) — "kps"/"vps" arrays
+[L, P, K, page] beside "kp"/"vp" — so the same HBM budget holds ~2x the
+live tokens. Quantization happens on the way IN (pack_prefill_pages, the
+prefill windowed scatter, the fused page-write kernel) and dequantization
+on the way OUT (inside the ragged read kernel's DMA'd tiles, or the
+int8-streaming einsum reference); `page_bytes`/`pages_for_budget` price
+the KV dtype so every capacity surface reports true bytes.
 """
 
 from __future__ import annotations
@@ -68,20 +77,39 @@ def default_page_size() -> int:
     return ps
 
 
-def page_bytes(cfg: LlamaConfig, page_size: int, itemsize: int = 2) -> int:
-    """Device bytes of ONE pool page across all layers (K and V)."""
-    return (
-        2 * cfg.num_layers * cfg.num_kv_heads * page_size * cfg.head_dim
-        * itemsize
-    )
+def page_bytes(
+    cfg: LlamaConfig, page_size: int, itemsize: int = 2,
+    kv_quant: Optional[str] = None,
+) -> int:
+    """Device bytes of ONE pool page across all layers (K and V).
+
+    `kv_quant="int8"` prices the QUANTIZED pool layout: int8 values plus
+    one f32 scale per (layer, page, kv-head, position) — the KV dtype, not
+    the compute dtype (`itemsize` is ignored there). Every capacity
+    surface (pages_for_budget, the scheduler's HBM-budget sizing,
+    /metrics serving.kv_pages, the bench accounting) must go through this
+    so an int8 pool reports ~2x the true tokens per HBM byte instead of
+    compute-dtype fiction."""
+    per_pos = cfg.head_dim * itemsize
+    if kv_quant == "int8":
+        # int8 value bytes + one f32 scale per position (absmax over H).
+        per_pos = cfg.head_dim * 1 + 4
+    elif kv_quant is not None:
+        raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
+    return 2 * cfg.num_layers * cfg.num_kv_heads * page_size * per_pos
 
 
 def pages_for_budget(
-    cfg: LlamaConfig, budget_bytes: int, page_size: int, itemsize: int = 2
+    cfg: LlamaConfig, budget_bytes: int, page_size: int, itemsize: int = 2,
+    kv_quant: Optional[str] = None,
 ) -> int:
     """Pool pages an HBM budget buys (the paged twin of
-    engine/kvcache.cache_bytes — same cfg, same itemsize convention)."""
-    return max(0, int(budget_bytes) // page_bytes(cfg, page_size, itemsize))
+    engine/kvcache.cache_bytes — same cfg, same itemsize convention;
+    `kv_quant` prices the int8 page layout, so the same budget buys ~2x
+    the pages)."""
+    return max(
+        0, int(budget_bytes) // page_bytes(cfg, page_size, itemsize, kv_quant)
+    )
 
 
 def pages_for_tokens(n_tokens: int, page_size: int) -> int:
@@ -90,23 +118,41 @@ def pages_for_tokens(n_tokens: int, page_size: int) -> int:
 
 
 def init_page_pool(
-    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16
+    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=jnp.bfloat16,
+    kv_quant: Optional[str] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Allocate the shared device page pool. Layout mirrors the contiguous
     cache with the (batch, S) axes replaced by one page axis: per
     (page, kv-head) the pool is a contiguous [page_size, H] tile — the
-    MXU/Pallas-friendly trailing (sublane, lane) shape."""
+    MXU/Pallas-friendly trailing (sublane, lane) shape.
+
+    `kv_quant="int8"` stores int8 values plus f32 per-position scales
+    ("kps"/"vps", [L, P, K, page_size] — the paged twin of the contiguous
+    {"k8","ks","v8","vs"} layout, ops/quant.quantize_kv): the pool holds
+    ~2x the live tokens per HBM byte. Scales init to 1.0 so an unwritten
+    page dequantizes to harmless zeros, never NaN."""
     if page_size <= 0 or page_size % 8:
         raise ValueError(
             f"page_size must be a positive multiple of 8, got {page_size}"
         )
     shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
              cfg.head_dim)
+    if kv_quant == "int8":
+        sshape = shape[:-1]
+        return {
+            "kp": jnp.zeros(shape, jnp.int8),
+            "kps": jnp.ones(sshape, jnp.float32),
+            "vp": jnp.zeros(shape, jnp.int8),
+            "vps": jnp.ones(sshape, jnp.float32),
+        }
+    if kv_quant is not None:
+        raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
     return {"kp": jnp.zeros(shape, dtype), "vp": jnp.zeros(shape, dtype)}
 
 
 def pack_prefill_pages(
-    cache: Dict[str, jnp.ndarray], page_size: int, pages_per_row: int
+    cache: Dict[str, jnp.ndarray], page_size: int, pages_per_row: int,
+    kv_quant: Optional[str] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Contiguous prefill cache {"k","v"} [L, B, K, S, H] -> paged cache
     {"kp","vp","ptab"} with identity per-row tables (row b owns pool pages
@@ -116,7 +162,14 @@ def pack_prefill_pages(
     handoff: prefill runs the proven contiguous scan path over a
     prompt-sized transient cache, one transpose-scatter packs its K/V into
     pool pages, and the decode `lax.while_loop` carries the pool + tables
-    (models/llama.forward's paged branch). Pure jnp — runs inside jit."""
+    (models/llama.forward's paged branch). Pure jnp — runs inside jit.
+
+    `kv_quant="int8"` QUANTIZES inside the pack (ops/quant.quantize_kv:
+    int8 values + one f32 scale per position, absmax over H) and returns
+    the int8 pool layout {"kp","kps","vp","vps","ptab"} — the
+    prefill-fills-bf16-then-quantize-once handoff the contiguous int8
+    path uses, applied per page. Unwritten pool scale entries stay 1.0 so
+    unmapped-page garbage dequantizes finite."""
     k = cache["k"]
     n_layers, b, kh, s, h = k.shape
     ppr = int(pages_per_row)
@@ -133,15 +186,35 @@ def pack_prefill_pages(
         + jnp.arange(ppr, dtype=jnp.int32)[None, :]
     )
 
-    def pack(arr):
-        a = jnp.pad(arr, ((0, 0), (0, 0), (0, 0), (0, s_pad - s), (0, 0)))
-        a = a.reshape(n_layers, b, kh, np0, page_size, h)
-        a = a.transpose(0, 1, 3, 2, 4, 5)  # [L, B, np0, K, PS, H]
-        pool = jnp.zeros(
-            (n_layers, num_pages, kh, page_size, h), arr.dtype
+    def pack(arr, fill=0.0):
+        # Values [L, B, K, S, H] and per-position scales [L, B, K, S] both
+        # land here: the scale path just drops the trailing H axis.
+        has_h = arr.ndim == 5
+        pad = ((0, 0), (0, 0), (0, 0), (0, s_pad - s)) + (
+            ((0, 0),) if has_h else ()
+        )
+        a = jnp.pad(arr, pad, constant_values=fill)
+        shape = (n_layers, b, kh, np0, page_size) + ((h,) if has_h else ())
+        a = a.reshape(shape)
+        perm = (0, 1, 3, 2, 4, 5) if has_h else (0, 1, 3, 2, 4)
+        a = a.transpose(perm)  # [L, B, np0, K, PS(, H)]
+        pool = jnp.full(
+            (n_layers, num_pages, kh, page_size) + ((h,) if has_h else ()),
+            fill, arr.dtype,
         )
         return pool.at[:, ptab[:, :np0]].set(a)
 
+    if kv_quant == "int8":
+        from ..ops.quant import quantize_kv
+
+        kq, vq = quantize_kv(cache["k"]), quantize_kv(cache["v"])
+        return {
+            "kp": pack(kq["q8"]), "kps": pack(kq["s"], fill=1.0),
+            "vp": pack(vq["q8"]), "vps": pack(vq["s"], fill=1.0),
+            "ptab": ptab,
+        }
+    if kv_quant is not None:
+        raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
     return {"kp": pack(cache["k"]), "vp": pack(cache["v"]), "ptab": ptab}
 
 

@@ -327,6 +327,7 @@ def make_speculative_generate_fn(
     constrained: bool = False,
     kv_layout: str = "contiguous",
     kv_page_size: Optional[int] = None,
+    kv_quant: Optional[str] = None,
     sampling: Optional["SamplingParams"] = None,
 ):
     """Generate with prompt-lookup speculation (greedy or sampled).
@@ -379,19 +380,24 @@ def make_speculative_generate_fn(
         )
     page_size = 0
     decode = attn_impl or decode_attention_impl(mesh)
+    if kv_quant not in (None, "int8"):
+        raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
+    if kv_quant and kv_layout != "paged":
+        raise ValueError(
+            "kv_quant='int8' speculation needs kv_layout='paged': the "
+            "contiguous verify loop streams the bf16 cache, the paged "
+            "pool's verify windows run the int8-streaming reference gather"
+        )
     if kv_layout == "paged":
         from .paged_kv import default_page_size
 
         page_size = int(kv_page_size or default_page_size())
-        if mesh is not None:
-            raise ValueError(
-                "kv_layout='paged' runs unsharded for now (the paged "
-                "programs are not mesh-threaded yet)"
-            )
         # The verify window is T=D+1 > 1 and the ragged-paged kernel is a
         # T=1 decode specialization: paged verify forwards always take the
         # reference gather path (same pin the scheduler's spec_decode
-        # makes), even under a forced-pallas attention mode.
+        # makes), even under a forced-pallas attention mode. A mesh shards
+        # the pool's KV-head axis over tp (constrain_cache's paged
+        # branch); page tables replicate.
         decode = "xla"
     return _make_speculative_generate_fn(
         cfg, max_new, stop_ids, mesh, draft_len, ngram,
@@ -400,6 +406,7 @@ def make_speculative_generate_fn(
         constrained,
         kv_layout,
         page_size,
+        kv_quant,
         sampling or SamplingParams(),
     )
 
@@ -417,6 +424,7 @@ def _make_speculative_generate_fn(
     constrained: bool = False,
     kv_layout: str = "contiguous",
     page_size: int = 0,
+    kv_quant: Optional[str] = None,
     sampling: SamplingParams = SamplingParams(),
 ):
     from .generate import _is_stop as _is_stop_ids
@@ -481,7 +489,10 @@ def _make_speculative_generate_fn(
             from .paged_kv import pack_prefill_pages
 
             ppr = -(-(t + max_new + d1) // page_size)
-            cache = pack_prefill_pages(cache, page_size, ppr)
+            cache = pack_prefill_pages(cache, page_size, ppr,
+                                       kv_quant=kv_quant)
+            if mesh is not None:
+                cache = constrain_cache(cache, mesh)
 
         # History = prompt tokens + generated, contiguous per row (generated
         # tokens land at hlen, after the row's REAL prompt; the pad gap up

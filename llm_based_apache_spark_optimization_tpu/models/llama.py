@@ -238,28 +238,11 @@ def _update_cache_layer(
     return cache
 
 
-def _update_paged_cache_layer(
-    pool: jnp.ndarray,       # [L, P, K, PS, H] — shared page pool
-    new: jnp.ndarray,        # [B, T, K, H] fresh K or V
-    positions: jnp.ndarray,  # [B, T] i32 absolute positions
-    page_table: jnp.ndarray,  # [B, NP] i32 (num_pages = unmapped sentinel)
-    layer: int,
-) -> jnp.ndarray:
-    """Write a fresh K/V sliver through per-row page tables at a static
-    layer index (the paged twin of `_update_cache_layer`).
-
-    One scatter per layer: positions translate to (pool page, in-page
-    offset) pairs and jax's OOB-scatter-drop semantics make unmapped table
-    entries (the `num_pages` sentinel) true no-ops — parked scheduler
-    slots and prefill padding rows write nothing, with no branching."""
-    ps = pool.shape[3]
-    pos = positions.astype(jnp.int32)
-    idx = jnp.clip(pos // ps, 0, page_table.shape[1] - 1)
-    pages = jnp.take_along_axis(page_table, idx, axis=1)  # [B, T]
-    offs = pos % ps
-    # Advanced indices at non-adjacent dims (pool page, in-page offset)
-    # broadcast to the front: the update is [B, T, K, H] — exactly `new`.
-    return pool.at[layer, pages, :, offs].set(new.astype(pool.dtype))
+# The paged write path lives in ops/pallas/paged_write.py: an XLA
+# reference scatter (`paged_write_reference`, the pre-kernel path
+# verbatim — bit-identical CPU/einsum serving) and the fused Pallas
+# scatter-through-table kernel the T=1 pallas decode path swaps in
+# (`fused_page_write` / the int8-quantizing variant).
 
 
 def forward(
@@ -499,22 +482,110 @@ def forward(
                     )
                 x = post_attn(p, x, attn)
             elif paged_cache:
-                # Paged pool: write the sliver through the page table (one
-                # scatter per layer; unmapped rows drop), then attend —
-                # the ragged-paged kernel gathers pool pages in the DMA
-                # index map (T=1), the reference path gathers them as a
-                # contiguous view (any small T, e.g. verify windows).
+                # Paged pool: write the sliver through the page table,
+                # then attend. The T=1 pallas path runs BOTH sides fused:
+                # the scatter-through-table write kernel (K+V in one
+                # launch, DMA slivers only — ops/pallas/paged_write) and
+                # the ragged-paged read kernel whose DMA index map does
+                # the gather; the xla/einsum path keeps the XLA reference
+                # scatter (bit-identical to the pre-kernel write) and the
+                # contiguous-view gather (any small T, e.g. verify
+                # windows). An int8 pool ({"kps","vps"} scale arrays)
+                # quantizes the fresh sliver on the way in — inside the
+                # write kernel on the pallas path — and dequantizes on
+                # the way out: in the read kernel's DMA'd tiles, or via
+                # the int8-streaming einsum attention on the reference
+                # path. Under a mesh, writes stay on the XLA scatter
+                # (GSPMD partitions it over the pool's tp-sharded head
+                # axis) and pallas reads go through the shard_map
+                # wrappers, mirroring the contiguous branch.
                 ptab = cache["ptab"]
-                new_cache["kp"] = _update_paged_cache_layer(
-                    new_cache["kp"], k, positions, ptab, l)
-                new_cache["vp"] = _update_paged_cache_layer(
-                    new_cache["vp"], v, positions, ptab, l)
-                if impl == "pallas":  # T == 1 (validated above)
-                    from ..ops.pallas import ragged_paged_attention
+                quant_paged = "kps" in cache
+                use_write_kernel = impl == "pallas" and mesh is None
+                if quant_paged:
+                    if use_write_kernel:
+                        from ..ops.pallas import fused_page_write_quantized
 
-                    attn = ragged_paged_attention(
-                        q, new_cache["kp"][l], new_cache["vp"][l], ptab,
-                        positions, cfg.sliding_window, kv_lens,
+                        (new_cache["kp"], new_cache["kps"],
+                         new_cache["vp"], new_cache["vps"]) = \
+                            fused_page_write_quantized(
+                                new_cache["kp"], new_cache["kps"],
+                                new_cache["vp"], new_cache["vps"],
+                                k, v, positions, ptab, l)
+                    else:
+                        from ..ops.pallas import (
+                            paged_write_reference_quantized,
+                        )
+
+                        (new_cache["kp"], new_cache["kps"],
+                         new_cache["vp"], new_cache["vps"]) = \
+                            paged_write_reference_quantized(
+                                new_cache["kp"], new_cache["kps"],
+                                new_cache["vp"], new_cache["vps"],
+                                k, v, positions, ptab, l)
+                else:
+                    if use_write_kernel:
+                        from ..ops.pallas import fused_page_write
+
+                        new_cache["kp"], new_cache["vp"] = fused_page_write(
+                            new_cache["kp"], new_cache["vp"], k, v,
+                            positions, ptab, l)
+                    else:
+                        from ..ops.pallas import paged_write_reference
+
+                        new_cache["kp"] = paged_write_reference(
+                            new_cache["kp"], k, positions, ptab, l)
+                        new_cache["vp"] = paged_write_reference(
+                            new_cache["vp"], v, positions, ptab, l)
+                if impl == "pallas":  # T == 1 (validated above)
+                    if quant_paged:
+                        from ..ops.pallas import (
+                            ragged_paged_attention_quantized,
+                            sharded_ragged_paged_attention_quantized,
+                        )
+
+                        if mesh is not None:
+                            attn = sharded_ragged_paged_attention_quantized(
+                                mesh, q, new_cache["kp"][l],
+                                new_cache["kps"][l], new_cache["vp"][l],
+                                new_cache["vps"][l], ptab, positions,
+                                cfg.sliding_window, kv_lens,
+                            )
+                        else:
+                            attn = ragged_paged_attention_quantized(
+                                q, new_cache["kp"][l], new_cache["kps"][l],
+                                new_cache["vp"][l], new_cache["vps"][l],
+                                ptab, positions, cfg.sliding_window,
+                                kv_lens,
+                            )
+                    else:
+                        from ..ops.pallas import (
+                            ragged_paged_attention,
+                            sharded_ragged_paged_attention,
+                        )
+
+                        if mesh is not None:
+                            attn = sharded_ragged_paged_attention(
+                                mesh, q, new_cache["kp"][l],
+                                new_cache["vp"][l], ptab, positions,
+                                cfg.sliding_window, kv_lens,
+                            )
+                        else:
+                            attn = ragged_paged_attention(
+                                q, new_cache["kp"][l], new_cache["vp"][l],
+                                ptab, positions, cfg.sliding_window,
+                                kv_lens,
+                            )
+                elif quant_paged:
+                    from ..ops.pallas import gather_page_scales, gather_pages
+
+                    attn = gqa_attention_quantized(
+                        q,
+                        gather_pages(new_cache["kp"][l], ptab),
+                        gather_page_scales(new_cache["kps"][l], ptab),
+                        gather_pages(new_cache["vp"][l], ptab),
+                        gather_page_scales(new_cache["vps"][l], ptab),
+                        mask,
                     )
                 else:
                     from ..ops.pallas import gather_pages

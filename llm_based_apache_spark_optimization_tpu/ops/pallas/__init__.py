@@ -17,9 +17,20 @@ from .attention import (  # noqa: F401
     sharded_flash_gqa_attention_quantized,
 )
 from .paged_attention import (  # noqa: F401
+    gather_page_scales,
     gather_pages,
     paged_attention_reference,
+    paged_attention_reference_quantized,
     ragged_paged_attention,
+    ragged_paged_attention_quantized,
+    sharded_ragged_paged_attention,
+    sharded_ragged_paged_attention_quantized,
+)
+from .paged_write import (  # noqa: F401
+    fused_page_write,
+    fused_page_write_quantized,
+    paged_write_reference,
+    paged_write_reference_quantized,
 )
 from .dispatch import (  # noqa: F401
     attention_impl,

@@ -47,58 +47,157 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..common import NEG_INF
+from ..common import NEG_INF, shard_map as _shard_map
 from .attention import _CompilerParams, _flash_block_update, _LANES
 
 
-def _paged_decode_kernel(
-    kvlen_ref,  # [B] i32 SMEM (scalar prefetch) — live KV tokens per row
-    table_ref,  # [B, NP] i32 SMEM (scalar prefetch) — page tables
-    qpos_ref,   # [1, 1, GT] i32
-    q_ref,      # [1, K, GT, H]
-    k_ref,      # [1, K, PS, H] — pool page picked by the index map
-    v_ref,      # [1, K, PS, H]
-    o_ref,      # [1, K, GT, H]
-    m_ref,      # [K, GT, LANES] f32 scratch
-    l_ref,      # [K, GT, LANES] f32 scratch
-    acc_ref,    # [K, GT, H] f32 scratch
-    *,
-    scale: float,
-    sliding_window: Optional[int],
-    kv_len: int,
-):
-    i = pl.program_id(1)
-    ps = k_ref.shape[2]
-    kvl = kvlen_ref[pl.program_id(0)]
+def _make_paged_decode_kernel(dequant):
+    """Paged decode kernel factory (grid = (B, NP), page axis innermost).
+    `dequant(stream_refs, dtype) -> (k, v)` turns the DMA'd pool-page
+    tiles into compute tiles — identity for bf16 pools, VMEM
+    dequantization for int8 values + per-position scales — so the
+    init/skip/finalize skeleton exists exactly once (the same factoring
+    as the contiguous `_make_decode_kernel`)."""
 
-    @pl.when(i == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+    def kernel(
+        kvlen_ref,  # [B] i32 SMEM (scalar prefetch) — live KV tokens/row
+        table_ref,  # [B, NP] i32 SMEM (scalar prefetch) — page tables
+        qpos_ref,   # [1, 1, GT] i32
+        q_ref,      # [1, K, GT, H]
+        *rest,      # stream refs (pool tiles picked by the index map),
+                    # then o_ref + m/l/acc scratch
+        scale: float,
+        sliding_window: Optional[int],
+        kv_len: int,
+    ):
+        *stream_refs, o_ref, m_ref, l_ref, acc_ref = rest
+        i = pl.program_id(1)
+        ps = stream_refs[0].shape[2]
+        kvl = kvlen_ref[pl.program_id(0)]
 
-    qp_row = qpos_ref[0, 0]       # [GT]
+        @pl.when(i == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Same skip rule as the contiguous decode kernel: pages whose first
-    # logical position exceeds every query position — or the row's live
-    # length — contribute nothing (their DMA was already elided by the
-    # clamped index map).
-    @pl.when((i * ps <= jnp.max(qp_row)) & (i * ps < kvl))
-    def _compute():
-        m_new, l_new, acc_new = _flash_block_update(
-            q_ref[0], k_ref[0], v_ref[0], qp_row, kvl, i, ps,
-            m_ref[:, :, :1], l_ref[:, :, :1], acc_ref[...],
-            scale=scale, sliding_window=sliding_window, kv_len=kv_len,
-        )
-        acc_ref[:] = acc_new
-        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
-        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        qp_row = qpos_ref[0, 0]       # [GT]
 
-    @pl.when(i == pl.num_programs(1) - 1)
-    def _finalize():
-        l = l_ref[:, :, :1]
-        out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = out.astype(o_ref.dtype)
+        # Same skip rule as the contiguous decode kernel: pages whose
+        # first logical position exceeds every query position — or the
+        # row's live length — contribute nothing (their DMA was already
+        # elided by the clamped index map).
+        @pl.when((i * ps <= jnp.max(qp_row)) & (i * ps < kvl))
+        def _compute():
+            k, v = dequant(stream_refs, q_ref.dtype)
+            m_new, l_new, acc_new = _flash_block_update(
+                q_ref[0], k, v, qp_row, kvl, i, ps,
+                m_ref[:, :, :1], l_ref[:, :, :1], acc_ref[...],
+                scale=scale, sliding_window=sliding_window, kv_len=kv_len,
+            )
+            acc_ref[:] = acc_new
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+        @pl.when(i == pl.num_programs(1) - 1)
+        def _finalize():
+            l = l_ref[:, :, :1]
+            out = acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = out.astype(o_ref.dtype)
+
+    return kernel
+
+
+# bf16 pool: streams are (k_page, v_page), used as-is.
+_paged_decode_kernel = _make_paged_decode_kernel(
+    lambda refs, dt: (refs[0][0], refs[1][0])
+)
+
+
+def _dequant_page_streams(refs, dt):
+    """(k8, ks, v8, vs) int8 page + per-position scale tiles -> compute
+    tiles. The pool streamed ~half the bytes of a bf16 pool; the dequant
+    runs on the VMEM tiles only (the contract ISSUE 11 names: dequantize
+    inside the kernel's DMA'd tiles)."""
+    k8, ks, v8, vs = refs
+    k = (k8[0].astype(jnp.float32) * ks[0].astype(jnp.float32)).astype(dt)
+    v = (v8[0].astype(jnp.float32) * vs[0].astype(jnp.float32)).astype(dt)
+    return k, v
+
+
+# int8 pool: streams are (k8 [1,K,PS,H], ks [1,K,PS,1], v8, vs).
+_paged_decode_kernel_q8 = _make_paged_decode_kernel(_dequant_page_streams)
+
+
+def _run_paged_grid(kernel, q, streams, page_table, q_positions,
+                    sliding_window, kv_lens, interpret):
+    """The paged decode pipeline shared by the bf16 and int8 kernels:
+    grid (B, NP) with the page table in SCALAR PREFETCH — every stream's
+    BlockSpec index map translates the kv_lens-clamped logical page
+    through the table, so the gather happens in the DMA engine's
+    addressing for values and scales alike. `streams` is a list of
+    (array [P, K, PS, ...tail], tail_block_shape) pairs — (h,) for K/V
+    value pools, (1,) for per-position scale columns."""
+    b, t, n, h = q.shape
+    num_pages, kh, ps = streams[0][0].shape[:3]
+    g = n // kh
+    np_tab = page_table.shape[1]
+    s_virt = np_tab * ps
+
+    if kv_lens is None:
+        kv_lens = jnp.max(q_positions, axis=1) + 1
+    kv_lens = jnp.clip(kv_lens.astype(jnp.int32), 0, s_virt)
+    table = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+
+    # [B, 1, N, H] -> [B, K, G, H] (GT = G at T=1), like the contiguous
+    # decode grid.
+    q5 = q.reshape(b, kh, g, h)
+    qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))[:, None, :]
+
+    def kv_map(bi, i, kvl, tab):
+        # Clamp at the row's last LIVE logical page, then translate through
+        # its table: steps past the live region re-map the same pool page
+        # and the DMA is elided — the bandwidth saving, not just a compute
+        # skip.
+        last = jnp.maximum((kvl[bi] + ps - 1) // ps - 1, 0)
+        return (tab[bi, jnp.minimum(i, last)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, np_tab),
+        in_specs=[
+            pl.BlockSpec((1, 1, g), lambda bi, i, kvl, tab: (bi, 0, 0)),
+            pl.BlockSpec((1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)),
+        ] + [
+            pl.BlockSpec((1, kh, ps) + tail, kv_map)
+            for _, tail in streams
+        ],
+        out_specs=pl.BlockSpec(
+            (1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((kh, g, _LANES), jnp.float32),
+            pltpu.VMEM((kh, g, _LANES), jnp.float32),
+            pltpu.VMEM((kh, g, h), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            kernel, scale=h**-0.5,
+            sliding_window=sliding_window, kv_len=s_virt,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, g, h), q.dtype),
+        # Batch rows are independent (megacore splits them); the page axis
+        # carries the online-softmax accumulators in order on one core.
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(kv_lens, table, qpos, q5, *[arr for arr, _ in streams])
+    return out.reshape(b, kh, g, 1, h).transpose(0, 3, 1, 2, 4).reshape(
+        b, 1, n, h
+    )
 
 
 @functools.partial(
@@ -126,11 +225,7 @@ def ragged_paged_attention(
             f"ragged paged kernel is decode-only (T=1), got T={t}; verify "
             f"windows take paged_attention_reference"
         )
-    num_pages, kh, ps, _ = k_pool.shape
-    g = n // kh
-    np_tab = page_table.shape[1]
-    s_virt = np_tab * ps
-
+    ps = k_pool.shape[2]
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     if not interpret and ps % 8:
@@ -138,59 +233,122 @@ def ragged_paged_attention(
             f"pool pages must be sublane-aligned (page size multiple of 8) "
             f"on TPU, got {ps}"
         )
-    if kv_lens is None:
-        kv_lens = jnp.max(q_positions, axis=1) + 1
-    kv_lens = jnp.clip(kv_lens.astype(jnp.int32), 0, s_virt)
-    table = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
-
-    # [B, 1, N, H] -> [B, K, G, H] (GT = G at T=1), like the contiguous
-    # decode grid.
-    q5 = q.reshape(b, kh, g, h)
-    qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))[:, None, :]
-
-    def kv_map(bi, i, kvl, tab):
-        # Clamp at the row's last LIVE logical page, then translate through
-        # its table: steps past the live region re-map the same pool page
-        # and the DMA is elided — the bandwidth saving, not just a compute
-        # skip.
-        last = jnp.maximum((kvl[bi] + ps - 1) // ps - 1, 0)
-        return (tab[bi, jnp.minimum(i, last)], 0, 0, 0)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, np_tab),
-        in_specs=[
-            pl.BlockSpec((1, 1, g), lambda bi, i, kvl, tab: (bi, 0, 0)),
-            pl.BlockSpec((1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)),
-            pl.BlockSpec((1, kh, ps, h), kv_map),
-            pl.BlockSpec((1, kh, ps, h), kv_map),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((kh, g, _LANES), jnp.float32),
-            pltpu.VMEM((kh, g, _LANES), jnp.float32),
-            pltpu.VMEM((kh, g, h), jnp.float32),
-        ],
+    h = q.shape[3]
+    return _run_paged_grid(
+        _paged_decode_kernel, q, [(k_pool, (h,)), (v_pool, (h,))],
+        page_table, q_positions, sliding_window, kv_lens, interpret,
     )
-    out = pl.pallas_call(
-        functools.partial(
-            _paged_decode_kernel, scale=h**-0.5,
-            sliding_window=sliding_window, kv_len=s_virt,
-        ),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, g, h), q.dtype),
-        # Batch rows are independent (megacore splits them); the page axis
-        # carries the online-softmax accumulators in order on one core.
-        compiler_params=_CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
-        ),
+
+
+@functools.partial(
+    jax.jit, static_argnames=("sliding_window", "interpret")
+)
+def ragged_paged_attention_quantized(
+    q: jnp.ndarray,            # [B, 1, N, H] — decode only (T == 1)
+    k_pool: jnp.ndarray,       # [P, K, PS, H] int8 — one layer's page pool
+    k_scale: jnp.ndarray,      # [P, K, PS] f32 — per-position K scales
+    v_pool: jnp.ndarray,       # [P, K, PS, H] int8
+    v_scale: jnp.ndarray,      # [P, K, PS] f32
+    page_table: jnp.ndarray,   # [B, NP] i32
+    q_positions: jnp.ndarray,  # [B, 1] i32
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,  # [B] i32
+    *,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """`ragged_paged_attention` over the INT8 page pool: the table-driven
+    DMA gather streams int8 value pages plus their f32 per-position scale
+    columns (~half a bf16 pool's bytes), and the dequantize runs on the
+    VMEM tiles inside the kernel — int8 streaming and per-row ragged
+    bounding stacked, the paged twin of
+    `attention.flash_gqa_attention_quantized`."""
+    b, t, n, h = q.shape
+    if t != 1:
+        raise ValueError(
+            f"quantized ragged paged kernel is decode-only (T=1), got "
+            f"T={t}; verify windows take paged_attention_reference_quantized"
+        )
+    ps = k_pool.shape[2]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if not interpret and ps % 8:
+        raise ValueError(
+            f"pool pages must be sublane-aligned (page size multiple of 8) "
+            f"on TPU, got {ps}"
+        )
+    ks4 = k_scale.astype(jnp.float32)[..., None]  # [P, K, PS, 1]
+    vs4 = v_scale.astype(jnp.float32)[..., None]
+    return _run_paged_grid(
+        _paged_decode_kernel_q8, q,
+        [(k_pool, (h,)), (ks4, (1,)), (v_pool, (h,)), (vs4, (1,))],
+        page_table, q_positions, sliding_window, kv_lens, interpret,
+    )
+
+
+def sharded_ragged_paged_attention(
+    mesh,
+    q, k_pool, v_pool, page_table, q_positions,
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,
+    *,
+    interpret: Optional[bool] = None,
+):
+    """`ragged_paged_attention` under a tp mesh via `jax.shard_map`: the
+    pool shards its KV-HEAD axis over tp (parallel/sharding — every page
+    holds all heads, each device holds its heads' slice of every page),
+    page tables and positions replicate, and the per-device body is the
+    single-device kernel on local shapes — no collective inside, exactly
+    like `attention.sharded_flash_gqa_attention`. The batch axis rides
+    "dp" (dp=1 for the scheduler, whose slot axis never shards)."""
+    from jax.sharding import PartitionSpec as P
+
+    body = functools.partial(
+        ragged_paged_attention, sliding_window=sliding_window,
         interpret=interpret,
-    )(kv_lens, table, qpos, q5, k_pool, v_pool)
-    return out.reshape(b, kh, g, 1, h).transpose(0, 3, 1, 2, 4).reshape(
-        b, 1, n, h
     )
+    if kv_lens is None:
+        kv_lens = jnp.max(q_positions.astype(jnp.int32), axis=1) + 1
+    return _shard_map(
+        lambda q_, k_, v_, t_, p_, l_: body(q_, k_, v_, t_, p_, kv_lens=l_),
+        mesh=mesh,
+        in_specs=(P("dp", None, "tp", None), P(None, "tp", None, None),
+                  P(None, "tp", None, None), P("dp", None), P("dp", None),
+                  P("dp")),
+        out_specs=P("dp", None, "tp", None),
+        check_vma=False,
+    )(q, k_pool, v_pool, page_table, q_positions, kv_lens)
+
+
+def sharded_ragged_paged_attention_quantized(
+    mesh,
+    q, k_pool, k_scale, v_pool, v_scale, page_table, q_positions,
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,
+    *,
+    interpret: Optional[bool] = None,
+):
+    """The int8-pool kernel under a tp mesh (scales shard with their
+    KV-head axis, like the contiguous quantized wrapper)."""
+    from jax.sharding import PartitionSpec as P
+
+    body = functools.partial(
+        ragged_paged_attention_quantized, sliding_window=sliding_window,
+        interpret=interpret,
+    )
+    if kv_lens is None:
+        kv_lens = jnp.max(q_positions.astype(jnp.int32), axis=1) + 1
+    return _shard_map(
+        lambda q_, k_, ks_, v_, vs_, t_, p_, l_: body(
+            q_, k_, ks_, v_, vs_, t_, p_, kv_lens=l_
+        ),
+        mesh=mesh,
+        in_specs=(P("dp", None, "tp", None), P(None, "tp", None, None),
+                  P(None, "tp", None), P(None, "tp", None, None),
+                  P(None, "tp", None), P("dp", None), P("dp", None),
+                  P("dp")),
+        out_specs=P("dp", None, "tp", None),
+        check_vma=False,
+    )(q, k_pool, k_scale, v_pool, v_scale, page_table, q_positions, kv_lens)
 
 
 def gather_pages(
@@ -207,6 +365,27 @@ def gather_pages(
     safe = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
     g = pool[safe]                          # [B, NP, K, PS, H]
     return g.transpose(0, 2, 1, 3, 4).reshape(b, kh, np_tab * ps, h)
+
+
+def gather_page_scales(
+    pool_s: jnp.ndarray,      # [P, K, PS] — one layer's per-position scales
+    page_table: jnp.ndarray,  # [B, NP] i32
+) -> jnp.ndarray:
+    """Materialize per-row contiguous scale views [B, K, NP*PS] by
+    gathering scale columns through the table — the H-less twin of
+    `gather_pages`, for the int8 pool's reference/verify-window paths."""
+    num_pages, kh, ps = pool_s.shape
+    b, np_tab = page_table.shape
+    safe = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
+    g = pool_s[safe]                        # [B, NP, K, PS]
+    return g.transpose(0, 2, 1, 3).reshape(b, kh, np_tab * ps)
+
+
+def _mask_kv_lens(mask, kv_lens, s_virt):
+    kv_idx = jnp.arange(s_virt, dtype=jnp.int32)[None, None, :]
+    return mask & (kv_idx < jnp.clip(
+        kv_lens.astype(jnp.int32), 0, s_virt
+    )[:, None, None])
 
 
 def paged_attention_reference(
@@ -227,10 +406,7 @@ def paged_attention_reference(
     s_virt = k_full.shape[2]
     mask = attention_mask(q_positions, s_virt, sliding_window)
     if kv_lens is not None:
-        kv_idx = jnp.arange(s_virt, dtype=jnp.int32)[None, None, :]
-        mask = mask & (kv_idx < jnp.clip(
-            kv_lens.astype(jnp.int32), 0, s_virt
-        )[:, None, None])
+        mask = _mask_kv_lens(mask, kv_lens, s_virt)
         # Fully-parked rows (kv_lens=0) return zeros like the kernel, not
         # a uniform softmax over NEG_INF scores.
         out = gqa_attention(q, k_full, v_full, mask)
@@ -238,3 +414,37 @@ def paged_attention_reference(
             (kv_lens > 0)[:, None, None, None], out, jnp.zeros_like(out)
         )
     return gqa_attention(q, k_full, v_full, mask)
+
+
+def paged_attention_reference_quantized(
+    q: jnp.ndarray,            # [B, T, N, H]
+    k_pool: jnp.ndarray,       # [P, K, PS, H] int8
+    k_scale: jnp.ndarray,      # [P, K, PS] f32
+    v_pool: jnp.ndarray,       # [P, K, PS, H] int8
+    v_scale: jnp.ndarray,      # [P, K, PS] f32
+    page_table: jnp.ndarray,   # [B, NP] i32
+    q_positions: jnp.ndarray,  # [B, T] i32
+    sliding_window: Optional[int] = None,
+    kv_lens: Optional[jnp.ndarray] = None,  # [B] i32
+) -> jnp.ndarray:
+    """XLA reference over the int8 pool: gather value pages AND scale
+    columns through the table, then run the int8-streaming einsum
+    attention (ops/attention.gqa_attention_quantized — the contiguous
+    int8 cache's exact math). Serves any T, so quantized verify windows
+    and CPU decode run through it."""
+    from ..attention import attention_mask, gqa_attention_quantized
+
+    k_full = gather_pages(k_pool, page_table)
+    v_full = gather_pages(v_pool, page_table)
+    ks_full = gather_page_scales(k_scale, page_table)
+    vs_full = gather_page_scales(v_scale, page_table)
+    s_virt = k_full.shape[2]
+    mask = attention_mask(q_positions, s_virt, sliding_window)
+    if kv_lens is not None:
+        mask = _mask_kv_lens(mask, kv_lens, s_virt)
+        out = gqa_attention_quantized(q, k_full, ks_full, v_full, vs_full,
+                                      mask)
+        return jnp.where(
+            (kv_lens > 0)[:, None, None, None], out, jnp.zeros_like(out)
+        )
+    return gqa_attention_quantized(q, k_full, ks_full, v_full, vs_full, mask)

@@ -1,0 +1,286 @@
+"""Fused page-write: the scatter-through-table twin of the ragged read.
+
+`ops/pallas/paged_attention.py` moved the paged READ's gather into the DMA
+engine (page table in scalar prefetch, pool page picked by the index map);
+this module does the same for the WRITE side — the per-layer
+write-through-table scatter in `models/llama.forward`'s paged branch, the
+known decode hot-path suspect opposite the already-kernelized read.
+
+Why the XLA scatter hurts at decode: `pool.at[layer, pages, :, offs].set`
+is a gather-indexed scatter over a [L, P, K, PS, H] operand — XLA lowers
+it as a scatter op whose operand layout frequently forces a full-pool
+layout-conversion copy per layer (the same pathology
+`models/llama._update_cache_layer`'s docstring measured for the contiguous
+cache), and even the good lowering re-touches whole pages to land a
+[B, T, K, H] sliver. The kernel instead issues ONE bounded DMA per
+(row, token) sliver straight into the page the scalar-prefetched table
+names: HBM traffic is exactly the fresh K/V bytes.
+
+Kernel design:
+
+- Grid = (B, T). The (page, offset, validity) triples are tiny int math
+  done OUTSIDE the kernel (`_write_coords`) and ride scalar prefetch; the
+  pools live in `ANY` (HBM) memory space and alias their outputs, so
+  nothing of the pool is ever streamed — the kernel's only HBM writes are
+  `pltpu.make_async_copy` slivers [K, H] (values) and [K] (scales).
+- Unmapped / out-of-row positions carry an invalid flag and skip the DMA
+  under `pl.when` — the same drop semantics jax gives the XLA scatter's
+  OOB indices, so parked scheduler slots and prefill padding rows write
+  nothing.
+- K and V land in one kernel launch per layer (the "fused" half: the XLA
+  path dispatched two scatters per layer); the quantizing variant also
+  computes the per-position absmax scale over H on the VPU and writes
+  int8 values + f32 scales in the same launch — four DMAs, zero extra
+  passes over the sliver.
+- Writes within a grid cell target that row's OWN exclusive pages (the
+  scheduler's copy-on-write sweep guarantees no shared page sits in a
+  write range), so cells never race on a page; the grid is declared
+  "arbitrary" anyway since DMA issue order is irrelevant for disjoint
+  destinations.
+
+`paged_write_reference` / `paged_write_reference_quantized` are the XLA
+goldens: bit-identical on CPU (interpret-mode parity tests) and the
+always-correct path `models/llama.forward` keeps for the einsum impl —
+bf16 paged serving off-TPU is byte-for-byte what it was before this
+kernel existed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _coords(positions, page_table, page_size, num_pages):
+    """(pages [B, T], offs [B, T]): pool page + in-page offset per written
+    position. Positions past the virtual row or through an unmapped table
+    entry get page == num_pages — the kernel's skip flag and the XLA
+    scatter's dropped-OOB index, one definition shared by both paths."""
+    pos = positions.astype(jnp.int32)
+    np_tab = page_table.shape[1]
+    page_idx = pos // page_size
+    pages = jnp.take_along_axis(
+        page_table.astype(jnp.int32),
+        jnp.clip(page_idx, 0, np_tab - 1), axis=1,
+    )
+    # Past-the-row positions must DROP, not clip (a clipped lookup would
+    # alias the row's last mapped page — the resumed-final-chunk overhang
+    # regression the scheduler's prefill scatter documents).
+    pages = jnp.where(
+        (page_idx >= 0) & (page_idx < np_tab), pages, jnp.int32(num_pages)
+    )
+    offs = pos % page_size
+    return pages, offs
+
+
+def _bf16_write_kernel(pages_ref, offs_ref, knew_ref, vnew_ref,
+                       _kp_any, _vp_any, okp, ovp, ksem, vsem, *,
+                       layer: int, num_pages: int):
+    b, t = pl.program_id(0), pl.program_id(1)
+    pg, off = pages_ref[b, t], offs_ref[b, t]
+
+    @pl.when(pg < num_pages)
+    def _():
+        kcp = pltpu.make_async_copy(
+            knew_ref.at[b, t],
+            okp.at[layer, pg, :, pl.ds(off, 1), :].at[:, 0], ksem,
+        )
+        vcp = pltpu.make_async_copy(
+            vnew_ref.at[b, t],
+            ovp.at[layer, pg, :, pl.ds(off, 1), :].at[:, 0], vsem,
+        )
+        kcp.start()
+        vcp.start()
+        kcp.wait()
+        vcp.wait()
+
+
+def _quant_write_kernel(pages_ref, offs_ref, knew_ref, vnew_ref,
+                        _kp, _ks, _vp, _vs, okp, oks, ovp, ovs,
+                        kq_scr, ks_scr, vq_scr, vs_scr,
+                        ksem, kssem, vsem, vssem, *,
+                        layer: int, num_pages: int):
+    b, t = pl.program_id(0), pl.program_id(1)
+    pg, off = pages_ref[b, t], offs_ref[b, t]
+
+    def quantize(x):
+        x = x.astype(jnp.float32)
+        s = jnp.max(jnp.abs(x), axis=-1) / 127.0          # [K]
+        s = jnp.where(s == 0.0, 1.0, s)
+        q8 = jnp.clip(jnp.round(x / s[:, None]), -127, 127).astype(jnp.int8)
+        return q8, s
+
+    kq, ks = quantize(knew_ref[b, t])
+    vq, vs = quantize(vnew_ref[b, t])
+    kq_scr[...], ks_scr[...] = kq, ks
+    vq_scr[...], vs_scr[...] = vq, vs
+
+    @pl.when(pg < num_pages)
+    def _():
+        cps = (
+            pltpu.make_async_copy(
+                kq_scr, okp.at[layer, pg, :, pl.ds(off, 1), :].at[:, 0],
+                ksem),
+            pltpu.make_async_copy(
+                ks_scr, oks.at[layer, pg, :, pl.ds(off, 1)].at[:, 0], kssem),
+            pltpu.make_async_copy(
+                vq_scr, ovp.at[layer, pg, :, pl.ds(off, 1), :].at[:, 0],
+                vsem),
+            pltpu.make_async_copy(
+                vs_scr, ovs.at[layer, pg, :, pl.ds(off, 1)].at[:, 0], vssem),
+        )
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
+
+
+@functools.partial(jax.jit, static_argnums=(6,),
+                   static_argnames=("interpret",))
+def fused_page_write(
+    kp: jnp.ndarray,          # [L, P, K, PS, H] — shared K page pool
+    vp: jnp.ndarray,          # [L, P, K, PS, H]
+    k_new: jnp.ndarray,       # [B, T, K, H] fresh K sliver
+    v_new: jnp.ndarray,       # [B, T, K, H]
+    positions: jnp.ndarray,   # [B, T] i32 absolute positions
+    page_table: jnp.ndarray,  # [B, NP] i32
+    layer: int,
+    *,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Write K and V slivers through per-row page tables at a static layer
+    index, in one kernel launch (the Pallas twin of
+    `paged_write_reference`, which remains the XLA/CPU golden). Both
+    pools alias their outputs: HBM traffic is the slivers alone."""
+    num_pages = kp.shape[1]
+    ps = kp.shape[3]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    pages, offs = _coords(positions, page_table, ps, num_pages)
+    b, t = pages.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, t),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+            pl.BlockSpec(memory_space=pltpu.ANY),    # kp (aliased)
+            pl.BlockSpec(memory_space=pltpu.ANY),    # vp (aliased)
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                   pl.BlockSpec(memory_space=pltpu.ANY)],
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        functools.partial(_bf16_write_kernel, layer=layer,
+                          num_pages=num_pages),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(kp.shape, kp.dtype),
+                   jax.ShapeDtypeStruct(vp.shape, vp.dtype)],
+        # args: 2 prefetch + (k_new, v_new, kp, vp) -> kp is arg 4, vp 5.
+        input_output_aliases={4: 0, 5: 1},
+        interpret=interpret,
+    )(pages, offs, k_new.astype(kp.dtype), v_new.astype(vp.dtype), kp, vp)
+
+
+@functools.partial(jax.jit, static_argnums=(8,),
+                   static_argnames=("interpret",))
+def fused_page_write_quantized(
+    kp: jnp.ndarray,          # [L, P, K, PS, H] int8
+    kps: jnp.ndarray,         # [L, P, K, PS] f32 per-position K scales
+    vp: jnp.ndarray,          # [L, P, K, PS, H] int8
+    vps: jnp.ndarray,         # [L, P, K, PS] f32
+    k_new: jnp.ndarray,       # [B, T, K, H] fresh bf16/f32 K sliver
+    v_new: jnp.ndarray,       # [B, T, K, H]
+    positions: jnp.ndarray,   # [B, T] i32
+    page_table: jnp.ndarray,  # [B, NP] i32
+    layer: int,
+    *,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The int8-quantizing fused write: absmax-over-H scales computed on
+    the VPU inside the kernel (ops/quant.quantize_kv's exact math —
+    parity-tested against `paged_write_reference_quantized`), int8 values
+    + f32 scales written in the same launch as four sliver DMAs."""
+    num_pages = kp.shape[1]
+    ps = kp.shape[3]
+    kh, h = kp.shape[2], kp.shape[4]
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    pages, offs = _coords(positions, page_table, ps, num_pages)
+    b, t = pages.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, t),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # k_new
+            pl.BlockSpec(memory_space=pltpu.VMEM),   # v_new
+            pl.BlockSpec(memory_space=pltpu.ANY),    # kp (aliased)
+            pl.BlockSpec(memory_space=pltpu.ANY),    # kps (aliased)
+            pl.BlockSpec(memory_space=pltpu.ANY),    # vp (aliased)
+            pl.BlockSpec(memory_space=pltpu.ANY),    # vps (aliased)
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY) for _ in range(4)],
+        scratch_shapes=[
+            pltpu.VMEM((kh, h), jnp.int8), pltpu.VMEM((kh,), jnp.float32),
+            pltpu.VMEM((kh, h), jnp.int8), pltpu.VMEM((kh,), jnp.float32),
+            pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_quant_write_kernel, layer=layer,
+                          num_pages=num_pages),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(a.shape, a.dtype)
+                   for a in (kp, kps, vp, vps)],
+        # args: 2 prefetch + (k_new, v_new, kp, kps, vp, vps).
+        input_output_aliases={4: 0, 5: 1, 6: 2, 7: 3},
+        interpret=interpret,
+    )(pages, offs, k_new, v_new, kp, kps, vp, vps)
+
+
+def paged_write_reference(
+    pool: jnp.ndarray,        # [L, P, K, PS, H]
+    new: jnp.ndarray,         # [B, T, K, H]
+    positions: jnp.ndarray,   # [B, T] i32
+    page_table: jnp.ndarray,  # [B, NP] i32
+    layer: int,
+) -> jnp.ndarray:
+    """XLA golden for the value write (one K-or-V pool): a single scatter
+    through the table whose OOB indices drop — parked/padding rows and
+    past-the-row positions write nothing. This IS the pre-kernel write
+    path, verbatim, so the bf16 CPU serving path stays bit-identical."""
+    num_pages = pool.shape[1]
+    ps = pool.shape[3]
+    pages, offs = _coords(positions, page_table, ps, num_pages)
+    # Advanced indices at non-adjacent dims (pool page, in-page offset)
+    # broadcast to the front: the update is [B, T, K, H] — exactly `new`.
+    return pool.at[layer, pages, :, offs].set(new.astype(pool.dtype))
+
+
+def paged_write_reference_quantized(
+    kp: jnp.ndarray, kps: jnp.ndarray, vp: jnp.ndarray, vps: jnp.ndarray,
+    k_new: jnp.ndarray, v_new: jnp.ndarray,
+    positions: jnp.ndarray, page_table: jnp.ndarray, layer: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """XLA golden for the quantizing write: ops/quant.quantize_kv on the
+    fresh slivers, then the value scatter plus its scale twin (the scale
+    pool drops the H axis; same dropped-OOB semantics)."""
+    from ..quant import quantize_kv
+
+    num_pages = kp.shape[1]
+    ps = kp.shape[3]
+    pages, offs = _coords(positions, page_table, ps, num_pages)
+    kq, vq = quantize_kv(k_new), quantize_kv(v_new)
+    return (
+        kp.at[layer, pages, :, offs].set(kq["q8"]),
+        kps.at[layer, pages, :, offs].set(kq["s"]),
+        vp.at[layer, pages, :, offs].set(vq["q8"]),
+        vps.at[layer, pages, :, offs].set(vq["s"]),
+    )

@@ -222,15 +222,38 @@ def shard_batch(tokens: Pytree, mesh: Mesh) -> Pytree:
     return jax.tree.map(put, tokens)
 
 
+def paged_pool_spec(ndim: int = 5) -> P:
+    """Pool arrays [L, P, K, PS(, H)]: KV heads over tp, every other axis
+    replicated. The PAGE axis cannot shard — page tables hold global pool
+    indices and any slot may map any page — and there is no dp/sp row to
+    shard either (the pool is shared across all slots; dp means replica
+    processes at the scheduler level). Per-position scale arrays (int8
+    pool, ndim=4) drop the trailing H but keep heads-over-tp."""
+    return (P(None, None, "tp", None, None) if ndim == 5
+            else P(None, None, "tp", None))
+
+
 def constrain_cache(cache: Pytree, mesh: Mesh) -> Pytree:
     """Pin the in-program KV cache layout (called inside jit).
 
-    Handles both cache forms: bf16 {"k","v"} [L, B, K, S, H] and int8
-    {"k8","ks","v8","vs"} — the [L, B, K, S] scale tensors drop the head
-    axis from the spec but keep batch-over-dp / heads-over-tp /
-    slots-over-sp."""
+    Handles every cache form: contiguous bf16 {"k","v"} [L, B, K, S, H]
+    and int8 {"k8","ks","v8","vs"} (the [L, B, K, S] scale tensors drop
+    the head axis from the spec but keep batch-over-dp / heads-over-tp /
+    slots-over-sp), and the PAGED pool {"kp","vp"(,"kps","vps"),"ptab"}
+    — pool KV heads over tp (paged_pool_spec), page tables replicated."""
+    def put(x, spec):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    if isinstance(cache, dict) and "kp" in cache:
+        def pin_paged(name, x):
+            if name == "ptab":
+                return put(x, P(None, None))
+            return put(x, paged_pool_spec(x.ndim))
+
+        return {k: pin_paged(k, v) for k, v in cache.items()}
+
     def pin(x):
         spec = cache_spec() if x.ndim == 5 else P(None, "dp", "tp", "sp")
-        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+        return put(x, spec)
 
     return jax.tree.map(pin, cache)

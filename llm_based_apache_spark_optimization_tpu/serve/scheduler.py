@@ -195,6 +195,25 @@ def _cache_tuple(d: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, ...]:
     return (d["k"], d["v"])
 
 
+def _paged_cache_dict(
+    arrs: Sequence[jnp.ndarray], ptab: jnp.ndarray
+) -> Dict[str, jnp.ndarray]:
+    """Paged cache tuple -> the dict form models/llama.forward takes:
+    (kp, vp) for a compute-dtype pool, (kp, kps, vp, vps) for the int8
+    pool (values + per-position scales, mirroring the contiguous
+    (k8, ks, v8, vs) ordering)."""
+    if len(arrs) == 2:
+        return {"kp": arrs[0], "vp": arrs[1], "ptab": ptab}
+    return {"kp": arrs[0], "kps": arrs[1], "vp": arrs[2], "vps": arrs[3],
+            "ptab": ptab}
+
+
+def _paged_cache_tuple(d: Dict[str, jnp.ndarray]) -> Tuple[jnp.ndarray, ...]:
+    if "kps" in d:
+        return (d["kp"], d["kps"], d["vp"], d["vps"])
+    return (d["kp"], d["vp"])
+
+
 @dataclasses.dataclass
 class _Request:
     ids: List[int]
@@ -276,7 +295,11 @@ class _Request:
     resume_pref: int = 0
     preempted: int = 0
     rng_count: int = 0
-    spilled: Optional[Tuple[np.ndarray, np.ndarray]] = None
+    # Host page copies under LSOT_KV_SPILL=1: one array per cache array —
+    # (k, v) for a compute-dtype pool, (k8, ks, v8, vs) for the int8 pool
+    # (the quantization scales serialize beside the pages, so restore is
+    # content-exact).
+    spilled: Optional[Tuple[np.ndarray, ...]] = None
 
     @property
     def full_ids(self) -> List[int]:
@@ -454,18 +477,11 @@ class ContinuousBatchingScheduler:
         self.kv_layout = kv_layout
         self._paged = kv_layout == "paged"
         if self._paged:
-            if kv_quant:
-                raise ValueError(
-                    "kv_quant and kv_layout='paged' cannot combine yet: "
-                    "pool pages store compute-dtype K/V (int8 pages are a "
-                    "follow-up)"
-                )
-            if mesh is not None:
-                raise ValueError(
-                    "kv_layout='paged' runs unsharded for now: the pool's "
-                    "KV-head axis can shard like the contiguous cache, "
-                    "but the paged programs are not mesh-threaded yet"
-                )
+            # Composes with kv_quant="int8" (the pool stores int8 pages +
+            # per-position scales — ~2x live tokens per HBM byte; page
+            # accounting below prices the TRUE page bytes) and with a
+            # dp=1 tp mesh (pool KV heads shard over tp exactly like the
+            # contiguous cache; page tables replicate).
             ps = int(kv_page_size or default_page_size())
             if ps <= 0 or ps % 8:
                 raise ValueError(
@@ -480,8 +496,12 @@ class ContinuousBatchingScheduler:
             if kv_pages:
                 num_pages = int(kv_pages)
             elif kv_hbm_budget_bytes:
+                # KV-dtype-aware sizing (ISSUE 11 satellite): an int8
+                # pool's pages cost ~half a compute-dtype page, so the
+                # same HBM budget buys ~2x the pages — capacity math must
+                # price the KV dtype, not the compute dtype.
                 num_pages = pages_for_budget(
-                    cfg, kv_hbm_budget_bytes, ps, dtype.itemsize
+                    cfg, kv_hbm_budget_bytes, ps, dtype.itemsize, kv_quant
                 )
             else:
                 # Default budget = the contiguous layout's own footprint:
@@ -570,14 +590,17 @@ class ContinuousBatchingScheduler:
 
         tp = dict(mesh.shape).get("tp", 1) if mesh is not None else 1
         if self._paged:
+            # page_bytes already prices the KV dtype (int8 values +
+            # scales), so no post-hoc halving; the pool's head axis
+            # shards over tp like the contiguous cache.
             cache_dev_bytes = self._page_alloc.num_pages * page_bytes(
-                cfg, self._page_size, dtype.itemsize
-            )
+                cfg, self._page_size, dtype.itemsize, kv_quant
+            ) // tp
         else:
             cache_dev_bytes = _cache_bytes(
                 cfg, num_slots, self.max_seq, dtype.itemsize
             ) // tp
-        if kv_quant:
+        if kv_quant and not self._paged:
             # Halving shifts the kernel/einsum crossover to the quantized
             # byte count. NOTE (advisor r4): the crossover threshold itself
             # was measured on the bf16 cache; quantization halves the
@@ -595,9 +618,11 @@ class ContinuousBatchingScheduler:
         # self._ptab, a non-donated arg to every program).
         if self._paged:
             pool = init_page_pool(
-                cfg, self._page_alloc.num_pages, self._page_size, dtype=dtype
+                cfg, self._page_alloc.num_pages, self._page_size,
+                dtype=dtype, kv_quant=kv_quant,
             )
-            arrs = (pool["kp"], pool["vp"])
+            arrs = ((pool["kp"], pool["kps"], pool["vp"], pool["vps"])
+                    if kv_quant else (pool["kp"], pool["vp"]))
             # Device page tables: [slots, pages_per_slot], the UNMAPPED
             # sentinel is num_pages — one past the pool, so jax drops the
             # scatter writes of parked/padding rows and gathers clip to a
@@ -617,8 +642,10 @@ class ContinuousBatchingScheduler:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            # Slots unsharded, KV heads on tp; scale tensors drop the head
-            # axis from the spec.
+            # Slots (contiguous) / pages (paged) unsharded, KV heads on
+            # tp; scale tensors drop the trailing axis from the spec but
+            # keep heads-over-tp. The same two specs serve all four cache
+            # forms — [L, B|P, K, S|PS(, H)].
             arrs = tuple(
                 jax.device_put(
                     x,
@@ -630,6 +657,12 @@ class ContinuousBatchingScheduler:
                 )
                 for x in arrs
             )
+            if self._paged:
+                # Page tables replicate: every device addresses the full
+                # page axis of its own head shard.
+                self._ptab = jax.device_put(
+                    self._ptab, NamedSharding(mesh, P(None, None))
+                )
         self._cache = arrs
 
         # Per-slot state lives ON DEVICE and chains between rounds: decode
@@ -970,7 +1003,8 @@ class ContinuousBatchingScheduler:
 
     def _build_page_ops(self):
         """Jitted paged-KV bookkeeping ops (async scatters, ~bytes of
-        traffic):
+        traffic), generic over the pool tuple — (kp, vp) compute-dtype or
+        (kp, kps, vp, vps) int8 values + per-position scales:
 
         set_row: replace one slot's device page-table row (admission,
         retirement, copy-on-write remaps). Driven at the OOB slot index
@@ -978,30 +1012,40 @@ class ContinuousBatchingScheduler:
         copy_page: one-page device copy for copy-on-write (a shared page
         about to be partially overwritten at a non-page-aligned boundary
         is copied into a fresh exclusive page first; the prefix-cache
-        entry keeps the original)."""
+        entry keeps the original). Under int8 the SCALES copy with their
+        values — a page's content is (q8, s) pairs.
+        restore_pages: spill-resume scatter; int8 spills restore values
+        AND scales (the spill serialized both)."""
+        nc = len(self._cache)
 
         @partial(jax.jit, donate_argnums=(0,))
         def set_row(ptab, slot, row):
             return ptab.at[slot].set(row)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def copy_page(kp, vp, dst, src):
-            head = (kp.shape[0], 1) + kp.shape[2:]
-            pk = lax.dynamic_slice(kp, (0, src, 0, 0, 0), head)
-            pv = lax.dynamic_slice(vp, (0, src, 0, 0, 0), head)
-            return (
-                lax.dynamic_update_slice(kp, pk, (0, dst, 0, 0, 0)),
-                lax.dynamic_update_slice(vp, pv, (0, dst, 0, 0, 0)),
-            )
+        @partial(jax.jit, donate_argnums=tuple(range(nc)))
+        def copy_page(*args):
+            cache, (dst, src) = args[:nc], args[nc:]
+            out = []
+            for c in cache:
+                head = (c.shape[0], 1) + c.shape[2:]
+                zeros = (0,) * (c.ndim - 2)
+                pg = lax.dynamic_slice(c, (0, src) + zeros, head)
+                out.append(
+                    lax.dynamic_update_slice(c, pg, (0, dst) + zeros)
+                )
+            return tuple(out)
 
-        @partial(jax.jit, donate_argnums=(0, 1))
-        def restore_pages(kp, vp, idx, kstack, vstack):
+        @partial(jax.jit, donate_argnums=tuple(range(nc)))
+        def restore_pages(*args):
             # Spill-resume (LSOT_KV_SPILL): write the host page copies
-            # [L, n, K, page, H] back into freshly allocated pool pages
-            # in ONE scatter (one dispatch + one transfer per resume, not
-            # per page; retraces per distinct page count, bounded by
-            # pages_per_slot).
-            return kp.at[:, idx].set(kstack), vp.at[:, idx].set(vstack)
+            # [L, n, K, page(, H)] back into freshly allocated pool pages
+            # in ONE scatter per array (one dispatch + one transfer per
+            # resume, not per page; retraces per distinct page count,
+            # bounded by pages_per_slot).
+            cache, idx, stacks = args[:nc], args[nc], args[nc + 1:]
+            return tuple(
+                c.at[:, idx].set(s) for c, s in zip(cache, stacks)
+            )
 
         return set_row, copy_page, restore_pages
 
@@ -1192,11 +1236,13 @@ class ContinuousBatchingScheduler:
             # committed positions ride along as garbage the resumed
             # decode overwrites before any read can see it (the same
             # write-before-read invariant every freed-page reuse relies
-            # on).
-            kparts, vparts = jax.device_get(
-                (self._cache[0][:, idx], self._cache[1][:, idx])
+            # on). EVERY cache array spills — under an int8 pool the
+            # quantization scales serialize beside the int8 pages, so a
+            # restore reproduces the page content (q8, s) exactly and the
+            # resumed output stays token-identical.
+            req.spilled = jax.device_get(
+                tuple(c[:, idx] for c in self._cache)
             )
-            req.spilled = (kparts, vparts)
             self._page_alloc.note_spill(int(npg))
         req.resume_pref = len(req.generated)
         req.preempted += 1
@@ -1333,14 +1379,15 @@ class ContinuousBatchingScheduler:
                           generated=len(req.generated), mode=mode)
 
     def _restore_spilled(self, slot: int, req: _Request) -> None:
-        """Spill-resume (LSOT_KV_SPILL=1): write the host page copies
-        back into the freshly allocated pages and arm the slot directly —
-        no re-prefill forward at all."""
-        kparts, vparts = req.spilled
-        n = kparts.shape[1]
+        """Spill-resume (LSOT_KV_SPILL=1): write the host page copies —
+        values AND, under an int8 pool, their quantization scales — back
+        into the freshly allocated pages and arm the slot directly; no
+        re-prefill forward at all."""
+        parts = req.spilled
+        n = parts[0].shape[1]
         idx = jnp.asarray(self._slot_pages[slot][:n], jnp.int32)
         self._cache = self._restore_page_fn(
-            *self._cache, idx, jnp.asarray(kparts), jnp.asarray(vparts),
+            *self._cache, idx, *(jnp.asarray(p) for p in parts),
         )
         self._page_alloc.note_restore(int(n))
         req.spilled = None
@@ -1365,6 +1412,15 @@ class ContinuousBatchingScheduler:
         out["spill"] = int(self._kv_spill)
         out["watermark_low_pages"] = self._wm_low_pages
         out["watermark_high_pages"] = self._wm_high_pages
+        # KV-dtype-aware capacity (ISSUE 11 satellite): the TRUE device
+        # bytes of one page — int8 pools report ~half a compute-dtype
+        # page — so /metrics serving.kv_pages, watermark ratios and
+        # overcommit dashboards act on real bytes, not compute-dtype
+        # fiction.
+        out["kv_quant"] = self.kv_quant or ""
+        out["page_bytes"] = page_bytes(
+            self.cfg, self._page_size, self._dtype.itemsize, self.kv_quant
+        )
         return out
 
     def _build_prefill(self, t_bucket: int, k: int):
@@ -1429,16 +1485,33 @@ class ContinuousBatchingScheduler:
                 safe = jnp.clip(tab, 0, num_pages - 1)
 
                 def rowview(pool):
-                    # [L, P, K, ps, H] -> contiguous per-row view
-                    # [L, k, K, NP*ps, H] for the chunk forward (the same
-                    # row gather the contiguous path pays via c[:, slots]).
-                    g = pool[:, safe]  # [L, k, NP, K, ps, H]
-                    return g.transpose(0, 1, 3, 2, 4, 5).reshape(
-                        pool.shape[0], safe.shape[0], pool.shape[2],
-                        np_tab * ps, pool.shape[4],
-                    )
+                    # [L, P, K, ps(, H)] -> contiguous per-row view
+                    # [L, k, K, NP*ps(, H)] for the chunk forward (the same
+                    # row gather the contiguous path pays via c[:, slots];
+                    # the scale arrays of an int8 pool drop the H axis).
+                    g = pool[:, safe]  # [L, k, NP, K, ps(, H)]
+                    perm = ((0, 1, 3, 2, 4, 5) if pool.ndim == 5
+                            else (0, 1, 3, 2, 4))
+                    shape = (pool.shape[0], safe.shape[0], pool.shape[2],
+                             np_tab * ps) + (
+                        (pool.shape[4],) if pool.ndim == 5 else ())
+                    return g.transpose(perm).reshape(shape)
 
-                row_cache = {"k": rowview(cache[0]), "v": rowview(cache[1])}
+                if quant:
+                    # int8 pool: dequantize the gathered rows for the
+                    # chunk forward (q8 × per-position scale), exactly
+                    # the contiguous int8 prefill's gather-dequant — the
+                    # scatter-back below requantizes ONLY this chunk's
+                    # window, so every entry quantizes exactly once.
+                    row_cache = {
+                        "k": (rowview(cache[0]).astype(dtype)
+                              * rowview(cache[1])[..., None].astype(dtype)),
+                        "v": (rowview(cache[2]).astype(dtype)
+                              * rowview(cache[3])[..., None].astype(dtype)),
+                    }
+                else:
+                    row_cache = {"k": rowview(cache[0]),
+                                 "v": rowview(cache[1])}
             else:
                 rows = [c[:, slots] for c in cache]  # [L, k, K, S(, H)]
                 if quant:
@@ -1479,10 +1552,25 @@ class ContinuousBatchingScheduler:
                 pages = jnp.where(page_idx < np_tab, pages,
                                   jnp.int32(num_pages))
                 offs = pos_idx % ps
-                cache = (
-                    cache[0].at[:, pages, :, offs].set(wk),
-                    cache[1].at[:, pages, :, offs].set(wv),
-                )
+                if quant:
+                    # int8 pool: requantize the chunk's window (values +
+                    # per-position scales) and scatter both through the
+                    # table — windowed, so earlier chunks' entries never
+                    # round-trip int8→bf16→int8 (the same
+                    # exactly-once-quantized contract as the contiguous
+                    # int8 path).
+                    from ..ops.quant import quantize_cache
+
+                    wins = _cache_tuple(quantize_cache(wk, wv))
+                    cache = tuple(
+                        c.at[:, pages, :, offs].set(w)
+                        for c, w in zip(cache, wins)
+                    )
+                else:
+                    cache = (
+                        cache[0].at[:, pages, :, offs].set(wk),
+                        cache[1].at[:, pages, :, offs].set(wv),
+                    )
             elif quant:
                 from ..ops.quant import quantize_cache
 
@@ -1544,12 +1632,12 @@ class ContinuousBatchingScheduler:
 
         def cache_in(cache, ptab):
             if paged:
-                return {"kp": cache[0], "vp": cache[1], "ptab": ptab}
+                return _paged_cache_dict(cache, ptab)
             return _cache_dict(cache)
 
         def cache_out(new_cache):
             if paged:
-                return (new_cache["kp"], new_cache["vp"])
+                return _paged_cache_tuple(new_cache)
             return _cache_tuple(new_cache)
 
         @partial(jax.jit,
@@ -1714,7 +1802,7 @@ class ContinuousBatchingScheduler:
             vpos = pos[:, None] + jd
             logits, new_cache = forward(
                 cfg, params, verify, vpos,
-                ({"kp": cache[0], "vp": cache[1], "ptab": ptab} if paged
+                (_paged_cache_dict(cache, ptab) if paged
                  else _cache_dict(cache)),
                 attn_impl="xla", mesh=mesh,
             )
@@ -1808,7 +1896,7 @@ class ContinuousBatchingScheduler:
             # reads only the row's own history — so (seed, request)
             # reproduces the same tokens under any batch mix.
             counts = counts + jnp.where(active & ~greedy, 1, 0)
-            out_cache = ((new_cache["kp"], new_cache["vp"]) if paged
+            out_cache = (_paged_cache_tuple(new_cache) if paged
                          else _cache_tuple(new_cache))
             return (*out_cache, hist, hlen, cur, pos, counts,
                     cstates, crem, emitted, n_emit)
@@ -3614,13 +3702,16 @@ class SchedulerPool:
         out: Dict[str, int] = {}
         for st in per:
             for k, v in st.items():
+                if isinstance(v, str):
+                    continue  # non-numeric knobs (kv_quant) keep-first below
                 out[k] = out.get(k, 0) + int(v)
         # Ratios/sizes/knobs/thresholds don't sum: keep the first
         # replica's values (homogeneous fleets; heterogeneous knobs show
         # per replica in replica_loads — a summed watermark compared
         # against summed free pages would misread per-pool pressure).
-        for k in ("page_size", "overcommit", "spill",
-                  "watermark_low_pages", "watermark_high_pages"):
+        for k in ("page_size", "overcommit", "spill", "kv_quant",
+                  "page_bytes", "watermark_low_pages",
+                  "watermark_high_pages"):
             if k in per[0]:
                 out[k] = per[0][k]
         return out

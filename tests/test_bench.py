@@ -395,3 +395,124 @@ def test_kv_pressure_pass_overcommit_sustains_more_concurrency():
     assert out["exact"]["preemptions"] == 0
     assert out["preemption_rate"] >= 0.0
     assert "tok_s_ratio" in out
+
+
+def test_paged_accounting_int8_strictly_more_slots():
+    """ISSUE 11 acceptance: slots-at-fixed-HBM for the int8 pool is
+    STRICTLY more than the bf16 pool at the same contiguous budget —
+    KV-dtype-aware page pricing, reconciled against the sizing
+    functions."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+    from llm_based_apache_spark_optimization_tpu.engine.paged_kv import (
+        page_bytes,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import TINY
+    from llm_based_apache_spark_optimization_tpu.models.configs import (
+        BENCH_1B,
+    )
+
+    for cfg, slots, max_seq, max_new, mix, ps, pb in (
+        (TINY, 4, 100, 8, [32, 16], 16, 8),
+        (BENCH_1B, 8, 1664, 128, [1024, 256], 64, 128),
+    ):
+        kw = dict(slots_contiguous=slots, max_seq=max_seq,
+                  max_new=max_new, overshoot=16, mix_lens=mix,
+                  page_size=ps, prompt_bucket=pb)
+        a = bench._paged_accounting(cfg, **kw)
+        a8 = bench._paged_accounting(cfg, kv_quant="int8", **kw)
+        assert a8["kv_quant"] == "int8"
+        # Same budget, cheaper pages, strictly more pages AND slots.
+        assert a8["hbm_budget_bytes"] == a["hbm_budget_bytes"]
+        assert a8["pages_total"] == \
+            a8["hbm_budget_bytes"] // page_bytes(cfg, ps, 2, "int8")
+        assert a8["pages_total"] > a["pages_total"]
+        assert a8["slots_paged"] > a["slots_paged"]
+        assert a8["pages_used"] <= a8["pages_total"]
+
+
+def test_micro_lane_records_all_kernel_legs():
+    """ISSUE 11 satellite: the kernel microbench lane records ns/op for
+    every leg — paged read (kernel vs XLA), fused page write vs XLA
+    scatter (bf16 + int8), mask gather — on tiny shapes in-process."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+
+    env = {"BENCH_MICRO_REPS": "2", "BENCH_MICRO_BATCH": "2",
+           "BENCH_MICRO_KV_HEADS": "2", "BENCH_MICRO_GROUP": "2",
+           "BENCH_MICRO_HEAD_DIM": "8", "BENCH_MICRO_PAGE": "8",
+           "BENCH_MICRO_PAGES_PER_ROW": "4", "BENCH_MICRO_LAYERS": "2",
+           "BENCH_MICRO_VOCAB": "64", "BENCH_MICRO_STATES": "8"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        out = bench._bench_micro("cpu-test")
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else \
+                os.environ.__setitem__(k, v)
+    assert out["device_kind"] == "cpu-test"
+    for leg in ("paged_read", "page_write", "page_write_int8"):
+        assert out[leg]["xla_ns"] > 0
+        ker = out[leg].get("kernel_ns", out[leg].get("fused_ns"))
+        assert ker and ker > 0
+        assert out[leg]["xla_over_kernel"] > 0
+    assert out["mask_gather"]["xla_ns"] > 0
+
+
+def test_compare_gate_flags_regressions(tmp_path):
+    """ISSUE 11 satellite: bench.py --compare exits non-zero on a >10%
+    decode-throughput or acceptance regression, zero otherwise — offline
+    two-artifact mode, no chip needed."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+
+    old = {"value": 100.0, "long_context": {"paged": {"tok_s": 40.0}},
+           "scheduler": {"speculative": {"tokens_per_round": 2.0}}}
+    ok = {"value": 95.0, "long_context": {"paged": {"tok_s": 38.0}},
+          "scheduler": {"speculative": {"tokens_per_round": 1.9}}}
+    bad = {"value": 80.0, "long_context": {"paged": {"tok_s": 40.0}},
+           "scheduler": {"speculative": {"tokens_per_round": 1.5}}}
+    assert bench.compare_artifacts(old, ok) == []
+    regs = bench.compare_artifacts(old, bad)
+    assert len(regs) == 2 and any("value" in r for r in regs)
+    # Metrics only one side has are coverage drift, not regressions.
+    assert bench.compare_artifacts({"value": 5.0}, {"tok_s": 1.0}) == []
+    # A metric that COLLAPSED to zero (failed leg emitting value=0 +
+    # error) is the worst regression, not a skipped leg — the gate must
+    # fire even though the new value fails a naive v > 0 filter.
+    dead = {"value": 0.0, "error": "probe failed",
+            "long_context": {"paged": {"tok_s": 0.0}}}
+    regs = bench.compare_artifacts(old, dead)
+    assert len(regs) == 2 and all("-100.0%" in r for r in regs)
+
+    # Cross-platform artifacts (chip baseline vs CPU-fallback run) are an
+    # environment problem, not a perf regression: distinct exit code 3.
+    last = tmp_path / "CHIP.json"
+    new = tmp_path / "CPU.json"
+    last.write_text(json.dumps({**old, "platform": "TPU v5e"}) + "\n")
+    new.write_text(json.dumps({**old, "value": 1.0, "platform": "cpu"})
+                   + "\n")
+    r = subprocess.run(
+        [sys.executable, BENCH, "--compare", str(last), str(new)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 3 and "environment mismatch" in r.stderr
+
+    # CLI: artifacts are the bench's own stdout JSONL (last line wins).
+    last = tmp_path / "LAST.json"
+    new = tmp_path / "NEW.json"
+    last.write_text("garbage\n" + json.dumps(old) + "\n")
+    new.write_text(json.dumps(ok) + "\n")
+    r = subprocess.run(
+        [sys.executable, BENCH, "--compare", str(last), str(new)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    new.write_text(json.dumps(bad) + "\n")
+    r = subprocess.run(
+        [sys.executable, BENCH, "--compare", str(last), str(new)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 1
+    assert "regression" in r.stderr

@@ -271,11 +271,21 @@ def test_engine_paged_speculative_parity(tiny):
 
 
 def test_engine_paged_rejects_bad_combos(tiny):
+    """ISSUE 11 lifted the PR-7 rejections: int8 + paged and mesh + paged
+    are ACCEPTED now; only genuinely invalid combos still raise."""
     cfg, params = tiny
     with pytest.raises(ValueError, match="kv_layout"):
         InferenceEngine(cfg, params, kv_layout="sideways")
-    with pytest.raises(ValueError, match="paged"):
-        InferenceEngine(cfg, params, kv_quant="int8", kv_layout="paged")
+    # int8 + paged composes (the int8 page pool) — constructor accepts.
+    InferenceEngine(cfg, params, kv_quant="int8", kv_layout="paged")
+    # int8 + paged + speculation composes too (verify windows run the
+    # int8-streaming reference gather)...
+    InferenceEngine(cfg, params, kv_quant="int8", kv_layout="paged",
+                    speculative_draft=4)
+    # ...but int8 + speculation on the CONTIGUOUS layout stays rejected
+    # (its verify loop streams the bf16 cache).
+    with pytest.raises(ValueError, match="contiguous"):
+        InferenceEngine(cfg, params, kv_quant="int8", speculative_draft=4)
 
 
 # -------------------------------------------------- scheduler-level parity --
@@ -417,13 +427,15 @@ def test_scheduler_paged_page_pressure_waits_and_completes(tiny):
 
 
 def test_scheduler_paged_rejects_bad_combos(tiny):
+    """Bogus layouts still fail loudly; int8 + paged (ISSUE 11) is a
+    supported configuration and must construct."""
     cfg, params = tiny
     with pytest.raises(ValueError, match="kv_layout"):
         ContinuousBatchingScheduler(cfg, params, kv_layout="bogus")
-    with pytest.raises(ValueError, match="paged"):
-        ContinuousBatchingScheduler(
-            cfg, params, kv_quant="int8", kv_layout="paged"
-        )
+    s = ContinuousBatchingScheduler(
+        cfg, params, kv_quant="int8", kv_layout="paged", num_slots=2,
+    )
+    assert s.page_stats["kv_quant"] == "int8"
 
 
 # ------------------------------------------------------- observability ----
@@ -874,3 +886,304 @@ def test_resume_envelope_clamped_to_slot_row(tiny):
     assert req.page_end <= s._pages_per_slot * 8
     s._free_slot_pages(0)
     s._page_alloc.check()
+
+
+# ----------------------------------------------- int8 page pool (ISSUE 11) --
+
+
+def test_page_bytes_prices_kv_dtype(tiny):
+    """Satellite: page accounting takes the KV dtype into account — an
+    int8 page costs int8-value + f32-scale bytes (not compute-dtype
+    bytes), the same HBM budget buys strictly more int8 pages, and
+    init_page_pool's actual device arrays reconcile the formula."""
+    cfg, _ = tiny
+    pb16 = page_bytes(cfg, 16, itemsize=2)
+    pb8 = page_bytes(cfg, 16, itemsize=2, kv_quant="int8")
+    assert pb8 < pb16
+    # Exact layout: 2 sides x L x K x PS x (H int8 bytes + one f32 scale).
+    assert pb8 == (2 * cfg.num_layers * cfg.num_kv_heads * 16
+                   * (cfg.head_dim + 4))
+    budget = 7 * pb16
+    assert pages_for_budget(cfg, budget, 16, 2, "int8") > \
+        pages_for_budget(cfg, budget, 16, 2)
+    pool = init_page_pool(cfg, 5, 16, kv_quant="int8")
+    actual = sum(pool[k].nbytes for k in ("kp", "kps", "vp", "vps"))
+    assert actual == 5 * pb8
+    assert pool["kp"].dtype == jnp.int8
+    assert float(pool["kps"].min()) == 1.0  # unwritten scales dequant finite
+    with pytest.raises(ValueError, match="kv_quant"):
+        page_bytes(cfg, 16, kv_quant="fp4")
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_allocator_dtype_heterogeneous_page_sizing(tiny, seed):
+    """Randomized property (satellite): for random (page_size, kv dtype,
+    pool size) geometries, the sizing functions and the real device pool
+    agree byte-for-byte, pages_for_budget inverts page_bytes, and the
+    allocator's invariants hold at that geometry."""
+    cfg, _ = tiny
+    rng = np.random.default_rng(100 + seed)
+    ps = 8 * int(rng.integers(1, 5))
+    kvq = [None, "int8"][int(rng.integers(0, 2))]
+    n_pages = int(rng.integers(2, 9))
+    itemsize = [2, 4][int(rng.integers(0, 2))]
+    dtype = {2: jnp.bfloat16, 4: jnp.float32}[itemsize]
+    pb = page_bytes(cfg, ps, itemsize, kvq)
+    pool = init_page_pool(cfg, n_pages, ps, dtype=dtype, kv_quant=kvq)
+    assert sum(a.nbytes for a in pool.values()) == n_pages * pb
+    assert pages_for_budget(cfg, n_pages * pb, ps, itemsize, kvq) == n_pages
+    assert pages_for_budget(cfg, n_pages * pb - 1, ps, itemsize, kvq) == \
+        n_pages - 1
+    a = PageAllocator(n_pages, ps)
+    held = []
+    for _ in range(50):
+        op = int(rng.integers(0, 2))
+        if op == 0:
+            got = a.alloc(int(rng.integers(1, 3)))
+            if got is not None:
+                held.extend(got)
+        elif held:
+            a.release([held.pop()])
+        a.check()
+    for pg in held:
+        a.release([pg])
+    a.check()
+    assert a.pages_free == a.num_pages
+
+
+def test_pack_prefill_pages_quantized_roundtrip(tiny):
+    """pack_prefill_pages(kv_quant='int8') quantizes inside the pack:
+    gather + dequantize reproduces the prefill cache within int8
+    rounding, and the packed layout carries per-position scales."""
+    cfg, _ = tiny
+    rng = np.random.default_rng(0)
+    b, s, ps, ppr = 3, 24, 16, 4
+    cache = {
+        "k": jnp.asarray(rng.normal(size=(
+            cfg.num_layers, b, cfg.num_kv_heads, s, cfg.head_dim
+        )), jnp.float32),
+        "v": jnp.asarray(rng.normal(size=(
+            cfg.num_layers, b, cfg.num_kv_heads, s, cfg.head_dim
+        )), jnp.float32),
+    }
+    paged = pack_prefill_pages(cache, ps, ppr, kv_quant="int8")
+    assert paged["kp"].dtype == jnp.int8
+    assert paged["kps"].shape == (cfg.num_layers, b * ppr,
+                                  cfg.num_kv_heads, ps)
+    from llm_based_apache_spark_optimization_tpu.ops.pallas import (
+        gather_page_scales,
+        gather_pages,
+    )
+
+    for name, pool, scales in (("k", paged["kp"], paged["kps"]),
+                               ("v", paged["vp"], paged["vps"])):
+        for layer in range(cfg.num_layers):
+            vals = gather_pages(pool[layer], paged["ptab"])     # int8
+            sc = gather_page_scales(scales[layer], paged["ptab"])
+            deq = vals.astype(np.float32) * np.asarray(sc)[..., None]
+            ref = np.asarray(cache[name][layer])
+            # Symmetric absmax int8: error bounded by scale/2 per element.
+            bound = np.asarray(sc)[..., :s, None] / 2 + 1e-6
+            assert (np.abs(deq[:, :, :s] - ref) <= bound).all(), name
+
+
+@pytest.mark.parametrize("ps,np_tab", [(16, 4), (8, 7)])
+def test_quantized_ragged_kernel_matches_reference(rng, ps, np_tab):
+    """The int8-pool decode kernel (dequantize inside the DMA'd tiles)
+    against the gather + int8-streaming-einsum reference."""
+    from llm_based_apache_spark_optimization_tpu.ops.pallas import (
+        paged_attention_reference_quantized,
+        ragged_paged_attention_quantized,
+    )
+
+    b, kh, g, h, pool_pages = 3, 2, 2, 8, 11
+    n = kh * g
+    kp = jnp.asarray(rng.integers(-127, 128, size=(pool_pages, kh, ps, h)),
+                     jnp.int8)
+    vp = jnp.asarray(rng.integers(-127, 128, size=(pool_pages, kh, ps, h)),
+                     jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.01, 0.1, size=(pool_pages, kh, ps)),
+                     jnp.float32)
+    vs = jnp.asarray(rng.uniform(0.01, 0.1, size=(pool_pages, kh, ps)),
+                     jnp.float32)
+    tab = np.stack([rng.permutation(pool_pages)[:np_tab] for _ in range(b)])
+    tab[0, -1] = pool_pages  # unmapped sentinel past the live region
+    tab = jnp.asarray(tab, jnp.int32)
+    q = jnp.asarray(rng.normal(size=(b, 1, n, h)), jnp.float32)
+    s_virt = np_tab * ps
+    pos = jnp.asarray([[ps // 2], [s_virt - ps - 1], [s_virt - 1]],
+                      jnp.int32)
+    kvl = pos[:, 0] + 1
+    out_k = ragged_paged_attention_quantized(q, kp, ks, vp, vs, tab, pos,
+                                             None, kvl)
+    out_r = paged_attention_reference_quantized(q, kp, ks, vp, vs, tab,
+                                                pos, None, kvl)
+    np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                               atol=2e-5)
+    # kv_lens=0 parks a row, like the bf16 kernel.
+    parked = ragged_paged_attention_quantized(
+        q, kp, ks, vp, vs, tab, pos, None,
+        jnp.asarray([0] + [int(x) for x in kvl[1:]], jnp.int32),
+    )
+    assert float(jnp.abs(parked[0]).max()) == 0.0
+
+
+def test_fused_page_write_matches_reference(rng):
+    """The fused Pallas page-write kernel (tentpole): bit-identical to
+    the XLA scatter-through-table reference — including dropped sentinel
+    rows and past-the-row positions — for the bf16 and int8-quantizing
+    variants."""
+    from llm_based_apache_spark_optimization_tpu.ops.pallas import (
+        fused_page_write,
+        fused_page_write_quantized,
+        paged_write_reference,
+        paged_write_reference_quantized,
+    )
+
+    L, P, kh, ps, h, b, t, np_tab = 2, 9, 2, 8, 8, 3, 3, 4
+    layer = 1
+    kp = jnp.asarray(rng.normal(size=(L, P, kh, ps, h)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(L, P, kh, ps, h)), jnp.float32)
+    k_new = jnp.asarray(rng.normal(size=(b, t, kh, h)), jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(b, t, kh, h)), jnp.float32)
+    tab = np.stack([rng.permutation(P)[:np_tab] for _ in range(b)])
+    tab[2, :] = P  # row 2 fully unmapped (parked slot)
+    tab = jnp.asarray(tab, jnp.int32)
+    # Row 1's final position runs past the virtual row: must DROP.
+    positions = jnp.asarray(
+        [[0, 1, 2], [np_tab * ps - 2, np_tab * ps - 1, np_tab * ps],
+         [5, 6, 7]], jnp.int32)
+    okp, ovp = fused_page_write(kp, vp, k_new, v_new, positions, tab, layer)
+    np.testing.assert_array_equal(
+        np.asarray(okp),
+        np.asarray(paged_write_reference(kp, k_new, positions, tab, layer)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ovp),
+        np.asarray(paged_write_reference(vp, v_new, positions, tab, layer)),
+    )
+    # Parked row 2 wrote nothing anywhere.
+    np.testing.assert_array_equal(np.asarray(okp[0]), np.asarray(kp[0]))
+
+    kq = jnp.zeros((L, P, kh, ps, h), jnp.int8)
+    ksq = jnp.ones((L, P, kh, ps), jnp.float32)
+    vq = jnp.zeros((L, P, kh, ps, h), jnp.int8)
+    vsq = jnp.ones((L, P, kh, ps), jnp.float32)
+    outs = fused_page_write_quantized(
+        kq, ksq, vq, vsq, k_new, v_new, positions, tab, layer)
+    refs = paged_write_reference_quantized(
+        kq, ksq, vq, vsq, k_new, v_new, positions, tab, layer)
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(r), atol=1e-6)
+
+
+def test_engine_paged_int8_tracks_bf16_and_matches_contiguous_int8(tiny):
+    """The documented accuracy contract (tolerance grid): int8 paged
+    greedy decode agrees with bf16 paged on most tokens (quant noise may
+    flip near-ties; >= 0.7 agreement like the contiguous int8 grid), and
+    is TOKEN-IDENTICAL to contiguous int8 — same per-position quantize
+    math, different storage layout."""
+    cfg, params = tiny
+    golden = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                             kv_layout="paged", kv_page_size=8) \
+        .generate(PROMPTS, max_new_tokens=8)
+    out_q = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                            kv_layout="paged", kv_page_size=8,
+                            kv_quant="int8") \
+        .generate(PROMPTS, max_new_tokens=8)
+    assert all(len(o) == 8 for o in out_q)
+    agree = sum(a == b for go, oo in zip(golden, out_q)
+                for a, b in zip(go, oo))
+    total = sum(len(o) for o in golden)
+    assert agree / total >= 0.7, f"only {agree}/{total} tokens agree"
+    out_qc = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8,
+                             kv_quant="int8") \
+        .generate(PROMPTS, max_new_tokens=8)
+    assert out_q == out_qc
+
+
+def test_scheduler_paged_int8_parity_mixed_constrained_speculative(tiny):
+    """Acceptance: greedy paged-int8 scheduler output matches paged-bf16
+    within the documented tolerance on MIXED constrained/speculative
+    batches — and matches contiguous-int8 exactly (same quantize math
+    through all three programs: prefill, decode, spec-decode)."""
+    from llm_based_apache_spark_optimization_tpu.constrain import (
+        get_constraint,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import (
+        ByteTokenizer,
+    )
+
+    cfg, params = tiny
+    tok = ByteTokenizer()
+    cm = get_constraint("spark_sql", tok, (2,))
+    budget = max(30, cm.min_new_tokens)
+    reqs = [
+        ([1, 5, 9], None, 8),
+        (tok.encode("SELECT", add_bos=True), cm, budget),
+        ([1, 3, 4, 8, 10, 11, 12, 13, 14], None, 8),
+        (tok.encode("SELECT c", add_bos=True), cm, budget),
+    ]
+
+    def run(**kw):
+        with ContinuousBatchingScheduler(
+            cfg, params, num_slots=3, decode_chunk=4, prompt_bucket=8,
+            stop_ids=(2,), speculative_draft=3, **kw
+        ) as s:
+            futs = [s.submit(ids, max_new_tokens=mn, constraint=c)
+                    for ids, c, mn in reqs]
+            return [f.result(timeout=300) for f in futs]
+
+    bf16 = run(kv_layout="paged", kv_page_size=16)
+    q8 = run(kv_layout="paged", kv_page_size=16, kv_quant="int8")
+    q8c = run(kv_quant="int8")
+    assert q8 == q8c  # layout-independent quantize math, token-identical
+    # Tolerance vs bf16: same-length-or-stop outputs, mostly agreeing
+    # tokens (constrained rows stay inside the grammar either way).
+    agree = sum(a == b for go, oo in zip(bf16, q8)
+                for a, b in zip(go, oo))
+    total = sum(min(len(a), len(b)) for a, b in zip(bf16, q8))
+    assert agree / max(1, total) >= 0.7
+
+
+@pytest.mark.chaos
+def test_scheduler_paged_int8_spill_restore_token_identical(tiny):
+    """Satellite: LSOT_KV_SPILL host page copies serialize the
+    quantization SCALES beside the int8 pages — a preempted request's
+    spill→restore resume is token-identical under an int8 pool, and the
+    spill/restore counters reconcile."""
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
+
+    cfg, params = tiny
+    samp = SamplingParams(temperature=0.8, top_p=0.95)
+    golden, _ = _drive(cfg, params, sampling=samp, kv_quant="int8")
+    out, st = _drive(cfg, params, sampling=samp,
+                     pressure="kv:pressure:1:3",
+                     kv_overcommit=0.25, kv_pages=9, kv_spill=True,
+                     kv_quant="int8")
+    assert out == golden
+    assert st["preemptions"] >= 1
+    assert st["spilled_pages"] > 0
+    assert st["spilled_pages"] == st["restored_pages"]
+    assert st["kv_quant"] == "int8"
+
+
+def test_page_stats_reports_true_int8_capacity(tiny):
+    """Satellite: /metrics serving.kv_pages reports the KV dtype and the
+    TRUE per-page bytes, and an HBM budget buys ~2x the int8 pages."""
+    cfg, params = tiny
+    budget = page_bytes(cfg, 16, itemsize=4) * 8  # 8 f32 pages' worth
+    kw = dict(num_slots=2, prompt_bucket=8, stop_ids=(-1,), max_seq=48,
+              kv_layout="paged", kv_page_size=16,
+              kv_hbm_budget_bytes=budget)
+    s16 = ContinuousBatchingScheduler(cfg, params, **kw)
+    s8 = ContinuousBatchingScheduler(cfg, params, kv_quant="int8", **kw)
+    st16, st8 = s16.page_stats, s8.page_stats
+    assert st16["kv_quant"] == "" and st8["kv_quant"] == "int8"
+    assert st8["page_bytes"] < st16["page_bytes"]
+    assert st8["pages_total"] > st16["pages_total"]
+    # The reported page_bytes reconcile the pool's actual device arrays.
+    assert st8["page_bytes"] * st8["pages_total"] == \
+        sum(a.nbytes for a in s8._cache)
