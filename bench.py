@@ -77,15 +77,14 @@ import time
 
 REFERENCE_TOKS_PER_S = 6.2  # 50-token SQL / 8.05 s avg latency (BASELINE.md)
 
-# Peak specs by TPU generation for MFU / bandwidth accounting:
-# substring of device_kind (lowercased) -> (bf16 TFLOP/s, int8 TOP/s, HBM GB/s).
-PEAKS = {
-    "v6": (918.0, 1836.0, 1640.0),
-    "v5e": (197.0, 394.0, 819.0),
-    "v5 lite": (197.0, 394.0, 819.0),
-    "v5p": (459.0, 918.0, 2765.0),
-    "v4": (275.0, 275.0, 1228.0),
-}
+# Peak specs by TPU generation for MFU / bandwidth accounting: moved
+# IN-TREE (ISSUE 12) to utils/perfmodel.py — the live scheduler's
+# per-round roofline ledger and this bench price with the SAME table and
+# the SAME FLOP/byte models, so the two can never disagree (a tier-1
+# reconciliation test pins it). Re-exported here for artifact diffing.
+from llm_based_apache_spark_optimization_tpu.utils.perfmodel import (  # noqa: E402
+    PEAKS,
+)
 
 
 #: Every _emit'd artifact line, in order (last = richest). The --compare
@@ -309,6 +308,11 @@ def outer() -> int:
 # --------------------------------------------------------------------------
 
 def _peak_for(device_kind: str, quant: str):
+    """Bench-side peak lookup over the shared in-tree table. Unlike the
+    live ledger (which uses perfmodel's nominal CPU fallback so serving
+    always has a defined roofline position), the bench returns
+    (None, None) off-chip — a COMMITTED artifact must omit utilization
+    figures rather than bake nominal host peaks into history."""
     dk = device_kind.lower()
     for key, (bf16_tf, int8_tf, bw) in PEAKS.items():
         if key in dk:
@@ -1277,11 +1281,15 @@ def _measure_tok_s(eng, cfg, b, prompt_len, max_new, rng) -> float:
 def _step_bytes(cfg, b, prompt_len, max_new, param_bytes,
                 cache_itemsize=2) -> int:
     """HBM bytes one decode step streams: full weights + the KV cache read
-    at the mid-run context length."""
-    from llm_based_apache_spark_optimization_tpu.engine.kvcache import cache_bytes
+    at the mid-run context length — the SHARED model
+    (utils/perfmodel.decode_step_bytes), so bench and the live ledger
+    can never disagree on what a step costs."""
+    from llm_based_apache_spark_optimization_tpu.utils.perfmodel import (
+        decode_step_bytes,
+    )
 
-    return param_bytes + cache_bytes(cfg, b, prompt_len + max_new // 2,
-                                     cache_itemsize)
+    return decode_step_bytes(cfg, b, prompt_len + max_new // 2, param_bytes,
+                             itemsize=cache_itemsize)
 
 
 def _decode_split_and_util(eng, cfg, b, prompt_len, max_new, agg_tok_s,
@@ -1498,19 +1506,47 @@ def _obs_overhead(n: int = 50_000, sched=None) -> dict:
     for _ in range(n):
         drawn += tracer.begin() is None  # sample draw; never a real trace
     begin_ns = (_t.perf_counter() - t0) / n * 1e9
+    # Roofline-ledger stamp (ISSUE 12): one PerfModel.observe per
+    # harvested round — a handful of float multiplies + an EWMA fold.
+    # Timed on a THROWAWAY model cloned from the live scheduler's pricing
+    # when one is passed (same cost profile, but 50k fake observations
+    # must not pollute the live per-phase EWMAs the artifact commits);
+    # the acceptance bar counts it inside the same <1%-of-cadence budget.
+    from llm_based_apache_spark_optimization_tpu.utils.perfmodel import (
+        PerfModel,
+    )
+
+    live = getattr(sched, "perf", None)
+    if live is not None:
+        perf = PerfModel(live.cfg, param_bytes=live.param_bytes,
+                         weight_bits=live.weight_bits,
+                         kv_itemsize=live.kv_itemsize,
+                         kv_quant=live.kv_quant, kv_layout=live.kv_layout,
+                         page_size=live.page_size, tp=live.tp,
+                         device_kind=live.device_kind)
+    else:
+        from llm_based_apache_spark_optimization_tpu.models import TINY
+
+        perf = PerfModel(TINY, param_bytes=10 ** 6)
+    t0 = _t.perf_counter()
+    for _ in range(n):
+        perf.observe("decode", rows=8, tokens=8, ctx=128, wall_s=0.001)
+    ledger_ns = (_t.perf_counter() - t0) / n * 1e9
     out = {
         "flight_record_ns": round(record_ns, 1),
         "span_unsampled_ns": round(span_off_ns, 1),
         "tracer_begin_ns": round(begin_ns, 1),
-        # One harvested round pays ONE flight record; spans are per
-        # request-terminal, not per round — record dominates.
-        "per_round_ns": round(record_ns + span_off_ns, 1),
+        "ledger_ns": round(ledger_ns, 1),
+        # One harvested round pays ONE flight record + ONE ledger stamp;
+        # spans are per request-terminal, not per round.
+        "per_round_ns": round(record_ns + span_off_ns + ledger_ns, 1),
     }
     hb = getattr(sched, "heartbeat", None)
     cadence = hb.expected_round_s() if hb is not None else None
     if cadence:
         out["pct_of_round"] = round(
-            100.0 * (record_ns + span_off_ns) * 1e-9 / cadence, 4
+            100.0 * (record_ns + span_off_ns + ledger_ns) * 1e-9 / cadence,
+            4,
         )
     return out
 
@@ -1770,8 +1806,17 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
     }
     # Observability tax (ISSUE 6): flight-recorder append + unsampled
     # tracing cost per round, as ns AND as % of this run's measured round
-    # cadence — the acceptance bar is <1% with sampling off.
+    # cadence — the acceptance bar is <1% with sampling off (the ISSUE-12
+    # roofline-ledger stamp now counts inside the same budget).
     out["observability"] = _obs_overhead(sched=sched)
+    # Per-round roofline ledger (ISSUE 12, utils/perfmodel.py): the
+    # scheduler's OWN per-phase attribution over the run just measured —
+    # the same numbers serving.perf exports live, committed beside the
+    # tok/s they explain (decode MFU / HBM-util enter the --compare
+    # regression gate via the `mfu`/`hbm_util` leaf keys).
+    perf_view = getattr(sched, "perf_stats", None)
+    if perf_view:
+        out["perf"] = perf_view
 
     draft = (int(os.environ.get("BENCH_SCHED_SPEC", "4"))
              if spec_draft is None else spec_draft)
@@ -2107,18 +2152,19 @@ def _detail(cfg, eng, prompts, prompt_len, max_new, batch, full_dt,
     decode_steps = max_new - 1
     decode_tok_s = batch * decode_steps / decode_dt
 
-    p = cfg.num_params
-    attn_flops_tok = 4 * cfg.num_layers * cfg.num_heads * cfg.head_dim
-    s_avg = prompt_len + max_new // 2
-    flops_per_tok = 2 * p + attn_flops_tok * s_avg
-    prefill_flops = batch * prompt_len * (2 * p + attn_flops_tok * prompt_len // 2)
+    # Shared analytic models (utils/perfmodel.py): the SAME formulas the
+    # live scheduler ledger stamps rounds with — factored out in ISSUE 12
+    # so bench artifacts and serving.perf can never disagree.
+    from llm_based_apache_spark_optimization_tpu.utils import perfmodel
 
-    from llm_based_apache_spark_optimization_tpu.engine.kvcache import cache_bytes
+    s_avg = prompt_len + max_new // 2
+    flops_per_tok = perfmodel.flops_per_token(cfg, s_avg)
+    prefill_flops = perfmodel.prefill_flops(cfg, batch, prompt_len)
 
     pbytes = _param_bytes(params)
     itemsize = 2  # bf16 cache
-    kv_read = cache_bytes(cfg, batch, s_avg, itemsize)
-    bytes_per_step = pbytes + kv_read
+    bytes_per_step = perfmodel.decode_step_bytes(cfg, batch, s_avg, pbytes,
+                                                 itemsize=itemsize)
 
     peak_flops, peak_bw = _peak_for(device_kind, quant)
     out = {
@@ -2187,10 +2233,14 @@ def _detail(cfg, eng, prompts, prompt_len, max_new, batch, full_dt,
 # --------------------------------------------------------------------------
 
 #: Higher-is-better metric keys the compare gate tracks wherever they
-#: appear in an artifact: decode/aggregate throughputs and speculative
-#: acceptance. Matched by full path, so "scheduler.tok_s" only ever
-#: compares against "scheduler.tok_s".
-_COMPARE_KEYS = ("value", "tok_s", "decode_tok_s", "tokens_per_round")
+#: appear in an artifact: decode/aggregate throughputs, speculative
+#: acceptance, and (ISSUE 12) the roofline-ledger utilization figures —
+#: a decode-MFU or HBM-util drop at flat tok/s means the analytic model
+#: or the hardware placement regressed, and the gate must say so.
+#: Matched by full path, so "scheduler.tok_s" only ever compares against
+#: "scheduler.tok_s" and "perf.phases.decode.mfu" against itself.
+_COMPARE_KEYS = ("value", "tok_s", "decode_tok_s", "tokens_per_round",
+                 "mfu", "hbm_util", "decode_mfu", "decode_hbm_util")
 
 
 def _collect_compare_metrics(obj, path="") -> "dict[str, float]":

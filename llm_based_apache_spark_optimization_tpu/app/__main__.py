@@ -518,12 +518,21 @@ def main(argv=None) -> None:
     # hidden env reads. This runs BEFORE any service/scheduler is built,
     # so every recorder/registry constructed below picks the values up.
     from ..serve import flightrecorder
-    from ..utils import observability
+    from ..utils import observability, slo, traceprof
     from ..utils.tracing import TRACER
 
     TRACER.reconfigure(sample=cfg.trace_sample, export_dir=cfg.trace_export)
     flightrecorder.reconfigure(rounds=cfg.flight_rounds)
     observability.reconfigure_request_log(cfg.request_log)
+    # Performance attribution & SLOs (ISSUE 12): the rolling SLO engine's
+    # objectives/window and the on-demand profiler's defaults resolve
+    # through AppConfig too — LSOT_SLO_* / LSOT_PROFILE_* are documented
+    # knobs with reconfigure seams, not hidden env reads.
+    slo.reconfigure(ttft_ms=cfg.slo_ttft_ms, tpot_ms=cfg.slo_tpot_ms,
+                    queue_wait_ms=cfg.slo_queue_wait_ms,
+                    window_s=cfg.slo_window_s, target=cfg.slo_target)
+    traceprof.reconfigure_profile(profile_dir=cfg.profile_dir or None,
+                                  rounds=cfg.profile_rounds)
 
     if args.backend == "checkpoint":
         if not args.sql_model_path:

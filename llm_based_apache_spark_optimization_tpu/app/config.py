@@ -148,6 +148,24 @@ class AppConfig:
     # emits at INFO): 1 = every request (historical behavior), 0 = off —
     # the hot path skips the json.dumps + handler I/O entirely.
     request_log: float = 1.0
+    # --- performance attribution & SLOs (utils/perfmodel.py,
+    # utils/slo.py; README "Performance attribution & SLOs").
+    # Rolling SLO objectives in MILLISECONDS (operator units); 0
+    # disables that objective. A replica whose multi-window burn rate
+    # exceeds 1 on both arms marks /readyz degraded and flags itself in
+    # the pool's placement view.
+    slo_ttft_ms: float = 0.0
+    slo_tpot_ms: float = 0.0
+    slo_queue_wait_ms: float = 0.0
+    # Long evaluation window in seconds (the fast-detect arm is
+    # window/12) and the good-fraction target (0.99 = 1% error budget).
+    slo_window_s: float = 300.0
+    slo_target: float = 0.99
+    # On-demand device profiling (/debug/profile): default rounds per
+    # capture, and the artifact directory ("" = next to the trace
+    # export dir, else a tempdir).
+    profile_rounds: int = 8
+    profile_dir: str = ""
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

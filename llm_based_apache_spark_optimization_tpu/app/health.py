@@ -127,7 +127,16 @@ def add_debug_routes(app: App, service: GenerationService) -> None:
     - `GET /debug/traces[?last=N]` — the most recent head-sampled
       request traces (utils/tracing.py): span trees with queue-wait /
       prefill / per-round decode / SQL-exec timing, plus the tracer's
-      sampling config."""
+      sampling config.
+    - `GET /debug/slo` — the rolling SLO engine's report (utils/slo.py):
+      per-replica + fleet quantile sketches over TTFT/TPOT/queue-wait,
+      burn rates per window arm, and which replicas are burning.
+    - `GET /debug/profile[?rounds=N[&model=M]]` — on-demand device
+      profiling: with `rounds`, ARM a bounded jax.profiler capture
+      around the scheduler's next N rounds (409 when a capture is
+      already in flight fleet-wide; the artifact is a Perfetto-loadable
+      trace next to the per-request trace exports); without `rounds`,
+      poll the capture state (armed/capturing/done + artifact list)."""
 
     @app.route("/debug/flightrecorder")
     def flightrecorder(req: Request) -> Response:
@@ -151,6 +160,33 @@ def add_debug_routes(app: App, service: GenerationService) -> None:
             "tracer": TRACER.stats(),
             "traces": service.recent_traces(last),
         })
+
+    @app.route("/debug/slo")
+    def slo(req: Request) -> Response:
+        return Response.json(service.slo_report())
+
+    @app.route("/debug/profile")
+    def profile(req: Request) -> Response:
+        rounds = req.query.get("rounds")
+        if rounds is None:
+            # Poll: the armed/capturing/last-artifact state per model.
+            return Response.json({"captures": service.profile_status()})
+        try:
+            n = int(rounds)
+        except ValueError:
+            return Response.json({"error": "'rounds' must be an integer"},
+                                 status=400)
+        model = req.query.get("model") or None
+        try:
+            return Response.json(service.profile_capture(n, model=model))
+        except LookupError as e:
+            # No registered backend can profile (fake/demo backends).
+            return Response.json({"error": str(e)}, status=400)
+        except RuntimeError as e:
+            # The fleet-wide single-capture guard: one at a time.
+            return Response.json({"error": str(e)}, status=409)
+        except ValueError as e:
+            return Response.json({"error": str(e)}, status=400)
 
 
 def install_drain_gate(app: App, service: GenerationService) -> None:

@@ -47,6 +47,7 @@ def render_report(
     constrained_speculation: Optional[Dict[str, dict]] = None,
     sampled_speculation: Optional[Dict[str, dict]] = None,
     round_cadence: Optional[Dict[str, float]] = None,
+    roofline: Optional[Dict[str, dict]] = None,
 ) -> str:
     """Render harness output as markdown mirroring the reference's report
     structure (per-query table -> aggregate table -> configs -> conclusion)."""
@@ -143,6 +144,24 @@ def render_report(
                 else "n/a"
                 for m in models
             )
+            + " |"
+        )
+    # Live roofline position (ISSUE 12, the per-round ledger's decode
+    # EWMA from serving.perf): achieved MFU / HBM-bandwidth utilization
+    # and which roof binds — the phase-asymmetry signal the
+    # disaggregation ROADMAP item cites, now a report row instead of a
+    # bench-only artifact. Renders only for backends with a ledger.
+    if roofline and any(roofline.get(m) for m in models):
+        def _roof(v: Optional[dict]) -> str:
+            if not v:
+                return "n/a"
+            return (f"{_fmt(100 * v.get('mfu', 0.0), 2)} % MFU / "
+                    f"{_fmt(100 * v.get('hbm_util', 0.0), 2)} % HBM "
+                    f"({v.get('bound', '?')})")
+
+        lines.append(
+            "| Decode roofline | "
+            + " | ".join(_roof(roofline.get(m)) for m in models)
             + " |"
         )
     if any(reports[m].execution_match_rate is not None for m in models):
@@ -442,11 +461,20 @@ def generate(
     # latency number is queueing or compute. None-valued for backends
     # without a heartbeat (fakes, engine).
     round_cadence: Dict[str, float] = {}
+    roofline: Dict[str, dict] = {}
     for m, stats in service.backend_stats().items():
         hb = (stats.get("watchdog") or {}).get("heartbeat") or {}
         ewma = hb.get("expected_round_s")
         if ewma:
             round_cadence[m] = ewma
+        # Decode-phase roofline EWMA (ISSUE 12, serving.perf): first
+        # replica's view for pools (replicas are homogeneous).
+        perf = stats.get("perf") or {}
+        if isinstance(perf.get("replicas"), list) and perf["replicas"]:
+            perf = perf["replicas"][0]
+        dec = (perf.get("phases") or {}).get("decode")
+        if dec:
+            roofline[m] = dec
     config_rows = []
     if with_configs:
         for key, cfg in CONFIGS.items():
@@ -471,6 +499,7 @@ def generate(
         constrained_speculation=constrained_speculation or None,
         sampled_speculation=sampled_speculation or None,
         round_cadence=round_cadence or None,
+        roofline=roofline or None,
     )
 
 

@@ -9,7 +9,13 @@ deaths left exactly that hole. The flight recorder is the black box:
 - `FlightRecorder.record(**fields)` — one append per HARVESTED round
   (the scheduler's natural bookkeeping instant), into a bounded deque
   under a tiny lock: O(1), no I/O, no serialization on the hot path.
-  Capacity defaults from `LSOT_FLIGHT_ROUNDS` (256).
+  Capacity defaults from `LSOT_FLIGHT_ROUNDS` (256). Since PR 12 each
+  round record also carries the roofline-ledger columns
+  (`phase`/`perf_ctx`/`mfu`/`hbm_util`/`bound`, plus
+  `prefill_mfu`/`prefill_hbm_util` on rounds that flushed prefill
+  chunks) — computed by utils/perfmodel.py from the SAME rounded
+  `round_wall_s` that lands in the record, so a reader can recompute
+  every utilization figure from the record alone.
 - `event(kind, **fields)` — sparse lifecycle markers (crash, stall
   escalation, restart, drain, grammar swap) ride the same ring with
   `"kind"` set, so the postmortem shows rounds and lifecycle interleaved

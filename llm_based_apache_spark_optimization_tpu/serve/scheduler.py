@@ -151,8 +151,10 @@ from ..ops.sampling import (
     sample_runtime,
 )
 from ..parallel.sharding import shard_params, validate_tp
+from ..utils import traceprof
 from ..utils.faults import FAULTS, InjectedFault
 from ..utils.observability import resilience
+from ..utils.perfmodel import PerfModel
 from .flightrecorder import FlightRecorder, merge_snapshots
 from .resilience import (
     Deadline,
@@ -295,6 +297,13 @@ class _Request:
     resume_pref: int = 0
     preempted: int = 0
     rng_count: int = 0
+    # Parked intervals [t_preempt, t_resume-or-0.0] for the request trace
+    # tree: a victim's Perfetto export shows WHERE its latency went while
+    # it sat preempted off the device (flush_spans emits one
+    # "sched.preempted" span per interval — ISSUE 12 satellite; PR 10
+    # only emitted flight-recorder events, so a victim's timeline had an
+    # unexplained hole exactly over the preemption).
+    parked: List[List[float]] = dataclasses.field(default_factory=list)
     # Host page copies under LSOT_KV_SPILL=1: one array per cache array —
     # (k, v) for a compute-dtype pool, (k8, ks, v8, vs) for the int8 pool
     # (the quantization scales serialize beside the pages, so restore is
@@ -336,6 +345,16 @@ class _Request:
                 tr.add_span("sched.decode", self.ready_at, now,
                             output_tokens=len(self.generated),
                             constrained=self.constraint is not None)
+            # Preemption parking (ISSUE 12 satellite): one span per parked
+            # interval, so a victim's exported timeline explains the gap —
+            # an interval still open at terminal time (preempted, never
+            # resumed: deadline burned while parked) closes at `now` with
+            # resumed=False.
+            for iv in self.parked:
+                t0, t1 = iv[0], iv[1]
+                tr.add_span("sched.preempted", t0, t1 or now,
+                            rid=self.rid, resumed=bool(t1),
+                            preemptions=self.preempted)
         except Exception:  # noqa: BLE001 — tracing must never kill the loop
             self.trace = None
 
@@ -611,6 +630,38 @@ class ContinuousBatchingScheduler:
             # the int8 cache (ops/pallas/dispatch.py has the recipe).
             cache_dev_bytes //= 2
         self._decode_impl = decode_attention_impl(mesh, cache_dev_bytes)
+        # Per-round roofline ledger (ISSUE 12, utils/perfmodel.py): the
+        # SAME analytic cost model bench.py prices artifacts with, built
+        # once from everything immutable — model shape, weight bytes/bits,
+        # KV layout/dtype pricing, tp shard, device peaks (CPU fallback
+        # included) — so every harvested round can stamp achieved MFU,
+        # HBM-bandwidth utilization, and a compute-vs-memory-bound
+        # verdict for a handful of float multiplies (bench's
+        # _obs_overhead prices the stamp against the <1% bar).
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — backend-less test doubles
+            device_kind = ""
+        self.perf = PerfModel(
+            cfg,
+            param_bytes=int(sum(x.nbytes for x in jax.tree.leaves(params))),
+            weight_bits=self._weight_bits,
+            kv_itemsize=dtype.itemsize,
+            kv_quant=kv_quant,
+            kv_layout=kv_layout,
+            page_size=self._page_size if self._paged else None,
+            tp=tp,
+            device_kind=device_kind,
+        )
+        self._last_harvest_t: Optional[float] = None
+        # On-demand device profiling (/debug/profile): armed captures
+        # start at the next issued round on the WORKER thread and stop
+        # after N harvested rounds; the process-wide guard in
+        # utils/traceprof keeps at most one capture in flight fleet-wide.
+        self._profile_lock = threading.Lock()
+        self._profile_arm: Optional[Dict[str, object]] = None
+        self._profile_active: Optional[Dict[str, object]] = None
+        self._profile_last: Optional[Dict[str, object]] = None
         # The persistent cache is a TUPLE of arrays threaded through every
         # jitted op: (k, v) in bf16 mode, (k8, ks, v8, vs) with int8 KV
         # (values + per-slot scales, ops/quant.quantize_kv), (kp, vp) pool
@@ -1268,6 +1319,9 @@ class ContinuousBatchingScheduler:
         self._free_slot_pages(slot)
         self._page_alloc.note_preempt()
         resilience.inc("kv_preemptions")
+        # Open a parked interval for the request trace tree (closed at
+        # resume; flush_spans exports it as a "sched.preempted" span).
+        req.parked.append([time.perf_counter(), 0.0])
         self.flight.event(
             "preempt", slot=slot, rid=req.rid,
             generated=len(req.generated), spill=req.spilled is not None,
@@ -1371,6 +1425,10 @@ class ContinuousBatchingScheduler:
             )
         req.ready = True
         req.ready_at = time.perf_counter()
+        if req.parked and not req.parked[-1][1]:
+            # Close the parked interval: the trace span now bounds
+            # exactly preempt → re-armed.
+            req.parked[-1][1] = req.ready_at
         # Decode re-writes [plen - 1, page_end): COW any page the
         # re-prefill's publish shared before the slot goes
         # decode-eligible (spill resumes never published — no-op there).
@@ -1422,6 +1480,174 @@ class ContinuousBatchingScheduler:
             self.cfg, self._page_size, self._dtype.itemsize, self.kv_quant
         )
         return out
+
+    # --------------------------------------------------- performance ledger
+
+    @property
+    def perf_stats(self) -> Dict[str, object]:
+        """The `serving.perf` /metrics payload: the analytic model's
+        pricing assumptions + per-phase EWMAs of the live roofline
+        position (prefill/decode/draft/verify MFU, HBM util, binding
+        roof), replica-labeled for the Prometheus gauges."""
+        return {"replica": self.flight.replica, **self.perf.stats()}
+
+    # ------------------------------------------------ on-demand profiling
+
+    def _profile_owner(self) -> str:
+        return f"sched:{self.flight.replica}:{id(self):x}"
+
+    def profile_rounds(self, rounds: Optional[int] = None,
+                       out_dir: Optional[str] = None) -> Dict[str, object]:
+        """Arm a bounded `jax.profiler` device-trace capture around the
+        next `rounds` scheduler rounds (the /debug/profile seam). The
+        capture starts on the worker thread at the next issued round and
+        stops after N harvested rounds; the artifact (Perfetto-loadable
+        *.trace.json.gz, the same format the per-request trace exports
+        use) lands under `out_dir` — default: next to the tracer's
+        export dir (utils/traceprof.profile_defaults). Raises
+        RuntimeError when ANY capture is already in flight fleet-wide
+        (the process-wide guard)."""
+        import tempfile
+
+        d_def, r_def = traceprof.profile_defaults()
+        # None -> the configured default; an EXPLICIT 0 must be a clear
+        # request error, never a silent default-8 capture that takes the
+        # fleet-wide slot nobody asked for.
+        n = r_def if rounds is None else int(rounds)
+        if n < 1:
+            raise ValueError(f"rounds must be >= 1, got {n}")
+        owner = self._profile_owner()
+        if not traceprof.try_acquire_capture(owner):
+            raise RuntimeError(
+                f"a device profile capture is already in flight "
+                f"(owner {traceprof.capture_owner()}); one at a time "
+                f"fleet-wide"
+            )
+        base = out_dir or d_def
+        try:
+            if base:
+                d = os.path.join(
+                    base, f"profile-{int(time.time() * 1000)}-"
+                          f"{self.flight.replica}"
+                )
+                os.makedirs(d, exist_ok=True)
+            else:
+                d = tempfile.mkdtemp(prefix="lsot_profile_")
+        except OSError:
+            traceprof.release_capture(owner)
+            raise
+        with self._profile_lock:
+            self._profile_arm = {"rounds": n, "dir": d, "owner": owner,
+                                 "armed_at": time.time()}
+        return {"state": "armed", "rounds": n, "dir": d,
+                "replica": self.flight.replica}
+
+    def profile_status(self) -> Dict[str, object]:
+        """Live capture state: armed (waiting for the next round) /
+        capturing (rounds left) / the last finished capture's artifact
+        list — what the smoke script polls."""
+        with self._profile_lock:
+            arm, active, last = (self._profile_arm, self._profile_active,
+                                 self._profile_last)
+            out: Dict[str, object] = {"replica": self.flight.replica}
+            if active is not None:
+                out.update({"state": "capturing",
+                            "rounds_left": active["rounds_left"],
+                            "dir": active["dir"]})
+            elif arm is not None:
+                out.update({"state": "armed", "rounds": arm["rounds"],
+                            "dir": arm["dir"]})
+            else:
+                out["state"] = "idle"
+            if last is not None:
+                out["last"] = dict(last)
+        return out
+
+    def _maybe_start_profile(self) -> None:
+        """Worker-thread start: consume the armed request and open the
+        device trace so the next issued round is inside the capture."""
+        with self._profile_lock:
+            arm = self._profile_arm
+            if arm is None or self._profile_active is not None:
+                return
+            self._profile_arm = None
+        try:
+            jax.profiler.start_trace(arm["dir"])
+        except Exception as e:  # noqa: BLE001 — profiling must not kill serving
+            traceprof.release_capture(arm["owner"])
+            with self._profile_lock:
+                self._profile_last = {"state": "error",
+                                      "error": str(e)[:200],
+                                      "dir": arm["dir"]}
+            return
+        with self._profile_lock:
+            self._profile_active = {
+                "rounds_left": arm["rounds"], "rounds": arm["rounds"],
+                # Rounds already in flight were ISSUED before the trace
+                # started: their harvests must not count toward the
+                # capture, or a lag-deep pipeline under live traffic
+                # brackets only N-1 (or zero) complete in-trace rounds.
+                "skip": len(self._pending),
+                "dir": arm["dir"], "owner": arm["owner"],
+                "started": time.time(),
+            }
+        self.flight.event("profile_start", rounds=arm["rounds"],
+                          dir=arm["dir"])
+
+    def _profile_round_done(self) -> None:
+        with self._profile_lock:
+            st = self._profile_active
+            if st is None:
+                return
+            if st["skip"] > 0:
+                st["skip"] -= 1  # pre-trace round draining the pipeline
+                return
+            st["rounds_left"] -= 1
+            if st["rounds_left"] > 0:
+                return
+            self._profile_active = None
+        self._finish_profile(st)
+
+    def _finish_profile(self, st: Dict[str, object],
+                        error: Optional[str] = None) -> None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — a failed stop is still a finish
+            error = error or str(e)[:200]
+        arts = traceprof.find_profile_artifacts(str(st["dir"]))
+        out: Dict[str, object] = {
+            "state": "done" if arts else "error",
+            "dir": st["dir"],
+            "rounds": st["rounds"],
+            "artifacts": arts,
+            "artifact_bytes": sum(
+                os.path.getsize(a) for a in arts if os.path.exists(a)
+            ),
+            "wall_s": round(time.time() - float(st["started"]), 3),
+        }
+        if error:
+            out["error"] = error
+            out["state"] = "error"
+        with self._profile_lock:
+            self._profile_last = out
+        traceprof.release_capture(str(st["owner"]))
+        self.flight.event("profile_done", state=out["state"],
+                          artifacts=len(arts))
+
+    def _abort_profile(self, reason: str) -> None:
+        """Shutdown/crash hygiene: an armed or mid-flight capture must
+        not leak the fleet-wide guard (or a dangling jax trace) past the
+        loop that owned it."""
+        with self._profile_lock:
+            arm, self._profile_arm = self._profile_arm, None
+            active, self._profile_active = self._profile_active, None
+        if arm is not None:
+            traceprof.release_capture(str(arm["owner"]))
+            with self._profile_lock:
+                self._profile_last = {"state": "aborted", "error": reason,
+                                      "dir": arm["dir"]}
+        if active is not None:
+            self._finish_profile(active, error=reason)
 
     def _build_prefill(self, t_bucket: int, k: int):
         cfg, impl, mesh = self.cfg, self._impl, self.mesh
@@ -2790,6 +3016,19 @@ class ContinuousBatchingScheduler:
         if self._paged:
             call_args.append(self._ptab)
         out = self._prefill_fns[(t, kb)](self.params, *self._cache, *call_args)
+        # Roofline ledger: bank this chunk batch's analytic work; the
+        # next harvested round attributes the pile over the measured
+        # inter-harvest wall — chunks dispatch asynchronously, so there
+        # is no honest per-chunk device wall outside /debug/profile.
+        # rows = kb, the PADDED k-bucket: the device computes every
+        # padding row's FLOPs too (finite garbage, writes dropped) —
+        # the same every-row convention the decode ledger uses
+        # (rows = num_slots), so prefill MFU is not understated vs
+        # decode's on small admission groups. ctx is the real group's
+        # mean attention context (padding rows attend over [0, t)).
+        avg_start = sum(starts[: len(group)]) // len(group)
+        self.perf.note_prefill(rows=kb, tokens=t,
+                               ctx=avg_start + t // 2)
         nc = len(self._cache)
         self._cache, toks = out[:nc], out[-1]
         if self._spec_draft:
@@ -3221,6 +3460,7 @@ class ContinuousBatchingScheduler:
         # included), and the heartbeat's measured cadence. One bounded
         # append; bench prices it.
         ewma = self.heartbeat.expected_round_s()
+        round_wall = round(t_harvest - t_issue, 6)
         rec = {
             "round": self.heartbeat.rounds,
             "occupancy": occupancy,
@@ -3228,11 +3468,54 @@ class ContinuousBatchingScheduler:
             "admitted": self._round_admitted,
             "retired": self._round_retired,
             "emitted": round_emitted,
-            "round_wall_s": round(t_harvest - t_issue, 6),
+            "round_wall_s": round_wall,
             "cadence_s": round(ewma, 6) if ewma is not None else None,
         }
         if n_emit is not None:
             rec["spec_emitted"] = spec_emitted
+        # Roofline ledger columns (ISSUE 12): this round's achieved MFU /
+        # HBM-bandwidth utilization / binding-roof verdict from the shared
+        # analytic model — computed from the ROUNDED wall that lands in
+        # the record, so a reader (and the tier-1 reconciliation test) can
+        # recompute the exact same numbers from the record alone.
+        # `rows` is num_slots: the device computes EVERY slot row, parked
+        # lanes included (occupancy is the goodput column beside it);
+        # `perf_ctx` is the active rows' mean committed context. Spec
+        # rounds are the VERIFY phase (one T=D+1 forward); the draft
+        # gather is ledgered separately into the phase EWMAs.
+        phase = "decode" if n_emit is None else "verify"
+        tokens = (self.decode_chunk if n_emit is None
+                  else self._spec_draft + 1)
+        ctx_sum = sum(
+            len(r.ids) + len(r.generated)
+            for r in issue_reqs if r is not None
+        )
+        perf_ctx = max(1, ctx_sum // max(1, occupancy))
+        att = self.perf.observe(phase, rows=self.num_slots, tokens=tokens,
+                                ctx=perf_ctx, wall_s=round_wall)
+        rec["phase"] = phase
+        rec["perf_ctx"] = perf_ctx
+        rec["mfu"] = att["mfu"]
+        rec["hbm_util"] = att["hbm_util"]
+        rec["bound"] = att["bound"]
+        if n_emit is not None and self._spec_draft:
+            self.perf.observe("draft", rows=self.num_slots,
+                              tokens=self._spec_draft,
+                              ctx=int(self._hist.shape[1]),
+                              wall_s=round_wall)
+        # Prefill chunks dispatched since the last harvest attribute over
+        # the inter-harvest wall (the live prefill-vs-decode asymmetry
+        # signal the disaggregation ROADMAP item needs per replica).
+        interval = round(
+            t_harvest - (self._last_harvest_t
+                         if self._last_harvest_t is not None else t_issue),
+            6,
+        )
+        self._last_harvest_t = t_harvest
+        pre = self.perf.flush_prefill(interval)
+        if pre is not None:
+            rec["prefill_mfu"] = pre["mfu"]
+            rec["prefill_hbm_util"] = pre["hbm_util"]
         if self._paged:
             # Page-pool occupancy per round: the flight-recorder column a
             # leaked page shows up in (pages_in_use that never drains
@@ -3245,6 +3528,8 @@ class ContinuousBatchingScheduler:
         self.flight.record(**rec)
         self._round_admitted = []
         self._round_retired = []
+        if self._profile_active is not None:
+            self._profile_round_done()
 
     def _harvest_firsts(self) -> None:
         """Drain path: ready slots whose first token never rode a round."""
@@ -3274,6 +3559,9 @@ class ContinuousBatchingScheduler:
 
     def _close(self, exc: BaseException) -> None:
         """Fail every in-flight and queued request; reject future submits."""
+        # An armed/mid-flight /debug/profile capture must not leak the
+        # fleet-wide guard past the loop that owned it.
+        self._abort_profile(f"scheduler closed: {type(exc).__name__}")
         with self._submit_lock:
             self._closed = True
             self._pending_new_tokens = 0
@@ -3394,6 +3682,10 @@ class ContinuousBatchingScheduler:
             if self._prefill_q:
                 self._prefill_step()
             if any(r is not None and r.ready for r in self._slot_req):
+                if self._profile_arm is not None:
+                    # Armed /debug/profile capture: start the device trace
+                    # on THIS thread, bracketing the next N rounds.
+                    self._maybe_start_profile()
                 self._issue_decode()
                 if len(self._pending) > self._harvest_lag:
                     self._harvest_round()
@@ -3717,6 +4009,48 @@ class SchedulerPool:
         return out
 
     @property
+    def perf_stats(self) -> Optional[Dict[str, object]]:
+        """Per-replica roofline ledgers (utils/perfmodel.py), labeled —
+        the Prometheus lsot_mfu/lsot_hbm_util gauges render phase ×
+        replica from this list. None when no replica ledgers (duck-typed
+        toy fleets)."""
+        per = []
+        for st, s in self._replica_items():
+            p = getattr(s, "perf_stats", None)
+            if isinstance(p, dict):
+                rec = dict(p)
+                rec["replica"] = st.label
+                per.append(rec)
+        return {"replicas": per} if per else None
+
+    def profile_rounds(self, rounds: Optional[int] = None,
+                       out_dir: Optional[str] = None,
+                       replica: Optional[str] = None) -> Dict[str, object]:
+        """Arm an on-demand device capture on ONE replica (the named one,
+        else the first placeable) — the process-wide guard in
+        utils/traceprof already enforces at most one capture in flight
+        across the whole fleet."""
+        for st, s in self._replica_items():
+            if replica is not None and st.label != replica:
+                continue
+            fn = getattr(s, "profile_rounds", None)
+            if callable(fn) and (replica is not None
+                                 or st.state in _ReplicaState.PLACEABLE):
+                return fn(rounds, out_dir)
+        raise ValueError(
+            f"no {'replica ' + replica if replica else 'placeable replica'}"
+            f" exposes device profiling"
+        )
+
+    def profile_status(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for st, s in self._replica_items():
+            fn = getattr(s, "profile_status", None)
+            if callable(fn):
+                out[st.label] = fn()
+        return out
+
+    @property
     def flight(self):
         """First replica's recorder (single-scheduler duck typing);
         flight_snapshot() is the merged pool view."""
@@ -3789,6 +4123,26 @@ class SchedulerPool:
                     pstats["watermark_low_pages"]
                 rec["kv_watermark_high_pages"] = \
                     pstats["watermark_high_pages"]
+            # Roofline + SLO placement signals (ISSUE 12): the replica's
+            # live decode roofline position and whether its rolling SLO
+            # is burning — the columns a phase-aware / SLO-aware router
+            # will consume (disaggregated prefill/decode ROADMAP item),
+            # exported per replica like every other numeric field here.
+            perf = getattr(s, "perf_stats", None)
+            if isinstance(perf, dict):
+                dec = (perf.get("phases") or {}).get("decode")
+                if dec:
+                    rec["decode_mfu"] = dec.get("mfu")
+                    rec["decode_hbm_util"] = dec.get("hbm_util")
+            try:
+                from ..utils import slo as _slo
+
+                if _slo.ENGINE.enabled:
+                    rec["slo_burning"] = bool(
+                        _slo.ENGINE.replica_burning(st.label)
+                    )
+            except Exception:  # noqa: BLE001 — placement view best-effort
+                pass
             out.append(rec)
         return out
 
@@ -4450,6 +4804,13 @@ class SchedulerBackend:
         pages = getattr(self.scheduler, "page_stats", None)
         if pages:
             out["kv_pages"] = pages
+        # Per-round roofline ledger (ISSUE 12, utils/perfmodel.py): the
+        # live per-phase MFU / HBM-util / binding-roof view under
+        # `serving.perf` — the Prometheus renderer turns it into the
+        # lsot_mfu / lsot_hbm_util gauges labeled phase × replica.
+        perf = getattr(self.scheduler, "perf_stats", None)
+        if perf:
+            out["perf"] = perf
         # Liveness view (serve/watchdog.py): heartbeat age/cadence, slots
         # retired for per-lane stalls, and — when supervised — whole-loop
         # stalls detected + the active stall threshold.
@@ -4704,6 +5065,22 @@ class SchedulerBackend:
         """Live flight-recorder view (per-round records; pool-merged and
         replica-labeled for dp>1) — the /debug/flightrecorder payload."""
         return merge_snapshots([self.scheduler], last)
+
+    def profile_rounds(self, rounds: Optional[int] = None,
+                       out_dir: Optional[str] = None) -> Dict[str, object]:
+        """On-demand device capture seam (the /debug/profile POST body):
+        arm a bounded jax.profiler trace around the scheduler's next N
+        rounds. Raises ValueError for backends whose scheduler has no
+        profiling seam (duck-typed fakes)."""
+        fn = getattr(self.scheduler, "profile_rounds", None)
+        if not callable(fn):
+            raise ValueError("backend scheduler does not support device "
+                             "profiling")
+        return fn(rounds, out_dir)
+
+    def profile_status(self) -> Optional[Dict[str, object]]:
+        fn = getattr(self.scheduler, "profile_status", None)
+        return fn() if callable(fn) else None
 
     def check_budget(self, prompt: str,
                      max_new_tokens: Optional[int] = None,

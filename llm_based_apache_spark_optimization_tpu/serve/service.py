@@ -131,6 +131,14 @@ class GenerationService:
             snap["resilience"] = dict(counters)
             if breakers:
                 snap["resilience"]["breakers"] = breakers
+        # Rolling SLO view (utils/slo.py) under the reserved "slo" key
+        # when objectives are configured: burn rates ARE the serving
+        # story under load, and the Prometheus renderer turns this into
+        # the lsot_slo_* families.
+        from ..utils import slo as slo_mod
+
+        if slo_mod.ENGINE.enabled:
+            snap["slo"] = slo_mod.ENGINE.report()
         return snap
 
     def metrics_prometheus(self) -> str:
@@ -167,6 +175,60 @@ class GenerationService:
     def recent_traces(self, n: Optional[int] = None) -> list:
         """Last head-sampled request traces (the /debug/traces payload)."""
         return TRACER.recent(n)
+
+    def slo_report(self) -> Dict[str, object]:
+        """The /debug/slo payload: the process SLO engine's rolling
+        report (objectives, per-replica quantiles + burn rates, fleet
+        merge) — populated even with no objective configured, so the
+        quantile sketches are inspectable before alerting is wired."""
+        from ..utils import slo as slo_mod
+
+        return slo_mod.ENGINE.report()
+
+    def profile_capture(self, rounds: Optional[int] = None,
+                        model: Optional[str] = None) -> Dict[str, object]:
+        """Arm an on-demand device-trace capture (the /debug/profile
+        trigger) on the first backend exposing the seam — or `model`'s.
+        Raises LookupError when no registered backend can profile
+        (fake/demo backends), RuntimeError when a capture is already in
+        flight fleet-wide (the endpoint's 409)."""
+        with self._lock:
+            entries = [e for e in self._models.values()
+                       if model is None or e.name == model]
+        seen = set()
+        for e in entries:
+            key = id(getattr(e.backend, "scheduler", e.backend))
+            if key in seen:
+                continue
+            seen.add(key)
+            fn = getattr(e.backend, "profile_rounds", None)
+            if callable(fn):
+                out = dict(fn(rounds))
+                out["model"] = e.name
+                return out
+        raise LookupError(
+            f"no {'backend for model ' + repr(model) if model else 'registered backend'}"
+            f" supports device profiling"
+        )
+
+    def profile_status(self) -> Dict[str, object]:
+        """Per-model capture state (armed/capturing/last artifact) —
+        what the smoke script polls after arming."""
+        out: Dict[str, object] = {}
+        with self._lock:
+            entries = list(self._models.values())
+        seen = set()
+        for e in entries:
+            key = id(getattr(e.backend, "scheduler", e.backend))
+            if key in seen:
+                continue
+            seen.add(key)
+            fn = getattr(e.backend, "profile_status", None)
+            if callable(fn):
+                st = fn()
+                if st:
+                    out[e.name] = st
+        return out
 
     # ------------------------------------------------------------- lifecycle
 
@@ -210,12 +272,30 @@ class GenerationService:
                 seen.add(key)
                 for k in totals:
                     totals[k] += int(h.get(k, 0) or 0)
-        return {
+        out: Dict[str, object] = {
             "state": worst,
             "draining": self._draining,
             "models": models,
             **totals,
         }
+        # Rolling SLO (utils/slo.py): a replica BURNING a configured
+        # objective (multi-window burn rate > 1 on both arms) marks the
+        # instance degraded — still serving (200 from /readyz), but
+        # flagged for operators and visibly worse than 'ready'. Crash/
+        # restart states stay strictly worse: a burning SLO never
+        # downgrades 'restarting'/'dead' information.
+        from ..utils import slo as slo_mod
+
+        if slo_mod.ENGINE.enabled:
+            # ONE report per probe: readiness polls every few seconds,
+            # and `burning` + `state` must come from the same snapshot
+            # (two calls could straddle a window-slice rollover).
+            rep = slo_mod.ENGINE.report()
+            out["slo"] = {"state": rep["state"],
+                          "burning": rep["burning"]}
+            if rep["burning"] and out["state"] == "ready":
+                out["state"] = "degraded"
+        return out
 
     def fleet_health(self) -> Dict[str, list]:
         """Per-replica lifecycle per model, for backends serving from a

@@ -431,6 +431,28 @@ class SupervisedScheduler:
         schedulers) — the /metrics kv_pages gauges survive supervision."""
         return getattr(self._inner, "page_stats", None)
 
+    @property
+    def perf_stats(self):
+        """Roofline-ledger passthrough (utils/perfmodel.py): the
+        serving.perf view and the lsot_mfu/lsot_hbm_util gauges survive
+        supervision (None for duck-typed inners without a ledger)."""
+        return getattr(self._inner, "perf_stats", None)
+
+    def profile_rounds(self, rounds=None, out_dir=None):
+        """On-demand device-capture passthrough (/debug/profile): the
+        INNER loop owns the device, so it owns the capture; the
+        fleet-wide single-capture guard lives below this seam."""
+        fn = getattr(self._inner, "profile_rounds", None)
+        if not callable(fn):
+            raise ValueError(
+                "supervised scheduler does not support device profiling"
+            )
+        return fn(rounds, out_dir)
+
+    def profile_status(self):
+        fn = getattr(self._inner, "profile_status", None)
+        return fn() if callable(fn) else None
+
     def retry_after_hint(self) -> float:
         """The inner scheduler's queue-depth × service-time estimate —
         except while the loop is down (stalled/crashed, mid-restart):

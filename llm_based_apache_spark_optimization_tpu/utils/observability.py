@@ -304,6 +304,22 @@ class MetricsRegistry:
         if m.queue_wait_s:
             self.histograms.observe("lsot_queue_wait_seconds",
                                     m.queue_wait_s, **labels)
+        # Rolling SLO engine (utils/slo.py): the same TTFT/TPOT/queue-wait
+        # observations feed the windowed burn-rate sketches, per replica.
+        # Lazy import (slo imports this module's bucket bounds) and gated
+        # on `enabled`, so the no-objective hot path pays one attribute
+        # read.
+        from . import slo as _slo
+
+        eng = _slo.ENGINE
+        if eng.enabled:
+            rep = m.replica or "r0"
+            if m.ttft_s:
+                eng.observe("ttft", m.ttft_s, replica=rep)
+            if m.output_tokens > 1:
+                eng.observe("tpot", m.tpot_s, replica=rep)
+            if m.queue_wait_s:
+                eng.observe("queue_wait", m.queue_wait_s, replica=rep)
         # Level check BEFORE the json.dumps (the formatting was the cost,
         # not the logging call), then the sampling knob.
         if self._log_sample > 0.0 and log.isEnabledFor(logging.INFO):

@@ -137,6 +137,82 @@ def _flatten_serving(emit: _Emitter, model: str, prefix: str, node) -> None:
         emit.add(_NAME_OK.sub("_", prefix), {"model": model}, n)
 
 
+def _emit_perf(emit: _Emitter, model: str, perf: Dict) -> None:
+    """The roofline-ledger gauges (ISSUE 12): `serving.perf` becomes
+    lsot_mfu / lsot_hbm_util / lsot_perf_compute_bound labeled
+    model × replica × PHASE (prefill|decode|draft|verify) — the live
+    per-replica prefill/decode hardware-asymmetry signal. Accepts one
+    replica's ledger ({"replica", "phases"}) or a pool's
+    ({"replicas": [...]})."""
+    ledgers = perf.get("replicas") if isinstance(perf.get("replicas"),
+                                                 list) else [perf]
+    for led in ledgers:
+        if not isinstance(led, dict):
+            continue
+        rep = led.get("replica") or "r0"
+        for k in ("peak_tflops", "peak_hbm_gbs"):
+            n = _num(led.get(k))
+            if n is not None:
+                emit.add(f"lsot_perf_{k}", {"model": model, "replica": rep},
+                         n)
+        for phase, ph in (led.get("phases") or {}).items():
+            if not isinstance(ph, dict):
+                continue
+            labels = {"model": model, "replica": rep, "phase": str(phase)}
+            for key, name in (("mfu", "lsot_mfu"),
+                              ("hbm_util", "lsot_hbm_util"),
+                              ("tflops", "lsot_perf_tflops"),
+                              ("gbs", "lsot_perf_hbm_gbs"),
+                              ("rounds", "lsot_perf_rounds")):
+                n = _num(ph.get(key))
+                if n is not None:
+                    emit.add(name, labels, n,
+                             "counter" if key == "rounds" else "gauge")
+            if "bound" in ph:
+                emit.add("lsot_perf_compute_bound", labels,
+                         1.0 if ph["bound"] == "compute-bound" else 0.0)
+
+
+def _emit_slo(emit: _Emitter, slo: Dict) -> None:
+    """The rolling-SLO families (ISSUE 12): per-replica + fleet quantile
+    gauges, bad-fraction/burn-rate gauges per window arm, and the 0/1
+    burning flag /readyz keys degraded off."""
+    for m, obj in (slo.get("objectives") or {}).items():
+        n = _num((obj or {}).get("threshold_s"))
+        if n is not None:
+            emit.add("lsot_slo_objective_seconds", {"metric": m}, n)
+    views = [(r.get("replica") or "r0", r.get("metrics") or {})
+             for r in slo.get("replicas") or [] if isinstance(r, dict)]
+    views.append(("fleet", slo.get("fleet") or {}))
+    for rep, metrics in views:
+        for m, v in metrics.items():
+            if not isinstance(v, dict):
+                continue
+            labels = {"metric": str(m), "replica": rep}
+            for q in ("p50", "p90", "p99"):
+                n = _num(v.get(q))
+                if n is not None:
+                    emit.add(f"lsot_slo_{q}_seconds", labels, n)
+            n = _num(v.get("count"))
+            if n is not None:
+                emit.add("lsot_slo_observations", labels, n)
+            for key, win in (("bad_frac", "long"),
+                             ("bad_frac_short", "short")):
+                n = _num(v.get(key))
+                if n is not None:
+                    emit.add("lsot_slo_bad_fraction",
+                             {**labels, "window": win}, n)
+            for key, win in (("burn_rate", "long"),
+                             ("burn_rate_short", "short")):
+                n = _num(v.get(key))
+                if n is not None:
+                    emit.add("lsot_slo_burn_rate",
+                             {**labels, "window": win}, n)
+            if "burning" in v:
+                emit.add("lsot_slo_burning", labels,
+                         1.0 if v["burning"] else 0.0)
+
+
 def render_prometheus(snapshot: Dict,
                       histograms: Optional[HistogramSet] = None) -> str:
     """Render `GenerationService.metrics_snapshot()` (+ the registry's
@@ -144,7 +220,7 @@ def render_prometheus(snapshot: Dict,
     emit = _Emitter()
     resilience = snapshot.get("resilience") or {}
     for model, agg in snapshot.items():
-        if model == "resilience" or not isinstance(agg, dict):
+        if model in ("resilience", "slo") or not isinstance(agg, dict):
             continue
         for key, (suffix, mtype) in _MODEL_KEYS.items():
             n = _num(agg.get(key))
@@ -152,6 +228,14 @@ def render_prometheus(snapshot: Dict,
                 emit.add(f"lsot_{suffix}", {"model": model}, n, mtype)
         serving = agg.get("serving")
         if isinstance(serving, dict):
+            # The roofline ledger renders as first-class phase × replica
+            # gauges (not path-flattened serving gauges) so dashboards
+            # join lsot_mfu/lsot_hbm_util on the same label vocabulary
+            # as the latency histograms.
+            serving = dict(serving)
+            perf = serving.pop("perf", None)
+            if isinstance(perf, dict):
+                _emit_perf(emit, model, perf)
             _flatten_serving(emit, model, "lsot_serving", serving)
     if resilience:
         breakers = resilience.get("breakers") or {}
@@ -171,6 +255,9 @@ def render_prometheus(snapshot: Dict,
                      1.0 if is_open else 0.0)
             if fails is not None:
                 emit.add("lsot_breaker_failures", {"dependency": dep}, fails)
+    slo = snapshot.get("slo")
+    if isinstance(slo, dict):
+        _emit_slo(emit, slo)
     if histograms is not None:
         for name, series in sorted(histograms.snapshot().items()):
             name = _NAME_OK.sub("_", name)

@@ -29,7 +29,8 @@ import gzip
 import json
 import os
 import tempfile
-from typing import Dict, List, Tuple
+import threading
+from typing import Dict, List, Optional, Tuple
 
 
 class Trace:
@@ -115,6 +116,87 @@ class Trace:
         ]
         rows.sort(key=lambda r: -r[1])
         return rows[:n]
+
+
+# ---------------------------------------------------------------------------
+# On-demand device profiling (/debug/profile): the fleet-wide single-capture
+# guard + app-configured defaults. jax.profiler supports ONE trace at a time
+# per process, and a capture is expensive enough that two overlapping ones
+# would corrupt each other's artifacts — so schedulers (every replica, every
+# model) funnel through this process-wide guard: at most one capture in
+# flight, whoever holds it releases on finish/abort.
+
+_capture_lock = threading.Lock()
+_capture_owner: Optional[str] = None
+
+#: App-startup overrides (AppConfig.profile_dir / profile_rounds via
+#: `reconfigure_profile`); env fallbacks LSOT_PROFILE_DIR /
+#: LSOT_PROFILE_ROUNDS keep the knobs usable without the app wiring.
+_profile_dir_override: Optional[str] = None
+_profile_rounds_override: Optional[int] = None
+
+
+def reconfigure_profile(profile_dir: Optional[str] = None,
+                        rounds: Optional[int] = None) -> None:
+    """App-startup wiring seam (AppConfig.profile_dir/profile_rounds) —
+    same pattern as `tracing.TRACER.reconfigure`, so the AppConfig knobs
+    are honored, not silent no-ops."""
+    global _profile_dir_override, _profile_rounds_override
+    _profile_dir_override = profile_dir or None
+    _profile_rounds_override = int(rounds) if rounds else None
+
+
+def profile_defaults() -> Tuple[Optional[str], int]:
+    """(artifact base dir or None, default rounds) for an on-demand
+    capture. Dir precedence: reconfigure_profile > LSOT_PROFILE_DIR >
+    the tracer's export dir (the capture lands NEXT TO the existing
+    per-request trace exports) > None (caller tempdirs)."""
+    d = _profile_dir_override or os.environ.get("LSOT_PROFILE_DIR") or None
+    if not d:
+        from .tracing import TRACER
+
+        d = TRACER.export_dir or None
+    if _profile_rounds_override:
+        return d, _profile_rounds_override
+    try:
+        n = int(os.environ.get("LSOT_PROFILE_ROUNDS", "8"))
+    except ValueError:
+        n = 8
+    return d, max(1, n)
+
+
+def try_acquire_capture(owner: str) -> bool:
+    """Claim the process-wide capture slot; False when someone holds it
+    (the /debug/profile 409)."""
+    global _capture_owner
+    with _capture_lock:
+        if _capture_owner is not None:
+            return False
+        _capture_owner = owner
+        return True
+
+
+def release_capture(owner: str) -> None:
+    """Release the slot (idempotent; only the owner's release counts, so
+    a late abort cannot free a successor's capture)."""
+    global _capture_owner
+    with _capture_lock:
+        if _capture_owner == owner:
+            _capture_owner = None
+
+
+def capture_owner() -> Optional[str]:
+    with _capture_lock:
+        return _capture_owner
+
+
+def find_profile_artifacts(trace_dir: str) -> List[str]:
+    """The Perfetto-loadable artifacts a jax.profiler capture wrote under
+    `trace_dir` (the same *.trace.json.gz files `Trace.load_dir` parses
+    and scripts/obs_smoke.sh asserts non-empty)."""
+    return sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True
+    ))
 
 
 @contextlib.contextmanager

@@ -11,9 +11,16 @@
 #      utils/traceprof.Trace (the same parser that reads jax.profiler
 #      device traces — Perfetto loads the same file);
 #   3. /debug/flightrecorder serves non-empty per-round records
-#      (occupancy, admitted/retired rids, round wall, cadence);
+#      (occupancy, admitted/retired rids, round wall, cadence — and the
+#      ISSUE-12 roofline ledger columns mfu/hbm_util/bound);
 #   4. /metrics?format=prometheus serves the exposition text with the
-#      TTFT/latency histogram families.
+#      TTFT/latency histogram families;
+#   5. the rolling SLO engine (/debug/slo) serves a POPULATED report
+#      (objectives + per-replica quantile sketches with observations)
+#      and the lsot_slo_* / lsot_mfu Prometheus families render;
+#   6. /debug/profile arms a bounded jax.profiler capture around the
+#      next scheduler rounds and finishes with a NON-EMPTY
+#      Perfetto-loadable artifact.
 #
 # The default test lane runs the same flow in-process
 # (tests/test_obs_smoke.py, not marked slow); this script is the focused
@@ -46,8 +53,14 @@ from llm_based_apache_spark_optimization_tpu.sql import default_backend
 from llm_based_apache_spark_optimization_tpu.utils.tracing import TRACER
 from llm_based_apache_spark_optimization_tpu.utils.traceprof import Trace
 
+from llm_based_apache_spark_optimization_tpu.utils import slo
+
 trace_dir = os.environ["LSOT_TRACE_EXPORT"]
 TRACER.reconfigure(sample=1.0, export_dir=trace_dir)
+# Generous objectives: the report must be POPULATED (sketches carrying
+# observations), not burning — CPU walls vary too much to pin a breach.
+slo.reconfigure(ttft_ms=60_000, tpot_ms=60_000, queue_wait_ms=60_000,
+                window_s=120)
 cfg = AppConfig(history_db=":memory:", port=0)
 service = make_tiny_service(8, scheduler=True)
 app = create_api_app(service, default_backend, SQLiteHistory(":memory:"),
@@ -98,12 +111,57 @@ assert rounds, f"flight recorder empty: { {k: len(v) for k, v in models.items()}
 assert {"occupancy", "round_wall_s"} <= set(rounds[-1])
 print(f"obs_smoke: flight recorder OK ({len(rounds)} round records)")
 
+# 3b. the roofline ledger columns ride the same records (ISSUE 12).
+perf_rounds = [r for r in rounds if "mfu" in r]
+assert perf_rounds, "no ledger columns on flight records"
+assert {"hbm_util", "bound", "phase"} <= set(perf_rounds[-1])
+print(f"obs_smoke: roofline ledger OK (last round "
+      f"{perf_rounds[-1]['bound']}, mfu {perf_rounds[-1]['mfu']})")
+
 # 4. Prometheus exposition with the histogram families.
 status, text = get("/metrics?format=prometheus")
 assert status == 200
 assert "# TYPE lsot_request_latency_seconds histogram" in text
 assert "lsot_ttft_seconds_bucket" in text
+# ...and the ISSUE-12 families: phase x replica roofline gauges + SLO.
+assert "lsot_mfu" in text, "lsot_mfu family missing"
+assert "lsot_hbm_util" in text
+assert "lsot_slo_burn_rate" in text, "lsot_slo_* families missing"
 print("obs_smoke: prometheus exposition OK")
+
+# 5. the rolling SLO engine served a POPULATED report.
+status, body = get("/debug/slo")
+assert status == 200
+rep = json.loads(body)
+assert rep["enabled"] and rep["objectives"], rep
+counts = [m.get("count", 0) for r in rep["replicas"]
+          for m in r["metrics"].values()]
+assert counts and sum(counts) > 0, f"SLO sketches empty: {rep}"
+assert rep["state"] in ("ok", "warning", "burning")
+print(f"obs_smoke: SLO report OK (state {rep['state']}, "
+      f"{sum(counts)} observations)")
+
+# 6. on-demand device profiling: arm around the next 2 rounds, drive
+# traffic through the capture, poll to a non-empty artifact.
+status, body = get("/debug/profile?rounds=2")
+assert status == 200, body
+armed = json.loads(body)
+assert armed["state"] == "armed", armed
+post("/api/generate", {"model": "duckdb-nsql", "prompt": "profile me"})
+last = None
+for _ in range(150):
+    status, body = get("/debug/profile")
+    caps = json.loads(body)["captures"]
+    lasts = [c.get("last") for c in caps.values() if c.get("last")]
+    if lasts and lasts[0].get("state") in ("done", "error"):
+        last = lasts[0]
+        break
+    time.sleep(0.2)
+assert last is not None, f"capture never finished: {caps}"
+assert last["state"] == "done", last
+assert last["artifacts"] and last["artifact_bytes"] > 0, last
+print(f"obs_smoke: device profile OK ({len(last['artifacts'])} "
+      f"artifact(s), {last['artifact_bytes']} bytes)")
 
 server.shutdown()
 service.close()

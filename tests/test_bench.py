@@ -186,13 +186,17 @@ def test_obs_overhead_measured_and_under_budget():
     assert out["flight_record_ns"] > 0
     assert out["span_unsampled_ns"] > 0
     assert out["tracer_begin_ns"] > 0
+    assert out["ledger_ns"] > 0
     assert out["per_round_ns"] == pytest.approx(
-        out["flight_record_ns"] + out["span_unsampled_ns"], rel=0.01)
+        out["flight_record_ns"] + out["span_unsampled_ns"]
+        + out["ledger_ns"], rel=0.01)
     # Sampling-off budget: a dict build + deque append + a contextvar
-    # read. Far under 100µs/round on any box; against the repo's
-    # SLOWEST measured healthy cadence (BENCH r03 CPU fallback rounds
-    # are ~10ms+) that is <1% — asserted against a 1ms floor here so a
-    # regression to even 1% of a FAST chip round fails loudly.
+    # read + the ISSUE-12 roofline-ledger stamp (a handful of float
+    # multiplies + an EWMA fold). Far under 100µs/round on any box;
+    # against the repo's SLOWEST measured healthy cadence (BENCH r03 CPU
+    # fallback rounds are ~10ms+) that is <1% — asserted against a 1ms
+    # floor here so a regression to even 1% of a FAST chip round fails
+    # loudly.
     assert out["per_round_ns"] < 100_000
     assert out["per_round_ns"] * 1e-9 / 0.001 < 0.01  # <1% of a 1ms round
 
@@ -458,6 +462,52 @@ def test_micro_lane_records_all_kernel_legs():
         assert ker and ker > 0
         assert out[leg]["xla_over_kernel"] > 0
     assert out["mask_gather"]["xla_ns"] > 0
+
+
+def test_compare_gate_tracks_ledger_fields():
+    """ISSUE 12 satellite: the --compare gate tracks the roofline-ledger
+    fields (decode MFU, HBM util — in _detail artifacts AND the
+    scheduler leg's perf.phases EWMAs) beside tok/s: a utilization drop
+    at flat throughput is a regression the gate must name."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+
+    old = {"value": 100.0, "decode_mfu": 0.30, "decode_hbm_util": 0.80,
+           "scheduler": {"tok_s": 50.0, "perf": {"phases": {
+               "decode": {"mfu": 0.02, "hbm_util": 0.6}}}}}
+    ok = {"value": 99.0, "decode_mfu": 0.29, "decode_hbm_util": 0.78,
+          "scheduler": {"tok_s": 50.0, "perf": {"phases": {
+              "decode": {"mfu": 0.019, "hbm_util": 0.58}}}}}
+    assert bench.compare_artifacts(old, ok) == []
+    bad = {"value": 100.0, "decode_mfu": 0.10, "decode_hbm_util": 0.80,
+           "scheduler": {"tok_s": 50.0, "perf": {"phases": {
+               "decode": {"mfu": 0.02, "hbm_util": 0.3}}}}}
+    regs = bench.compare_artifacts(old, bad)
+    assert len(regs) == 2
+    assert any(r.startswith("decode_mfu") for r in regs)
+    assert any("scheduler.perf.phases.decode.hbm_util" in r for r in regs)
+
+
+def test_bench_shares_perfmodel_analytics():
+    """ISSUE 12 tentpole reconciliation (no chip needed): bench's peak
+    table IS utils/perfmodel's, and its step-byte pricing delegates to
+    the shared model — the live ledger and the committed artifact cannot
+    disagree by construction."""
+    sys.path.insert(0, str(Path(BENCH).parent))
+    import bench
+
+    from llm_based_apache_spark_optimization_tpu.models import TINY
+    from llm_based_apache_spark_optimization_tpu.utils import perfmodel
+
+    assert bench.PEAKS is perfmodel.PEAKS
+    f, bw = bench._peak_for("TPU v5e", "")
+    assert (f, bw) == perfmodel.peak_for("TPU v5e", "")
+    # Off-chip: bench omits (None — committed artifacts stay honest),
+    # the live ledger falls back to nominal host peaks (always defined).
+    assert bench._peak_for("cpu", "") == (None, None)
+    assert perfmodel.peak_for("cpu", "") == perfmodel.cpu_fallback_peaks()
+    assert bench._step_bytes(TINY, 4, 100, 64, 10 ** 6) == \
+        perfmodel.decode_step_bytes(TINY, 4, 100 + 32, 10 ** 6)
 
 
 def test_compare_gate_flags_regressions(tmp_path):

@@ -131,6 +131,55 @@ def test_debug_flightrecorder_route_shapes():
     assert bad.status == 400
 
 
+def test_debug_slo_route_shapes():
+    """ISSUE-12 twin: /debug/slo serves the rolling engine's report —
+    objectives + per-replica sketches — and a burning replica flips
+    /readyz's payload to degraded (while staying 200: still serving)."""
+    from llm_based_apache_spark_optimization_tpu.utils import slo
+
+    old = slo.ENGINE
+    try:
+        eng = slo.reconfigure(ttft_ms=10, window_s=60)
+        svc, app = _fake_app()
+        client = app.test_client()
+        # Empty engine: enabled, no replicas yet.
+        rep = client.request("GET", "/debug/slo").json()
+        assert rep["enabled"] and "ttft" in rep["objectives"]
+        assert rep["replicas"] == [] and rep["state"] == "ok"
+        # Feed breaches on one replica: burning, and health degrades.
+        for _ in range(20):
+            eng.observe("ttft", 5.0, replica="r1")
+        rep = client.request("GET", "/debug/slo").json()
+        assert rep["burning"] == ["r1"]
+        assert rep["state"] == "burning"
+        ready = client.request("GET", "/readyz")
+        assert ready.status == 200  # degraded still serves
+        assert ready.json()["state"] == "degraded"
+        assert ready.json()["slo"]["burning"] == ["r1"]
+        # The Prometheus families render from the same snapshot.
+        text = client.request("GET", "/metrics",
+                              query="format=prometheus").text
+        assert "lsot_slo_burn_rate" in text
+        assert 'lsot_slo_burning{metric="ttft",replica="r1"} 1' in text
+    finally:
+        slo.ENGINE = old
+
+
+def test_debug_profile_route_shapes():
+    """Fakes cannot profile: arming is a clean 400, polling an empty
+    captures map — the route contract without a scheduler."""
+    svc, app = _fake_app()
+    client = app.test_client()
+    res = client.request("GET", "/debug/profile")
+    assert res.status == 200
+    assert res.json() == {"captures": {}}
+    res = client.request("GET", "/debug/profile", query="rounds=2")
+    assert res.status == 400
+    assert "profiling" in res.json()["error"]
+    bad = client.request("GET", "/debug/profile", query="rounds=x")
+    assert bad.status == 400
+
+
 def test_request_log_gating(caplog):
     """Satellite: the per-request JSON log line is gated — no json.dumps
     or handler I/O when INFO is off or LSOT_REQUEST_LOG=0."""

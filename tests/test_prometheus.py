@@ -153,6 +153,83 @@ def test_render_histograms_bucket_triplets():
     assert all(l.get("model") == "m" for l, _ in buckets)
 
 
+def test_render_perf_gauges_phase_replica():
+    """ISSUE-12 golden: serving.perf renders as lsot_mfu / lsot_hbm_util
+    / lsot_perf_compute_bound labeled model × replica × PHASE — not
+    path-flattened serving gauges — for both the single-replica and the
+    pool ({"replicas": [...]}) payload shapes."""
+    perf_r0 = {
+        "replica": "r0", "device_kind": "cpu",
+        "peak_tflops": 0.2, "peak_hbm_gbs": 50.0,
+        "phases": {
+            "decode": {"mfu": 0.01, "hbm_util": 0.6, "tflops": 0.002,
+                       "gbs": 30.0, "rounds": 7, "bound": "memory-bound"},
+            "prefill": {"mfu": 0.4, "hbm_util": 0.05, "tflops": 0.08,
+                        "gbs": 2.5, "rounds": 3,
+                        "bound": "compute-bound"},
+        },
+    }
+    snap = {"m": {"requests": 1, "serving": {"perf": perf_r0}}}
+    text = render_prometheus(snap)
+    types, samples = parse_exposition(text)
+    assert types["lsot_mfu"] == "gauge"
+    assert types["lsot_hbm_util"] == "gauge"
+    by = {(n, l.get("phase"), l.get("replica")): v for n, l, v in samples}
+    assert by[("lsot_mfu", "decode", "r0")] == 0.01
+    assert by[("lsot_mfu", "prefill", "r0")] == 0.4
+    assert by[("lsot_hbm_util", "decode", "r0")] == 0.6
+    assert by[("lsot_perf_compute_bound", "decode", "r0")] == 0.0
+    assert by[("lsot_perf_compute_bound", "prefill", "r0")] == 1.0
+    assert by[("lsot_perf_peak_tflops", None, "r0")] == 0.2
+    # Nothing perf-shaped leaked through the generic serving flattener.
+    assert not any(n.startswith("lsot_serving_perf") for n, _, _ in samples)
+    # Pool shape: per-replica ledgers under "replicas".
+    perf_r1 = {**perf_r0, "replica": "r1"}
+    snap = {"m": {"requests": 1,
+                  "serving": {"perf": {"replicas": [perf_r0, perf_r1]}}}}
+    _, samples = parse_exposition(render_prometheus(snap))
+    reps = {l["replica"] for n, l, _ in samples if n == "lsot_mfu"}
+    assert reps == {"r0", "r1"}
+
+
+def test_render_slo_families():
+    """ISSUE-12 golden: the top-level "slo" snapshot renders burn-rate /
+    bad-fraction gauges per window arm, quantile gauges, the 0/1 burning
+    flag, and the objective — per replica plus the fleet merge."""
+    metrics = {
+        "ttft": {"count": 40, "sum": 2.0, "p50": 0.05, "p90": 0.25,
+                 "p99": 0.5, "objective_s": 0.1, "bad_frac": 0.02,
+                 "bad_frac_short": 0.2, "burn_rate": 2.0,
+                 "burn_rate_short": 20.0, "burning": True,
+                 "warning": True},
+    }
+    snap = {
+        "slo": {
+            "enabled": True,
+            "objectives": {"ttft": {"threshold_s": 0.1, "target": 0.99}},
+            "window_s": 300.0,
+            "replicas": [{"replica": "r1", "metrics": metrics,
+                          "state": "burning"}],
+            "fleet": metrics,
+            "burning": ["r1"],
+            "state": "burning",
+        },
+    }
+    text = render_prometheus(snap)
+    types, samples = parse_exposition(text)
+    by = {(n, l.get("metric"), l.get("replica"), l.get("window")): v
+          for n, l, v in samples}
+    assert by[("lsot_slo_objective_seconds", "ttft", None, None)] == 0.1
+    assert by[("lsot_slo_burn_rate", "ttft", "r1", "long")] == 2.0
+    assert by[("lsot_slo_burn_rate", "ttft", "r1", "short")] == 20.0
+    assert by[("lsot_slo_bad_fraction", "ttft", "fleet", "long")] == 0.02
+    assert by[("lsot_slo_burning", "ttft", "r1", None)] == 1.0
+    assert by[("lsot_slo_p99_seconds", "ttft", "fleet", None)] == 0.5
+    assert by[("lsot_slo_observations", "ttft", "r1", None)] == 40
+    # And the reserved key never renders as a fake model.
+    assert not any(l.get("model") == "slo" for _, l, _ in samples)
+
+
 # ------------------------------------------------------- golden app scrape
 
 

@@ -1,0 +1,213 @@
+"""Rolling SLO engine (utils/slo.py): sketch quantiles within the bucket
+error bound vs exact, lossless merge, burn-rate state transitions on a
+fake clock, env/reconfigure knobs, and the MetricsRegistry feed."""
+
+import bisect
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.utils import slo
+from llm_based_apache_spark_optimization_tpu.utils.observability import (
+    LATENCY_BUCKETS_S,
+)
+from llm_based_apache_spark_optimization_tpu.utils.slo import (
+    QuantileSketch,
+    SLOEngine,
+)
+
+
+# ------------------------------------------------------------------ sketch
+
+
+def _exact_nearest_rank(vals, q):
+    s = sorted(vals)
+    rank = min(len(s), max(1, -int(-q * len(s) // 1)))
+    return s[rank - 1]
+
+
+def test_sketch_quantile_within_bucket_error_bound():
+    """The documented bound: quantile(q) returns the UPPER bound of the
+    bucket holding the exact nearest-rank value — so for every q, the
+    exact value is <= the answer, and the answer is the tightest bound
+    the bucketing can give (the bucket containing the exact value)."""
+    import random
+
+    rng = random.Random(7)
+    vals = [rng.uniform(0.0005, 40.0) for _ in range(500)]
+    sk = QuantileSketch()
+    for v in vals:
+        sk.observe(v)
+    bounds = sk.bounds
+    for q in (0.5, 0.9, 0.95, 0.99):
+        exact = _exact_nearest_rank(vals, q)
+        got = sk.quantile(q)
+        assert exact <= got or got == bounds[-1]
+        # Tightest containing bound: the first bucket bound >= exact.
+        i = bisect.bisect_left(bounds, exact)
+        expect = bounds[i] if i < len(bounds) else bounds[-1]
+        assert got == expect, (q, exact, got, expect)
+
+
+def test_sketch_quantile_edges():
+    sk = QuantileSketch(bounds=(0.1, 1.0, 10.0))
+    assert sk.quantile(0.5) == 0.0  # empty
+    sk.observe(0.05)
+    assert sk.quantile(0.5) == 0.1
+    sk2 = QuantileSketch(bounds=(0.1, 1.0, 10.0))
+    sk2.observe(99.0)  # past the last bound: saturates, documented
+    assert sk2.quantile(0.99) == 10.0
+
+
+def test_sketch_merge_is_lossless():
+    a, b, both = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for i, v in enumerate((0.001, 0.02, 0.3, 4.0, 55.0)):
+        (a if i % 2 else b).observe(v)
+        both.observe(v)
+    a.merge(b)
+    assert a.counts == both.counts
+    assert a.count == both.count and a.sum == pytest.approx(both.sum)
+    with pytest.raises(ValueError):
+        a.merge(QuantileSketch(bounds=(1.0,)))
+
+
+def test_sketch_frac_over_exact_at_bounds():
+    sk = QuantileSketch(bounds=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 1.0, 5.0, 50.0):
+        sk.observe(v)
+    # Strictly over 1.0: {5.0, 50.0} (1.0 itself counts <= the bound).
+    assert sk.frac_over(1.0) == pytest.approx(2 / 6)
+    assert sk.frac_over(10.0) == pytest.approx(1 / 6)
+    assert sk.frac_over(0.1) == pytest.approx(4 / 6)
+
+
+# ------------------------------------------------------------------ engine
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _engine(clock, **kw):
+    kw.setdefault("ttft_ms", 100.0)
+    kw.setdefault("window_s", 120.0)
+    kw.setdefault("target", 0.99)
+    return SLOEngine(time_fn=clock, **kw)
+
+
+def test_objective_snaps_to_bucket_bound():
+    eng = _engine(_Clock())
+    thr = eng.objectives["ttft"]
+    assert thr in LATENCY_BUCKETS_S and thr >= 0.1
+
+
+def test_burn_rate_state_transitions():
+    """ok → burning (both arms over 1) → warning (short arm recovers
+    while the long window still holds the incident) → ok (the window
+    rotates the incident out) — the multi-window alerting contract."""
+    clock = _Clock()
+    eng = _engine(clock)
+    # Healthy traffic: all under the objective.
+    for _ in range(50):
+        eng.observe("ttft", 0.01)
+    assert eng.report()["state"] == "ok"
+    assert eng.burning() == []
+    # Breach storm: both arms burn.
+    for _ in range(50):
+        eng.observe("ttft", 5.0)
+    rep = eng.report()
+    assert rep["state"] == "burning"
+    assert rep["burning"] == ["r0"]
+    m = rep["replicas"][0]["metrics"]["ttft"]
+    assert m["burn_rate"] > 1.0 and m["burn_rate_short"] > 1.0
+    # Short arm recovers (advance past the 10 s short window, feed good
+    # traffic), long window still holds the incident → warning.
+    clock.t += 15.0
+    for _ in range(50):
+        eng.observe("ttft", 0.01)
+    rep = eng.report()
+    assert rep["state"] == "warning"
+    assert rep["burning"] == []
+    # The whole window rotates the incident out → ok.
+    clock.t += 130.0
+    for _ in range(10):
+        eng.observe("ttft", 0.01)
+    assert eng.report()["state"] == "ok"
+
+
+def test_per_replica_attribution_and_fleet_merge():
+    clock = _Clock()
+    eng = _engine(clock)
+    for _ in range(20):
+        eng.observe("ttft", 0.01, replica="r0")
+        eng.observe("ttft", 5.0, replica="r1")
+    rep = eng.report()
+    assert eng.replica_burning("r1") and not eng.replica_burning("r0")
+    assert rep["burning"] == ["r1"]
+    # Fleet view merges the sketches (half the observations breach).
+    fleet = rep["fleet"]["ttft"]
+    assert fleet["count"] == 40
+    assert fleet["bad_frac"] == pytest.approx(0.5)
+
+
+def test_disabled_metrics_still_sketch_quantiles():
+    """No objective for a metric → no burn rate, but the sketch records
+    so /debug/slo shows quantiles before alerting is configured."""
+    clock = _Clock()
+    eng = _engine(clock)  # only ttft objective
+    for _ in range(10):
+        eng.observe("queue_wait", 0.02)
+    m = eng.replica_report("r0")["metrics"]["queue_wait"]
+    assert m["count"] == 10 and "burn_rate" not in m
+    assert m["p50"] > 0
+
+
+def test_engine_env_and_reconfigure(monkeypatch):
+    monkeypatch.setenv("LSOT_SLO_TTFT_MS", "250")
+    monkeypatch.setenv("LSOT_SLO_WINDOW_S", "60")
+    eng = slo._engine_from_env()
+    assert eng.enabled and eng.objectives["ttft"] == 0.25
+    assert eng.window_s == 60.0
+    old = slo.ENGINE
+    try:
+        eng2 = slo.reconfigure(tpot_ms=50, window_s=30)
+        assert slo.ENGINE is eng2
+        assert eng2.objectives == {"tpot": 0.05}
+        assert not slo.reconfigure().enabled  # all-zero = disabled
+    finally:
+        slo.ENGINE = old
+
+
+def test_metrics_registry_feeds_engine():
+    """The wiring: MetricsRegistry.record forwards TTFT/TPOT/queue-wait
+    into the process engine with the request's replica label — and pays
+    nothing when no objective is configured."""
+    from llm_based_apache_spark_optimization_tpu.utils.observability import (
+        MetricsRegistry,
+        RequestMetrics,
+    )
+
+    old = slo.ENGINE
+    try:
+        eng = slo.reconfigure(ttft_ms=100, tpot_ms=100,
+                              queue_wait_ms=100, window_s=60)
+        reg = MetricsRegistry(request_log_sample=0.0)
+        reg.record(RequestMetrics(
+            model="m", prompt_tokens=4, output_tokens=8, latency_s=0.5,
+            ttft_s=0.2, queue_wait_s=0.05, replica="r2",
+        ))
+        rep = eng.replica_report("r2")["metrics"]
+        assert rep["ttft"]["count"] == 1
+        assert rep["tpot"]["count"] == 1
+        assert rep["queue_wait"]["count"] == 1
+        # 1-token completions have no TPOT (same rule as the histogram).
+        reg.record(RequestMetrics(
+            model="m", prompt_tokens=4, output_tokens=1, latency_s=0.5,
+            ttft_s=0.2, replica="r3",
+        ))
+        assert "tpot" not in eng.replica_report("r3")["metrics"]
+    finally:
+        slo.ENGINE = old
