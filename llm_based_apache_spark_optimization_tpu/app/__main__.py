@@ -264,6 +264,9 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
         sys.exit("pick one of --int8 / --int4")
 
     app_cfg = AppConfig.from_env()
+    if app_cfg.pool_phases and not (args.scheduler and args.dp > 1):
+        sys.exit("LSOT_POOL_PHASES needs --scheduler with --dp > 1 "
+                 "(phase roles are per pool replica)")
 
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
@@ -315,7 +318,25 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
             from ..serve.scheduler import (
                 ContinuousBatchingScheduler,
                 SchedulerPool,
+                parse_pool_phases,
             )
+
+            # Disaggregated prefill/decode fleet (LSOT_POOL_PHASES, e.g.
+            # "prefill:1,decode:3"): per-replica phase roles. Validated
+            # up front so a typo'd spec dies with a clean message, not a
+            # traceback mid-pool-build; roles require the paged layout
+            # (the handoff ships KV pool pages).
+            try:
+                phase_roles = parse_pool_phases(
+                    app_cfg.pool_phases, len(scheduler_meshes)
+                )
+            except ValueError as e:
+                sys.exit(f"LSOT_POOL_PHASES: {e}")
+            if any(r != "mixed" for r in phase_roles) \
+                    and getattr(args, "kv_layout", "contiguous") != "paged":
+                sys.exit("LSOT_POOL_PHASES with prefill/decode roles "
+                         "needs --kv-layout=paged (the prefill→decode "
+                         "handoff ships KV pool pages)")
 
             if path.endswith(".gguf"):
                 cfg, params = load_gguf_checkpoint(path, mesh=None)
@@ -346,6 +367,7 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                     kv_watermark_high=app_cfg.kv_watermark_high,
                     speculative_draft=getattr(args, "speculative", 0),
                     max_queue_depth=app_cfg.max_queue_depth,
+                    phase_role=phase_roles[i],
                 )
 
             def make_pool():

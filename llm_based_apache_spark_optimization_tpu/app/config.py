@@ -110,6 +110,15 @@ class AppConfig:
     # restarting/draining replicas); "round_robin" keeps the pre-fleet
     # blind rotation.
     pool_router: str = "least_loaded"
+    # Disaggregated prefill/decode serving (README "Disaggregated
+    # serving"): per-replica phase roles for a dp>1 scheduler pool, e.g.
+    # "prefill:1,decode:3" — prefill replicas run chunked prefill, pack
+    # the KV pages into a handoff blob and retire into a handoff queue;
+    # the phase-aware router places the migrated request on a decode
+    # replica (falling back to decoding in place when none can take it).
+    # Counts must sum to --dp; requires --kv-layout=paged. "" = every
+    # replica "mixed" (today's behavior bit for bit).
+    pool_phases: str = ""
     # --- liveness / hang detection (serve/watchdog.py; README "Liveness &
     # hangs"). The supervisor's watchdog escalates a BUSY decode loop
     # whose heartbeat age exceeds

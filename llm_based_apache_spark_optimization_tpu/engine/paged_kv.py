@@ -44,15 +44,25 @@ prefill windowed scatter, the fused page-write kernel) and dequantization
 on the way OUT (inside the ragged read kernel's DMA'd tiles, or the
 int8-streaming einsum reference); `page_bytes`/`pages_for_budget` price
 the KV dtype so every capacity surface reports true bytes.
+
+`export_pages`/`import_pages` (ISSUE 13) make KV page migration a
+first-class op: a request's live pages (values + int8 scales — the full
+cache tuple, generalized from the LSOT_KV_SPILL host-copy path) extract
+into a portable host blob and install into ANOTHER pool's freshly
+allocated pages — the page-table + page-transfer handoff that
+disaggregated prefill/decode serving rides (serve/scheduler.py
+`phase_role`).
 """
 
 from __future__ import annotations
 
 import os
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..models.configs import LlamaConfig
 
@@ -216,6 +226,48 @@ def pack_prefill_pages(
     if kv_quant is not None:
         raise ValueError(f"kv_quant must be None or 'int8', got {kv_quant!r}")
     return {"kp": pack(cache["k"]), "vp": pack(cache["v"]), "ptab": ptab}
+
+
+def export_pages(
+    cache: Sequence[jnp.ndarray], page_ids: Sequence[int],
+) -> Tuple[np.ndarray, ...]:
+    """Extract live pool pages into a PORTABLE host-side handoff blob:
+    one `[L, n, K, page_size(, H)]` numpy array per cache array, in the
+    pool tuple's own order — `(kp, vp)` for a compute-dtype pool,
+    `(kp, kps, vp, vps)` for the int8 pool, so the quantization scales
+    always serialize beside their values and a restore reproduces the
+    page content `(q8, s)` exactly. This is the LSOT_KV_SPILL host-copy
+    format promoted to a first-class op: the same blob serves victim
+    spill-resume on one replica AND prefill→decode page migration across
+    replicas (disaggregated serving — ISSUE 13). The arrays are COPIES
+    (one `device_get`): a page the source shared copy-on-write with its
+    prefix cache exports as content, never as a reference, so the blob
+    stays valid after the source releases, evicts or overwrites every
+    page it covered."""
+    idx = np.asarray(list(page_ids), np.int32)
+    return jax.device_get(tuple(c[:, idx] for c in cache))
+
+
+def import_pages(
+    cache: Sequence[jnp.ndarray], page_ids, stacks: Sequence,
+) -> Tuple[jnp.ndarray, ...]:
+    """Install an `export_pages` blob into (freshly allocated, exclusive)
+    pool pages: one scatter per cache array, pure jnp — callers jit it
+    (the scheduler's `restore_pages` op wraps exactly this with buffer
+    donation). The receiving side owns the allocation policy: the
+    scheduler grants the blob's pages all-or-nothing through the same
+    `_page_wait`/overcommit admission every fresh request rides, so
+    migration changes no pressure semantics."""
+    idx = jnp.asarray(page_ids, jnp.int32)
+    return tuple(
+        c.at[:, idx].set(jnp.asarray(s)) for c, s in zip(cache, stacks)
+    )
+
+
+def handoff_bytes(stacks: Sequence[np.ndarray]) -> int:
+    """Host bytes of one export_pages blob (the handoff observability
+    figure: what actually crossed — or would cross — the wire)."""
+    return int(sum(int(np.asarray(s).nbytes) for s in stacks))
 
 
 class PageAllocator:

@@ -71,7 +71,8 @@ def main(argv=None) -> None:
 
     if args.chaos is not None:
         # Mostly host-only (fake daemon + SQLite + toy schedulers); the
-        # kv-pressure stage alone builds a tiny jax scheduler on CPU.
+        # kv-pressure and disagg stages alone build tiny jax schedulers
+        # on CPU.
         from .chaos import run_chaos
 
         print(json.dumps(

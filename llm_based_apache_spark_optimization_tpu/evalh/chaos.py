@@ -716,6 +716,169 @@ def _run_pressure_stage(seed: int, withhold_pages: int = 6) -> Dict:
     return report
 
 
+#: Per-seed cached crash-free controls for the disagg stage (pytest
+#: drives run_chaos repeatedly; the control scheduler build is paid once).
+_DISAGG_CONTROLS: Dict[int, list] = {}
+
+
+def _run_disagg_stage(seed: int) -> Dict:
+    """Disaggregated-serving chaos (ISSUE 13): a supervised PHASE-SPLIT
+    fleet — one prefill + one decode replica, real tiny paged schedulers
+    on CPU — serves greedy, sampled and constrained traffic in two
+    waves. Wave 1 runs clean and must migrate every request through the
+    export→requeue→import handoff (≥1 export asserted: an in-place
+    fallback pass proves nothing). Wave 2 runs under `sched:handoff:1`,
+    which kills the prefill replica MID-HANDOFF — first token committed
+    and streamed, blob never shipped; the pool must restart ONLY the
+    prefill replica (decode sibling's restart counter stays zero) while
+    the supervisor re-places its journaled requests onto the decode
+    sibling — the re-prefill-on-a-sibling path — with delivered
+    prefixes suppressed. Both waves must come out TOKEN-IDENTICAL to a
+    single mixed-replica control, zero lost. Own injection scope, like
+    stages 3-5."""
+    import random as _random
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..constrain import get_constraint
+    from ..models import TINY, init_params
+    from ..ops.sampling import SamplingParams
+    from ..serve.resilience import RetryPolicy
+    from ..serve.scheduler import ContinuousBatchingScheduler, SchedulerPool
+    from ..serve.supervisor import SupervisedScheduler
+    from ..tokenizer import ByteTokenizer
+    from ..utils.faults import FAULTS
+
+    params = init_params(TINY, jax.random.key(seed), dtype=jnp.float32)
+    tok = ByteTokenizer()
+    cm = get_constraint("spark_sql", tok, (2,))
+    budget = max(16, cm.min_new_tokens)
+    reqs = [
+        ([1, 5, 9], SamplingParams(), None, 8),
+        ([1, 7, 11], SamplingParams(temperature=0.8, top_p=0.95), None, 8),
+        (tok.encode("SELECT", add_bos=True), SamplingParams(), cm, budget),
+        ([1, 3, 4, 8], SamplingParams(), None, 8),
+    ]
+
+    def make_replica(role="mixed"):
+        return ContinuousBatchingScheduler(
+            TINY, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+            stop_ids=(2,), max_seq=96, kv_layout="paged", kv_page_size=8,
+            phase_role=role,
+        )
+
+    control = _DISAGG_CONTROLS.get(seed)
+    if control is None:
+        with make_replica() as ctl:
+            futs = [
+                ctl.submit(ids, max_new_tokens=mn, sampling=sp,
+                           seed=700 + i, constraint=c)
+                for i, (ids, sp, c, mn) in enumerate(reqs)
+            ]
+            control = [f.result(timeout=300) for f in futs]
+        _DISAGG_CONTROLS[seed] = control
+
+    roles = ["prefill", "decode"]
+    rebuilt = []
+
+    def rebuild(i):
+        if i == 0:
+            # Exactly ONE crash episode: the rebuilt prefill replica
+            # runs clean, making the schedule deterministic.
+            FAULTS.clear()
+        rebuilt.append(i)
+        return make_replica(roles[i])
+
+    def make_pool():
+        return SchedulerPool(
+            [make_replica(r) for r in roles], factory=rebuild,
+            max_restarts=3,
+            restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                       max_delay_s=0.01),
+            rng=_random.Random(seed),
+        )
+
+    sup = SupervisedScheduler(
+        make_pool, max_restarts=3,
+        restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=_random.Random(seed),
+    ).start()
+
+    def wave():
+        futs = [
+            sup.submit(ids, max_new_tokens=mn, sampling=sp, seed=700 + i,
+                       constraint=c)
+            for i, (ids, sp, c, mn) in enumerate(reqs)
+        ]
+        outs = []
+        for f in futs:
+            try:
+                outs.append(f.result(timeout=300))
+            except Exception:  # noqa: BLE001 — lost, counted below
+                outs.append(None)
+        return outs
+
+    try:
+        outs_clean = wave()  # wave 1: clean disaggregated serving
+        pool = sup._inner
+        exports = sum(
+            int(r.get("exports", 0))
+            for r in (pool.handoff_stats or {}).get("replicas", [])
+        )
+        FAULTS.configure("sched:handoff:1", seed)
+        outs_crash = wave()  # wave 2: prefill replica dies mid-handoff
+        # FAULTS.counts() is wiped by the rebuild factory's clear(): the
+        # crash evidence is the pool's own lifecycle ring instead.
+        crashes = sum(
+            1 for r in pool.flight_snapshot()
+            if r.get("kind") == "replica_crash" and r.get("replica") == "r0"
+        )
+        loads = {r["replica"]: r for r in pool.replica_loads()}
+    finally:
+        FAULTS.clear()
+        sup.shutdown()
+
+    lost = sum(1 for o in outs_clean + outs_crash if o is None)
+    mismatched = sum(
+        1 for o, c in zip(outs_clean, control) if o is not None and o != c
+    ) + sum(
+        1 for o, c in zip(outs_crash, control) if o is not None and o != c
+    )
+    report = {
+        "requests": 2 * len(reqs),
+        "request_classes": ["greedy", "sampled", "constrained", "greedy"],
+        "handoffs": exports,
+        "crashes_injected": crashes,
+        "prefill_restarts": loads.get("r0", {}).get("restarts", 0),
+        "decode_restarts": loads.get("r1", {}).get("restarts", 0),
+        "lost": lost,
+        "mismatched": mismatched,
+    }
+    assert exports >= 1, (
+        "the phase-split fleet exported no handoff — every request fell "
+        "back to decoding in place, the stage proved nothing"
+    )
+    assert report["crashes_injected"] >= 1, (
+        "sched:handoff never fired — the crash-mid-handoff path was not "
+        "exercised"
+    )
+    assert lost == 0, (
+        f"{lost} request(s) never completed across the prefill-replica "
+        f"crash — the handoff state lost acknowledged work"
+    )
+    assert mismatched == 0, (
+        f"{mismatched} request(s) diverged from the mixed-replica "
+        f"control — the phase-split path is not token-identical"
+    )
+    assert report["decode_restarts"] == 0, (
+        "the decode replica restarted during a prefill-replica crash — "
+        "the recovery was not targeted"
+    )
+    return report
+
+
 def run_chaos(
     spec: Optional[str] = None,
     seed: int = 0,
@@ -864,12 +1027,21 @@ def run_chaos(
     # injection scope, outside the snapshot pair, like stages 3-4. This
     # stage (alone) builds a tiny jax scheduler on CPU.
     pressure_report = _run_pressure_stage(seed)
+    # Stage 6 — disaggregated serving: a supervised phase-split fleet
+    # (prefill + decode replicas, real tiny paged schedulers) must
+    # migrate every request through the KV handoff token-identical to a
+    # mixed-replica control, and survive a `sched:handoff` crash that
+    # kills the prefill replica mid-handoff — targeted restart, journal
+    # re-placement onto the decode sibling, zero lost. Own injection
+    # scope, outside the snapshot pair, like stages 3-5.
+    disagg_report = _run_disagg_stage(seed)
     requests = rounds * len(FOUR_QUERY_SUITE)
     hung = requests - sum(outcomes.values())
     hung += scheduler_report["unresolved"]
     hung += watchdog_report["unresolved"]
     hung += fleet_report["unresolved"]
     hung += pressure_report["lost"]
+    hung += disagg_report["lost"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
     # Wall-clock figures are non-deterministic by nature: lifted OUT of
     # the scheduler stage's report so the seeded-replay determinism
@@ -885,6 +1057,7 @@ def run_chaos(
         "watchdog": watchdog_report,
         "fleet": fleet_report,
         "kv_pressure": pressure_report,
+        "disagg": disagg_report,
         "latency": latency,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)

@@ -15,7 +15,13 @@ deaths left exactly that hole. The flight recorder is the black box:
   `prefill_mfu`/`prefill_hbm_util` on rounds that flushed prefill
   chunks) — computed by utils/perfmodel.py from the SAME rounded
   `round_wall_s` that lands in the record, so a reader can recompute
-  every utilization figure from the record alone.
+  every utilization figure from the record alone. Disaggregated
+  serving (ISSUE 13) adds `pages_migrated`/`handoff_wait_s` columns on
+  decode-side rounds that imported a prefill→decode handoff, a
+  `handoffs` column on prefill-role pack records, and the
+  `handoff_export`/`handoff_import`/`handoff_inplace`/`handoff_place`
+  lifecycle events — so a migrated request's timeline explains the gap
+  between prefill and its first decode token.
 - `event(kind, **fields)` — sparse lifecycle markers (crash, stall
   escalation, restart, drain, grammar swap) ride the same ring with
   `"kind"` set, so the postmortem shows rounds and lifecycle interleaved

@@ -136,6 +136,9 @@ from ..engine.kvcache import bucket_len, init_cache
 from ..engine.paged_kv import (
     PageAllocator,
     default_page_size,
+    export_pages,
+    handoff_bytes,
+    import_pages,
     init_page_pool,
     page_bytes,
     pages_for_budget,
@@ -166,6 +169,59 @@ from .resilience import (
 from .watchdog import CombinedHeartbeat, Heartbeat
 
 _log = logging.getLogger("lsot.scheduler")
+
+#: Scheduler phase roles (ISSUE 13 — disaggregated prefill/decode
+#: serving). "mixed" (the default) is today's behavior bit for bit; a
+#: "prefill" replica runs chunked prefill to completion, packs the
+#: request's KV pages into a portable handoff blob and retires it into a
+#: handoff queue instead of entering its decode loop; a "decode" replica
+#: is a routing preference — full mixed capability, but the pool's
+#: phase-aware router sends it migrated requests and keeps fresh prompts
+#: off it.
+PHASE_ROLES = ("mixed", "prefill", "decode")
+
+
+def parse_pool_phases(spec: str, replicas: int) -> List[str]:
+    """Parse LSOT_POOL_PHASES ("prefill:1,decode:3") into a per-replica
+    role list of length `replicas`. Empty/None spec means an all-"mixed"
+    fleet (the pre-disaggregation behavior). Counts must sum to the
+    replica count, and a fleet with any "prefill" replica must also have
+    somewhere for its handoffs to decode ("decode" or "mixed") — a
+    prefill-only fleet would silently fall back to decoding in place on
+    every request, which is a misconfiguration, not a deployment."""
+    if not spec:
+        return ["mixed"] * replicas
+    roles: List[str] = []
+    for entry in filter(None, (s.strip() for s in spec.split(","))):
+        parts = entry.split(":")
+        if len(parts) != 2:
+            raise ValueError(
+                f"bad pool-phases entry {entry!r} (want role:count)"
+            )
+        role, n = parts[0].strip(), parts[1].strip()
+        if role not in PHASE_ROLES:
+            raise ValueError(
+                f"bad phase role {role!r} (want one of {PHASE_ROLES})"
+            )
+        try:
+            count = int(n)
+        except ValueError:
+            raise ValueError(f"bad replica count in {entry!r}") from None
+        if count < 1:
+            raise ValueError(f"replica count must be >= 1 in {entry!r}")
+        roles.extend([role] * count)
+    if len(roles) != replicas:
+        raise ValueError(
+            f"pool phases {spec!r} describe {len(roles)} replica(s) but "
+            f"the pool has {replicas}"
+        )
+    if "prefill" in roles and not any(
+            r in ("decode", "mixed") for r in roles):
+        raise ValueError(
+            f"pool phases {spec!r} have prefill replicas but no decode/"
+            f"mixed replica to hand off to"
+        )
+    return roles
 
 
 def _first_token_timer(then: Optional[Callable[[int], None]] = None):
@@ -309,6 +365,15 @@ class _Request:
     # (the quantization scales serialize beside the pages, so restore is
     # content-exact).
     spilled: Optional[Tuple[np.ndarray, ...]] = None
+    # Prefill→decode handoff metadata (ISSUE 13): set when a prefill-role
+    # replica packed this request's KV into `spilled` for migration —
+    # {"t_pack", "export_s", "pages", "bytes", "src"} — and cleared by
+    # the importing replica's resume, which turns it into the
+    # `sched.handoff` trace span + the pages_migrated/handoff_wait_s
+    # flight columns. None everywhere outside a live handoff, so the
+    # spill-resume paths can tell a migrated blob from a preemption spill
+    # (different counters, same restore machinery).
+    handoff: Optional[Dict] = None
 
     @property
     def full_ids(self) -> List[int]:
@@ -407,9 +472,61 @@ class ContinuousBatchingScheduler:
         kv_spill: Optional[bool] = None,
         kv_watermark_low: Optional[float] = None,
         kv_watermark_high: Optional[float] = None,
+        phase_role: str = "mixed",
     ):
         self.cfg = cfg
         self.mesh = mesh
+        # Disaggregated prefill/decode serving (ISSUE 13): "mixed" (the
+        # default) is today's behavior bit for bit. A "prefill" replica
+        # never enters its decode loop for fresh requests: the final
+        # prompt chunk's sampled first token is committed and streamed,
+        # the request's KV pages export into a portable handoff blob
+        # (engine/paged_kv.export_pages — the spill format), and the
+        # request parks in `_handoff` for the pool's phase-aware router
+        # to re-place onto a decode replica (`on_handoff` wakes it; no
+        # consumer wired → the replica arms the slot and decodes in
+        # place, so a lone prefill-role scheduler still serves). A
+        # "decode" replica is routing policy only — full capability, but
+        # the router feeds it migrated requests and keeps fresh prompts
+        # off it. Handoff needs pages to ship, hence paged-only.
+        if phase_role not in PHASE_ROLES:
+            raise ValueError(
+                f"phase_role must be one of {PHASE_ROLES}, got "
+                f"{phase_role!r}"
+            )
+        if phase_role != "mixed" and kv_layout != "paged":
+            raise ValueError(
+                f"phase_role={phase_role!r} needs kv_layout='paged': the "
+                f"prefill→decode handoff ships KV pool pages"
+            )
+        self.phase_role = phase_role
+        # Handoff state. `_handoff_pending` holds (slot, req, tok, epoch)
+        # for final chunks whose first token is still on device;
+        # `_handoff` is the packed-blob queue the pool drains. Counters
+        # feed handoff_stats / the lsot_handoff_* Prometheus families.
+        self._handoff: "deque[_Request]" = deque()
+        self._handoff_pending: list = []
+        self.on_handoff: Optional[Callable[[], None]] = None
+        self._ho_exports = 0
+        self._ho_imports = 0
+        self._ho_inplace = 0
+        self._ho_pages_out = 0
+        self._ho_pages_in = 0
+        self._ho_bytes_out = 0
+        self._ho_bytes_in = 0
+        self._ho_wait_sum = 0.0
+        self._ho_wait_count = 0
+        # Per-round migration accumulators (flushed into the flight
+        # record's pages_migrated/handoff_wait_s columns at harvest).
+        self._mig_pages = 0
+        self._mig_wait = 0.0
+        # Prefill-side backlog signal: outstanding PROMPT tokens and a
+        # per-prompt-token service EWMA (submit→handoff wall), so a
+        # prefill replica's backlog_score prices compute backlog instead
+        # of decode budgets it will never spend.
+        self._pending_prompt_tokens = 0
+        self._pref_stok_ewma: Optional[float] = None
+        self._last_pack_t: Optional[float] = None
         # Per-slot stall retirement: a slot that appends nothing for this
         # many consecutive harvest rounds WHILE other slots advance is
         # retired typed (SlotStalled/504) — a wedged lane must not pin a
@@ -1088,15 +1205,15 @@ class ContinuousBatchingScheduler:
 
         @partial(jax.jit, donate_argnums=tuple(range(nc)))
         def restore_pages(*args):
-            # Spill-resume (LSOT_KV_SPILL): write the host page copies
-            # [L, n, K, page(, H)] back into freshly allocated pool pages
-            # in ONE scatter per array (one dispatch + one transfer per
-            # resume, not per page; retraces per distinct page count,
-            # bounded by pages_per_slot).
+            # Spill-resume (LSOT_KV_SPILL) and handoff import (ISSUE 13):
+            # write the host page copies [L, n, K, page(, H)] back into
+            # freshly allocated pool pages in ONE scatter per array (one
+            # dispatch + one transfer per resume, not per page; retraces
+            # per distinct page count, bounded by pages_per_slot). The
+            # scatter itself is engine/paged_kv.import_pages — the
+            # first-class migration op — wrapped here with donation.
             cache, idx, stacks = args[:nc], args[nc], args[nc + 1:]
-            return tuple(
-                c.at[:, idx].set(s) for c, s in zip(cache, stacks)
-            )
+            return import_pages(cache, idx, stacks)
 
         return set_row, copy_page, restore_pages
 
@@ -1282,7 +1399,6 @@ class ContinuousBatchingScheduler:
             plen = len(req.ids) + len(req.generated)
             npg = min(pages_for_tokens(plen, self._page_size),
                       len(self._slot_pages[slot]))
-            idx = np.asarray(self._slot_pages[slot][:npg], np.int32)
             # Syncs in-flight rounds; their uncommitted writes beyond the
             # committed positions ride along as garbage the resumed
             # decode overwrites before any read can see it (the same
@@ -1290,9 +1406,10 @@ class ContinuousBatchingScheduler:
             # on). EVERY cache array spills — under an int8 pool the
             # quantization scales serialize beside the int8 pages, so a
             # restore reproduces the page content (q8, s) exactly and the
-            # resumed output stays token-identical.
-            req.spilled = jax.device_get(
-                tuple(c[:, idx] for c in self._cache)
+            # resumed output stays token-identical. export_pages is the
+            # same first-class op the prefill→decode handoff ships.
+            req.spilled = export_pages(
+                self._cache, self._slot_pages[slot][:npg]
             )
             self._page_alloc.note_spill(int(npg))
         req.resume_pref = len(req.generated)
@@ -1429,6 +1546,34 @@ class ContinuousBatchingScheduler:
             # Close the parked interval: the trace span now bounds
             # exactly preempt → re-armed.
             req.parked[-1][1] = req.ready_at
+        ho = req.handoff
+        if ho is not None:
+            # Prefill→decode migration landed (ISSUE 13): close the
+            # handoff interval — pack wall, page/byte volume, and the
+            # wait for a decode slot — into the request trace (the
+            # `sched.handoff` span that explains the Perfetto gap
+            # between prefill and first decode token), the per-round
+            # flight columns, and the lsot_handoff_* counters.
+            wait = max(0.0, req.ready_at - float(ho["t_pack"]))
+            self._ho_wait_sum += wait
+            self._ho_wait_count += 1
+            self._mig_pages += int(ho["pages"])
+            self._mig_wait += wait
+            if req.trace is not None:
+                try:
+                    req.trace.add_span(
+                        "sched.handoff", float(ho["t_pack"]),
+                        req.ready_at, rid=req.rid,
+                        pages=int(ho["pages"]), bytes=int(ho["bytes"]),
+                        export_s=float(ho["export_s"]),
+                        wait_s=round(wait, 6), src=ho.get("src", ""),
+                    )
+                except Exception:  # noqa: BLE001 — tracing must never kill the loop
+                    req.trace = None
+            self.flight.event("handoff_import", slot=slot, rid=req.rid,
+                              pages=int(ho["pages"]),
+                              wait_s=round(wait, 6), src=ho.get("src", ""))
+            req.handoff = None
         # Decode re-writes [plen - 1, page_end): COW any page the
         # re-prefill's publish shared before the slot goes
         # decode-eligible (spill resumes never published — no-op there).
@@ -1447,10 +1592,211 @@ class ContinuousBatchingScheduler:
         self._cache = self._restore_page_fn(
             *self._cache, idx, *(jnp.asarray(p) for p in parts),
         )
-        self._page_alloc.note_restore(int(n))
+        if req.handoff is None:
+            self._page_alloc.note_restore(int(n))
+        else:
+            # A MIGRATED blob, not a preemption spill: counted in the
+            # handoff families so the spill path's spilled == restored
+            # reconciliation stays exact per pool.
+            self._ho_imports += 1
+            self._ho_pages_in += int(n)
+            self._ho_bytes_in += handoff_bytes(parts)
+        mode = "import" if req.handoff is not None else "spill"
         req.spilled = None
         req.prefilled = len(req.full_ids)
-        self._resume_ready(slot, req, mode="spill")
+        self._resume_ready(slot, req, mode=mode)
+
+    # ----------------------------- prefill→decode handoff (ISSUE 13)
+
+    def _pack_handoffs(self) -> None:
+        """Prefill-role terminal step: sync the parked first tokens of
+        every just-completed prompt (one device_get for the whole
+        group), run the same stop/budget/cancel/deadline checks a
+        harvest would, commit + stream the first token, and either
+        export the request's pages into a handoff blob for the pool's
+        router (`on_handoff` wired) or arm the slot to decode in place
+        (no consumer — a lone prefill-role scheduler still serves)."""
+        if not self._handoff_pending:
+            return
+        pending, self._handoff_pending = self._handoff_pending, []
+        vals = jax.device_get([t for (_, _, t, _) in pending])
+        emitted = 0
+        packed = 0
+        for (slot, req, _, epoch), fv in zip(pending, vals):
+            # _append_first IS the first-token commit sequence (identity/
+            # epoch guard, cancel, deadline, stop-id, append+emit, budget
+            # retire) — sharing it keeps the prefill-role path bit-
+            # identical to the mixed harvest's, which the token-identity
+            # contract depends on. Return 1 with the slot still held
+            # means "committed and mid-generation": the handoff case.
+            emitted += self._append_first(slot, req,
+                                          int(np.asarray(fv)[0]),
+                                          epoch=epoch)
+            if req is not self._slot_req[slot]:
+                continue  # terminal (retired/failed/budget-exhausted)
+            # Chaos seam: `sched:handoff` kills the prefill loop exactly
+            # here — first token committed and possibly already streamed,
+            # blob never shipped. The supervisor must re-prefill on a
+            # sibling with the delivered prefix suppressed (the
+            # crash-mid-handoff chaos tests).
+            FAULTS.check("sched:handoff")
+            if self.on_handoff is None:
+                self._arm_inplace(slot, req)
+                continue
+            self._export_handoff(slot, req)
+            packed += 1
+        if packed:
+            cb = self.on_handoff
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — a broken pump must not strand work
+                _log.exception("on_handoff pump failed; decoding in place")
+                # Reclaim whatever the pump left behind: requeue to
+                # ourselves — re-admission restores the blob and decodes
+                # here (the fall-back-to-in-place rule, applied late).
+                for req in self.extract_handoffs():
+                    self.requeue(req)
+        self._record_prefill_round(emitted, packed)
+
+    def _export_handoff(self, slot: int, req: _Request) -> None:
+        """Pack one request's live KV into a portable blob and park it in
+        the handoff queue: pages covering the committed positions
+        (prompt + the first token — whose KV the importer's first decode
+        round writes, exactly like a preemption resume rewrites its last
+        committed token) extract via export_pages, and the request's
+        resume state (`resume_pref`, `rng_count`) is staged so the
+        importing replica's spill-restore machinery arms a slot
+        device-state-identical to a mixed replica's post-prefill arm —
+        the token-identity contract."""
+        t0 = time.perf_counter()
+        ps = self._page_size
+        committed = len(req.ids) + len(req.generated)
+        npg = min(pages_for_tokens(committed, ps),
+                  len(self._slot_pages[slot]))
+        blob = export_pages(self._cache, self._slot_pages[slot][:npg])
+        wall = time.perf_counter() - t0
+        nbytes = handoff_bytes(blob)
+        req.spilled = blob
+        req.resume_pref = len(req.generated)
+        req.rng_count = 1  # the prefill sample consumed fold index 0
+        req.handoff = {
+            "t_pack": time.perf_counter(), "export_s": round(wall, 6),
+            "pages": int(npg), "bytes": nbytes,
+            "src": self.flight.replica,
+        }
+        self._ho_exports += 1
+        self._ho_pages_out += int(npg)
+        self._ho_bytes_out += nbytes
+        # Prefill service EWMA: submit→pack wall per prompt token — the
+        # compute-backlog price backlog_score quotes the router.
+        if req.submitted_at > 0.0:
+            pstok = (time.perf_counter() - req.submitted_at) \
+                / max(1, len(req.ids))
+            prev = self._pref_stok_ewma
+            self._pref_stok_ewma = (pstok if prev is None
+                                    else 0.2 * pstok + 0.8 * prev)
+        # The request leaves this replica's backlog (the importing side's
+        # requeue re-adds it there); its rid reads as retired in THIS
+        # replica's flight attribution.
+        with self._submit_lock:
+            self._pending_new_tokens = max(
+                0, self._pending_new_tokens - req.max_new)
+            self._pending_prompt_tokens = max(
+                0, self._pending_prompt_tokens - len(req.ids))
+        self._round_retired.append(req.rid)
+        if req.trace is not None:
+            try:
+                req.trace.event("sched.handoff_export", rid=req.rid,
+                                pages=int(npg), bytes=nbytes)
+            except Exception:  # noqa: BLE001 — tracing must never kill the loop
+                req.trace = None
+        self.flight.event("handoff_export", slot=slot, rid=req.rid,
+                          pages=int(npg), bytes=nbytes,
+                          export_s=round(wall, 6))
+        self._release_slot(slot)
+        self._handoff.append(req)
+
+    def _arm_inplace(self, slot: int, req: _Request) -> None:
+        """Fallback when no handoff consumer exists (bare prefill-role
+        scheduler, or the pool pump failed): decode in place. The resume
+        machinery arms the slot exactly as a mixed replica's ready path
+        would — cur = the committed first token at its own position,
+        counts = 1, FSM replayed, budget decremented — so the output is
+        token-identical either way."""
+        self._ho_inplace += 1
+        req.resume_pref = len(req.generated)
+        req.rng_count = 1
+        req.prefilled = len(req.full_ids)
+        self.flight.event("handoff_inplace", slot=slot, rid=req.rid)
+        self._resume_ready(slot, req, mode="inplace")
+
+    def _record_prefill_round(self, emitted: int, handoffs: int) -> None:
+        """Prefill-role round bookkeeping: a pure prefill replica never
+        harvests a decode round, so the flight record, heartbeat cadence
+        and prefill roofline attribution land here — one record per pack
+        pass that concluded at least one request (handoff, in-place arm
+        or terminal)."""
+        if not (emitted or handoffs or self._round_retired
+                or self._round_admitted):
+            return
+        self.heartbeat.round_done()
+        now = time.perf_counter()
+        prev, self._last_pack_t = self._last_pack_t, now
+        interval = round(now - prev, 6) if prev is not None else 0.0
+        ewma = self.heartbeat.expected_round_s()
+        rec = {
+            "round": self.heartbeat.rounds,
+            "occupancy": sum(1 for r in self._slot_req if r is not None),
+            "queued": self._queue.qsize(),
+            "admitted": self._round_admitted,
+            "retired": self._round_retired,
+            "emitted": emitted,
+            "handoffs": handoffs,
+            "round_wall_s": interval,
+            "cadence_s": round(ewma, 6) if ewma is not None else None,
+            "phase": "prefill",
+        }
+        if prev is not None:
+            # First pack pass has no interval origin: leave the banked
+            # FLOPs for the next record instead of attributing a real
+            # wall of work over a degenerate denominator (the inflated
+            # MFU would pollute the EWMA and bench --compare's gates).
+            pre = self.perf.flush_prefill(interval)
+            if pre is not None:
+                rec["prefill_mfu"] = pre["mfu"]
+                rec["prefill_hbm_util"] = pre["hbm_util"]
+        if self._paged:
+            rec["kv_pages"] = self._page_alloc.pages_in_use
+            rec["kv_pages_free"] = self._page_alloc.pages_free
+            rec["kv_pressure"] = self._page_alloc.withheld
+        self.flight.record(**rec)
+        self._round_admitted = []
+        self._round_retired = []
+
+    @property
+    def handoff_stats(self) -> Optional[Dict[str, object]]:
+        """Disaggregation observability (None for a mixed replica that
+        never touched a handoff): export/import/fallback counters, page
+        and byte volumes, and the summed wait for a decode slot — the
+        lsot_handoff_* Prometheus families and the /metrics
+        serving.handoff payload."""
+        if self.phase_role == "mixed" and not (
+                self._ho_exports or self._ho_imports or self._ho_inplace):
+            return None
+        return {
+            "replica": self.flight.replica,
+            "phase_role": self.phase_role,
+            "exports": self._ho_exports,
+            "imports": self._ho_imports,
+            "inplace_fallbacks": self._ho_inplace,
+            "pages_out": self._ho_pages_out,
+            "pages_in": self._ho_pages_in,
+            "bytes_out": self._ho_bytes_out,
+            "bytes_in": self._ho_bytes_in,
+            "wait_s_sum": round(self._ho_wait_sum, 6),
+            "wait_count": self._ho_wait_count,
+            "queued_handoffs": len(self._handoff),
+        }
 
     @property
     def page_stats(self) -> Optional[Dict[str, int]]:
@@ -2428,6 +2774,7 @@ class ContinuousBatchingScheduler:
             req.future._lsot_replica = self.flight.replica
             req.submitted_at = time.perf_counter()
             self._pending_new_tokens += req.max_new
+            self._pending_prompt_tokens += len(req.ids)
             self._queue.put(req)
         return req.future
 
@@ -2575,6 +2922,16 @@ class ContinuousBatchingScheduler:
         tie-break carries the routing. Lock-free read like
         retry_after_hint (atomic attribute reads; a hair-stale estimate
         is still an estimate)."""
+        if self.phase_role == "prefill":
+            # A prefill replica's backlog is COMPUTE backlog: outstanding
+            # prompt tokens priced by the measured submit→handoff wall
+            # per prompt token — the decode budgets it will never spend
+            # say nothing about how long a new prompt waits here.
+            toks = int(self._pending_prompt_tokens)
+            stok = self._pref_stok_ewma
+            secs = (toks * stok / max(1, self.num_slots)
+                    if stok is not None else 0.0)
+            return float(secs), toks
         stok = self._stok_ewma
         toks = int(self._pending_new_tokens)
         secs = (toks * stok / max(1, self.num_slots)
@@ -2603,6 +2960,25 @@ class ContinuousBatchingScheduler:
                     0, self._pending_new_tokens
                     - sum(r.max_new for r in out)
                 )
+                self._pending_prompt_tokens = max(
+                    0, self._pending_prompt_tokens
+                    - sum(len(r.ids) for r in out)
+                )
+        return out
+
+    def extract_handoffs(self) -> List[_Request]:
+        """Drain the packed-handoff queue (the pool's placement pump and
+        the drain-replica re-placement both come through here). Each
+        request carries its portable KV blob (`spilled` + `handoff`
+        metadata), so any paged sibling can `requeue()` it and resume
+        decode without re-prefilling. Backlog accounting already left
+        this replica at pack time — no decrement here."""
+        out: List[_Request] = []
+        while True:
+            try:
+                out.append(self._handoff.popleft())
+            except IndexError:
+                break
         return out
 
     def requeue(self, req: _Request) -> None:
@@ -2612,6 +2988,22 @@ class ContinuousBatchingScheduler:
         the request was already admitted (acknowledged) once; shedding
         acknowledged work because it had to move replicas would turn a
         drain into data loss."""
+        if req.spilled is not None:
+            # A migrated/spilled blob can only restore into a COMPATIBLE
+            # pool: paged, same page size (blob pages are [L, n, K, ps
+            # (, H)] slices of the source pool). The pool's handoff
+            # placement treats this ValueError as "target can't take it"
+            # and tries the next sibling.
+            if not self._paged:
+                raise ValueError(
+                    "cannot requeue a KV-page blob onto a contiguous "
+                    "scheduler"
+                )
+            if req.spilled[0].shape[3] != self._page_size:
+                raise ValueError(
+                    f"handoff blob page size {req.spilled[0].shape[3]} "
+                    f"!= this pool's {self._page_size}"
+                )
         with self._submit_lock:
             if self._closed:
                 if self._crash is not None:
@@ -2623,6 +3015,7 @@ class ContinuousBatchingScheduler:
             req.rid = self._rid_seq
             req.future._lsot_replica = self.flight.replica
             self._pending_new_tokens += req.max_new
+            self._pending_prompt_tokens += len(req.ids)
             self._queue.put(req)
 
     def _record_service_time(self, req: _Request) -> None:
@@ -3054,6 +3447,18 @@ class ContinuousBatchingScheduler:
                 # the unpreempted control would produce it).
                 self._resume_ready(slot, req)
                 continue
+            if self.phase_role == "prefill":
+                # Disaggregation (ISSUE 13): don't arm the slot for
+                # decode — park the final chunk's still-on-device first
+                # token; _pack_handoffs (called right after this step)
+                # syncs it, commits/streams it, and exports the slot's
+                # pages into the handoff blob. The ready/spec-ready
+                # scatters are skipped on purpose: the importing replica
+                # arms everything through the resume machinery.
+                self._handoff_pending.append(
+                    (slot, req, toks[i : i + 1], self._slot_epoch[slot])
+                )
+                continue
             # No sync: arm the slot with the still-on-device first token and
             # attach it to the next round's harvest. Stop-token / budget
             # checks on the first token happen there, one round late — the
@@ -3245,6 +3650,9 @@ class ContinuousBatchingScheduler:
         with self._submit_lock:
             self._pending_new_tokens = max(
                 0, self._pending_new_tokens - req.max_new
+            )
+            self._pending_prompt_tokens = max(
+                0, self._pending_prompt_tokens - len(req.ids)
             )
 
     def _release_slot(self, slot: int) -> None:
@@ -3525,6 +3933,15 @@ class ContinuousBatchingScheduler:
             rec["kv_pages"] = self._page_alloc.pages_in_use
             rec["kv_pages_free"] = self._page_alloc.pages_free
             rec["kv_pressure"] = self._page_alloc.withheld
+        if self._mig_pages:
+            # Handoff columns (ISSUE 13 satellite): pages imported since
+            # the last record and the decode-slot wait they carried —
+            # present only on rounds that actually imported, so a mixed
+            # replica's records stay byte-identical to pre-disagg.
+            rec["pages_migrated"] = self._mig_pages
+            rec["handoff_wait_s"] = round(self._mig_wait, 6)
+            self._mig_pages = 0
+            self._mig_wait = 0.0
         self.flight.record(**rec)
         self._round_admitted = []
         self._round_retired = []
@@ -3568,6 +3985,13 @@ class ContinuousBatchingScheduler:
         self._prefill_q.clear()  # their requests fail via the slot sweep below
         self._pending.clear()    # in-flight rounds: futures fail below
         self._first_pending = []
+        self._handoff_pending = []  # still slot-held: the sweep covers them
+        for req in self._handoff:
+            # Parked in the handoff queue when the loop died: the blob is
+            # lost with this replica — fail typed so the supervisor's
+            # journal re-prefills the request on a sibling.
+            req.future.set_exception(exc)
+        self._handoff.clear()
         for req in self._constraint_wait:  # waiting on a grammar swap
             req.future.set_exception(exc)
         self._constraint_wait.clear()
@@ -3601,6 +4025,7 @@ class ContinuousBatchingScheduler:
         of the stamp alone."""
         return bool(
             self._prefill_q or self._pending or self._constraint_wait
+            or self._handoff or self._handoff_pending
             or (self._paged and self._page_wait)
             or any(r is not None for r in self._slot_req)
             or not self._queue.empty()
@@ -3681,6 +4106,11 @@ class ContinuousBatchingScheduler:
             # than one prompt_bucket forward.
             if self._prefill_q:
                 self._prefill_step()
+            if self._handoff_pending:
+                # Prefill-role terminal step: commit first tokens, pack
+                # blobs, wake the pool's placement pump (mixed/decode
+                # replicas never queue anything here).
+                self._pack_handoffs()
             if any(r is not None and r.ready for r in self._slot_req):
                 if self._profile_arm is not None:
                     # Armed /debug/profile capture: start the device trace
@@ -3871,6 +4301,10 @@ class SchedulerPool:
             if fl is not None:
                 fl.replica = label
             self._states.append(_ReplicaState(label=label))
+            # Disaggregation (ISSUE 13): a prefill-role replica's packed
+            # handoffs drain through the pool's phase-aware placement
+            # pump (re-wired after every restart swap).
+            self._wire_handoff(i, s)
         # Pool-level black box: placement decisions + replica lifecycle
         # events (restart/drain/dead), merged into flight_snapshot() so
         # the postmortem timeline shows WHERE every request went and what
@@ -4123,11 +4557,20 @@ class SchedulerPool:
                     pstats["watermark_low_pages"]
                 rec["kv_watermark_high_pages"] = \
                     pstats["watermark_high_pages"]
+            # Disaggregation (ISSUE 13): which phase this replica serves
+            # and its handoff traffic — the router's placement feed and
+            # the per-replica lsot_serving_* gauges.
+            rec["phase_role"] = self._phase_role(s)
+            ho = getattr(s, "handoff_stats", None)
+            if isinstance(ho, dict):
+                rec["handoff_exports"] = ho["exports"]
+                rec["handoff_imports"] = ho["imports"]
+                rec["handoff_queued"] = ho["queued_handoffs"]
             # Roofline + SLO placement signals (ISSUE 12): the replica's
             # live decode roofline position and whether its rolling SLO
-            # is burning — the columns a phase-aware / SLO-aware router
-            # will consume (disaggregated prefill/decode ROADMAP item),
-            # exported per replica like every other numeric field here.
+            # is burning — the columns the phase-aware router consumes
+            # (decode_hbm_util is _decode_pressure's feed), exported per
+            # replica like every other numeric field here.
             perf = getattr(s, "perf_stats", None)
             if isinstance(perf, dict):
                 dec = (perf.get("phases") or {}).get("decode")
@@ -4223,6 +4666,153 @@ class SchedulerPool:
             out.append((i, st, s))
         return out
 
+    @staticmethod
+    def _phase_role(s) -> str:
+        return getattr(s, "phase_role", "mixed") or "mixed"
+
+    def _wire_handoff(self, idx: int, s) -> None:
+        """Point a prefill-role replica's handoff queue at the pool's
+        placement pump (idempotent; called at construction and after
+        every targeted-restart swap)."""
+        if self._phase_role(s) == "prefill" and hasattr(s, "on_handoff"):
+            s.on_handoff = partial(self._pump_handoffs, idx)
+
+    def _penalty(self, st: "_ReplicaState", s) -> int:
+        """Pressure-aware placement (ISSUE 13 satellite): deprioritize a
+        replica mid-KV-pressure-storm (withheld pool pages — PR-10's
+        `kv_pressure` signal) or mid-SLO-burn BEFORE the least-loaded
+        tie-break — backlog scores say nothing about a replica that is
+        busy preempting victims or already blowing its latency budget.
+        Additive, so a replica with both problems sorts after one with
+        either; 0 everywhere in a healthy fleet, which keeps the
+        pre-disagg placement order bit for bit."""
+        pen = 0
+        try:
+            pstats = getattr(s, "page_stats", None)
+            if pstats and int(pstats.get("pages_withheld", 0) or 0) > 0:
+                pen += 1
+        except Exception:  # noqa: BLE001 — a dying replica mid-read
+            pass
+        try:
+            from ..utils import slo as _slo
+
+            if _slo.ENGINE.enabled \
+                    and _slo.ENGINE.replica_burning(st.label):
+                pen += 1
+        except Exception:  # noqa: BLE001 — placement view best-effort
+            pass
+        return pen
+
+    @staticmethod
+    def _decode_pressure(s) -> float:
+        """The live decode-side placement signal (ISSUE 13): the
+        replica's decode-phase HBM-bandwidth utilization EWMA from the
+        per-round roofline ledger (PR 12) — the closer to the roof, the
+        less headroom a migrated request's decode leg has there. 0.0
+        for replicas without a ledger (duck-typed fakes)."""
+        try:
+            perf = getattr(s, "perf_stats", None)
+            if isinstance(perf, dict):
+                dec = (perf.get("phases") or {}).get("decode")
+                if dec and dec.get("hbm_util") is not None:
+                    return float(dec["hbm_util"])
+        except Exception:  # noqa: BLE001 — a dying replica mid-read
+            pass
+        return 0.0
+
+    def _pump_handoffs(self, src_idx: int) -> None:
+        """Drain one prefill replica's packed handoffs and place each
+        onto a decode-capable sibling. Runs on the prefill replica's
+        worker thread the moment a blob is packed — placement is a lock
+        plus a queue put, so the pump costs the prefill loop
+        microseconds, and there is no polling thread to fall behind."""
+        src = self.schedulers[src_idx]
+        ex = getattr(src, "extract_handoffs", None)
+        if not callable(ex):
+            return
+        for req in ex():
+            self._place_handoff(req, src_idx)
+
+    def _place_handoff(self, req, src_idx: int) -> None:
+        """Phase-aware placement of ONE migrated request: decode
+        replicas first — ordered by the pressure penalty, the live
+        decode-phase HBM utilization, then backlog — mixed siblings
+        next, the originating prefill replica last (the documented
+        "fall back to decoding in place" rule: a decode-side placement
+        failure must never lose the request). The remaining deadline is
+        budgeted across the handoff: a target whose backlog estimate
+        already exceeds it is skipped, so TTFT accounting spans both
+        legs."""
+        src = self.schedulers[src_idx]
+        remaining = (req.deadline.remaining()
+                     if req.deadline is not None else None)
+        cands = self._placeable()
+
+        def ordered(role):
+            # Score once per candidate (decorate-sort): backlog_score /
+            # penalty reads run on the prefill worker thread, and the
+            # sort key must not re-invoke them per comparison pass.
+            decorated = []
+            for (i, st, s) in cands:
+                if self._phase_role(s) != role or s is src:
+                    continue
+                secs, toks = self._score(s)
+                decorated.append((self._penalty(st, s),
+                                  self._decode_pressure(s),
+                                  secs, toks, i, st, s))
+            decorated.sort(key=lambda t: t[:5])
+            return [(i, st, s) for (*_k, i, st, s) in decorated]
+
+        targets = ordered("decode") + ordered("mixed") + [
+            (src_idx, self._states[src_idx], src)
+        ]
+        # Snapshot the event fields BEFORE the target can race us: the
+        # importing replica's worker may restore the blob (clearing
+        # req.handoff) and requeue reassigns rid the moment rq(req)
+        # returns.
+        pages = (req.handoff or {}).get("pages", 0)
+        rid = req.rid
+        for i, st, s in targets:
+            if remaining is not None and s is not src:
+                secs, _ = self._score(s)
+                if secs >= remaining:
+                    continue  # its backlog alone would burn the deadline
+            rq = getattr(s, "requeue", None)
+            if not callable(rq):
+                continue
+            try:
+                rq(req)
+            except Exception:  # noqa: BLE001 — crashed/incompatible target
+                continue
+            with self._lock:
+                st.placements += 1
+            self._pool_flight.event(
+                "handoff_place", to=st.label,
+                src=self._states[src_idx].label, rid=rid,
+                pages=pages, inplace=s is src,
+            )
+            return
+        # Not even the (live — we are on its worker thread) source could
+        # take it back: fail typed so the supervisor's journal replays it
+        # instead of a client hanging on a parked future.
+        req.future.set_exception(SchedulerCrashed(
+            "no replica could accept a prefill→decode handoff"
+        ))
+
+    @property
+    def handoff_stats(self) -> Optional[Dict[str, object]]:
+        """Per-replica handoff counters (None when no replica has any) —
+        the pool-level serving.handoff payload the lsot_handoff_*
+        Prometheus families render."""
+        per = []
+        for st, s in self._replica_items():
+            h = getattr(s, "handoff_stats", None)
+            if isinstance(h, dict):
+                rec = dict(h)
+                rec["replica"] = st.label
+                per.append(rec)
+        return {"replicas": per} if per else None
+
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
                on_token=None, constraint=None, deadline_s=None, trace=None):
@@ -4243,6 +4833,19 @@ class SchedulerPool:
             cands = self._placeable(exclude=tried)
             if not cands:
                 break
+            # Phase-aware routing (ISSUE 13): NEW requests are prefill
+            # work — keep them off decode-role replicas while any
+            # prefill/mixed replica can take them (all-decode leftovers
+            # still serve rather than shed: roles are routing policy,
+            # not capability). All-mixed fleets filter nothing. The
+            # filtered-out decode replicas are kept as the deadline
+            # spillover tier below.
+            spill: List = []
+            front = [c for c in cands if self._phase_role(c[2]) != "decode"]
+            if front and len(front) < len(cands):
+                spill = [c for c in cands
+                         if self._phase_role(c[2]) == "decode"]
+                cands = front
             if self.router == "round_robin":
                 with self._lock:
                     pick = self._rr % len(cands)
@@ -4251,14 +4854,33 @@ class SchedulerPool:
                 scored = [(self._score(s), i, st, s)
                           for (i, st, s) in order]
             else:
+                # Pressure-aware least-loaded: replicas mid-KV-pressure-
+                # storm or mid-SLO-burn sort after healthy ones BEFORE
+                # the backlog comparison (ISSUE 13 satellite; penalty is
+                # 0 fleet-wide in the healthy case, preserving the
+                # pre-disagg order bit for bit).
                 scored = sorted(
                     ((self._score(s), i, st, s) for (i, st, s) in cands),
-                    key=lambda t: (t[0][0], t[0][1], t[1]),
+                    key=lambda t: (self._penalty(t[2], t[3]),
+                                   t[0][0], t[0][1], t[1]),
                 )
             if deadline_s is not None:
                 feasible = [t for t in scored if t[0][0] < deadline_s]
+                if not feasible and spill:
+                    # The prefill/mixed tier can't meet the deadline, but
+                    # the decode-role replicas the phase filter set aside
+                    # are FULL-capability — serving there beats shedding
+                    # a request that still fits its budget somewhere.
+                    spilled = sorted(
+                        ((self._score(s), i, st, s)
+                         for (i, st, s) in spill),
+                        key=lambda t: (self._penalty(t[2], t[3]),
+                                       t[0][0], t[0][1], t[1]),
+                    )
+                    feasible = [t for t in spilled if t[0][0] < deadline_s]
+                    scored = scored + spilled
                 if not feasible:
-                    # Every remaining replica's backlog estimate already
+                    # Every placeable replica's backlog estimate already
                     # exceeds the budget: admitting anywhere would burn
                     # the deadline in queue. Shed 504 below (unless a
                     # not-yet-tried replica frees up — there is none:
@@ -4297,8 +4919,14 @@ class SchedulerPool:
                 tried.add(i)
                 continue
             # Replica attribution for the metrics label set: which
-            # replica actually served this submit.
-            fut._lsot_replica = st.label
+            # replica actually served this submit. Real schedulers
+            # already stamped their own label under the submit lock —
+            # only fill the gap for duck-typed replicas, so a handoff
+            # requeue that migrated the request in the microseconds
+            # since submit() returned is never overwritten with the
+            # prefill replica's label.
+            if getattr(fut, "_lsot_replica", None) is None:
+                fut._lsot_replica = st.label
             with self._lock:
                 st.placements += 1
             if st.state == "degraded":
@@ -4501,6 +5129,9 @@ class SchedulerPool:
                 if fl is not None:
                     fl.replica = st.label
                 self.schedulers[idx] = fresh
+                # A rebuilt prefill-role replica needs its handoff pump
+                # re-pointed at the pool (the corpse took the wiring).
+                self._wire_handoff(idx, fresh)
                 # Degraded until a clean completion lands on it (the
                 # submit-path done-callback promotes it back to ready).
                 st.state = "degraded"
@@ -4541,25 +5172,38 @@ class SchedulerPool:
         # Re-place queued work BEFORE waiting on in-flight: the queue
         # would otherwise drain into the replica we are emptying.
         replaced = 0
+        pulls = []
         extract = getattr(sched, "extract_queued", None)
         if callable(extract):
-            for req in extract():
+            pulls.extend(extract())
+        # Packed handoffs waiting on this replica drain too: each carries
+        # its portable KV blob, so a sibling restores and decodes it
+        # without a re-prefill (acknowledged work never sheds).
+        exh = getattr(sched, "extract_handoffs", None)
+        if callable(exh):
+            pulls.extend(exh())
+        if pulls:
+            for req in pulls:
                 target = None
                 cands = self._placeable()
                 if cands:
                     target = min(
-                        ((self._score(s), i, s) for (i, _st, s) in cands),
-                        key=lambda t: (t[0][0], t[0][1], t[1]),
-                    )[2]
+                        ((self._score(s), self._penalty(_st, s), i, s)
+                         for (i, _st, s) in cands),
+                        key=lambda t: (t[1], t[0][0], t[0][1], t[2]),
+                    )[3]
                 if target is not None and callable(
                         getattr(target, "requeue", None)):
-                    target.requeue(req)
-                    replaced += 1
-                else:
-                    # No sibling can take it: leave it on the draining
-                    # replica — it serves out its queue inside the grace
-                    # (a lone-replica drain degenerates to a plain drain).
-                    sched.requeue(req)
+                    try:
+                        target.requeue(req)
+                        replaced += 1
+                        continue
+                    except Exception:  # noqa: BLE001 — incompatible/racing target
+                        pass
+                # No sibling can take it: leave it on the draining
+                # replica — it serves out its queue inside the grace
+                # (a lone-replica drain degenerates to a plain drain).
+                sched.requeue(req)
         if replaced:
             self._pool_flight.event("replica_drain_replaced",
                                     replica=st.label, replaced=replaced)
@@ -4640,6 +5284,7 @@ class SchedulerPool:
             rec: Dict[str, object] = {
                 "replica": st.label,
                 "state": st.state,
+                "phase_role": self._phase_role(s),
                 "restarts": st.restarts,
                 "max_restarts": self.max_restarts,
                 "stalls": st.stalls,
@@ -4811,6 +5456,12 @@ class SchedulerBackend:
         perf = getattr(self.scheduler, "perf_stats", None)
         if perf:
             out["perf"] = perf
+        # Prefill→decode handoff traffic (ISSUE 13): exports/imports/
+        # fallbacks, page+byte volume, decode-slot wait — rendered as
+        # the lsot_handoff_* Prometheus families (utils/prometheus.py).
+        ho = getattr(self.scheduler, "handoff_stats", None)
+        if ho:
+            out["handoff"] = ho
         # Liveness view (serve/watchdog.py): heartbeat age/cadence, slots
         # retired for per-lane stalls, and — when supervised — whole-loop
         # stalls detected + the active stall threshold.

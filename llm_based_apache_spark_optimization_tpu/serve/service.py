@@ -300,10 +300,13 @@ class GenerationService:
     def fleet_health(self) -> Dict[str, list]:
         """Per-replica lifecycle per model, for backends serving from a
         replica fleet (SchedulerPool / a supervisor wrapping one):
-        {model: [{replica, state, restarts, ...}]}. Empty for single-
-        scheduler and engine backends. Surfaced on /healthz so one probe
-        shows WHICH replica is restarting/dead, and deduped by underlying
-        scheduler like health() (shared-weights aliasing)."""
+        {model: [{replica, state, phase_role, restarts, ...}]} — a
+        disaggregated fleet (ISSUE 13) shows each replica's prefill/
+        decode/mixed role beside its lifecycle state, so one probe says
+        both WHICH replica is restarting/dead and which phase lost
+        capacity. Empty for single-scheduler and engine backends.
+        Surfaced on /healthz, and deduped by underlying scheduler like
+        health() (shared-weights aliasing)."""
         out: Dict[str, list] = {}
         with self._lock:
             entries = list(self._models.values())

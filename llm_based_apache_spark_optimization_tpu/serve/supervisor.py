@@ -438,6 +438,17 @@ class SupervisedScheduler:
         supervision (None for duck-typed inners without a ledger)."""
         return getattr(self._inner, "perf_stats", None)
 
+    @property
+    def handoff_stats(self):
+        """Prefill→decode handoff passthrough (ISSUE 13): the
+        serving.handoff view and the lsot_handoff_* families survive
+        supervision (None for mixed/duck-typed inners)."""
+        return getattr(self._inner, "handoff_stats", None)
+
+    @property
+    def phase_role(self):
+        return getattr(self._inner, "phase_role", "mixed")
+
     def profile_rounds(self, rounds=None, out_dir=None):
         """On-demand device-capture passthrough (/debug/profile): the
         INNER loop owns the device, so it owns the capture; the

@@ -173,6 +173,39 @@ def _emit_perf(emit: _Emitter, model: str, perf: Dict) -> None:
                          1.0 if ph["bound"] == "compute-bound" else 0.0)
 
 
+def _emit_handoff(emit: _Emitter, model: str, ho: Dict) -> None:
+    """The prefill→decode handoff families (ISSUE 13): `serving.handoff`
+    becomes lsot_handoff_* counters labeled model × replica ×
+    phase_role — exports/imports/in-place fallbacks, page and byte
+    volume each way, and the summed wait for a decode slot (the
+    between-legs latency a disaggregated deployment tunes). Accepts one
+    replica's stats dict or a pool's ({"replicas": [...]})."""
+    stats = ho.get("replicas") if isinstance(ho.get("replicas"),
+                                             list) else [ho]
+    for rec in stats:
+        if not isinstance(rec, dict):
+            continue
+        labels = {"model": model,
+                  "replica": str(rec.get("replica") or "r0"),
+                  "phase_role": str(rec.get("phase_role") or "mixed")}
+        for key, name, mtype in (
+                ("exports", "lsot_handoff_exports_total", "counter"),
+                ("imports", "lsot_handoff_imports_total", "counter"),
+                ("inplace_fallbacks",
+                 "lsot_handoff_inplace_fallbacks_total", "counter"),
+                ("pages_out", "lsot_handoff_pages_out_total", "counter"),
+                ("pages_in", "lsot_handoff_pages_in_total", "counter"),
+                ("bytes_out", "lsot_handoff_bytes_out_total", "counter"),
+                ("bytes_in", "lsot_handoff_bytes_in_total", "counter"),
+                ("wait_s_sum", "lsot_handoff_wait_seconds_sum", "counter"),
+                ("wait_count", "lsot_handoff_wait_count", "counter"),
+                ("queued_handoffs", "lsot_handoff_queued", "gauge"),
+        ):
+            n = _num(rec.get(key))
+            if n is not None:
+                emit.add(name, labels, n, mtype)
+
+
 def _emit_slo(emit: _Emitter, slo: Dict) -> None:
     """The rolling-SLO families (ISSUE 12): per-replica + fleet quantile
     gauges, bad-fraction/burn-rate gauges per window arm, and the 0/1
@@ -236,6 +269,12 @@ def render_prometheus(snapshot: Dict,
             perf = serving.pop("perf", None)
             if isinstance(perf, dict):
                 _emit_perf(emit, model, perf)
+            # Handoff traffic renders as first-class replica × phase_role
+            # families (not path-flattened gauges) so dashboards join
+            # lsot_handoff_* on the same label vocabulary as lsot_mfu.
+            ho = serving.pop("handoff", None)
+            if isinstance(ho, dict):
+                _emit_handoff(emit, model, ho)
             _flatten_serving(emit, model, "lsot_serving", serving)
     if resilience:
         breakers = resilience.get("breakers") or {}

@@ -357,6 +357,48 @@ def test_pool_routing_pass_balances_skewed_load():
     assert "speedup" in out
 
 
+def test_disagg_pass_structural_on_cpu():
+    """ISSUE 13 bench leg: the disagg pass runs a mixed fleet and a
+    phase-split fleet at equal replica count over the bimodal fixture
+    end to end on CPU, committing TTFT/TPOT percentiles + decode tok/s
+    for both shapes and the split fleet's handoff tally. On this
+    shared-core host the structural assertions are the contract — every
+    request served, every split-fleet request actually migrated (no
+    silent in-place fallback), the --compare-gated keys present — while
+    the latency/throughput DELTAS are owed to the chip capture."""
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(Path(BENCH).parent))
+    from bench import _bench_disagg
+
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+
+    params = init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+    out = _bench_disagg(TINY, params)
+    assert out["requests"] == 6
+    total = (out["long"]["n"] * out["long"]["max_new"]
+             + out["short"]["n"] * out["short"]["max_new"])
+    for leg in ("mixed_fleet", "split_fleet"):
+        rec = out[leg]
+        assert rec["tokens"] == total  # every token served, none dropped
+        assert rec["decode_tok_s"] > 0 and rec["wall_s"] > 0
+        for k in ("ttft_p50_s", "ttft_p95_s", "tpot_p50_s", "tpot_p95_s"):
+            assert rec[k] >= 0.0
+        assert rec["ttft_p95_s"] >= rec["ttft_p50_s"]
+    # The split fleet migrated EVERY request: zero in-place fallbacks
+    # (the direct no-silent-fallback signal), and the export tally
+    # reconciles with reps full waves plus the prefill replica's one
+    # warmup request (which also migrates).
+    assert out["split_fleet"]["inplace_fallbacks"] == 0
+    assert out["split_fleet"]["handoffs"] == 2 * out["requests"] + 1
+    assert "handoffs" not in out["mixed_fleet"]
+    assert "speedup" in out
+
+
 def test_kv_pressure_pass_overcommit_sustains_more_concurrency():
     """ISSUE 10 bench leg: at a FIXED page pool, overcommit admission
     sustains STRICTLY more concurrent requests than exact-envelope

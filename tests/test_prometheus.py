@@ -192,6 +192,46 @@ def test_render_perf_gauges_phase_replica():
     assert reps == {"r0", "r1"}
 
 
+def test_render_handoff_families():
+    """ISSUE-13 golden: serving.handoff renders as lsot_handoff_*
+    counters labeled model × replica × phase_role — not path-flattened
+    serving gauges — for both the single-replica and the pool
+    ({"replicas": [...]}) payload shapes."""
+    ho_r0 = {
+        "replica": "r0", "phase_role": "prefill",
+        "exports": 4, "imports": 0, "inplace_fallbacks": 1,
+        "pages_out": 8, "pages_in": 0, "bytes_out": 16384, "bytes_in": 0,
+        "wait_s_sum": 0.0, "wait_count": 0, "queued_handoffs": 0,
+    }
+    ho_r1 = {
+        "replica": "r1", "phase_role": "decode",
+        "exports": 0, "imports": 4, "inplace_fallbacks": 0,
+        "pages_out": 0, "pages_in": 8, "bytes_out": 0, "bytes_in": 16384,
+        "wait_s_sum": 0.125, "wait_count": 4, "queued_handoffs": 0,
+    }
+    snap = {"m": {"requests": 1,
+                  "serving": {"handoff": {"replicas": [ho_r0, ho_r1]}}}}
+    text = render_prometheus(snap)
+    types, samples = parse_exposition(text)
+    assert types["lsot_handoff_exports_total"] == "counter"
+    assert types["lsot_handoff_imports_total"] == "counter"
+    assert types["lsot_handoff_queued"] == "gauge"
+    by = {(n, l.get("replica")): (v, l) for n, l, v in samples}
+    v, labels = by[("lsot_handoff_exports_total", "r0")]
+    assert v == 4 and labels["phase_role"] == "prefill"
+    v, labels = by[("lsot_handoff_imports_total", "r1")]
+    assert v == 4 and labels["phase_role"] == "decode"
+    assert by[("lsot_handoff_bytes_in_total", "r1")][0] == 16384
+    assert by[("lsot_handoff_wait_seconds_sum", "r1")][0] == 0.125
+    # Nothing handoff-shaped leaked through the generic flattener.
+    assert not any(n.startswith("lsot_serving_handoff")
+                   for n, _, _ in samples)
+    # Single-replica payload shape renders too.
+    snap = {"m": {"requests": 1, "serving": {"handoff": ho_r0}}}
+    _, samples = parse_exposition(render_prometheus(snap))
+    assert any(n == "lsot_handoff_exports_total" for n, _, _ in samples)
+
+
 def test_render_slo_families():
     """ISSUE-12 golden: the top-level "slo" snapshot renders burn-rate /
     bad-fraction gauges per window arm, quantile gauges, the 0/1 burning

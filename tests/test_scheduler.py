@@ -615,6 +615,42 @@ def test_pool_deadline_aware_skip_and_504_when_infeasible():
     assert len(backed_up.submitted) + len(fresh.submitted) == 2
 
 
+def test_pool_pressure_penalty_deprioritizes_stormy_replica():
+    """ISSUE 13 satellite: a replica mid-KV-pressure-storm (withheld
+    pool pages — PR-10's kv_pressure signal) sorts AFTER healthy
+    siblings before the least-loaded tie-break, even when its backlog
+    score is strictly better; with no pressure anywhere the order is
+    the pre-disagg backlog order bit for bit."""
+    calm, stormy = _FakeReplica(secs=2.0), _FakeReplica(secs=0.1)
+    stormy.page_stats = {"pages_withheld": 6, "pages_free": 0}
+    pool = _fake_pool(stormy, calm)
+    pool.submit([1, 2])
+    assert calm.submitted and not stormy.submitted
+    # Pressure lifted: the better backlog score wins again.
+    stormy.page_stats = {"pages_withheld": 0, "pages_free": 12}
+    pool.submit([3])
+    assert stormy.submitted
+
+
+def test_pool_slo_burning_deprioritized(monkeypatch):
+    """ISSUE 13 satellite: a replica whose rolling SLO is burning sorts
+    after healthy siblings before the backlog tie-break."""
+    from llm_based_apache_spark_optimization_tpu.utils import slo as slo_mod
+
+    class _Engine:
+        enabled = True
+
+        @staticmethod
+        def replica_burning(label):
+            return label == "r0"
+
+    monkeypatch.setattr(slo_mod, "ENGINE", _Engine())
+    burning, healthy = _FakeReplica(secs=0.1), _FakeReplica(secs=5.0)
+    pool = _fake_pool(burning, healthy)
+    pool.submit([1])
+    assert healthy.submitted and not burning.submitted
+
+
 def test_pool_all_full_sheds_with_min_retry_after():
     """One full replica no longer answers for the fleet: the pool sheds
     Overloaded only when EVERY placeable replica is at capacity, and the
