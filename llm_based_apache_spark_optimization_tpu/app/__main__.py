@@ -546,6 +546,14 @@ def main(argv=None) -> None:
     TRACER.reconfigure(sample=cfg.trace_sample, export_dir=cfg.trace_export)
     flightrecorder.reconfigure(rounds=cfg.flight_rounds)
     observability.reconfigure_request_log(cfg.request_log)
+    # Prefix-cache telemetry bounds (ISSUE 14): registry top-K and the
+    # reuse-distance ring resolve through AppConfig too —
+    # LSOT_PREFIX_TOPK / LSOT_PREFIX_RING are documented knobs with a
+    # reconfigure seam, not hidden env reads.
+    from ..serve.scheduler import reconfigure_prefix_telemetry
+
+    reconfigure_prefix_telemetry(top_k=cfg.prefix_topk,
+                                 ring=cfg.prefix_ring)
     # Performance attribution & SLOs (ISSUE 12): the rolling SLO engine's
     # objectives/window and the on-demand profiler's defaults resolve
     # through AppConfig too — LSOT_SLO_* / LSOT_PROFILE_* are documented

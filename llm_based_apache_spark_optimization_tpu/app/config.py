@@ -157,6 +157,13 @@ class AppConfig:
     # emits at INFO): 1 = every request (historical behavior), 0 = off —
     # the hot path skips the json.dumps + handler I/O entirely.
     request_log: float = 1.0
+    # Prefix-cache telemetry bounds (ISSUE 14; README "Prefix-cache
+    # telemetry"). How many registry entries /debug/prefixcache returns
+    # per replica (top-K by token mass) and how many recent admissions
+    # the reuse-distance ring remembers — both bound memory and payload
+    # size, never correctness (entries carry digests, not token ids).
+    prefix_topk: int = 32
+    prefix_ring: int = 256
     # --- performance attribution & SLOs (utils/perfmodel.py,
     # utils/slo.py; README "Performance attribution & SLOs").
     # Rolling SLO objectives in MILLISECONDS (operator units); 0

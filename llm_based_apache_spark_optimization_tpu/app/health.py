@@ -131,6 +131,14 @@ def add_debug_routes(app: App, service: GenerationService) -> None:
     - `GET /debug/slo` — the rolling SLO engine's report (utils/slo.py):
       per-replica + fleet quantile sketches over TTFT/TPOT/queue-wait,
       burn rates per window arm, and which replicas are burning.
+    - `GET /debug/prefixcache[?top=K]` — the content-addressed
+      prefix-cache registry per model (ISSUE 14): top-K resident
+      entries by token mass (digest, tokens, pages/bytes held, live
+      shares, hit counts, insert/last-hit round), the reuse-distance
+      histogram over a bounded ring of recent admissions, and the
+      eviction-churn counters (evictions, ghost-list reinsertions).
+      Replica-labeled for fleets; entries carry digests, never token
+      ids.
     - `GET /debug/profile[?rounds=N[&model=M]]` — on-demand device
       profiling: with `rounds`, ARM a bounded jax.profiler capture
       around the scheduler's next N rounds (409 when a capture is
@@ -164,6 +172,20 @@ def add_debug_routes(app: App, service: GenerationService) -> None:
     @app.route("/debug/slo")
     def slo(req: Request) -> Response:
         return Response.json(service.slo_report())
+
+    @app.route("/debug/prefixcache")
+    def prefixcache(req: Request) -> Response:
+        try:
+            top = int(req.query.get("top", "0")) or None
+        except ValueError:
+            return Response.json({"error": "'top' must be an integer"},
+                                 status=400)
+        if top is not None and top < 1:
+            # A negative K would flow into list slicing as a from-the-end
+            # slice — a near-unbounded payload instead of a bound.
+            return Response.json({"error": "'top' must be >= 1"},
+                                 status=400)
+        return Response.json({"models": service.prefix_registry(top)})
 
     @app.route("/debug/profile")
     def profile(req: Request) -> Response:

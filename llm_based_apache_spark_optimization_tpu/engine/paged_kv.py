@@ -310,6 +310,17 @@ class PageAllocator:
         self.evictions = 0
         self.spilled_pages = 0
         self.restored_pages = 0
+        #: Per-page resident-PREFIX reference counts (ISSUE 14): how many
+        #: live prefix-cache entries currently map each page. Chained
+        #: entries overlap on their leading pages, so the scheduler's
+        #: "bytes held by the prefix cache" figure needs the UNIQUE page
+        #: set, not a per-entry sum — `prefix_resident_pages` counts pages
+        #: with at least one entry reference, in O(1) via the nonzero
+        #: tally. Distinct from `_ref` on purpose: a page can be prefix-
+        #: resident and slot-mapped at once, and eviction accounting must
+        #: not disturb the free-list/refcount partition invariant.
+        self._prefix_ref = [0] * self.num_pages
+        self._prefix_resident = 0
 
     # ------------------------------------------------------------- queries
 
@@ -417,6 +428,33 @@ class PageAllocator:
         zero-copy mappings once they are known to persist."""
         self.shares += n
 
+    def prefix_hold(self, pages: List[int]) -> None:
+        """Mark pages as mapped by one more resident prefix-cache entry
+        (publish). Idempotent per entry, not per page — chained entries
+        legitimately hold the same leading pages more than once."""
+        for p in pages:
+            if self._prefix_ref[p] == 0:
+                self._prefix_resident += 1
+            self._prefix_ref[p] += 1
+
+    def prefix_drop(self, pages: List[int]) -> None:
+        """Drop one prefix-entry reference per page (entry eviction).
+        A negative count is an accounting bug, not a recoverable state."""
+        for p in pages:
+            if self._prefix_ref[p] <= 0:
+                raise PageAccountingError(
+                    f"prefix_drop of page {p} with no prefix reference"
+                )
+            self._prefix_ref[p] -= 1
+            if self._prefix_ref[p] == 0:
+                self._prefix_resident -= 1
+
+    @property
+    def prefix_resident_pages(self) -> int:
+        """UNIQUE pages currently held by at least one prefix-cache
+        entry — the registry's resident-bytes numerator (× page_bytes)."""
+        return self._prefix_resident
+
     def release(self, pages: List[int]) -> List[int]:
         """Drop one reference per page; pages reaching refcount 0 return to
         the free list. Returns the freed subset."""
@@ -469,6 +507,7 @@ class PageAllocator:
             "pages_in_use": self.pages_in_use,
             "pages_shared": self.pages_shared,
             "pages_withheld": self.withheld,
+            "prefix_resident_pages": self.prefix_resident_pages,
             "zero_copy_shares": self.shares,
             "cow_copies": self.cow_copies,
             "preemptions": self.preemptions,

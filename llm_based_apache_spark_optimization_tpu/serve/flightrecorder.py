@@ -21,7 +21,12 @@ deaths left exactly that hole. The flight recorder is the black box:
   `handoffs` column on prefill-role pack records, and the
   `handoff_export`/`handoff_import`/`handoff_inplace`/`handoff_place`
   lifecycle events — so a migrated request's timeline explains the gap
-  between prefill and its first decode token.
+  between prefill and its first decode token. Prefix-cache telemetry
+  (ISSUE 14) adds a `prefix_reuse` column on rounds that admitted
+  requests with at least one full prompt block: one {rid, digest,
+  reused, prefilled} row per admission, the per-request attribution the
+  tier-1 reconciliation test sums against the scheduler's locked
+  counters — and the pool ring gains `prefix_affinity` lookup events.
 - `event(kind, **fields)` — sparse lifecycle markers (crash, stall
   escalation, restart, drain, grammar swap) ride the same ring with
   `"kind"` set, so the postmortem shows rounds and lifecycle interleaved

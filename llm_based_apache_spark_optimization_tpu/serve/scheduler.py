@@ -116,6 +116,7 @@ garbage covered by the invariant above).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import os
 import queue
@@ -222,6 +223,77 @@ def parse_pool_phases(spec: str, replicas: int) -> List[str]:
             f"mixed replica to hand off to"
         )
     return roles
+
+
+#: Prefix-cache telemetry bounds (ISSUE 14): how many registry entries
+#: /debug/prefixcache returns per replica (top-K by token mass) and how
+#: many recent admissions the reuse-distance ring remembers. App-startup
+#: overrides via `reconfigure_prefix_telemetry` (AppConfig.prefix_topk /
+#: prefix_ring — the same wiring seam as flightrecorder.reconfigure);
+#: None falls through to the LSOT_PREFIX_TOPK / LSOT_PREFIX_RING env
+#: reads below.
+_PREFIX_TOPK: Optional[int] = None
+_PREFIX_RING: Optional[int] = None
+
+
+def reconfigure_prefix_telemetry(top_k: Optional[int] = None,
+                                 ring: Optional[int] = None) -> None:
+    """Set the prefix-registry bounds schedulers constructed AFTER this
+    call will use (app/__main__ wires AppConfig.prefix_topk/prefix_ring
+    through here, so the knobs are documented config, not hidden env)."""
+    global _PREFIX_TOPK, _PREFIX_RING
+    _PREFIX_TOPK = int(top_k) if top_k else None
+    _PREFIX_RING = int(ring) if ring else None
+
+
+def _prefix_bound(configured: Optional[int], env: str, default: int) -> int:
+    if configured is not None:
+        return max(1, configured)
+    try:
+        n = int(os.environ.get(env, str(default)))
+    except ValueError:
+        n = default
+    return max(1, n)
+
+
+def prefix_digest(ids: Sequence[int]) -> str:
+    """Stable content address of a token prefix: blake2b over the int32
+    token ids, 16 hex chars. Deterministic across processes and replicas
+    — the SAME schema prefix hashes to the SAME digest fleet-wide, which
+    is what lets `SchedulerPool.prefix_affinity` compare a request's
+    prefix against every replica's resident set without shipping token
+    lists around (ISSUE 14)."""
+    return hashlib.blake2b(
+        np.asarray(ids, np.int32).tobytes(), digest_size=8
+    ).hexdigest()
+
+
+def prefix_chain_digests(ids: Sequence[int], block: int) -> List[str]:
+    """Digests of every whole-block prefix of a prompt (the hash-chain
+    keys' content addresses): what a cache-aware router hands to
+    `SchedulerPool.prefix_affinity` — a replica holding ANY chain prefix
+    of the request saves that much re-prefill, so affinity matches on
+    the whole chain, not just the longest prefix."""
+    return [
+        prefix_digest(ids[: (j + 1) * block])
+        for j in range(max(0, (len(ids) - 1) // block))
+    ]
+
+
+def _rd_buckets(ring_cap: int) -> Tuple[int, ...]:
+    """Reuse-distance histogram buckets (admissions between consecutive
+    sightings of the same prefix digest): le-style powers of two up to
+    the ring cap, so a ring configured wider than the default still
+    buckets its whole window instead of dumping the tail into "inf". A
+    distance histogram bounded by the ring answers "would a cache of N
+    entries have held this working set" — the capacity-planning
+    readout."""
+    b, buckets = 1, []
+    while b < ring_cap:
+        buckets.append(b)
+        b *= 2
+    buckets.append(ring_cap)
+    return tuple(buckets)
 
 
 def _first_token_timer(then: Optional[Callable[[int], None]] = None):
@@ -374,6 +446,19 @@ class _Request:
     # spill-resume paths can tell a migrated blob from a preemption spill
     # (different counters, same restore machinery).
     handoff: Optional[Dict] = None
+    # Prefix-cache reuse attribution (ISSUE 14), stamped at admission:
+    # the request's schema-prefix content digest — the MATCHED chain
+    # entry's digest on a hit (joinable against /debug/prefixcache and
+    # the resident-digest routing feed), the longest block-aligned
+    # prompt prefix on a miss (the best schema-identity guess when
+    # nothing matched); same digest fleet-wide for the same token
+    # prefix. Plus how many prompt tokens the hit let prefill SKIP and
+    # the analytic prefill seconds that skip saved
+    # (utils/perfmodel.prefill_saved). "" / 0 when the prompt is shorter
+    # than one block or the cache is off.
+    prefix_digest: str = ""
+    tokens_reused: int = 0
+    prefill_s_saved: float = 0.0
 
     @property
     def full_ids(self) -> List[int]:
@@ -404,8 +489,19 @@ class _Request:
                             rid=self.rid)
             if self.admitted_at:
                 t_ready = self.ready_at or now
+                # Reuse attribution rides the prefill span (ISSUE 14): a
+                # traced request's timeline says how much of its prompt
+                # the prefix cache already held and what that skip was
+                # worth — beside the span whose wall it shortened.
+                attrs = {"prompt_tokens": len(self.ids)}
+                if self.prefix_digest:
+                    attrs["prefix_digest"] = self.prefix_digest
+                    attrs["tokens_reused"] = self.tokens_reused
+                    attrs["tokens_prefilled"] = (
+                        len(self.ids) - self.tokens_reused
+                    )
                 tr.add_span("sched.prefill", self.admitted_at, t_ready,
-                            prompt_tokens=len(self.ids))
+                            **attrs)
             if self.ready_at:
                 tr.add_span("sched.decode", self.ready_at, now,
                             output_tokens=len(self.generated),
@@ -1000,6 +1096,65 @@ class ContinuousBatchingScheduler:
         self._prefix_seen: "OrderedDict[Tuple[int, ...], None]" = OrderedDict()
         self._prefix_hits = 0
         self._prefix_blocks_reused = 0
+        # --- Prefix-cache telemetry (ISSUE 14). Counters move as a group
+        # under _submit_lock (the PR-1 speculation-counter pattern) so
+        # /metrics scrapes and bench's pre/post delta bracketing never
+        # read a torn (hits, misses, reused_tokens) triple; the worker
+        # thread is the only writer.
+        self._prefix_misses = 0
+        self._prefix_evictions = 0
+        self._prefix_reinserts = 0
+        self._prefix_reused_tokens = 0
+        self._prefix_flops_saved = 0.0
+        self._prefix_s_saved = 0.0
+        # Hit-rate EWMA over admissions (1.0 hit / 0.0 miss, alpha 0.2):
+        # the live per-replica routing signal replica_loads() exports —
+        # a ratio of lifetime counters would take hours to reflect a
+        # cold cache after a restart.
+        self._prefix_hit_ewma: Optional[float] = None
+        # Content-addressed registry: per-entry live metadata keyed by
+        # the same chain keys as the caches (digest, token length, hit
+        # count, insert/last-hit round). Pages/bytes/refcounts are read
+        # off the live structures at registry() time, never duplicated.
+        self._prefix_meta: Dict[Tuple[int, ...], Dict[str, object]] = {}
+        # Eviction-churn ghost: keys evicted from the cache, bounded like
+        # _prefix_seen — a publish that finds its key here is a
+        # REINSERTION (the cache was too small for the working set), the
+        # churn signal the ring-size knob acts on.
+        self._prefix_evicted_ghost: "OrderedDict[Tuple[int, ...], None]" = (
+            OrderedDict()
+        )
+        # Reuse-distance ring: the last N admissions' schema-prefix
+        # digests. distance = admissions since the same digest last
+        # appeared, computed O(1) off a digest -> admission-seq map
+        # (bounded: stale entries older than the ring window are swept
+        # when the map doubles — a linear deque scan was the measured
+        # hog of the admission stamp). Histogram buckets are powers of
+        # two plus an overflow arm ("inf" = first sighting inside the
+        # ring window).
+        self._prefix_ring_cap = _prefix_bound(
+            _PREFIX_RING, "LSOT_PREFIX_RING", 256)
+        self._prefix_topk = _prefix_bound(
+            _PREFIX_TOPK, "LSOT_PREFIX_TOPK", 32)
+        self._prefix_adm_seq = 0
+        self._prefix_ring_seq: Dict[str, int] = {}
+        self._prefix_rd_buckets = _rd_buckets(self._prefix_ring_cap)
+        self._prefix_rd_hist: Dict[str, int] = {}
+        # Digest memo (chain key -> digest), LRU-bounded: packing a
+        # Python token list into hashable bytes is the measured hog of
+        # the admission stamp (~6µs/256 tokens), and steady-state traffic
+        # repeats the SAME schema prefix — so the hot path is a tuple +
+        # dict probe, and blake2b runs once per distinct prefix.
+        self._prefix_digest_memo: "OrderedDict[Tuple[int, ...], str]" = (
+            OrderedDict()
+        )
+        # Per-round reuse attribution, flushed into the flight record at
+        # the next harvest ({rid, digest, reused, prefilled} per admitted
+        # request that went through the prefix-match path).
+        self._round_prefix: List[Dict[str, object]] = []
+        # Contiguous block bytes (one cache entry's device footprint),
+        # filled lazily from the first published entry.
+        self._prefix_block_bytes = 0
         # Contiguous mode materializes prefix blocks by device copy; paged
         # mode shares pool pages by refcount instead and never needs the
         # slice/restore copies.
@@ -1236,7 +1391,8 @@ class ContinuousBatchingScheduler:
         under pressure: cached prefixes are a perf win funded by SPARE
         pages, never a reason to make a live request wait."""
         while not self._page_alloc.can_alloc(n) and self._prefix_pages:
-            _, pages = self._prefix_pages.popitem(last=False)
+            key, pages = self._prefix_pages.popitem(last=False)
+            self._prefix_note_evict(key, pages=pages)
             self._page_alloc.release(list(pages))
         return self._page_alloc.alloc(n)
 
@@ -1255,7 +1411,9 @@ class ContinuousBatchingScheduler:
         copy: un-publishing makes the page exclusive again, so the write
         can proceed in place without ever touching shared content)."""
         for key in [k for k, v in self._prefix_pages.items() if page in v]:
-            self._page_alloc.release(list(self._prefix_pages.pop(key)))
+            pages = self._prefix_pages.pop(key)
+            self._prefix_note_evict(key, pages=pages)
+            self._page_alloc.release(list(pages))
 
     def _ensure_writable(self, slot: int, start_tok: int, end_tok: int) -> None:
         """Copy-on-write sweep before writing cache positions
@@ -1351,7 +1509,8 @@ class ContinuousBatchingScheduler:
         evicted = 0
         while self._prefix_pages and \
                 self._page_alloc.pages_available < self._wm_high_pages:
-            _, pages = self._prefix_pages.popitem(last=False)
+            key, pages = self._prefix_pages.popitem(last=False)
+            self._prefix_note_evict(key, pages=pages)
             self._page_alloc.release(list(pages))
             evicted += 1
         if evicted:
@@ -3045,17 +3204,331 @@ class ContinuousBatchingScheduler:
                 self._gen_ewma = (g if prev_g is None
                                   else 0.2 * g + 0.8 * prev_g)
 
-    @property
-    def prefix_stats(self) -> Dict[str, int]:
-        """Prefix-cache observability: requests that reused any blocks, total
-        blocks reused (each one is a skipped pblock-token prefill), and the
-        current LRU size (paged mode: entries are zero-copy page
-        references; page_stats carries the sharing counters)."""
+    # ------------------------------------- prefix-cache telemetry (ISSUE 14)
+
+    def _digest_for(self, key: Tuple[int, ...]) -> str:
+        """Memoized content digest of a chain key (worker thread only;
+        see _prefix_digest_memo for why)."""
+        memo = self._prefix_digest_memo
+        d = memo.get(key)
+        if d is None:
+            d = prefix_digest(key)
+            memo[key] = d
+            bound = 4 * max(self._prefix_topk,
+                            self._prefix_cache_blocks or 1)
+            while len(memo) > bound:
+                memo.popitem(last=False)
+        else:
+            memo.move_to_end(key)
+        return d
+
+    def _prefix_note_publish(self, key: Tuple[int, ...]) -> None:
+        """Register a freshly published cache entry: content digest +
+        live metadata, and the eviction-churn check — a key coming back
+        through publish while still on the evicted ghost means the cache
+        was too small for the working set (reinsertion, the signal the
+        capacity knob acts on). Worker thread only; the lock is for
+        registry/metrics readers."""
+        digest = self._digest_for(key)
+        with self._submit_lock:
+            if key in self._prefix_evicted_ghost:
+                del self._prefix_evicted_ghost[key]
+                self._prefix_reinserts += 1
+            self._prefix_meta[key] = {
+                "digest": digest,
+                "tokens": len(key),
+                "hits": 0,
+                "insert_round": self.heartbeat.rounds,
+                "last_hit_round": None,
+            }
+
+    def _prefix_note_evict(self, key: Tuple[int, ...],
+                           pages: Optional[Tuple[int, ...]] = None) -> None:
+        """Entry left the cache (capacity cap, allocation pressure,
+        watermark sweep, or COW un-publish): count it, drop its registry
+        metadata, remember the key on the churn ghost, and release the
+        allocator's per-page resident-prefix accounting."""
+        if pages is not None:
+            self._page_alloc.prefix_drop(list(pages))
+        with self._submit_lock:
+            self._prefix_evictions += 1
+            self._prefix_meta.pop(key, None)
+            self._prefix_evicted_ghost[key] = None
+            while len(self._prefix_evicted_ghost) > \
+                    4 * self._prefix_cache_blocks:
+                self._prefix_evicted_ghost.popitem(last=False)
+
+    def _prefix_note_admission(self, req: _Request, ids: Sequence[int],
+                               reuse: int, blocks: int) -> None:
+        """Per-request reuse attribution, at the one instant admission
+        knows both the request and the match: stamp the request (digest,
+        tokens_reused, analytic prefill seconds saved), move the
+        hit/miss counter group under the scheduler lock, feed the
+        reuse-distance ring, and queue the {rid, digest, reused,
+        prefilled} row for the next flight record. `reuse` is in tokens
+        (always a whole number of pblock blocks), `blocks` = reuse //
+        pblock."""
+        pb = self._pblock
+        max_blocks = (len(ids) - 1) // pb
+        hit = reuse > 0
+        # HIT: the digest is the MATCHED chain entry's (ids[:reuse]) —
+        # joinable against /debug/prefixcache and the resident-digest
+        # sets, and stable across requests whose tails differ. MISS: the
+        # longest whole-block prompt prefix is the best schema-identity
+        # guess available (there is no match to name); once the prefix
+        # publishes and hits, later admissions converge on the matched
+        # digest, so the reuse-distance ring sees the recurrence.
+        if hit:
+            digest = self._digest_for(tuple(ids[:reuse]))
+        elif max_blocks:
+            digest = self._digest_for(tuple(ids[: max_blocks * pb]))
+        else:
+            digest = ""
+        flops = secs = 0.0
+        if hit:
+            flops, secs = self.perf.prefill_saved(reuse)
+        req.prefix_digest = digest
+        req.tokens_reused = reuse
+        req.prefill_s_saved = secs
+        # Reuse distance BEFORE this admission joins the ring: admissions
+        # since the same schema-prefix digest last appeared, from the
+        # O(1) digest -> seq map; a sighting older than the ring window
+        # counts as absent (the "inf" histogram arm).
+        bucket = None
+        if digest:
+            seq = self._prefix_adm_seq
+            last = self._prefix_ring_seq.get(digest)
+            dist = (seq - last
+                    if last is not None
+                    and seq - last <= self._prefix_ring_cap else None)
+            bucket = "inf"
+            if dist is not None:
+                # dist <= ring cap by the window check above, and the
+                # bucket list tops out AT the ring cap — next() always
+                # finds an arm, however wide the ring is configured.
+                bucket = str(next(b for b in self._prefix_rd_buckets
+                                  if dist <= b))
+        with self._submit_lock:
+            if hit:
+                self._prefix_hits += 1
+                self._prefix_blocks_reused += blocks
+                self._prefix_reused_tokens += reuse
+                self._prefix_flops_saved += flops
+                self._prefix_s_saved += secs
+                meta = self._prefix_meta.get(tuple(ids[:reuse]))
+                if meta is not None:
+                    meta["hits"] += 1
+                    meta["last_hit_round"] = self.heartbeat.rounds
+            elif digest:
+                # CACHEABLE admissions only: a prompt shorter than one
+                # block (digest == "") can never hit, and counting it as
+                # a miss would deflate hit_rate / the EWMA routing signal
+                # on short-query traffic the cache was never for.
+                self._prefix_misses += 1
+            if digest:
+                x = 1.0 if hit else 0.0
+                prev = self._prefix_hit_ewma
+                self._prefix_hit_ewma = (x if prev is None
+                                         else 0.2 * x + 0.8 * prev)
+            if bucket is not None:
+                self._prefix_rd_hist[bucket] = \
+                    self._prefix_rd_hist.get(bucket, 0) + 1
+                self._prefix_ring_seq[digest] = self._prefix_adm_seq
+                self._prefix_adm_seq += 1
+                if len(self._prefix_ring_seq) > 2 * self._prefix_ring_cap:
+                    # Amortized sweep of sightings older than the window.
+                    cutoff = self._prefix_adm_seq - self._prefix_ring_cap
+                    self._prefix_ring_seq = {
+                        d: s for d, s in self._prefix_ring_seq.items()
+                        if s >= cutoff
+                    }
+        if digest:
+            self._round_prefix.append({
+                "rid": req.rid,
+                "digest": digest,
+                "reused": reuse,
+                "prefilled": len(ids) - reuse,
+            })
+
+    def _prefix_snapshot(self) -> Dict[str, object]:
+        """ONE-acquisition copy of the whole telemetry counter group (the
+        PR-1 speculation-counter pattern, widened): every field a reader
+        pairs — hits/misses/reused beside the priced savings and the
+        EWMA — comes from the same instant, so /metrics scrapes and
+        bench's pre/post delta bracketing can never see a hits delta
+        inconsistent with its prefill_s_saved delta."""
+        with self._submit_lock:
+            return {
+                "hits": self._prefix_hits,
+                "misses": self._prefix_misses,
+                "blocks_reused": self._prefix_blocks_reused,
+                "reused_tokens": self._prefix_reused_tokens,
+                "evictions": self._prefix_evictions,
+                "reinserts": self._prefix_reinserts,
+                "flops_saved": self._prefix_flops_saved,
+                "s_saved": self._prefix_s_saved,
+                "hit_ewma": self._prefix_hit_ewma,
+                "resident_entries": len(self._prefix_meta),
+            }
+
+    @staticmethod
+    def _prefix_stats_from(snap: Dict[str, object]) -> Dict[str, object]:
+        total = int(snap["hits"]) + int(snap["misses"])
         return {
-            "hits": self._prefix_hits,
-            "blocks_reused": self._prefix_blocks_reused,
+            "hits": snap["hits"],
+            "misses": snap["misses"],
+            "hit_rate": (round(int(snap["hits"]) / total, 4) if total
+                         else 0.0),
+            "blocks_reused": snap["blocks_reused"],
+            "reused_tokens": snap["reused_tokens"],
+            "evictions": snap["evictions"],
+        }
+
+    @property
+    def prefix_stats(self) -> Dict[str, object]:
+        """Prefix-cache observability: requests that reused any blocks vs
+        requests the match path came up empty for (`hit_rate` =
+        hits/(hits+misses)), total blocks and TOKENS reused (each block
+        is a skipped pblock-token prefill), entries evicted, and the
+        current LRU size (paged mode: entries are zero-copy page
+        references; page_stats carries the sharing counters). The counter
+        group is copied under the scheduler lock in ONE acquisition so a
+        /metrics scrape or bench's pre/post delta bracketing never
+        observes a torn (hits, blocks_reused) pair."""
+        return {
+            **self._prefix_stats_from(self._prefix_snapshot()),
             "cached_blocks": (len(self._prefix_pages) if self._paged
                               else len(self._prefix_cache)),
+        }
+
+    @property
+    def prefix_telemetry(self) -> Optional[Dict[str, object]]:
+        """The `serving.prefix` /metrics block (ISSUE 14): the counter
+        group plus churn, the live hit-rate EWMA, the priced value of the
+        hits (analytic prefill FLOPs/seconds saved —
+        utils/perfmodel.prefill_saved), and what the cache currently
+        HOLDS (entries / tokens / device bytes; paged residency comes
+        from the allocator's unique-page accounting, so chained entries
+        are not double-counted). None when the cache is off
+        (prefix_cache_blocks=0 — including speculative schedulers, which
+        disable reuse by design). The whole block derives from ONE locked
+        snapshot, so no field pairs across a concurrent admission."""
+        if not self._prefix_cache_blocks:
+            return None
+        snap = self._prefix_snapshot()
+        st = self._prefix_stats_from(snap)
+        st["cached_blocks"] = (len(self._prefix_pages) if self._paged
+                               else len(self._prefix_cache))
+        reinserts = snap["reinserts"]
+        flops = float(snap["flops_saved"])
+        secs = float(snap["s_saved"])
+        ewma = snap["hit_ewma"]
+        entries = int(snap["resident_entries"])
+        # Residency counts what the cache HOLDS, deduped: chained entries
+        # overlap on their leading pages, so paged tokens/bytes come from
+        # the allocator's unique-page accounting; a contiguous entry
+        # holds exactly ONE pblock-token block regardless of its chain
+        # key's length (summing per-entry chain lengths would overstate
+        # residency ~2x on deep chains).
+        if self._paged:
+            resident_pages = self._page_alloc.prefix_resident_pages
+            tokens = resident_pages * self._page_size
+            resident_bytes = resident_pages * page_bytes(
+                self.cfg, self._page_size, self._dtype.itemsize,
+                self.kv_quant,
+            )
+        else:
+            tokens = entries * self._pblock
+            resident_bytes = entries * self._prefix_block_bytes
+        return {
+            "replica": self.flight.replica,
+            **st,
+            "reinserts": reinserts,
+            "hit_rate_ewma": round(ewma, 4) if ewma is not None else 0.0,
+            "prefill_flops_saved": round(flops, 1),
+            "prefill_s_saved": round(secs, 6),
+            "resident_entries": entries,
+            "resident_tokens": tokens,
+            "resident_bytes": resident_bytes,
+        }
+
+    def resident_digests(self, limit: Optional[int] = None) -> List[str]:
+        """Hottest-K resident prefix digests (by hit count, then token
+        mass): the bounded per-replica residency set `replica_loads()`
+        exports and `SchedulerPool.prefix_affinity` matches a request's
+        chain digests against — the cache-aware routing feed the
+        multi-host ROADMAP item consumes."""
+        k = limit if limit and limit > 0 else self._prefix_topk
+        with self._submit_lock:
+            metas = sorted(
+                self._prefix_meta.values(),
+                key=lambda m: (int(m["hits"]), int(m["tokens"])),
+                reverse=True,
+            )[:k]
+        return [str(m["digest"]) for m in metas]
+
+    def prefix_registry(self, top_k: Optional[int] = None
+                        ) -> Dict[str, object]:
+        """The /debug/prefixcache payload for this replica: top-K
+        resident entries by token mass (digest, token length, pages/
+        blocks + device bytes held, live share refcount, hit count,
+        insert/last-hit round), the reuse-distance histogram over the
+        bounded admission ring, and the eviction-churn counters. Bounded
+        by `top_k` (default LSOT_PREFIX_TOPK) so a huge cache never turns
+        a debug scrape into a token-list dump — entries carry digests,
+        never token ids."""
+        k = top_k if top_k and top_k > 0 else self._prefix_topk
+        tel = self.prefix_telemetry
+        # Snapshot metadata, page tuples AND refcounts under ONE lock
+        # acquisition: read piecemeal, an entry evicted mid-iteration
+        # could have its freed page reallocated to another slot, and the
+        # registry would report the unrelated slot's refcount as the
+        # entry's share count.
+        with self._submit_lock:
+            rd = dict(self._prefix_rd_hist)
+            metas = []
+            for key, m in self._prefix_meta.items():
+                pages = self._prefix_pages.get(key) if self._paged else None
+                shares = (self._page_alloc.refcount(pages[-1])
+                          if pages else None)
+                metas.append((m, pages, shares))
+        entries: List[Dict[str, object]] = []
+        for m, pages, shares in metas:
+            e: Dict[str, object] = {
+                "digest": m["digest"],
+                "tokens": m["tokens"],
+                "hits": m["hits"],
+                "insert_round": m["insert_round"],
+                "last_hit_round": m["last_hit_round"],
+            }
+            if self._paged:
+                if pages is None:
+                    continue  # evicted between its meta pop and page pop
+                e["pages"] = len(pages)
+                e["bytes"] = len(pages) * page_bytes(
+                    self.cfg, self._page_size, self._dtype.itemsize,
+                    self.kv_quant,
+                )
+                # How many owners the chain's DEEPEST page had at the
+                # snapshot (1 = resident but unmapped by any slot).
+                e["shares"] = shares
+            else:
+                e["blocks"] = 1
+                e["bytes"] = self._prefix_block_bytes
+            entries.append(e)
+        entries.sort(key=lambda e: (int(e["tokens"]), int(e["hits"])),
+                     reverse=True)
+        return {
+            "replica": self.flight.replica,
+            "enabled": bool(self._prefix_cache_blocks),
+            "block_tokens": self._pblock,
+            "capacity": self._prefix_cache_blocks,
+            "ring": self._prefix_ring_cap,
+            "top_k": k,
+            "entries": entries[:k],
+            "reuse_distance": rd,
+            **({k2: v for k2, v in tel.items() if k2 != "replica"}
+               if tel else {}),
         }
 
     @property
@@ -3196,12 +3669,15 @@ class ContinuousBatchingScheduler:
         req.page_end = need_end
         if reuse:
             req.prefilled = reuse
-            self._prefix_hits += 1
-            self._prefix_blocks_reused += n
             for j in range(n):  # LRU touch along the matched chain
                 key = tuple(ids[: (j + 1) * pb])
                 if key in self._prefix_pages:
                     self._prefix_pages.move_to_end(key)
+        if self._prefix_cache_blocks and req.spilled is None:
+            # Reuse attribution at the one instant admission knows both
+            # the request and the match (counters move inside, under the
+            # scheduler lock — ISSUE 14).
+            self._prefix_note_admission(req, ids, reuse, n)
         return True
 
     def _admit(self, slot: int, req: _Request) -> bool:
@@ -3284,8 +3760,7 @@ class ContinuousBatchingScheduler:
                 )
             if n:
                 req.prefilled = n * pb
-                self._prefix_hits += 1
-                self._prefix_blocks_reused += n
+            self._prefix_note_admission(req, req.ids, n * pb, n)
         self._prefill_q.append((slot, req))
         return True
 
@@ -3516,11 +3991,18 @@ class ContinuousBatchingScheduler:
                 while len(self._prefix_seen) > 4 * self._prefix_cache_blocks:
                     self._prefix_seen.popitem(last=False)
                 continue
-            self._prefix_cache[key] = self._slice_block_fn(
+            entry = self._slice_block_fn(
                 *self._cache, jnp.int32(slot), jnp.int32(b0 * pb)
             )
+            self._prefix_cache[key] = entry
+            if not self._prefix_block_bytes:
+                # One block's device footprint (constant per scheduler):
+                # the registry's contiguous resident-bytes unit.
+                self._prefix_block_bytes = sum(int(b.nbytes) for b in entry)
+            self._prefix_note_publish(key)
             while len(self._prefix_cache) > self._prefix_cache_blocks:
-                self._prefix_cache.popitem(last=False)
+                old_key, _ = self._prefix_cache.popitem(last=False)
+                self._prefix_note_evict(old_key)
 
     def _publish_blocks_paged(self, slot: int, req: _Request,
                               chunk_start: int) -> None:
@@ -3547,9 +4029,12 @@ class ContinuousBatchingScheduler:
                 self._slot_pages[slot][: pages_for_tokens(covered, ps)]
             )
             self._page_alloc.share(list(pages))
+            self._page_alloc.prefix_hold(list(pages))
             self._prefix_pages[key] = pages
+            self._prefix_note_publish(key)
             while len(self._prefix_pages) > self._prefix_cache_blocks:
-                _, old = self._prefix_pages.popitem(last=False)
+                old_key, old = self._prefix_pages.popitem(last=False)
+                self._prefix_note_evict(old_key, pages=old)
                 self._page_alloc.release(list(old))
 
     def _issue_decode(self) -> None:
@@ -3881,6 +4366,14 @@ class ContinuousBatchingScheduler:
         }
         if n_emit is not None:
             rec["spec_emitted"] = spec_emitted
+        if self._round_prefix:
+            # Per-request reuse attribution for admissions since the last
+            # record (ISSUE 14): {rid, digest, reused, prefilled} per
+            # admitted request with at least one full prompt block —
+            # present only on rounds that admitted such requests, so
+            # records elsewhere stay byte-identical to pre-telemetry.
+            rec["prefix_reuse"] = self._round_prefix
+            self._round_prefix = []
         # Roofline ledger columns (ISSUE 12): this round's achieved MFU /
         # HBM-bandwidth utilization / binding-roof verdict from the shared
         # analytic model — computed from the ROUNDED wall that lands in
@@ -4557,6 +5050,26 @@ class SchedulerPool:
                     pstats["watermark_low_pages"]
                 rec["kv_watermark_high_pages"] = \
                     pstats["watermark_high_pages"]
+            # Prefix-cache residency feed (ISSUE 14): the replica's live
+            # hit-rate EWMA (a numeric gauge under the shared r{i} label
+            # vocabulary) and its hottest-K resident digest set (JSON
+            # only — strings never become Prometheus samples). This is
+            # the per-replica half of the cache-aware routing feed;
+            # prefix_affinity() is the lookup over it.
+            ptel = getattr(s, "prefix_telemetry", None)
+            if isinstance(ptel, dict):
+                rec["prefix_hit_rate"] = ptel.get("hit_rate_ewma", 0.0)
+                rec["prefix_resident_entries"] = \
+                    ptel.get("resident_entries", 0)
+            digs = getattr(s, "resident_digests", None)
+            if callable(digs):
+                try:
+                    # No explicit limit: the replica's own configured
+                    # top-K bound applies, so this export and
+                    # prefix_affinity() see the SAME resident set.
+                    rec["resident_digests"] = digs()
+                except Exception:  # noqa: BLE001 — a dying replica mid-read
+                    pass
             # Disaggregation (ISSUE 13): which phase this replica serves
             # and its handoff traffic — the router's placement feed and
             # the per-replica lsot_serving_* gauges.
@@ -5326,17 +5839,94 @@ class SchedulerPool:
         }
 
     @property
-    def prefix_stats(self) -> Dict[str, int]:
+    def prefix_stats(self) -> Dict[str, object]:
         """Summed prefix-cache stats across replicas (SchedulerBackend
-        duck typing — each replica owns an independent cache)."""
-        out: Dict[str, int] = {"hits": 0, "blocks_reused": 0,
-                               "cached_blocks": 0}
+        duck typing — each replica owns an independent cache). Counters
+        sum; `hit_rate` is DERIVED from the summed hits/misses — summing
+        or averaging per-replica ratios would misweight replicas with
+        different traffic shares."""
+        out: Dict[str, object] = {
+            "hits": 0, "misses": 0, "blocks_reused": 0,
+            "reused_tokens": 0, "evictions": 0, "cached_blocks": 0,
+        }
         for s in self.schedulers:
             st = getattr(s, "prefix_stats", None)
             if isinstance(st, dict):
                 for k in out:
                     out[k] += int(st.get(k, 0))
+        total = out["hits"] + out["misses"]
+        out["hit_rate"] = (round(out["hits"] / total, 4) if total
+                           else 0.0)
         return out
+
+    @property
+    def prefix_telemetry(self) -> Optional[Dict[str, object]]:
+        """Per-replica prefix-cache telemetry, labeled (the serving.prefix
+        payload the lsot_prefix_* Prometheus families render). None when
+        no replica has an enabled cache."""
+        per = []
+        for st, s in self._replica_items():
+            t = getattr(s, "prefix_telemetry", None)
+            if isinstance(t, dict):
+                rec = dict(t)
+                rec["replica"] = st.label
+                per.append(rec)
+        return {"replicas": per} if per else None
+
+    def prefix_registry(self, top_k: Optional[int] = None
+                        ) -> Dict[str, object]:
+        """Per-replica content-addressed registries (the
+        /debug/prefixcache payload for a fleet), labeled with the pool's
+        replica vocabulary."""
+        per = []
+        for st, s in self._replica_items():
+            fn = getattr(s, "prefix_registry", None)
+            if not callable(fn):
+                continue
+            try:
+                reg = fn(top_k)
+            except Exception:  # noqa: BLE001 — a dying replica mid-read
+                continue
+            if isinstance(reg, dict):
+                reg = dict(reg)
+                reg["replica"] = st.label
+                per.append(reg)
+        return {"replicas": per}
+
+    def prefix_affinity(self, digests: Sequence[str]
+                        ) -> List[Dict[str, object]]:
+        """Cache-aware routing feed (ISSUE 14): score every placeable
+        replica by how many of `digests` (a request's chain-prefix
+        digests — `prefix_chain_digests(ids, block)`) it currently holds
+        resident. Returns [{replica, score}] sorted best-first, scoring
+        replicas only (no score-0 noise); empty when nobody holds any.
+        Landed here as OBSERVABILITY: the placement decision itself stays
+        with the multi-host routing item — submit() does not consume this
+        yet. Each non-empty lookup drops a `prefix_affinity` event into
+        the pool flight ring so placement postmortems can see what the
+        router WOULD have known."""
+        want = {d for d in digests if d}
+        if not want:
+            return []
+        scored: List[Dict[str, object]] = []
+        for _i, st, s in self._placeable():
+            fn = getattr(s, "resident_digests", None)
+            if not callable(fn):
+                continue
+            try:
+                score = len(want & set(fn()))
+            except Exception:  # noqa: BLE001 — a dying replica mid-read
+                continue
+            if score:
+                scored.append({"replica": st.label, "score": score})
+        scored.sort(key=lambda r: -int(r["score"]))
+        if scored:
+            self._pool_flight.event(
+                "prefix_affinity", best=scored[0]["replica"],
+                score=scored[0]["score"], digests=len(want),
+                holders=len(scored),
+            )
+        return scored
 
     @property
     def speculation_stats(self) -> Optional[Dict[str, float]]:
@@ -5439,6 +6029,14 @@ class SchedulerBackend:
         (when supervised) the crash-recovery lifecycle — merged into the
         app's /metrics payload per model."""
         out: Dict[str, object] = {"prefix_cache": self.scheduler.prefix_stats}
+        # Prefix-cache telemetry (ISSUE 14): the per-replica counter/
+        # residency/priced-savings block the lsot_prefix_* Prometheus
+        # families render — beside (not replacing) the flat prefix_cache
+        # sums above, whose lsot_serving_prefix_cache_* gauges dashboards
+        # already scrape.
+        ptel = getattr(self.scheduler, "prefix_telemetry", None)
+        if ptel:
+            out["prefix"] = ptel
         spec = self.scheduler.speculation_stats
         if spec is not None:
             out["speculation"] = spec
@@ -5716,6 +6314,16 @@ class SchedulerBackend:
         """Live flight-recorder view (per-round records; pool-merged and
         replica-labeled for dp>1) — the /debug/flightrecorder payload."""
         return merge_snapshots([self.scheduler], last)
+
+    def prefix_registry(self, top_k: Optional[int] = None
+                        ) -> Optional[Dict[str, object]]:
+        """Content-addressed prefix-cache registry (ISSUE 14) — the
+        /debug/prefixcache payload: top-K resident digests with live
+        metadata, reuse-distance histogram, churn counters; pool-shaped
+        ({"replicas": [...]}) for fleets. None for schedulers without
+        the seam (duck-typed fakes)."""
+        fn = getattr(self.scheduler, "prefix_registry", None)
+        return fn(top_k) if callable(fn) else None
 
     def profile_rounds(self, rounds: Optional[int] = None,
                        out_dir: Optional[str] = None) -> Dict[str, object]:

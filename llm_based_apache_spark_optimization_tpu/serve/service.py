@@ -176,6 +176,32 @@ class GenerationService:
         """Last head-sampled request traces (the /debug/traces payload)."""
         return TRACER.recent(n)
 
+    def prefix_registry(self, top_k: Optional[int] = None) -> Dict[str, Dict]:
+        """Per-model content-addressed prefix-cache registries (ISSUE 14)
+        — the /debug/prefixcache payload: resident digests with live
+        metadata (token mass, bytes held, shares, hit counts), the
+        reuse-distance histogram over recent admissions, and the
+        eviction-churn counters. Deduped by underlying scheduler like
+        flight_snapshot(), so a shared scheduler's registry is not
+        reported twice; backends without the seam (fakes, engines) are
+        skipped."""
+        out: Dict[str, Dict] = {}
+        with self._lock:
+            entries = list(self._models.values())
+        seen = set()
+        for e in entries:
+            fn = getattr(e.backend, "prefix_registry", None)
+            if not callable(fn):
+                continue
+            key = id(getattr(e.backend, "scheduler", e.backend))
+            if key in seen:
+                continue
+            seen.add(key)
+            reg = fn(top_k)
+            if reg:
+                out[e.name] = reg
+        return out
+
     def slo_report(self) -> Dict[str, object]:
         """The /debug/slo payload: the process SLO engine's rolling
         report (objectives, per-replica quantiles + burn rates, fleet

@@ -426,6 +426,28 @@ class SupervisedScheduler:
         return getattr(self._inner, "speculation_stats", None)
 
     @property
+    def prefix_telemetry(self):
+        """Prefix-cache telemetry passthrough (ISSUE 14): the
+        serving.prefix block and the lsot_prefix_* families survive
+        supervision (None for duck-typed inners / disabled caches)."""
+        return getattr(self._inner, "prefix_telemetry", None)
+
+    def prefix_registry(self, top_k=None):
+        """Content-addressed prefix registry passthrough — the
+        /debug/prefixcache payload survives supervision."""
+        fn = getattr(self._inner, "prefix_registry", None)
+        return fn(top_k) if callable(fn) else None
+
+    def resident_digests(self, limit=None):
+        fn = getattr(self._inner, "resident_digests", None)
+        return fn(limit) if callable(fn) else []
+
+    def prefix_affinity(self, digests):
+        """Cache-aware routing feed passthrough (inner SchedulerPool)."""
+        fn = getattr(self._inner, "prefix_affinity", None)
+        return fn(digests) if callable(fn) else []
+
+    @property
     def page_stats(self):
         """Paged-KV pool stats passthrough (None for contiguous inner
         schedulers) — the /metrics kv_pages gauges survive supervision."""

@@ -312,6 +312,20 @@ class PerfModel:
             "bound": verdict(mfu, util),
         }
 
+    def prefill_saved(self, tokens: int) -> Tuple[float, float]:
+        """(FLOPs, seconds) a prefix-cache hit of `tokens` reused tokens
+        SAVED: the one-row prefill forward those tokens would have cost
+        (average attention context tokens/2 — the from-zero prefill
+        convention shared with `prefill_flops`), priced at whichever roof
+        binds that forward. Pure float math — the admission path stamps
+        it per hit inside the same <1%-of-cadence budget the flight
+        record rides (ISSUE 14)."""
+        if tokens <= 0:
+            return 0.0, 0.0
+        flops, hbm = self.phase_work("prefill", rows=1, tokens=tokens,
+                                     ctx=tokens // 2)
+        return flops, max(flops / self.peak_flops, hbm / self.peak_bw)
+
     # ------------------------------------------------------------- ledger
 
     def note_prefill(self, *, rows: int, tokens: int, ctx: int) -> None:

@@ -206,6 +206,44 @@ def _emit_handoff(emit: _Emitter, model: str, ho: Dict) -> None:
                 emit.add(name, labels, n, mtype)
 
 
+def _emit_prefix(emit: _Emitter, model: str, pv: Dict) -> None:
+    """The prefix-cache telemetry families (ISSUE 14): `serving.prefix`
+    becomes lsot_prefix_* counters/gauges labeled model × replica —
+    hits/misses/evictions/ghost-reinsertions, reused tokens, the priced
+    prefill seconds the hits saved, the live hit-rate EWMA, and what the
+    cache currently holds (entries / tokens / device bytes). Accepts one
+    replica's block or a pool's ({"replicas": [...]})."""
+    stats = pv.get("replicas") if isinstance(pv.get("replicas"),
+                                             list) else [pv]
+    for rec in stats:
+        if not isinstance(rec, dict):
+            continue
+        labels = {"model": model,
+                  "replica": str(rec.get("replica") or "r0")}
+        for key, name, mtype in (
+                ("hits", "lsot_prefix_hits_total", "counter"),
+                ("misses", "lsot_prefix_misses_total", "counter"),
+                ("evictions", "lsot_prefix_evictions_total", "counter"),
+                ("reinserts", "lsot_prefix_reinserts_total", "counter"),
+                ("reused_tokens", "lsot_prefix_reused_tokens_total",
+                 "counter"),
+                ("blocks_reused", "lsot_prefix_blocks_reused_total",
+                 "counter"),
+                ("prefill_s_saved",
+                 "lsot_prefix_saved_prefill_seconds_total", "counter"),
+                ("hit_rate", "lsot_prefix_hit_rate", "gauge"),
+                ("hit_rate_ewma", "lsot_prefix_hit_rate_ewma", "gauge"),
+                ("resident_entries", "lsot_prefix_resident_entries",
+                 "gauge"),
+                ("resident_tokens", "lsot_prefix_resident_tokens",
+                 "gauge"),
+                ("resident_bytes", "lsot_prefix_resident_bytes", "gauge"),
+        ):
+            n = _num(rec.get(key))
+            if n is not None:
+                emit.add(name, labels, n, mtype)
+
+
 def _emit_slo(emit: _Emitter, slo: Dict) -> None:
     """The rolling-SLO families (ISSUE 12): per-replica + fleet quantile
     gauges, bad-fraction/burn-rate gauges per window arm, and the 0/1
@@ -275,6 +313,15 @@ def render_prometheus(snapshot: Dict,
             ho = serving.pop("handoff", None)
             if isinstance(ho, dict):
                 _emit_handoff(emit, model, ho)
+            # Prefix-cache telemetry renders as first-class
+            # model × replica families (not path-flattened gauges) so
+            # dashboards join lsot_prefix_* on the same label vocabulary
+            # as lsot_mfu / the latency histograms. The flat
+            # serving.prefix_cache sums keep their historical
+            # lsot_serving_prefix_cache_* names below.
+            pv = serving.pop("prefix", None)
+            if isinstance(pv, dict):
+                _emit_prefix(emit, model, pv)
             _flatten_serving(emit, model, "lsot_serving", serving)
     if resilience:
         breakers = resilience.get("breakers") or {}

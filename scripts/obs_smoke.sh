@@ -20,7 +20,11 @@
 #      and the lsot_slo_* / lsot_mfu Prometheus families render;
 #   6. /debug/profile arms a bounded jax.profiler capture around the
 #      next scheduler rounds and finishes with a NON-EMPTY
-#      Perfetto-loadable artifact.
+#      Perfetto-loadable artifact;
+#   7. shared-schema-prefix traffic shows up in the ISSUE-14 prefix-cache
+#      telemetry: /debug/prefixcache serves a content-addressed registry
+#      with resident entries and a hit, and the lsot_prefix_* Prometheus
+#      families render.
 #
 # The default test lane runs the same flow in-process
 # (tests/test_obs_smoke.py, not marked slow); this script is the focused
@@ -162,6 +166,35 @@ assert last["state"] == "done", last
 assert last["artifacts"] and last["artifact_bytes"] > 0, last
 print(f"obs_smoke: device profile OK ({len(last['artifacts'])} "
       f"artifact(s), {last['artifact_bytes']} bytes)")
+
+# 7. prefix-cache telemetry (ISSUE 14): three requests sharing one
+# schema prefix — seen on 1, published on 2, HIT on 3 (the publish
+# gate) — then the registry and the lsot_prefix_* families.
+schema = ("CREATE TABLE taxi (trip_id INT, fare REAL, tip REAL, "
+          "dist REAL); -- ")
+for i in range(3):
+    post("/api/generate",
+         {"model": "duckdb-nsql", "prompt": schema + f"q{i}"})
+status, body = get("/debug/prefixcache")
+assert status == 200
+reg = json.loads(body)["models"]
+assert "duckdb-nsql" in reg, f"no registry: {list(reg)}"
+r = reg["duckdb-nsql"]
+entries = (r.get("entries")
+           or [e for rep in r.get("replicas", [])
+               for e in rep.get("entries", [])])
+assert entries, f"registry empty: {r}"
+assert all({"digest", "tokens", "hits"} <= set(e) for e in entries)
+hits = r.get("hits", sum(rep.get("hits", 0)
+                         for rep in r.get("replicas", [])))
+assert hits >= 1, f"no prefix hit recorded: {r}"
+status, text = get("/metrics?format=prometheus")
+assert status == 200
+assert "lsot_prefix_hits_total" in text, "lsot_prefix_* families missing"
+assert "lsot_prefix_resident_bytes" in text
+assert "lsot_prefix_reused_tokens_total" in text
+print(f"obs_smoke: prefix-cache telemetry OK ({len(entries)} resident "
+      f"entr{'y' if len(entries) == 1 else 'ies'}, {hits} hit(s))")
 
 server.shutdown()
 service.close()

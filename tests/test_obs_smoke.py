@@ -165,6 +165,96 @@ def test_debug_slo_route_shapes():
         slo.ENGINE = old
 
 
+def test_debug_prefixcache_route_shapes():
+    """Fakes have no prefix cache: the route serves an empty models map
+    (not an error), and a bad 'top' is a clean 400."""
+    svc, app = _fake_app()
+    client = app.test_client()
+    res = client.request("GET", "/debug/prefixcache")
+    assert res.status == 200
+    assert res.json() == {"models": {}}
+    bad = client.request("GET", "/debug/prefixcache", query="top=x")
+    assert bad.status == 400
+    # A negative K would flow into list slicing as a from-the-end slice
+    # (near-unbounded payload) — rejected at the route.
+    neg = client.request("GET", "/debug/prefixcache", query="top=-1")
+    assert neg.status == 400
+
+
+def test_debug_prefixcache_live_registry():
+    """ISSUE-14 twin of obs_smoke step 7: shared-schema-prefix traffic
+    through a real scheduler-backed app shows up in /debug/prefixcache
+    (content-addressed resident entries, a hit from the third request
+    on) and the lsot_prefix_* Prometheus families render."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.app.api import (
+        create_api_app,
+    )
+    from llm_based_apache_spark_optimization_tpu.app.config import AppConfig
+    from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        GenerationService,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerBackend,
+    )
+    from llm_based_apache_spark_optimization_tpu.sql import default_backend
+    from llm_based_apache_spark_optimization_tpu.tokenizer import (
+        ByteTokenizer,
+    )
+
+    cfg = dataclasses.replace(TINY, name="tiny-prefix", max_seq_len=2048)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=4, prompt_bucket=32, stop_ids=(-1,),
+    )
+    svc = GenerationService()
+    svc.register("duckdb-nsql",
+                 SchedulerBackend(sched, ByteTokenizer(), max_new_tokens=4))
+    app = create_api_app(svc, default_backend, SQLiteHistory(":memory:"),
+                         AppConfig(history_db=":memory:"))
+    client = app.test_client()
+    try:
+        schema = ("CREATE TABLE taxi (trip_id INT, fare REAL, tip REAL, "
+                  "dist REAL); -- ")
+        for i in range(3):  # seen -> published -> HIT (the publish gate)
+            res = client.post_json(
+                "/api/generate",
+                {"model": "duckdb-nsql", "prompt": schema + f"q{i}"})
+            assert res.status == 200, res.text
+        reg = client.request("GET", "/debug/prefixcache").json()["models"]
+        assert "duckdb-nsql" in reg, reg
+        r = reg["duckdb-nsql"]
+        assert r["enabled"] and r["entries"], r
+        assert all({"digest", "tokens", "hits", "bytes"} <= set(e)
+                   for e in r["entries"])
+        assert r["hits"] >= 1 and r["reused_tokens"] >= r["block_tokens"]
+        assert r["resident_bytes"] > 0
+        # top=1 bounds the payload without touching the summary counters.
+        top1 = client.request("GET", "/debug/prefixcache",
+                              query="top=1").json()["models"]["duckdb-nsql"]
+        assert len(top1["entries"]) == 1
+        assert top1["hits"] == r["hits"]
+        text = client.request("GET", "/metrics",
+                              query="format=prometheus").text
+        assert "lsot_prefix_hits_total" in text
+        assert "lsot_prefix_reused_tokens_total" in text
+        assert "lsot_prefix_resident_bytes" in text
+        assert 'lsot_prefix_hits_total{model="duckdb-nsql",replica="r0"}' \
+            in text
+    finally:
+        svc.close()
+
+
 def test_debug_profile_route_shapes():
     """Fakes cannot profile: arming is a clean 400, polling an empty
     captures map — the route contract without a scheduler."""

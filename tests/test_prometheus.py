@@ -192,6 +192,52 @@ def test_render_perf_gauges_phase_replica():
     assert reps == {"r0", "r1"}
 
 
+def test_render_prefix_families():
+    """ISSUE-14 golden: serving.prefix renders as lsot_prefix_* families
+    labeled model × replica — hits/misses/evictions/reinserts/reused
+    tokens/saved prefill seconds as counters, hit rate and residency as
+    gauges — not path-flattened serving gauges, for both the
+    single-replica and the pool ({"replicas": [...]}) payload shapes."""
+    pv_r0 = {
+        "replica": "r0", "hits": 6, "misses": 2, "hit_rate": 0.75,
+        "hit_rate_ewma": 0.8, "blocks_reused": 18, "reused_tokens": 288,
+        "evictions": 3, "reinserts": 1, "cached_blocks": 4,
+        "prefill_flops_saved": 1.0e9, "prefill_s_saved": 0.125,
+        "resident_entries": 4, "resident_tokens": 64,
+        "resident_bytes": 16384,
+    }
+    snap = {"m": {"requests": 1, "serving": {"prefix": pv_r0}}}
+    text = render_prometheus(snap)
+    types, samples = parse_exposition(text)
+    assert types["lsot_prefix_hits_total"] == "counter"
+    assert types["lsot_prefix_misses_total"] == "counter"
+    assert types["lsot_prefix_evictions_total"] == "counter"
+    assert types["lsot_prefix_reused_tokens_total"] == "counter"
+    assert types["lsot_prefix_saved_prefill_seconds_total"] == "counter"
+    assert types["lsot_prefix_hit_rate"] == "gauge"
+    assert types["lsot_prefix_resident_bytes"] == "gauge"
+    by = {(n, l.get("replica")): (v, l) for n, l, v in samples}
+    v, labels = by[("lsot_prefix_hits_total", "r0")]
+    assert v == 6 and labels["model"] == "m"
+    assert by[("lsot_prefix_misses_total", "r0")][0] == 2
+    assert by[("lsot_prefix_hit_rate", "r0")][0] == 0.75
+    assert by[("lsot_prefix_reinserts_total", "r0")][0] == 1
+    assert by[("lsot_prefix_saved_prefill_seconds_total", "r0")][0] == 0.125
+    assert by[("lsot_prefix_resident_bytes", "r0")][0] == 16384
+    # Nothing prefix-shaped leaked through the generic flattener (the
+    # flat serving.prefix_cache sums keep their historical names).
+    assert not any(n.startswith("lsot_serving_prefix_") and "cache" not in n
+                   for n, _, _ in samples)
+    # Pool shape: per-replica blocks under "replicas".
+    pv_r1 = {**pv_r0, "replica": "r1", "hits": 1}
+    snap = {"m": {"requests": 1,
+                  "serving": {"prefix": {"replicas": [pv_r0, pv_r1]}}}}
+    _, samples = parse_exposition(render_prometheus(snap))
+    reps = {l["replica"] for n, l, _ in samples
+            if n == "lsot_prefix_hits_total"}
+    assert reps == {"r0", "r1"}
+
+
 def test_render_handoff_families():
     """ISSUE-13 golden: serving.handoff renders as lsot_handoff_*
     counters labeled model × replica × phase_role — not path-flattened

@@ -326,8 +326,13 @@ def test_prefix_cache_disabled(tiny_model_module):
     with make_sched(cfg, params, prefix_cache_blocks=0) as sched:
         out = sched.generate(PROMPTS[:2], max_new_tokens=4)
     assert out == golden
-    assert sched.prefix_stats == {"hits": 0, "blocks_reused": 0,
-                                  "cached_blocks": 0}
+    # Disabled cache: every counter (incl. the ISSUE-14 telemetry keys)
+    # stays zeroed, and the telemetry block reports absent entirely.
+    assert sched.prefix_stats == {
+        "hits": 0, "misses": 0, "hit_rate": 0.0, "blocks_reused": 0,
+        "reused_tokens": 0, "evictions": 0, "cached_blocks": 0,
+    }
+    assert sched.prefix_telemetry is None
 
 
 @pytest.mark.slow
