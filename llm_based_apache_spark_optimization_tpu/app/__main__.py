@@ -267,6 +267,9 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     if app_cfg.pool_phases and not (args.scheduler and args.dp > 1):
         sys.exit("LSOT_POOL_PHASES needs --scheduler with --dp > 1 "
                  "(phase roles are per pool replica)")
+    if app_cfg.pool_remote and not (args.scheduler and args.dp > 1):
+        sys.exit("LSOT_POOL_REMOTE needs --scheduler with --dp > 1 "
+                 "(remote replicas are pool slots)")
 
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
@@ -346,11 +349,35 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 from ..ops.quant import quantize_params
 
                 params = quantize_params(params)
+            # Remote replicas (ISSUE 15, LSOT_POOL_REMOTE
+            # "1=host:port"): those pool slots become SocketTransports
+            # to `python -m …serve.remote` workers — the per-replica
+            # factory reconnects on a targeted restart, so a healed
+            # partition re-admits the same worker. Validated up front.
+            remote_map = {}
+            for entry in filter(None, (
+                    s.strip() for s in app_cfg.pool_remote.split(","))):
+                idx_s, _, addr = entry.partition("=")
+                if not idx_s.isdigit() or not addr:
+                    sys.exit(f"LSOT_POOL_REMOTE: bad entry {entry!r} "
+                             f"(want index=host:port)")
+                if int(idx_s) >= len(scheduler_meshes):
+                    sys.exit(f"LSOT_POOL_REMOTE: replica index {idx_s} "
+                             f"out of range for --dp "
+                             f"{len(scheduler_meshes)}")
+                remote_map[int(idx_s)] = addr
+
             def make_replica(i):
                 # Per-replica factory: builds replica i against ITS
                 # submesh — the pool's targeted-restart driver calls it to
                 # rebuild exactly the crashed/stalled replica from the
-                # already-loaded (and already-quantized) params.
+                # already-loaded (and already-quantized) params. A
+                # remote slot rebuilds as a fresh transport connection
+                # instead.
+                if i in remote_map:
+                    from ..serve.remote import SocketTransport
+
+                    return SocketTransport(remote_map[i], label=f"r{i}")
                 return ContinuousBatchingScheduler(
                     cfg, params, num_slots=args.slots,
                     stop_ids=resolve_stop_ids(cfg, tok),
@@ -370,6 +397,14 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                     phase_role=phase_roles[i],
                 )
 
+            from ..serve.scheduler import parse_replica_weights
+
+            try:
+                pool_weights = parse_replica_weights(
+                    app_cfg.replica_weights, len(scheduler_meshes))
+            except ValueError as e:
+                sys.exit(f"LSOT_REPLICA_WEIGHTS: {e}")
+
             def make_pool():
                 return SchedulerPool(
                     [make_replica(i)
@@ -377,6 +412,10 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                     factory=make_replica,
                     max_restarts=app_cfg.replica_max_restarts,
                     router=app_cfg.pool_router,
+                    affinity_routing=app_cfg.pool_affinity,
+                    weights=pool_weights,
+                    lease_s=app_cfg.lease_s,
+                    lease_misses=app_cfg.lease_misses,
                 )
 
             if supervise:

@@ -119,6 +119,31 @@ class AppConfig:
     # Counts must sum to --dp; requires --kv-layout=paged. "" = every
     # replica "mixed" (today's behavior bit for bit).
     pool_phases: str = ""
+    # --- multi-host fleet (serve/remote.py; README "Multi-host fleet").
+    # Cache-aware routing (ISSUE 15): SchedulerPool.submit consumes the
+    # PR-14 prefix-affinity feed in the placement order (affinity →
+    # pressure penalty → weighted least-loaded tie-break). ON by
+    # default; 0 reproduces the pre-affinity placement order bit for
+    # bit (no digest lookups, no affinity flight events).
+    pool_affinity: bool = True
+    # Heterogeneous replica weights ("4,1,1" — one positive capacity
+    # multiplier per replica index, padded with 1.0): a tp=4 replica
+    # weighted 4 takes proportionally more token mass than a tp=1
+    # sibling. "" = all 1.0 (the unweighted order, bit for bit).
+    replica_weights: str = ""
+    # Remote replicas ("1=host:port,3=host:port" — replica INDEX =
+    # worker address): those pool slots become SocketTransports to
+    # `python -m …serve.remote` workers instead of local schedulers.
+    # The lease below is their liveness authority; a dead/partitioned
+    # worker's journaled work re-places on siblings with zero
+    # acknowledged requests lost. "" = all replicas in-process.
+    pool_remote: str = ""
+    # Remote-replica lease: ping each transport replica every lease_s
+    # seconds; lease_misses consecutive failures expire the lease
+    # (unreachable → targeted restart → journal re-placement).
+    # lease_s <= 0 disables the monitor.
+    lease_s: float = 2.0
+    lease_misses: int = 3
     # --- liveness / hang detection (serve/watchdog.py; README "Liveness &
     # hangs"). The supervisor's watchdog escalates a BUSY decode loop
     # whose heartbeat age exceeds

@@ -378,6 +378,11 @@ def get_constraint(
     compiled = compile_token_masks(
         spark_sql_dfa(table, columns), tokenizer, eos, fingerprint
     )
+    # The serializable twin of the compiled tables, stamped so transports
+    # and journals can ship the SPEC across a wire/spill and recompile on
+    # the far side (serve/remote.py, serve/supervisor.py) — the tables
+    # themselves are device-sized and never serialize.
+    compiled.wire_spec = spec if isinstance(spec, (str, dict)) else None
     with _cache_lock:
         kept = _constraint_cache.setdefault(key, compiled)
         _constraint_cache.move_to_end(key)

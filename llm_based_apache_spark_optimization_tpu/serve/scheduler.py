@@ -225,6 +225,48 @@ def parse_pool_phases(spec: str, replicas: int) -> List[str]:
     return roles
 
 
+def normalize_replica_weights(values: Sequence[float], replicas: int,
+                              label: str = "replica weights"
+                              ) -> List[float]:
+    """ONE pad/validate policy for replica capacity weights, shared by
+    the LSOT_REPLICA_WEIGHTS spec parser and SchedulerPool's explicit
+    `weights=` argument: positive floats, at most one per replica
+    (more is a misconfigured fleet and raises — never a silent
+    truncation), padded with 1.0."""
+    out = [float(w) for w in values]
+    for w in out:
+        if w <= 0:
+            raise ValueError(
+                f"replica weights must be positive, got {w} in {label}")
+    if len(out) > replicas:
+        raise ValueError(
+            f"{label} name {len(out)} replica(s) but the pool has "
+            f"{replicas}"
+        )
+    return out + [1.0] * (replicas - len(out))
+
+
+def parse_replica_weights(spec: str, replicas: int) -> List[float]:
+    """Parse LSOT_REPLICA_WEIGHTS ("4,1,1" — one positive capacity
+    multiplier per replica index) into a weight list of length
+    `replicas`, padded with 1.0. A tp=4 replica weighted 4 takes
+    proportionally more token mass than a tp=1 sibling: placement
+    ORDERING compares backlog DIVIDED by weight (deadline feasibility
+    stays wall-clock). Empty spec = all 1.0, which is bit-identical to
+    the unweighted order."""
+    if not spec:
+        return [1.0] * replicas
+    out: List[float] = []
+    for entry in filter(None, (s.strip() for s in spec.split(","))):
+        try:
+            out.append(float(entry))
+        except ValueError:
+            raise ValueError(
+                f"bad replica weight {entry!r} in {spec!r}") from None
+    return normalize_replica_weights(out, replicas,
+                                     label=f"replica weights {spec!r}")
+
+
 #: Prefix-cache telemetry bounds (ISSUE 14): how many registry entries
 #: /debug/prefixcache returns per replica (top-K by token mass) and how
 #: many recent admissions the reuse-distance ring remembers. App-startup
@@ -2956,10 +2998,20 @@ class ContinuousBatchingScheduler:
         """Cooperatively cancel a submitted request: the worker retires it
         (resolving the future with whatever was generated) at its next
         harvest instead of decoding the remaining budget for an abandoned
-        consumer. Safe on finished/foreign futures (no-op)."""
+        consumer. Safe on finished/foreign futures (no-op). A REMOTE
+        request's `_Request` lives in another process — its future
+        carries an `_lsot_cancel` callable instead (serve/remote.py),
+        which ships the cancel over the wire."""
         req = getattr(future, "_lsot_request", None)
         if req is not None:
             req.cancelled = True
+            return
+        cb = getattr(future, "_lsot_cancel", None)
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — cancel of the unreachable is moot
+                pass
 
     @property
     def overshoot(self) -> int:
@@ -4727,6 +4779,26 @@ class SchedulerPool:
         sleep: Callable[[float], None] = time.sleep,
         router: str = "least_loaded",
         replica_join_s: float = 1.0,
+        # Cache-aware routing (ISSUE 15): consume `prefix_affinity` in
+        # the placement order — affinity → pressure penalty → weighted
+        # least-loaded tie-break. None reads LSOT_POOL_AFFINITY (default
+        # ON); 0/False reproduces the pre-affinity order bit for bit
+        # (no digest lookups, no affinity flight events).
+        affinity_routing: Optional[bool] = None,
+        # Heterogeneous replica weights: replica i's serving capacity
+        # relative to its siblings (a tp=4 replica takes proportionally
+        # more token mass than a tp=1 sibling — its backlog is DIVIDED
+        # by its weight before comparison). None reads
+        # LSOT_REPLICA_WEIGHTS ("4,1,1" by index); all-1.0 (the default)
+        # is bit-identical to the unweighted order.
+        weights: Optional[Sequence[float]] = None,
+        # Remote-replica lease (serve/remote.py): ping every transport
+        # replica each `lease_s`; `lease_misses` consecutive failures
+        # expire the lease — the replica is declared unreachable and its
+        # journaled work re-places on siblings. None reads LSOT_LEASE_S /
+        # LSOT_LEASE_MISSES; lease_s <= 0 disables the monitor.
+        lease_s: Optional[float] = None,
+        lease_misses: Optional[int] = None,
     ):
         if not schedulers:
             raise ValueError("SchedulerPool needs at least one scheduler")
@@ -4803,6 +4875,44 @@ class SchedulerPool:
         # the postmortem timeline shows WHERE every request went and what
         # the fleet did about failures.
         self._pool_flight = FlightRecorder(capacity=256, replica="pool")
+        # Cache-aware routing flip (ISSUE 15): ON by default — the PR-14
+        # feed (resident digests + hit-rate EWMAs) is now consumed by
+        # submit(); LSOT_POOL_AFFINITY=0 restores the pre-affinity
+        # placement order bit for bit.
+        if affinity_routing is None:
+            affinity_routing = os.environ.get(
+                "LSOT_POOL_AFFINITY", "1").strip().lower() not in (
+                    "0", "false", "no", "off")
+        self._affinity = bool(affinity_routing)
+        self._aff_checked = 0
+        self._aff_hits = 0
+        # Heterogeneous replica weights: capacity multipliers by index
+        # (missing entries default 1.0; weights must be positive).
+        if weights is None:
+            self._weights = parse_replica_weights(
+                os.environ.get("LSOT_REPLICA_WEIGHTS", ""),
+                len(self.schedulers),
+            )
+        else:
+            # Same pad/validate policy as the env-spec path — an
+            # overlong explicit list raises instead of silently
+            # truncating a misconfigured fleet.
+            self._weights = normalize_replica_weights(
+                list(weights), len(self.schedulers))
+        # Remote-replica lease monitor (serve/remote.py): started lazily
+        # at start() when any replica exposes the lease surface.
+        self._lease_s = (float(os.environ.get("LSOT_LEASE_S", "2.0"))
+                         if lease_s is None else float(lease_s))
+        self._lease_misses = (int(os.environ.get("LSOT_LEASE_MISSES", "3"))
+                              if lease_misses is None
+                              else int(lease_misses))
+        self._lease_stop = threading.Event()
+        self._lease_thread: Optional[threading.Thread] = None
+        # Live targeted-restart driver threads: shutdown() joins them so
+        # a pool teardown racing a rebuild does not leave a daemon
+        # thread inside an XLA compile when the process exits (a C++
+        # abort at interpreter teardown, seen in the chaos suites).
+        self._restart_threads: List[threading.Thread] = []
 
     # Admission-arithmetic surface, so SchedulerBackend can wrap a pool the
     # same way it wraps one scheduler (replicas are homogeneous: same cfg,
@@ -5099,8 +5209,70 @@ class SchedulerPool:
                     )
             except Exception:  # noqa: BLE001 — placement view best-effort
                 pass
+            # Remote replicas (ISSUE 15): a socket transport has no
+            # in-process attributes to read — merge its cached loads
+            # digest (refreshed by every lease ping / rpc ack) without
+            # overwriting anything read directly above.
+            ld = getattr(s, "loads_digest", None)
+            if callable(ld):
+                try:
+                    for k, v in ld().items():
+                        rec.setdefault(k, v)
+                except Exception:  # noqa: BLE001 — a dying replica mid-read
+                    pass
+            # Transport attribution: which wire this replica is behind
+            # and how it is behaving (rpc/retry/timeout totals, lease
+            # state) — the per-replica half of serving.transport.
+            ts = getattr(s, "transport_stats", None)
+            if callable(ts):
+                try:
+                    rec["transport"] = self._transport_summary(ts())
+                except Exception:  # noqa: BLE001 — a dying replica mid-read
+                    pass
+            idx = next((j for j, x in enumerate(self._states) if x is st),
+                       -1)
+            if 0 <= idx < len(self._weights) \
+                    and self._weights[idx] != 1.0:
+                rec["weight"] = self._weights[idx]
             out.append(rec)
         return out
+
+    @staticmethod
+    def _transport_summary(t: Dict[str, object]) -> Dict[str, object]:
+        """Flatten one transport's stats into the compact per-replica
+        block replica_loads()/replica_health()//healthz carry."""
+        eps = t.get("endpoints") or {}
+        total = {"rpcs": 0, "retries": 0, "timeouts": 0, "errors": 0}
+        for rec in eps.values():
+            for k in total:
+                total[k] += int(rec.get(k, 0))
+        return {
+            "kind": t.get("kind", "transport"),
+            "unreachable": bool(t.get("unreachable", False)),
+            "lease_misses": int(t.get("lease_misses", 0)),
+            "lease_expiries": int(t.get("lease_expiries", 0)),
+            "reconnects": int(t.get("reconnects", 0)),
+            **total,
+        }
+
+    @property
+    def transport_stats(self) -> Optional[Dict[str, object]]:
+        """Per-replica transport counters, labeled (the serving.transport
+        payload the lsot_transport_* Prometheus families render). None
+        when no replica is behind a transport — in-process fleets pay
+        nothing."""
+        per = []
+        for st, s in self._replica_items():
+            fn = getattr(s, "transport_stats", None)
+            if not callable(fn):
+                continue
+            try:
+                rec = dict(fn())
+            except Exception:  # noqa: BLE001 — a dying replica mid-read
+                continue
+            rec["replica"] = st.label
+            per.append(rec)
+        return {"replicas": per} if per else None
 
     def start(self) -> "SchedulerPool":
         with self._lock:
@@ -5108,13 +5280,92 @@ class SchedulerPool:
         for st, s in zip(self._states, self.schedulers):
             if st.state != "removed":
                 s.start()
+        self._maybe_start_lease()
         return self
+
+    # ------------------------------------------------ remote-replica lease
+
+    @staticmethod
+    def _leaseable(s) -> bool:
+        return bool(getattr(s, "supports_lease", False)) and callable(
+            getattr(s, "ping", None))
+
+    def _maybe_start_lease(self) -> None:
+        """Spawn the lease monitor iff any replica is a transport
+        (serve/remote.py): in-process scheduler fleets have the
+        watchdog's heartbeat as their liveness authority and pay
+        nothing here."""
+        if self._lease_s <= 0 or self._lease_thread is not None:
+            return
+        if not any(self._leaseable(s) for s in self.schedulers):
+            return
+        self._lease_stop = threading.Event()
+        self._lease_thread = threading.Thread(
+            target=self._lease_loop, daemon=True, name="lsot-pool-lease",
+        )
+        self._lease_thread.start()
+
+    def _lease_loop(self) -> None:
+        """Per-replica heartbeat LEASE over the transports: ping each
+        placeable transport replica every `lease_s`; `lease_misses`
+        consecutive failures expire the lease — the transport is marked
+        unreachable (pending futures fail typed, streams gate shut) and
+        `_note_replica_crash` kicks the targeted restart, whose
+        `on_replica_restart` callback re-places the journaled work on
+        siblings via the supervisor's existing fleet replay. A dead or
+        partitioned host loses zero acknowledged requests."""
+        while not self._lease_stop.wait(self._lease_s):
+            with self._lock:
+                if self._closed:
+                    return
+                items = [(i, st, self.schedulers[i])
+                         for i, st in enumerate(self._states)
+                         if st.state in _ReplicaState.PLACEABLE]
+            for i, st, s in items:
+                if not self._leaseable(s):
+                    continue
+                try:
+                    s.ping(timeout=self._lease_s)
+                except Exception as e:  # noqa: BLE001 — any failure is a miss
+                    miss_fn = getattr(s, "lease_miss", None)
+                    misses = (miss_fn() if callable(miss_fn)
+                              else self._lease_misses)
+                    self._pool_flight.event("lease_miss", replica=st.label,
+                                            misses=misses)
+                    if misses < self._lease_misses:
+                        continue
+                    exc = None
+                    mark = getattr(s, "mark_unreachable", None)
+                    if callable(mark):
+                        exc = mark(
+                            f"lease expired after {misses} missed "
+                            f"beat(s): {e}"
+                        )
+                    if exc is None:
+                        from .remote import ReplicaUnreachable
+
+                        exc = ReplicaUnreachable(
+                            f"replica {st.label} lease expired after "
+                            f"{misses} missed beat(s): {e}"
+                        )
+                    resilience.inc("lease_expiries")
+                    self._pool_flight.event("lease_expired",
+                                            replica=st.label,
+                                            misses=misses)
+                    _log.warning("replica %s lease expired (%d misses)",
+                                 st.label, misses)
+                    self._note_replica_crash(i, exc)
+                else:
+                    ok_fn = getattr(s, "lease_ok", None)
+                    if callable(ok_fn):
+                        ok_fn()
 
     def shutdown(self, timeout: Optional[float] = None) -> None:
         # _closed stops any in-flight replica-restart driver from swapping
         # a fresh replica into a pool that is going away.
         with self._lock:
             self._closed = True
+        self._lease_stop.set()
         for st, s in zip(self._states, self.schedulers):
             if s is None:
                 continue
@@ -5122,6 +5373,19 @@ class SchedulerPool:
                 s.shutdown(timeout=timeout)
             except Exception:  # noqa: BLE001 — one corpse must not wedge the rest
                 _log.exception("replica %s shutdown failed", st.label)
+        # Join in-flight restart drivers: `_closed` makes each exit at
+        # its next checkpoint (discarding any fresh replica it built),
+        # but a driver can be seconds deep in a rebuild's XLA compiles —
+        # abandoning it leaves a daemon thread inside native code when a
+        # short-lived process (tests, the chaos harness) exits. The same
+        # `timeout` bound callers pass for replica teardown applies; a
+        # driver that cannot finish inside it is abandoned like a wedged
+        # replica join.
+        with self._lock:
+            drivers = list(self._restart_threads)
+        for t in drivers:
+            if t is not threading.current_thread():
+                t.join(timeout)
 
     def __enter__(self):
         return self.start()
@@ -5146,6 +5410,59 @@ class SchedulerPool:
                 return 0.0, 0
         q = getattr(s, "_queue", None)
         return 0.0, (q.qsize() if q is not None else 0)
+
+    def _wscore(self, i: int, s) -> Tuple[float, float]:
+        """Weighted placement ORDERING score: replica i's backlog
+        divided by its capacity weight, so a tp=4 replica weighted 4
+        looks a quarter as loaded per unit of capacity and takes
+        proportionally more token mass. Ordering only — deadline
+        feasibility and Retry-After hints always compare the RAW
+        seconds estimate (a replica's real backlog is wall-clock no
+        matter its capacity weight). Weight 1.0 (the default) returns
+        `_score` UNCHANGED — same types, same values — keeping the
+        unweighted placement order bit for bit."""
+        secs, toks = self._score(s)
+        return self._wkey(i, secs, toks)
+
+    def _wkey(self, i: int, secs: float, toks):
+        w = self._weights[i] if i < len(self._weights) else 1.0
+        if w == 1.0:
+            return secs, toks
+        return secs / w, toks / w
+
+    def _affinity_scores(self, ids) -> Dict[str, int]:
+        """The cache-aware routing lookup for one submit (ISSUE 15):
+        the request's chain-prefix digests scored against every
+        placeable replica's resident set via `prefix_affinity`. Empty
+        when routing is off, the prompt is shorter than one block, or
+        nobody holds anything — every one of which leaves the placement
+        sort exactly where it was."""
+        block = int(getattr(self.schedulers[0], "_pblock", 0) or 0)
+        if not block:
+            return {}
+        digests = prefix_chain_digests(ids, block)
+        if not digests:
+            return {}
+        scored = self.prefix_affinity(digests)
+        if not scored:
+            return {}
+        with self._lock:
+            self._aff_checked += 1
+        return {str(r["replica"]): int(r["score"]) for r in scored}
+
+    def routing_stats(self) -> Dict[str, object]:
+        """The placement layer's own counters (the bench `fleet_routing`
+        affinity pass cites these): how many submits had a non-empty
+        affinity lookup and how many landed on a best-affinity holder."""
+        with self._lock:
+            return {
+                "router": self.router,
+                "affinity_routing": self._affinity,
+                "weights": list(self._weights),
+                "placements": sum(st.placements for st in self._states),
+                "affinity_checked": self._aff_checked,
+                "affinity_hits": self._aff_hits,
+            }
 
     def _replica_items(self, states: Optional[Sequence[str]] = None
                        ) -> List[Tuple["_ReplicaState", object]]:
@@ -5269,7 +5586,7 @@ class SchedulerPool:
             for (i, st, s) in cands:
                 if self._phase_role(s) != role or s is src:
                     continue
-                secs, toks = self._score(s)
+                secs, toks = self._wscore(i, s)
                 decorated.append((self._penalty(st, s),
                                   self._decode_pressure(s),
                                   secs, toks, i, st, s))
@@ -5360,6 +5677,7 @@ class SchedulerPool:
                          if self._phase_role(c[2]) == "decode"]
                 cands = front
             if self.router == "round_robin":
+                aff: Dict[str, int] = {}
                 with self._lock:
                     pick = self._rr % len(cands)
                     self._rr += 1
@@ -5367,15 +5685,27 @@ class SchedulerPool:
                 scored = [(self._score(s), i, st, s)
                           for (i, st, s) in order]
             else:
-                # Pressure-aware least-loaded: replicas mid-KV-pressure-
-                # storm or mid-SLO-burn sort after healthy ones BEFORE
-                # the backlog comparison (ISSUE 13 satellite; penalty is
-                # 0 fleet-wide in the healthy case, preserving the
-                # pre-disagg order bit for bit).
+                # Cache-aware, pressure-aware, weighted least-loaded
+                # (ISSUE 15): a replica already holding the request's
+                # schema-prefix pages sorts FIRST (zero-copy hit instead
+                # of a re-prefill — at fleet scale the schema-prefix
+                # working set IS the traffic shape), then replicas
+                # mid-KV-pressure-storm or mid-SLO-burn sort after
+                # healthy ones, then the weighted backlog tie-break.
+                # With LSOT_POOL_AFFINITY=0 (no lookup, no events) and
+                # all-1.0 weights this is the pre-affinity order bit
+                # for bit.
+                aff = (self._affinity_scores(ids) if self._affinity
+                       else {})
+                # Scores stay RAW (deadline feasibility + the 504 hint
+                # below compare wall-clock backlog); the capacity weight
+                # applies only inside the ordering key.
                 scored = sorted(
                     ((self._score(s), i, st, s) for (i, st, s) in cands),
-                    key=lambda t: (self._penalty(t[2], t[3]),
-                                   t[0][0], t[0][1], t[1]),
+                    key=lambda t: (-aff.get(t[2].label, 0),
+                                   self._penalty(t[2], t[3]),
+                                   *self._wkey(t[1], t[0][0], t[0][1]),
+                                   t[1]),
                 )
             if deadline_s is not None:
                 feasible = [t for t in scored if t[0][0] < deadline_s]
@@ -5387,8 +5717,11 @@ class SchedulerPool:
                     spilled = sorted(
                         ((self._score(s), i, st, s)
                          for (i, st, s) in spill),
-                        key=lambda t: (self._penalty(t[2], t[3]),
-                                       t[0][0], t[0][1], t[1]),
+                        key=lambda t: (-aff.get(t[2].label, 0),
+                                       self._penalty(t[2], t[3]),
+                                       *self._wkey(t[1], t[0][0],
+                                                   t[0][1]),
+                                       t[1]),
                     )
                     feasible = [t for t in spilled if t[0][0] < deadline_s]
                     scored = scored + spilled
@@ -5442,6 +5775,11 @@ class SchedulerPool:
                 fut._lsot_replica = st.label
             with self._lock:
                 st.placements += 1
+                if aff and aff.get(st.label, 0) > 0 \
+                        and aff[st.label] == max(aff.values()):
+                    # The request landed on a best-affinity holder: the
+                    # zero-copy prefix hit the router was built to buy.
+                    self._aff_hits += 1
             if st.state == "degraded":
                 # A clean completion proves the restarted replica serves.
                 def _prove(f, st=st):
@@ -5452,11 +5790,14 @@ class SchedulerPool:
                 fut.add_done_callback(_prove)
             # Placement decision into the pool black box: where the
             # request went and what the router saw (bounded ring append).
-            self._pool_flight.event(
-                "placement", to=st.label, router=self.router,
+            ev: Dict[str, object] = dict(
+                to=st.label, router=self.router,
                 backlog_s=round(secs, 4), pending_new_tokens=toks,
                 considered=len(cands),
             )
+            if aff:
+                ev["affinity"] = aff.get(st.label, 0)
+            self._pool_flight.event("placement", **ev)
             return fut
         if last_overloaded is not None:
             # Min Retry-After across the full fleet (restart-aware), not
@@ -5570,10 +5911,17 @@ class SchedulerPool:
         return True
 
     def _spawn_restart(self, idx: int) -> None:
-        threading.Thread(
+        t = threading.Thread(
             target=self._restart_driver, args=(idx,), daemon=True,
             name=f"lsot-pool-restart-{self._states[idx].label}",
-        ).start()
+        )
+        with self._lock:
+            # Prune finished episodes so the list tracks live drivers.
+            self._restart_threads = [
+                x for x in self._restart_threads if x.is_alive()
+            ]
+            self._restart_threads.append(t)
+        t.start()
 
     def _build_replica(self, idx: int):
         return (self._factory(idx) if self._factory_takes_index
@@ -5616,6 +5964,11 @@ class SchedulerPool:
                 # replica is down promise at least the backoff remaining.
                 st.restart_eta = time.monotonic() + delay
             self._sleep(delay)
+            with self._lock:
+                if self._closed:
+                    # The pool died during the backoff: don't start a
+                    # rebuild nobody will use (shutdown() is joining us).
+                    return
             try:
                 fresh = self._build_replica(idx)
                 # Warm BEFORE serving, like the supervisor's restart
@@ -5701,7 +6054,7 @@ class SchedulerPool:
                 cands = self._placeable()
                 if cands:
                     target = min(
-                        ((self._score(s), self._penalty(_st, s), i, s)
+                        ((self._wscore(i, s), self._penalty(_st, s), i, s)
                          for (i, _st, s) in cands),
                         key=lambda t: (t[1], t[0][0], t[0][1], t[2]),
                     )[3]
@@ -5803,6 +6156,16 @@ class SchedulerPool:
                 "stalls": st.stalls,
                 "crashed": getattr(s, "_crash", None) is not None,
             }
+            # Transport-backed replicas (ISSUE 15): the /healthz fleet
+            # view says which wire the replica is behind and whether its
+            # lease is healthy — one probe answers "is r2 down or just
+            # partitioned from us".
+            ts = getattr(s, "transport_stats", None)
+            if callable(ts):
+                try:
+                    rec["transport"] = self._transport_summary(ts())
+                except Exception:  # noqa: BLE001 — a dying replica mid-read
+                    pass
             if st.last_crash:
                 rec["last_crash"] = st.last_crash
             if st.restart_eta is not None:
@@ -5900,11 +6263,14 @@ class SchedulerPool:
         digests — `prefix_chain_digests(ids, block)`) it currently holds
         resident. Returns [{replica, score}] sorted best-first, scoring
         replicas only (no score-0 noise); empty when nobody holds any.
-        Landed here as OBSERVABILITY: the placement decision itself stays
-        with the multi-host routing item — submit() does not consume this
-        yet. Each non-empty lookup drops a `prefix_affinity` event into
-        the pool flight ring so placement postmortems can see what the
-        router WOULD have known."""
+        CONSUMED BY PLACEMENT (ISSUE 15): submit() sorts candidates by
+        this lookup's scores ahead of the pressure penalty and the
+        weighted least-loaded tie-break whenever affinity routing is on
+        (the default; LSOT_POOL_AFFINITY=0 restores the pure
+        observability role) — changing the scoring here changes where
+        requests LAND. Each non-empty lookup drops a `prefix_affinity`
+        event into the pool flight ring so placement postmortems can
+        see what the router knew."""
         want = {d for d in digests if d}
         if not want:
             return []
@@ -6060,6 +6426,22 @@ class SchedulerBackend:
         ho = getattr(self.scheduler, "handoff_stats", None)
         if ho:
             out["handoff"] = ho
+        # Replica-transport traffic (ISSUE 15): per-replica rpc/retry/
+        # timeout counters + lease state for remote fleets — rendered as
+        # the lsot_transport_* families (utils/prometheus.py).
+        tr = getattr(self.scheduler, "transport_stats", None)
+        if tr:
+            out["transport"] = tr
+        # Cache-aware placement counters (ISSUE 15): how often affinity
+        # had an opinion and how often the router took it.
+        rt = getattr(self.scheduler, "routing_stats", None)
+        if callable(rt):
+            try:
+                routing = rt()
+            except Exception:  # noqa: BLE001 — a churning fleet mid-read
+                routing = None
+            if routing:
+                out["routing"] = routing
         # Liveness view (serve/watchdog.py): heartbeat age/cadence, slots
         # retired for per-lane stalls, and — when supervised — whole-loop
         # stalls detected + the active stall threshold.

@@ -471,6 +471,18 @@ class SupervisedScheduler:
     def phase_role(self):
         return getattr(self._inner, "phase_role", "mixed")
 
+    @property
+    def transport_stats(self):
+        """Replica-transport passthrough (ISSUE 15): the
+        serving.transport view and the lsot_transport_* families
+        survive supervision (None for in-process fleets)."""
+        return getattr(self._inner, "transport_stats", None)
+
+    def routing_stats(self):
+        """Cache-aware placement counters passthrough (ISSUE 15)."""
+        fn = getattr(self._inner, "routing_stats", None)
+        return fn() if callable(fn) else None
+
     def profile_rounds(self, rounds=None, out_dir=None):
         """On-demand device-capture passthrough (/debug/profile): the
         INNER loop owns the device, so it owns the capture; the

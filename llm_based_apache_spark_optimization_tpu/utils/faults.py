@@ -41,11 +41,30 @@ relies on it to tell `site:point:prob:secs` from a malformed entry.
 **Value-valued sites** reuse the same 4th field as a plain NUMBER the
 injection point interprets itself, read through `FAULTS.value(site)`
 (fires with the configured probability, returns the value, never raises
-or sleeps). The one in-tree value site is `kv:pressure:p:v` — the paged
-KV scheduler shrinks its effective page pool by `v` (a fraction of the
+or sleeps). In-tree value sites: `kv:pressure:p:v` — the paged KV
+scheduler shrinks its effective page pool by `v` (a fraction of the
 pool when v < 1, an absolute page count otherwise) for every loop
 iteration the site fires, forcing the allocation failures that drive
-victim preemption (serve/scheduler.py; `evalh --chaos` pressure stage).
+victim preemption (serve/scheduler.py; `evalh --chaos` pressure stage)
+— and `net:delay:p:secs` — the replica-transport rpc envelope
+(serve/remote.py) stalls that long on the wire, driving the
+deadline-propagating timeout path.
+
+**Network sites** (ISSUE 15, consumed at the CLIENT side of both
+replica transports in serve/remote.py so one seeded schedule drives
+loopback and socket fleets alike): `net:drop:p` — the RPC executes on
+the server but the response is lost, so the retry must dedup against
+the idempotency-token ledger (the no-double-generate proof);
+`net:dup:p` — the request is delivered twice and the second delivery
+must be absorbed by the same ledger; `net:delay:p:secs` — above;
+`net:partition_r{i}:p` — replica-ADDRESSABLE, like `sched:wedge_r{i}`:
+every RPC, token-stream delivery and lease ping to pool replica r{i}
+fails while the site is configured, which is what drives the
+lease-expiry → targeted-restart → journal-replay recovery path
+(`evalh --chaos` stage 7). Drop/dup consult the non-raising
+`FAULTS.fires(site)` draw; the partition's STATE (token-stream gating)
+reads `FAULTS.site_active(site)`, which never draws — concurrent
+stream deliveries must not perturb the seeded schedule.
 
 Injection points call `FAULTS.check("site:point")`, which raises
 `InjectedFault` (a ConnectionError subclass, so connect-phase retry
@@ -201,6 +220,33 @@ class FaultRegistry:
             self._sleep(secs)
             return
         raise InjectedFault(site)
+
+    def fires(self, site: str) -> bool:
+        """Boolean draw: True with the site's configured probability
+        (counted like check()), never raises or sleeps — for injection
+        points that apply their own semantics to a PLAIN firing (the
+        transport layer's `net:drop`/`net:dup`). False when the site is
+        unconfigured; an unconfigured site draws nothing, so sites
+        compose without perturbing each other's seeded schedules."""
+        if not self._probs:  # fast path: injection off
+            return False
+        with self._lock:
+            prob = self._probs.get(site)
+            if prob is None or self._rng.random() >= prob:
+                return False
+            self._counts[site] = self._counts.get(site, 0) + 1
+        resilience.inc("faults_injected")
+        return True
+
+    def site_active(self, site: str) -> bool:
+        """Is the site configured at all? NO randomness — no draw, no
+        count — so state-like consultations (is replica r1 currently
+        partitioned?) can run from any thread at any rate without
+        perturbing the seeded schedule the raising/boolean draws replay."""
+        if not self._probs:
+            return False
+        with self._lock:
+            return site in self._probs
 
     def value(self, site: str):
         """Value-valued check: with the site's configured probability,

@@ -206,6 +206,46 @@ def _emit_handoff(emit: _Emitter, model: str, ho: Dict) -> None:
                 emit.add(name, labels, n, mtype)
 
 
+def _emit_transport(emit: _Emitter, model: str, tr: Dict) -> None:
+    """The replica-transport families (ISSUE 15): `serving.transport`
+    becomes lsot_transport_* counters labeled model × replica ×
+    ENDPOINT (the rpc op — submit/requeue/ping/…) for the per-call
+    counters, and model × replica for the lease/connection lifecycle —
+    rpc volume, retries, timeouts, errors, lease misses/expiries,
+    reconnects, and the 0/1 unreachable flag a partition trips. Accepts
+    one transport's stats dict or a pool's ({"replicas": [...]})."""
+    stats = tr.get("replicas") if isinstance(tr.get("replicas"),
+                                             list) else [tr]
+    for rec in stats:
+        if not isinstance(rec, dict):
+            continue
+        rep = str(rec.get("replica") or "r0")
+        for op, counters in sorted((rec.get("endpoints") or {}).items()):
+            if not isinstance(counters, dict):
+                continue
+            labels = {"model": model, "replica": rep, "endpoint": str(op)}
+            for key, name in (("rpcs", "lsot_transport_rpcs_total"),
+                              ("retries", "lsot_transport_retries_total"),
+                              ("timeouts", "lsot_transport_timeouts_total"),
+                              ("errors", "lsot_transport_errors_total")):
+                n = _num(counters.get(key))
+                if n is not None:
+                    emit.add(name, labels, n, "counter")
+        labels = {"model": model, "replica": rep,
+                  "kind": str(rec.get("kind") or "transport")}
+        for key, name, mtype in (
+                ("lease_misses", "lsot_transport_lease_misses", "gauge"),
+                ("lease_expiries",
+                 "lsot_transport_lease_expiries_total", "counter"),
+                ("reconnects", "lsot_transport_reconnects_total",
+                 "counter"),
+                ("unreachable", "lsot_transport_unreachable", "gauge"),
+        ):
+            n = _num(rec.get(key))
+            if n is not None:
+                emit.add(name, labels, n, mtype)
+
+
 def _emit_prefix(emit: _Emitter, model: str, pv: Dict) -> None:
     """The prefix-cache telemetry families (ISSUE 14): `serving.prefix`
     becomes lsot_prefix_* counters/gauges labeled model × replica —
@@ -313,6 +353,12 @@ def render_prometheus(snapshot: Dict,
             ho = serving.pop("handoff", None)
             if isinstance(ho, dict):
                 _emit_handoff(emit, model, ho)
+            # Replica-transport traffic renders as first-class
+            # replica × endpoint families (ISSUE 15) so dashboards join
+            # lsot_transport_* on the shared replica vocabulary.
+            tr = serving.pop("transport", None)
+            if isinstance(tr, dict):
+                _emit_transport(emit, model, tr)
             # Prefix-cache telemetry renders as first-class
             # model × replica families (not path-flattened gauges) so
             # dashboards join lsot_prefix_* on the same label vocabulary

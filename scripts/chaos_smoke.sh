@@ -31,24 +31,33 @@ export JAX_PLATFORMS=cpu
 
 python -m pytest tests -q -m chaos -p no:cacheprovider "$@"
 
-# Crash-restart + hang-detection + fleet + KV-PRESSURE + DISAGG
-# scenarios in the default lane: the supervised scheduler must survive
-# injected mid-batch loop deaths with zero lost acknowledged requests,
-# the watchdog must detect an injected WEDGE (sched:hang — the loop
-# sleeps, nothing raises) and recover it with zero silently-hung
-# clients, a supervised FLEET pool with one replica wedged must recover
-# it with a TARGETED restart — siblings untouched, zero lost — the real
-# paged scheduler under a kv:pressure storm must preempt ≥1 victim and
-# complete every request token-identical to a pressure-free control,
-# and a phase-split PREFILL/DECODE fleet must migrate every request
-# through the KV-page handoff token-identical to a mixed control AND
-# survive a sched:handoff crash that kills the prefill replica
-# mid-handoff (targeted restart, journal re-placement onto the decode
-# sibling, zero lost). run_chaos asserts all five; the JSON summary
-# shows restarts/replayed/lost, the watchdog stage's stalls/detection
-# bound, the fleet stage's per-replica restart attribution, the
-# kv_pressure stage's preemption tally, and the disagg stage's
-# handoff/crash/restart attribution.
+# Crash-restart + hang-detection + fleet + KV-PRESSURE + DISAGG +
+# NET-TRANSPORT scenarios in the default lane: the supervised scheduler
+# must survive injected mid-batch loop deaths with zero lost
+# acknowledged requests, the watchdog must detect an injected WEDGE
+# (sched:hang — the loop sleeps, nothing raises) and recover it with
+# zero silently-hung clients, a supervised FLEET pool with one replica
+# wedged must recover it with a TARGETED restart — siblings untouched,
+# zero lost — the real paged scheduler under a kv:pressure storm must
+# preempt ≥1 victim and complete every request token-identical to a
+# pressure-free control, a phase-split PREFILL/DECODE fleet must
+# migrate every request through the KV-page handoff token-identical to
+# a mixed control AND survive a sched:handoff crash that kills the
+# prefill replica mid-handoff (targeted restart, journal re-placement
+# onto the decode sibling, zero lost), and — stage 7, the NET lane
+# (ISSUE 15) — a fleet of real schedulers behind replica TRANSPORTS
+# must ride out every network fault class (net:drop / net:delay /
+# net:dup / net:partition_r1): lost responses retried and deduped by
+# the idempotency-token ledger (exactly-once execution proven by
+# scheduler-side submit counts), duplicated deliveries absorbed, and a
+# partition detected by LEASE expiry with only the partitioned
+# replica restarted and its journaled work re-placed — every wave
+# token-identical to a fault-free control. run_chaos asserts all six;
+# the JSON summary shows restarts/replayed/lost, the watchdog stage's
+# stalls/detection bound, the fleet stage's per-replica restart
+# attribution, the kv_pressure stage's preemption tally, the disagg
+# stage's handoff/crash/restart attribution, and the transport stage's
+# per-wave fault/idempotency/lease accounting.
 LSOT_FAULTS= python -m llm_based_apache_spark_optimization_tpu.evalh \
   --chaos "ollama:connect:0.5,sql:exec:1,sched:crash:0.2" \
   --chaos-seed "${LSOT_FAULTS_SEED}"

@@ -1,0 +1,174 @@
+#!/usr/bin/env bash
+# Multi-host fleet smoke: the ISSUE-15 remote transport end to end over
+# REAL localhost sockets and a REAL second process.
+#
+# Boots a `python -m …serve.remote` worker (tiny paged scheduler,
+# decode role) as a separate OS process, then stands up a 1-prefill +
+# 1-remote-decode SchedulerPool in this process with a SocketTransport
+# pointed at the worker, and asserts the whole contract:
+#
+#   1. the hello exchange negotiates the frame protocol (version
+#      checked, scheduler digest shipped);
+#   2. shared-schema-prefix traffic submitted to the pool migrates
+#      prefill→decode THROUGH the wire: every request's KV handoff blob
+#      (pages + resume state) serializes into a requeue frame, imports
+#      on the remote worker, and decodes there (≥1 export asserted — an
+#      in-place fallback run proves nothing);
+#   3. outputs are TOKEN-IDENTICAL to a single mixed-replica control,
+#      and the streamed tokens match the final results exactly
+#      (exactly-once streaming across the wire);
+#   4. replica_loads() carries the remote replica's transport block
+#      (rpc counters, lease state) — the lsot_transport_* feed;
+#   5. killing the worker with SIGKILL mid-traffic expires the LEASE:
+#      the pool declares r1 unreachable, restarts only r1, and the
+#      supervisor's journal re-places the lost work on the local
+#      replica — zero acknowledged requests lost, outputs still
+#      token-identical.
+#
+# The default test lane runs the same flow in-process
+# (tests/test_remote_smoke.py, not marked slow); this script is the
+# focused real-process lane, beside chaos_smoke.sh / obs_smoke.sh.
+#
+#   scripts/remote_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+WORKER_LOG="$(mktemp)"
+trap 'kill "$WORKER_PID" 2>/dev/null || true; rm -f "$WORKER_LOG"' EXIT
+
+python -m llm_based_apache_spark_optimization_tpu.serve.remote \
+  --port 0 --num-slots 2 --decode-chunk 4 --prompt-bucket 8 \
+  --max-seq 96 --kv-layout paged --kv-page-size 8 \
+  --phase-role decode >"$WORKER_LOG" 2>&1 &
+WORKER_PID=$!
+
+# The worker prints "lsot-remote-worker listening on HOST:PORT" once the
+# scheduler is warmed and the server bound.
+ADDR=""
+for _ in $(seq 1 120); do
+  ADDR="$(grep -oE 'listening on [0-9.:]+' "$WORKER_LOG" | awk '{print $3}' || true)"
+  [ -n "$ADDR" ] && break
+  kill -0 "$WORKER_PID" 2>/dev/null || { cat "$WORKER_LOG"; exit 1; }
+  sleep 1
+done
+[ -n "$ADDR" ] || { echo "worker never bound"; cat "$WORKER_LOG"; exit 1; }
+echo "remote worker at $ADDR (pid $WORKER_PID)"
+
+LSOT_REMOTE_ADDR="$ADDR" LSOT_REMOTE_PID="$WORKER_PID" python - <<'EOF'
+import os
+import random
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+
+from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+from llm_based_apache_spark_optimization_tpu.serve.remote import (
+    SocketTransport,
+)
+from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+    RetryPolicy,
+)
+from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    SchedulerPool,
+)
+from llm_based_apache_spark_optimization_tpu.serve.supervisor import (
+    SupervisedScheduler,
+)
+
+addr = os.environ["LSOT_REMOTE_ADDR"]
+worker_pid = int(os.environ["LSOT_REMOTE_PID"])
+params = init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+
+
+def mk(role):
+    return ContinuousBatchingScheduler(
+        TINY, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+        stop_ids=(2,), max_seq=96, kv_layout="paged", kv_page_size=8,
+        phase_role=role,
+    )
+
+
+reqs = [[1, 5, 9 + i] for i in range(4)]
+with mk("mixed") as ctl:
+    want = [ctl.submit(ids, max_new_tokens=8, seed=40 + i).result(timeout=300)
+            for i, ids in enumerate(reqs)]
+
+
+def make_replica(i):
+    if i == 1:
+        return SocketTransport(
+            addr, label="r1",
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.01,
+                                     max_delay_s=0.1),
+        )
+    return mk("prefill")
+
+
+def make_pool():
+    return SchedulerPool(
+        [make_replica(0), make_replica(1)], factory=make_replica,
+        max_restarts=3,
+        restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                   max_delay_s=0.1),
+        rng=random.Random(0), lease_s=0.2, lease_misses=2,
+    )
+
+
+sup = SupervisedScheduler(
+    make_pool, max_restarts=3,
+    restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                               max_delay_s=0.1),
+    rng=random.Random(0),
+).start()
+try:
+    # step 1+2+3: traffic migrates through the wire, token-identical.
+    streams = [[] for _ in reqs]
+    futs = [sup.submit(ids, max_new_tokens=8, seed=40 + i,
+                       on_token=streams[i].append)
+            for i, ids in enumerate(reqs)]
+    outs = [f.result(timeout=300) for f in futs]
+    assert outs == want, f"remote decode diverged: {outs} != {want}"
+    assert streams == outs, "streamed tokens != final results"
+    pool = sup._inner
+    exports = sum(int(r.get("exports", 0))
+                  for r in (pool.handoff_stats or {}).get("replicas", []))
+    assert exports >= 1, "no handoff crossed the wire (in-place fallback?)"
+    print(f"step 1-3 OK: {len(outs)} requests, {exports} exports over "
+          f"the wire, token-identical + exactly-once streams")
+
+    # step 4: transport block in the loads feed.
+    loads = {r["replica"]: r for r in pool.replica_loads()}
+    tr = loads["r1"].get("transport")
+    assert tr and tr["kind"] == "socket" and tr["rpcs"] >= 1, tr
+    print(f"step 4 OK: transport block {tr}")
+
+    # step 5: SIGKILL the worker mid-fleet → lease expiry → targeted
+    # restart → journal re-placement, zero lost.
+    os.kill(worker_pid, signal.SIGKILL)
+    futs2 = [sup.submit(ids, max_new_tokens=8, seed=40 + i)
+             for i, ids in enumerate(reqs)]
+    outs2 = [f.result(timeout=300) for f in futs2]
+    assert outs2 == want, f"post-kill outputs diverged: {outs2} != {want}"
+    deadline = time.monotonic() + 30
+    h = sup.health()
+    while time.monotonic() < deadline:
+        reps = {r["replica"]: r for r in h.get("replicas", [])}
+        if int(reps.get("r1", {}).get("restarts", 0)) >= 1:
+            break
+        time.sleep(0.05)
+        h = sup.health()
+    reps = {r["replica"]: r for r in h.get("replicas", [])}
+    assert int(reps.get("r1", {}).get("restarts", 0)) >= 1, \
+        "worker SIGKILL never expired the lease"
+    assert h["lost"] == 0, f"{h['lost']} acknowledged request(s) lost"
+    print(f"step 5 OK: worker SIGKILL -> lease expired, r1 restarts="
+          f"{reps['r1']['restarts']}, lost={h['lost']}, outputs identical")
+finally:
+    sup.shutdown()
+print("REMOTE SMOKE OK")
+EOF

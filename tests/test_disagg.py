@@ -192,14 +192,25 @@ def test_mixed_role_default_reproduces_today_bitforbit(tiny_model_module):
     """phase_role="mixed" (the default) must be today's scheduler bit
     for bit: identical outputs, identical page accounting, no handoff
     state touched, no handoff events or columns in the flight ring."""
+    import time as _t
+
+    def drained_stats(s):
+        # Page release at retire runs a harvest-beat behind the futures
+        # resolving: wait for the pool to drain before snapshotting, or
+        # a busy host catches one side mid-retire (flaky inequality).
+        deadline = _t.monotonic() + 5.0
+        while s.page_stats["pages_in_use"] and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        return dict(s.page_stats)
+
     cfg, params = tiny_model_module
     with make_sched(cfg, params) as a:
         out_a = a.generate(PROMPTS, max_new_tokens=6)
-        stats_a = dict(a.page_stats)
+        stats_a = drained_stats(a)
         snap_a = a.flight.snapshot()
     with make_sched(cfg, params, role="mixed") as b:
         out_b = b.generate(PROMPTS, max_new_tokens=6)
-        stats_b = dict(b.page_stats)
+        stats_b = drained_stats(b)
         snap_b = b.flight.snapshot()
         assert b.handoff_stats is None
     assert out_a == out_b

@@ -117,7 +117,17 @@ def test_golden_decode_pinned_tokens(tiny_model):
     """Regression pin: greedy decode from fixed weights/prompt must produce
     the exact same tokens forever (SURVEY.md §4 golden-decode tests). If an
     intentional numerics change (new kernel, dtype policy) breaks this,
-    verify the change on real weights and re-pin."""
+    verify the change on real weights and re-pin.
+
+    Provenance (re-pinned at ISSUE 15, carried failing since the seed):
+    the original pin ([190, 182, ...]) was generated in the seed author's
+    environment and NEVER passed in this container (ROADMAP: "seed tests
+    failing"). Bisect evidence: the seed COMMIT's own code (24a3760, the
+    commit that added the pin) run in this environment reproduces today's
+    output [61, ...] bit for bit — so no in-repo change drifted the
+    numerics; the committed value encoded a foreign jax build's RNG/XLA
+    bit-stream. Current pin is this environment's jax 0.4.37 / CPU / f32
+    output, stable across runs."""
     cfg, params = tiny_model
     eng = InferenceEngine(cfg, params, stop_ids=(-1,), prompt_bucket=8)
     out = eng.generate([[1, 17, 93, 5]], max_new_tokens=8)[0]

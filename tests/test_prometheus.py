@@ -278,6 +278,59 @@ def test_render_handoff_families():
     assert any(n == "lsot_handoff_exports_total" for n, _, _ in samples)
 
 
+def test_render_transport_families():
+    """ISSUE-15 golden: serving.transport renders as lsot_transport_*
+    families — per-call counters labeled model × replica × ENDPOINT
+    (the rpc op) and lease/connection lifecycle labeled model × replica
+    × kind — for both the single-transport and the pool
+    ({"replicas": [...]}) payload shapes."""
+    tr_r1 = {
+        "replica": "r1", "kind": "socket", "unreachable": False,
+        "lease_misses": 0, "lease_expiries": 1, "reconnects": 2,
+        "endpoints": {
+            "submit": {"rpcs": 12, "retries": 3, "timeouts": 1,
+                       "errors": 4},
+            "ping": {"rpcs": 40, "retries": 0, "timeouts": 2,
+                     "errors": 2},
+        },
+    }
+    tr_r0 = {
+        "replica": "r0", "kind": "loopback", "unreachable": True,
+        "lease_misses": 2, "lease_expiries": 0, "reconnects": 0,
+        "endpoints": {"submit": {"rpcs": 5, "retries": 0, "timeouts": 0,
+                                 "errors": 0}},
+    }
+    snap = {"m": {"requests": 1,
+                  "serving": {"transport": {"replicas": [tr_r0, tr_r1]}}}}
+    text = render_prometheus(snap)
+    types, samples = parse_exposition(text)
+    assert types["lsot_transport_rpcs_total"] == "counter"
+    assert types["lsot_transport_retries_total"] == "counter"
+    assert types["lsot_transport_timeouts_total"] == "counter"
+    assert types["lsot_transport_lease_expiries_total"] == "counter"
+    assert types["lsot_transport_reconnects_total"] == "counter"
+    assert types["lsot_transport_unreachable"] == "gauge"
+    assert types["lsot_transport_lease_misses"] == "gauge"
+    by = {(n, l.get("replica"), l.get("endpoint")): (v, l)
+          for n, l, v in samples}
+    v, labels = by[("lsot_transport_rpcs_total", "r1", "submit")]
+    assert v == 12 and labels["model"] == "m"
+    assert by[("lsot_transport_retries_total", "r1", "submit")][0] == 3
+    assert by[("lsot_transport_timeouts_total", "r1", "ping")][0] == 2
+    v, labels = by[("lsot_transport_lease_expiries_total", "r1", None)]
+    assert v == 1 and labels["kind"] == "socket"
+    v, labels = by[("lsot_transport_unreachable", "r0", None)]
+    assert v == 1 and labels["kind"] == "loopback"
+    assert by[("lsot_transport_lease_misses", "r0", None)][0] == 2
+    # Nothing transport-shaped leaked through the generic flattener.
+    assert not any(n.startswith("lsot_serving_transport")
+                   for n, _, _ in samples)
+    # Single-transport payload shape renders too.
+    snap = {"m": {"requests": 1, "serving": {"transport": tr_r1}}}
+    _, samples = parse_exposition(render_prometheus(snap))
+    assert any(n == "lsot_transport_rpcs_total" for n, _, _ in samples)
+
+
 def test_render_slo_families():
     """ISSUE-12 golden: the top-level "slo" snapshot renders burn-rate /
     bad-fraction gauges per window arm, quantile gauges, the 0/1 burning

@@ -1,0 +1,712 @@
+"""Replica transports (ISSUE 15): wire format, idempotency, leases,
+loopback/socket parity.
+
+Host-only where possible (frame codec, token ledger, toy-replica
+envelope tests); the parity suite builds ONE tiny jax scheduler pair on
+CPU — the loopback fleet must be token- and accounting-identical to the
+direct fleet, and the socket fleet token-identical to both."""
+
+import random
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.evalh.chaos import _ToyScheduler
+from llm_based_apache_spark_optimization_tpu.serve import remote
+from llm_based_apache_spark_optimization_tpu.serve.remote import (
+    FrameDecoder,
+    FrameError,
+    FrameVersionError,
+    LoopbackTransport,
+    ReplicaServer,
+    ReplicaUnreachable,
+    SocketTransport,
+    TransportError,
+    TransportTimeout,
+    encode_frame,
+)
+from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+    DeadlineExceeded,
+    Overloaded,
+    RetryPolicy,
+    SchedulerCrashed,
+    SchedulerStalled,
+)
+from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+    SchedulerPool,
+)
+from llm_based_apache_spark_optimization_tpu.utils.faults import FAULTS
+
+
+def _no_sleep(_s):
+    pass
+
+
+def _fast_retry(attempts=4):
+    return RetryPolicy(max_attempts=attempts, base_delay_s=0.001,
+                       max_delay_s=0.01)
+
+
+# ------------------------------------------------------------- wire format
+
+
+def test_frame_roundtrip_property_both_encodings():
+    """Property test: random nested payloads — ints, floats, strings,
+    lists, dicts, ndarrays (the handoff-blob dtypes), bytes — round-trip
+    bit-exactly through both encodings, one frame or many per feed."""
+    rng = np.random.default_rng(0)
+    encodings = [0] + ([1] if remote.HAVE_MSGPACK else [])
+
+    def rand_payload(depth=0):
+        kind = rng.integers(0, 8 if depth < 3 else 5)
+        if kind == 0:
+            return int(rng.integers(-(2**31), 2**31))
+        if kind == 1:
+            return float(rng.normal())
+        if kind == 2:
+            return "s" * int(rng.integers(0, 5)) + str(rng.integers(0, 99))
+        if kind == 3:
+            return rng.integers(-128, 127, size=(2, 3)).astype(np.int8)
+        if kind == 4:
+            return rng.normal(size=(3, 2)).astype(np.float32)
+        if kind == 5:
+            return [rand_payload(depth + 1) for _ in range(3)]
+        if kind == 6:
+            return {f"k{i}": rand_payload(depth + 1) for i in range(3)}
+        return bytes(rng.integers(0, 256, size=5).astype(np.uint8))
+
+    def eq(a, b):
+        if isinstance(a, np.ndarray):
+            return (isinstance(b, np.ndarray) and a.dtype == b.dtype
+                    and a.shape == b.shape and (a == b).all())
+        if isinstance(a, list):
+            return (isinstance(b, list) and len(a) == len(b)
+                    and all(eq(x, y) for x, y in zip(a, b)))
+        if isinstance(a, dict):
+            return (isinstance(b, dict) and a.keys() == b.keys()
+                    and all(eq(v, b[k]) for k, v in a.items()))
+        if isinstance(a, float):
+            return a == b or (np.isnan(a) and np.isnan(b))
+        return a == b
+
+    for enc in encodings:
+        msgs = [{"op": "t", "seq": i, "payload": rand_payload()}
+                for i in range(20)]
+        stream = b"".join(encode_frame(m, enc) for m in msgs)
+        # Feed in awkward chunk sizes: the decoder must reassemble.
+        dec = FrameDecoder()
+        got = []
+        i = 0
+        while i < len(stream):
+            step = int(rng.integers(1, 70))
+            got.extend(dec.feed(stream[i:i + step]))
+            i += step
+        dec.eof()
+        assert len(got) == len(msgs)
+        for m, g in zip(msgs, got):
+            assert eq(m["payload"], g["payload"]), (m, g)
+
+
+def test_frame_rejections_typed():
+    """Garbage magic, a foreign protocol version, an oversize length
+    field, an undecodable body and a truncated stream are all refused
+    TYPED — never a silent resync or a bare struct error."""
+    good = encode_frame({"op": "x"})
+    with pytest.raises(FrameError):
+        FrameDecoder().feed(b"XX" + good[2:])
+    bumped = bytearray(good)
+    bumped[2] = remote.PROTOCOL_VERSION + 1
+    with pytest.raises(FrameVersionError):
+        FrameDecoder().feed(bytes(bumped))
+    # Corrupt length field far past the ceiling.
+    import struct
+
+    hdr = struct.pack(">2sBBI", b"LT", remote.PROTOCOL_VERSION, 0,
+                      remote._MAX_FRAME + 1)
+    with pytest.raises(FrameError, match="ceiling"):
+        FrameDecoder().feed(hdr)
+    # Undecodable body (claims JSON, carries garbage).
+    bad = struct.pack(">2sBBI", b"LT", remote.PROTOCOL_VERSION, 0, 4) \
+        + b"\xff\xfe\x00\x01"
+    with pytest.raises(FrameError, match="undecodable"):
+        FrameDecoder().feed(bad)
+    # Truncated mid-frame: eof() names it.
+    dec = FrameDecoder()
+    assert dec.feed(good[: len(good) - 2]) == []
+    with pytest.raises(FrameError, match="truncated"):
+        dec.eof()
+    # A non-object payload is refused (messages are dicts by contract).
+    import json
+
+    payload = json.dumps([1, 2]).encode()
+    framed = struct.pack(">2sBBI", b"LT", remote.PROTOCOL_VERSION, 0,
+                         len(payload)) + payload
+    with pytest.raises(FrameError, match="objects"):
+        FrameDecoder().feed(framed)
+
+
+def test_error_codec_roundtrips_types():
+    """Typed application errors cross the wire as themselves —
+    Retry-After included — and unknown subtypes map to their nearest
+    wire-known ancestor (SchedulerStalled → SchedulerCrashed), never to
+    a bare string."""
+    e = remote._decode_error(remote._encode_error(
+        Overloaded("full", retry_after_s=7.5)))
+    assert isinstance(e, Overloaded) and e.retry_after_s == 7.5
+    e = remote._decode_error(remote._encode_error(DeadlineExceeded("late")))
+    assert isinstance(e, DeadlineExceeded)
+    e = remote._decode_error(remote._encode_error(SchedulerStalled("wedge")))
+    assert isinstance(e, SchedulerCrashed)
+    e = remote._decode_error(remote._encode_error(ValueError("shape")))
+    assert isinstance(e, ValueError) and "shape" in str(e)
+    e = remote._decode_error(remote._encode_error(KeyError("weird")))
+    assert isinstance(e, RuntimeError)
+
+
+def test_request_wire_roundtrip_with_blob():
+    """A scheduler `_Request` — committed tokens, deterministic-resume
+    state, KV handoff blob arrays (int8 pages + f32 scales) — survives
+    the wire form content-exactly."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        _Request,
+    )
+
+    pages = np.arange(2 * 3 * 2 * 4 * 2, dtype=np.int8).reshape(
+        2, 3, 2, 4, 2)
+    scales = np.linspace(0, 1, 2 * 3 * 2 * 4, dtype=np.float32).reshape(
+        2, 3, 2, 4)
+    req = _Request(ids=[1, 5, 9], max_new=16, temperature=0.8, top_p=0.9,
+                   top_k=40, seed=7, future=Future())
+    req.rid = 42
+    req.generated = [10, 11, 12]
+    req.resume_pref = 3
+    req.rng_count = 2
+    req.spilled = (pages, scales)
+    req.handoff = {"t_pack": 1.0, "pages": 3, "bytes": 144, "src": "r0"}
+    wire = remote.request_to_wire(req)
+    # Through a real frame (the requeue rpc's payload shape).
+    wire2 = FrameDecoder().feed(encode_frame({"req": wire}))[0]["req"]
+    back = remote.request_from_wire(wire2)
+    assert back.ids == req.ids and back.rid == 42
+    assert back.generated == [10, 11, 12]
+    assert back.resume_pref == 3 and back.rng_count == 2
+    assert (back.temperature, back.top_p, back.top_k) == (0.8, 0.9, 40)
+    assert back.deadline is None
+    assert back.spilled[0].dtype == np.int8
+    assert (back.spilled[0] == pages).all()
+    assert back.spilled[1].dtype == np.float32
+    assert (back.spilled[1] == scales).all()
+    assert back.handoff["src"] == "r0"
+    assert back.future._lsot_request is back
+
+
+def test_token_ledger_single_flight():
+    led = remote._TokenLedger(cap=4)
+    calls = []
+
+    def run():
+        calls.append(1)
+        return len(calls)
+
+    v1, fresh1 = led.get_or_run("t1", run)
+    v2, fresh2 = led.get_or_run("t1", run)
+    assert (v1, fresh1) == (1, True)
+    assert (v2, fresh2) == (1, False)
+    assert len(calls) == 1
+    # token=None never dedups.
+    led.get_or_run(None, run)
+    led.get_or_run(None, run)
+    assert len(calls) == 3
+    # Bounded: old tokens age out and re-run.
+    for i in range(6):
+        led.get_or_run(f"x{i}", run)
+    led.get_or_run("t1", run)
+    assert len(calls) == 10
+
+
+def test_token_ledger_single_flight_mid_execution():
+    """A duplicate delivery arriving WHILE the first execution is still
+    running parks on the in-flight marker instead of executing again —
+    the race a reconnect retry against a slow submit opens; and a
+    FAILED execution unregisters, so a later retry runs afresh."""
+    led = remote._TokenLedger()
+    started, release = threading.Event(), threading.Event()
+    calls = []
+
+    def slow():
+        calls.append(1)
+        started.set()
+        release.wait(5)
+        return "v"
+
+    results = []
+    t1 = threading.Thread(
+        target=lambda: results.append(led.get_or_run("t", slow)))
+    t1.start()
+    assert started.wait(5)
+    t2 = threading.Thread(
+        target=lambda: results.append(led.get_or_run("t", slow)))
+    t2.start()
+    time.sleep(0.05)  # t2 must be parked on the marker, not running
+    assert len(calls) == 1
+    release.set()
+    t1.join(5)
+    t2.join(5)
+    assert len(calls) == 1, "duplicate executed mid-flight"
+    assert sorted(r[0] for r in results) == ["v", "v"]
+    # Failure path: the slot clears and a retry re-runs.
+    def boom():
+        calls.append(1)
+        raise RuntimeError("transient")
+
+    with pytest.raises(RuntimeError):
+        led.get_or_run("f", boom)
+    led.get_or_run("f", lambda: "ok")
+    assert led.get_or_run("f", lambda: "other")[0] == "ok"
+
+
+# ---------------------------------------------------- loopback envelope
+
+
+def test_loopback_fast_path_is_the_direct_call():
+    """With no fault spec configured the loopback transport returns the
+    scheduler's OWN future object — bit-identical by construction —
+    and attribute reads pass through."""
+    toy = _ToyScheduler()
+    tr = LoopbackTransport(toy, "r0")
+    tr.start()
+    try:
+        fut = tr.submit([3, 4], seed=5)
+        assert fut.result(timeout=5) == _ToyScheduler.expected([3, 4], 6, 5)
+        # Reads delegate: the pool's duck-typed surface is untouched.
+        assert tr.backlog_score() == toy.backlog_score()
+        assert tr.transport_stats()["endpoints"]["submit"]["rpcs"] == 1
+    finally:
+        tr.shutdown()
+
+
+class _CountingToy(_ToyScheduler):
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.submits = 0
+
+    def submit(self, *a, **k):
+        self.submits += 1
+        return super().submit(*a, **k)
+
+
+def test_loopback_drop_retries_never_double_generate():
+    """net:drop loses responses AFTER server-side execution: the retry
+    re-delivers the same idempotency token and must bind to the first
+    execution — the scheduler sees each logical request exactly once."""
+    FAULTS.configure("net:drop:0.5", 3)
+    toy = _CountingToy()
+    tr = LoopbackTransport(toy, "r0", retry_policy=_fast_retry(6),
+                           sleep=_no_sleep)
+    tr.start()
+    try:
+        outs = [tr.submit([3 + i, 4 + i], seed=50 + i).result(timeout=10)
+                for i in range(6)]
+        assert outs == [_ToyScheduler.expected([3 + i, 4 + i], 6, 50 + i)
+                        for i in range(6)]
+        assert toy.submits == 6, f"double-generated: {toy.submits}"
+        st = tr.transport_stats()
+        assert st["endpoints"]["submit"]["retries"] >= 1
+    finally:
+        FAULTS.clear()
+        tr.shutdown()
+
+
+def test_loopback_dup_absorbed_by_ledger():
+    FAULTS.configure("net:dup:1", 0)
+    toy = _CountingToy()
+    tr = LoopbackTransport(toy, "r0", sleep=_no_sleep)
+    tr.start()
+    try:
+        out = tr.submit([8, 2], seed=9).result(timeout=10)
+        assert out == _ToyScheduler.expected([8, 2], 6, 9)
+        assert toy.submits == 1
+    finally:
+        FAULTS.clear()
+        tr.shutdown()
+
+
+def test_loopback_delay_past_budget_is_typed_timeout_then_unreachable():
+    """A net:delay at/over the rpc budget burns the budget and raises
+    TransportTimeout per attempt; exhausting the retry ladder declares
+    the replica unreachable TYPED (SchedulerCrashed subclass — the
+    supervisor's fleet-replay trigger) and counts the timeouts."""
+    FAULTS.configure("net:delay:1:5", 0)
+    slept = []
+    toy = _ToyScheduler()
+    tr = LoopbackTransport(toy, "r0", retry_policy=_fast_retry(3),
+                           rpc_timeout_s=0.05, sleep=slept.append)
+    tr.start()
+    try:
+        with pytest.raises(ReplicaUnreachable):
+            tr.submit([1, 2], seed=1)
+        st = tr.transport_stats()
+        assert st["endpoints"]["submit"]["timeouts"] == 3
+        assert st["unreachable"] is True
+        # The envelope slept the BUDGET (once per attempt), never the
+        # 5 s injected delay.
+        assert slept.count(0.05) == 3 and 5.0 not in slept
+        assert isinstance(tr._crash, SchedulerCrashed)
+    finally:
+        FAULTS.clear()
+        tr.shutdown()
+
+
+def test_mark_unreachable_fails_pending_typed_and_gates_stream():
+    """Declaring a replica unreachable fails its pending client futures
+    with ReplicaUnreachable and gates the zombie token stream: a late
+    inner-scheduler resolution must neither crash the worker nor reach
+    the client twice."""
+    FAULTS.configure("net:drop:0.000001", 0)  # envelope mode, no firing
+    toy = _ToyScheduler(tokens_per_request=4, token_sleep_s=0.2)
+    tr = LoopbackTransport(toy, "r0", sleep=_no_sleep)
+    tr.start()
+    try:
+        seen = []
+        fut = tr.submit([5, 6], seed=3, on_token=seen.append)
+        exc = tr.mark_unreachable("test partition")
+        assert isinstance(exc, ReplicaUnreachable)
+        with pytest.raises(ReplicaUnreachable):
+            fut.result(timeout=5)
+        # The zombie completes inside the toy; its stream was gated and
+        # its late resolution swallowed.
+        time.sleep(1.2)
+        assert seen == []
+        assert tr.transport_stats()["lease_expiries"] == 1
+        with pytest.raises(ReplicaUnreachable):
+            tr.submit([7, 8])
+    finally:
+        FAULTS.clear()
+        tr.shutdown()
+
+
+# ----------------------------------------------------- lease + pool wiring
+
+
+def test_pool_lease_expiry_targets_partitioned_replica():
+    """The pool's lease monitor pings transport replicas; a partition
+    (all pings failing) expires the lease after LSOT_LEASE_MISSES
+    beats, declares ONLY that replica unreachable and kicks its
+    targeted restart while the sibling keeps serving."""
+    rebuilt = []
+
+    def factory(i):
+        if i == 1:
+            FAULTS.clear()  # the partition heals on rebuild
+        rebuilt.append(i)
+        return LoopbackTransport(_ToyScheduler(), f"r{i}",
+                                 retry_policy=_fast_retry(2),
+                                 sleep=_no_sleep)
+
+    pool = SchedulerPool(
+        [LoopbackTransport(_ToyScheduler(), "r0", sleep=_no_sleep),
+         LoopbackTransport(_ToyScheduler(), "r1",
+                           retry_policy=_fast_retry(2), sleep=_no_sleep)],
+        factory=factory, max_restarts=3,
+        restart_policy=_fast_retry(4), rng=random.Random(0),
+        lease_s=0.02, lease_misses=2,
+    )
+    pool.start()
+    try:
+        FAULTS.configure("net:partition_r1:1", 0)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and 1 not in rebuilt:
+            time.sleep(0.01)
+        assert 1 in rebuilt, "lease expiry never rebuilt r1"
+        assert 0 not in rebuilt, "the sibling was restarted too"
+        # The healed fleet serves on both replicas again.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            reps = {r["replica"]: r for r in pool.replica_health()}
+            if reps["r1"]["state"] in ("ready", "degraded"):
+                break
+            time.sleep(0.01)
+        out = pool.submit([4, 2], seed=6).result(timeout=10)
+        assert out == _ToyScheduler.expected([4, 2], 6, 6)
+        ev = [r for r in pool.flight_snapshot()
+              if r.get("kind") == "lease_expired"]
+        assert ev and ev[-1]["replica"] == "r1"
+    finally:
+        FAULTS.clear()
+        pool.shutdown()
+
+
+def test_replica_loads_and_health_carry_transport_block():
+    pool = SchedulerPool(
+        [LoopbackTransport(_ToyScheduler(), "r0", sleep=_no_sleep)],
+        lease_s=0.0,
+    )
+    pool.start()
+    try:
+        pool.submit([1, 2]).result(timeout=5)
+        loads = pool.replica_loads()[0]
+        assert loads["transport"]["kind"] == "loopback"
+        assert loads["transport"]["rpcs"] >= 1
+        health = pool.replica_health()[0]
+        assert health["transport"]["unreachable"] is False
+        ts = pool.transport_stats
+        assert ts["replicas"][0]["replica"] == "r0"
+    finally:
+        pool.shutdown()
+
+
+# ------------------------------------------------------- socket transport
+
+
+def test_socket_roundtrip_streaming_and_errors():
+    """Submit/stream/cancel and typed-error propagation over a real
+    localhost socket against a toy replica."""
+    toy = _ToyScheduler()
+    toy.start()
+    srv = ReplicaServer(toy)
+    tr = SocketTransport(srv.address, label="r0")
+    try:
+        toks = []
+        fut = tr.submit([9, 4], seed=7, on_token=toks.append)
+        out = fut.result(timeout=10)
+        assert out == _ToyScheduler.expected([9, 4], 6, 7)
+        assert toks == out  # exactly-once, in order
+        assert fut._lsot_replica == "r0"
+        # The live load digest piggybacked on acks feeds the router.
+        assert isinstance(tr.backlog_score(), tuple)
+        assert tr._busy_now() in (False, True)
+    finally:
+        tr.shutdown()
+        srv.close()
+        toy.shutdown()
+
+
+def test_socket_hello_digest_and_version_guard():
+    toy = _ToyScheduler()
+    srv = ReplicaServer(toy)
+    tr = SocketTransport(srv.address, label="r2")
+    try:
+        assert tr._digest["version"] == remote.PROTOCOL_VERSION
+        # A client from the future is refused typed by the server.
+        import socket as pysock
+
+        with pysock.create_connection((srv.host, srv.port)) as s:
+            s.sendall(encode_frame({"op": "hello", "seq": 1,
+                                    "client_version":
+                                    remote.PROTOCOL_VERSION + 1}))
+            dec = FrameDecoder()
+            msgs = []
+            while not msgs:
+                data = s.recv(65536)
+                if not data:
+                    break
+                msgs = dec.feed(data)
+        assert msgs and msgs[0]["ok"] is False
+        assert "protocol" in msgs[0]["err"]["msg"]
+    finally:
+        tr.shutdown()
+        srv.close()
+
+
+def test_socket_server_death_lease_fails_pending_typed():
+    """A dead server severs the connection; in-flight client futures
+    survive the blip (a reconnect could resume them) until the LEASE
+    declares the replica unreachable — then they fail typed with
+    ReplicaUnreachable and the transport's `_crash` marker makes the
+    pool skip it at placement."""
+    toy = _ToyScheduler(tokens_per_request=8, token_sleep_s=0.5)
+    toy.start()
+    srv = ReplicaServer(toy)
+    tr = SocketTransport(srv.address, label="r0",
+                         retry_policy=_fast_retry(2), rpc_timeout_s=2.0,
+                         sleep=_no_sleep)
+    try:
+        fut = tr.submit([1, 2], seed=0)  # slow toy: stays in flight
+        srv.close()  # server death severs live connections too
+        # The pool's lease monitor would now see pings fail and expire
+        # the lease; do its job inline.
+        with pytest.raises((TransportError, TransportTimeout)):
+            tr.ping(timeout=1.0)
+        tr.mark_unreachable("lease expired (test)")
+        with pytest.raises(ReplicaUnreachable):
+            fut.result(timeout=5)
+        assert tr._crash is not None
+        with pytest.raises(ReplicaUnreachable):
+            tr.submit([3, 4])
+    finally:
+        tr.shutdown()
+        srv.close()
+        toy.shutdown()
+
+
+# ------------------------------------------------ parity on the real thing
+
+
+@pytest.fixture(scope="module")
+def tiny_sched_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from llm_based_apache_spark_optimization_tpu.constrain import (
+        get_constraint,
+    )
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+    from llm_based_apache_spark_optimization_tpu.tokenizer import (
+        ByteTokenizer,
+    )
+
+    params = init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+    tok = ByteTokenizer()
+    cm = get_constraint("spark_sql", tok, (2,))
+    return TINY, params, tok, cm
+
+
+def _mk_sched(cfg, params, **kw):
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("prompt_bucket", 8)
+    kw.setdefault("stop_ids", (2,))
+    kw.setdefault("max_seq", 96)
+    return ContinuousBatchingScheduler(cfg, params, **kw)
+
+
+def _mixed_wave(sub, cm, budget):
+    from llm_based_apache_spark_optimization_tpu.ops.sampling import (
+        SamplingParams,
+    )
+
+    reqs = [
+        ([1, 5, 9], SamplingParams(), None, 8),
+        ([1, 7, 11], SamplingParams(temperature=0.8, top_p=0.95), None, 8),
+        ([1, 19, 33, 2, 7], SamplingParams(), None, 8),
+    ]
+    if cm is not None:
+        reqs.append((None, SamplingParams(), cm, budget))
+    futs = []
+    for i, (ids, sp, c, mn) in enumerate(reqs):
+        if ids is None:
+            from llm_based_apache_spark_optimization_tpu.tokenizer import (
+                ByteTokenizer,
+            )
+
+            ids = ByteTokenizer().encode("SELECT", add_bos=True)
+        futs.append(sub(ids, max_new_tokens=mn, sampling=sp, seed=60 + i,
+                        constraint=c))
+    return [f.result(timeout=300) for f in futs]
+
+
+def test_loopback_fleet_token_and_accounting_identical(tiny_sched_parts):
+    """The reconciliation the tentpole promises: a loopback-transport
+    fleet is token-identical AND accounting-identical (flight records,
+    prefix counters) to the direct-call fleet on mixed greedy/sampled/
+    constrained traffic — the transport is an address, not a filter."""
+    cfg, params, tok, cm = tiny_sched_parts
+    budget = max(16, cm.min_new_tokens)
+
+    def strip(records):
+        # Round SLICING and per-round emitted counts are wall-clock-
+        # dependent (harvest phase vs overshoot varies run to run,
+        # direct or loopback alike) — the accounting contract is the
+        # per-replica ATTRIBUTION: every admitted/retired rid, every
+        # placement decision, every lifecycle event, none added, lost
+        # or relabeled by the transport. (Token identity is asserted on
+        # the outputs themselves.)
+        per: dict = {}
+        for r in records:
+            agg = per.setdefault(
+                r.get("replica"),
+                {"admitted": set(), "retired": set(),
+                 "events": [], "placements": []},
+            )
+            agg["admitted"].update(r.get("admitted") or [])
+            agg["retired"].update(r.get("retired") or [])
+            if r.get("kind") == "placement":
+                agg["placements"].append(r.get("to"))
+            elif r.get("kind"):
+                agg["events"].append(r["kind"])
+        return per
+
+    pool_a = SchedulerPool(
+        [_mk_sched(cfg, params), _mk_sched(cfg, params)], lease_s=0.0)
+    pool_a.start()
+    try:
+        outs_a = _mixed_wave(pool_a.submit, cm, budget)
+        prefix_a = pool_a.prefix_stats
+    finally:
+        # Snapshot AFTER shutdown: the final rounds' retire records land
+        # a harvest-lag behind the futures resolving.
+        pool_a.shutdown()
+    recs_a = strip(pool_a.flight_snapshot())
+
+    pool_b = SchedulerPool(
+        [LoopbackTransport(_mk_sched(cfg, params), "r0"),
+         LoopbackTransport(_mk_sched(cfg, params), "r1")], lease_s=0.0)
+    pool_b.start()
+    try:
+        outs_b = _mixed_wave(pool_b.submit, cm, budget)
+        prefix_b = pool_b.prefix_stats
+    finally:
+        pool_b.shutdown()
+    recs_b = strip(pool_b.flight_snapshot())
+
+    assert outs_a == outs_b
+    assert recs_a == recs_b
+    assert prefix_a == prefix_b
+
+
+def test_socket_fleet_token_identical(tiny_sched_parts):
+    """Loopback-vs-socket parity on a REAL tiny scheduler: the same
+    mixed wave through a ReplicaServer + SocketTransport produces the
+    same tokens (constrained requests cross as specs and recompile on
+    the worker side)."""
+    from llm_based_apache_spark_optimization_tpu.constrain import (
+        get_constraint,
+    )
+
+    cfg, params, tok, cm = tiny_sched_parts
+    budget = max(16, cm.min_new_tokens)
+    with _mk_sched(cfg, params) as direct:
+        want = _mixed_wave(direct.submit, cm, budget)
+    worker = _mk_sched(cfg, params)
+    worker.start()
+    srv = ReplicaServer(
+        worker,
+        constraint_resolver=lambda spec: get_constraint(spec, tok, (2,)),
+    )
+    tr = SocketTransport(srv.address, label="r0")
+    try:
+        outs = _mixed_wave(tr.submit, cm, budget)
+        assert outs == want
+    finally:
+        tr.shutdown()
+        srv.close()
+        worker.shutdown()
+
+
+def test_socket_rejects_compiled_only_constraint(tiny_sched_parts):
+    """A raw pre-compiled CompiledMask (no serializable spec) cannot
+    cross the wire: refused typed at submit, not silently dropped."""
+    import dataclasses
+
+    cfg, params, tok, cm = tiny_sched_parts
+    toy = _ToyScheduler()
+    srv = ReplicaServer(toy)
+    tr = SocketTransport(srv.address, label="r0")
+    try:
+        bare = dataclasses.replace(cm)  # fresh instance, no wire_spec
+        assert getattr(bare, "wire_spec", None) is None
+        with pytest.raises(ValueError, match="serializable spec"):
+            tr.submit([1, 2], constraint=bare)
+    finally:
+        tr.shutdown()
+        srv.close()

@@ -810,3 +810,107 @@ def test_pool_targeted_restart_rebuilds_only_crashed_replica(
         # degraded back to ready).
         out2 = pool.generate(PROMPTS[:2] * 2, max_new_tokens=4)
         assert out2 == golden * 2
+
+
+# --------------------------------- cache-aware + weighted routing (ISSUE 15)
+
+
+def test_pool_affinity_routes_to_prefix_holder():
+    """The cache-aware flip: a replica already holding the request's
+    chain-prefix digests sorts FIRST — ahead of a strictly better
+    backlog score — and the placement event + routing counters record
+    the hit."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        prefix_chain_digests,
+    )
+
+    holder, lighter = _FakeReplica(secs=2.0), _FakeReplica(secs=0.1)
+    ids = list(range(1, 20))  # 19 tokens / block 8 -> 2 chain digests
+    digs = prefix_chain_digests(ids, 8)
+    assert len(digs) == 2
+    holder._pblock = lighter._pblock = 8
+    holder.resident_digests = lambda: list(digs)
+    lighter.resident_digests = lambda: []
+    pool = _fake_pool(holder, lighter, affinity_routing=True)
+    pool.submit(ids)
+    assert holder.submitted and not lighter.submitted
+    rs = pool.routing_stats()
+    assert rs["affinity_checked"] == 1 and rs["affinity_hits"] == 1
+    placements = [r for r in pool.flight_snapshot()
+                  if r.get("kind") == "placement"]
+    assert placements[-1]["to"] == "r0"
+    assert placements[-1]["affinity"] == 2
+    # A prompt with NO resident prefix anywhere falls back to backlog.
+    pool.submit(list(range(50, 69)))
+    assert lighter.submitted
+
+
+def test_pool_affinity_off_reproduces_backlog_order_bit_for_bit():
+    """LSOT_POOL_AFFINITY=0: no digest lookups, no affinity flight
+    events, and the placement order is exactly the pre-affinity
+    backlog order even when a replica holds the whole prefix."""
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        prefix_chain_digests,
+    )
+
+    holder, lighter = _FakeReplica(secs=2.0), _FakeReplica(secs=0.1)
+    ids = list(range(1, 20))
+    holder._pblock = lighter._pblock = 8
+    holder.resident_digests = lambda: prefix_chain_digests(ids, 8)
+    lighter.resident_digests = lambda: []
+    pool = _fake_pool(holder, lighter, affinity_routing=False)
+    pool.submit(ids)
+    assert lighter.submitted and not holder.submitted
+    kinds = {r.get("kind") for r in pool.flight_snapshot()}
+    assert "prefix_affinity" not in kinds
+    placements = [r for r in pool.flight_snapshot()
+                  if r.get("kind") == "placement"]
+    assert "affinity" not in placements[-1]
+    rs = pool.routing_stats()
+    assert rs["affinity_checked"] == 0 and rs["affinity_hits"] == 0
+
+
+def test_pool_weights_scale_backlog_comparison():
+    """Heterogeneous capacity: a replica weighted 4 takes token mass
+    its raw backlog would have lost — placement compares backlog/weight
+    — while all-1.0 weights keep the unweighted order (same types,
+    same values)."""
+    import pytest as _pytest
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        parse_replica_weights,
+    )
+
+    big, small = _FakeReplica(secs=4.0), _FakeReplica(secs=3.0)
+    pool = _fake_pool(big, small, weights=[4.0, 1.0])
+    pool.submit([1])
+    assert big.submitted and not small.submitted  # 4/4 = 1.0 < 3.0
+    big2, small2 = _FakeReplica(secs=4.0), _FakeReplica(secs=3.0)
+    pool2 = _fake_pool(big2, small2)  # unweighted: raw backlog wins
+    pool2.submit([1])
+    assert small2.submitted and not big2.submitted
+    # Weighted replicas surface their weight in the loads feed.
+    loads = {r["replica"]: r for r in pool.replica_loads()}
+    assert loads["r0"]["weight"] == 4.0 and "weight" not in loads["r1"]
+    # Deadline feasibility stays WALL-CLOCK: the weighted ordering may
+    # prefer the big replica (2.0/4 = 0.5 < 1.0), but its RAW backlog
+    # blows a 1.5 s budget, so the request must land on the sibling.
+    big3, small3 = _FakeReplica(secs=2.0), _FakeReplica(secs=1.0)
+    pool3 = _fake_pool(big3, small3, weights=[4.0, 1.0])
+    pool3.submit([2])
+    assert big3.submitted  # ordering: weighted score wins
+    pool3.submit([3], deadline_s=1.5)
+    assert small3.submitted  # feasibility: raw seconds win
+    # Spec parsing: pads with 1.0, refuses nonsense; the explicit
+    # `weights=` ctor argument follows the SAME policy (no silent
+    # truncation of an overlong list).
+    assert parse_replica_weights("2,1", 3) == [2.0, 1.0, 1.0]
+    assert parse_replica_weights("", 2) == [1.0, 1.0]
+    with _pytest.raises(ValueError, match="positive"):
+        parse_replica_weights("0,1", 2)
+    with _pytest.raises(ValueError, match="bad replica weight"):
+        parse_replica_weights("fast", 1)
+    with _pytest.raises(ValueError, match="pool has"):
+        parse_replica_weights("1,1,1", 2)
+    with _pytest.raises(ValueError, match="pool has"):
+        _fake_pool(_FakeReplica(), _FakeReplica(), weights=[1.0, 1.0, 2.0])
