@@ -171,34 +171,58 @@ _LEGS = (
     # write vs XLA scatter, mask gather — ns/op per leg): the numbers a
     # hot-path PR cites without waiting on a chip tunnel.
     ("micro", "kernels", "BENCH_MICRO", 300),
+    # Multi-model routing (ISSUE 16): two co-resident tiny checkpoints
+    # in ONE model-routing pool under concurrent mixed traffic. Its
+    # tok_s keys enter the --compare gate like every other leg's.
+    ("multi_model", "multi_model", "BENCH_MULTI_MODEL", 420),
 )
 
 
 def _run_sub(leg: str, timeout_s: int, extra_env: dict) -> tuple[dict | None, str]:
-    """Run one inner leg as a subprocess; return (last JSON line, error)."""
+    """Run one inner leg as a subprocess; return (last JSON line, error).
+
+    Per-leg watchdog: the leg runs in its OWN process group and a hung
+    leg gets the whole group SIGKILLed at timeout — subprocess.run's
+    kill only reaches the direct child, so a leg that spawned helpers
+    (a scheduler pool's worker, a wedged compile) used to hold the
+    stdout pipe open and wedge the OUTER process until CI's `timeout`
+    killed the whole run rc=124, losing every completed leg's numbers.
+    Now the watchdog fires, the partial artifact is salvaged from
+    whatever the leg printed, and the caller records the leg as
+    `timed_out` in the BENCH JSON instead of the round dying."""
     env = dict(os.environ)
     env["BENCH_INNER"] = "1"
     env["BENCH_LEG"] = leg
     env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True,
+    )
     try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env, timeout=timeout_s, capture_output=True, text=True,
-        )
-    except subprocess.TimeoutExpired as e:
-        # run() kills the child on timeout and hands back what it printed —
-        # the core leg flushes its primary line early for exactly this case.
-        stdout = e.stdout if isinstance(e.stdout, str) else (
-            e.stdout.decode(errors="replace") if e.stdout else ""
-        )
-        return _last_json(stdout), f"timeout after {timeout_s}s"
-    sys.stderr.write((r.stderr or "")[-4000:])
-    parsed = _last_json(r.stdout)
-    if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()
-        return parsed, f"rc={r.returncode}: " + (tail[-1][-300:] if tail else "no stderr")
+        stdout, stderr = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            stdout, stderr = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:  # pragma: no cover - pipe wedge
+            stdout, stderr = "", ""
+        sys.stderr.write((stderr or "")[-4000:])
+        # The core leg flushes its primary line early for exactly this
+        # case — salvage it.
+        return _last_json(stdout or ""), f"timed_out after {timeout_s}s"
+    sys.stderr.write((stderr or "")[-4000:])
+    parsed = _last_json(stdout)
+    if proc.returncode != 0:
+        tail = (stderr or "").strip().splitlines()
+        return parsed, f"rc={proc.returncode}: " + (tail[-1][-300:] if tail else "no stderr")
     if parsed is None:
-        return None, f"printed no JSON: {(r.stdout or '')[:200]!r}"
+        return None, f"printed no JSON: {(stdout or '')[:200]!r}"
     return parsed, ""
 
 
@@ -294,9 +318,15 @@ def outer() -> int:
             extra["BENCH_FORCE_CPU"] = "1"
         t0 = time.time()
         parsed, err = _run_sub(leg, timeout_s, extra)
+        timed_out = err.startswith("timed_out")
         if parsed is not None and key in parsed:
             result[key] = parsed[key]
-            legs_status[leg] = f"ok ({time.time() - t0:.0f}s)"
+            # A timed-out leg that still printed its result dict keeps
+            # the numbers but is MARKED: a partial measurement must not
+            # read as a clean one in the committed artifact.
+            legs_status[leg] = (f"timed_out after {timeout_s}s (partial)"
+                                if timed_out
+                                else f"ok ({time.time() - t0:.0f}s)")
         else:
             legs_status[leg] = err or "no result"
         _emit(result)  # re-flush after every leg: last line = richest
@@ -464,6 +494,10 @@ def inner_leg(leg: str) -> int:
     if leg == "micro":
         # Needs no params tree — pure kernel shapes.
         _emit({"kernels": _bench_micro(device_kind)})
+        return 0
+    if leg == "multi_model":
+        # Builds its own two-checkpoint fleet — no shared params tree.
+        _emit({"multi_model": _bench_multi_model(device_kind)})
         return 0
 
     cfg = REGISTRY[os.environ.get("BENCH_CONFIG", "bench-1b")]
@@ -1934,6 +1968,62 @@ def _bench_disagg(cfg, params, n_long: int = 3, n_short: int = 3,
             split["decode_tok_s"] / mixed["decode_tok_s"], 3
         ) if mixed["decode_tok_s"] else 0.0,
     }
+
+
+def _bench_multi_model(device_kind) -> dict:
+    """Multi-model routing throughput (ISSUE 16): two tiny checkpoints
+    co-resident in ONE model-routing SchedulerPool, mixed traffic
+    alternating between them from concurrent submitters. Records
+    aggregate tok/s plus the per-model split the lsot_model_* families
+    export — placements, tokens, and each model's partitioned share of
+    the page arena. Random weights, so the number is a ROUTING+SCHEDULER
+    overhead figure, not a model-quality one; the leg exists to price
+    what co-residency costs versus the single-model scheduler leg."""
+    import time as _t
+    from concurrent.futures import ThreadPoolExecutor
+
+    from llm_based_apache_spark_optimization_tpu.serve.modelpool import (
+        ModelSpec,
+        build_tiny_model_service,
+    )
+
+    n_req = int(os.environ.get("BENCH_MM_REQS", "8"))
+    max_new = int(os.environ.get("BENCH_MM_NEW", "24"))
+    specs = [ModelSpec("sql", hbm_fraction=0.75),
+             ModelSpec("explainer", hbm_fraction=0.25)]
+    svc, pool, _reg = build_tiny_model_service(
+        specs, num_slots=4, max_new_tokens=max_new,
+    )
+    try:
+        prompt = "SELECT something from the bench table please"
+        t0 = _t.perf_counter()
+
+        def one(i):
+            model = "sql" if i % 2 == 0 else "explainer"
+            return svc.generate(model=model, prompt=f"{prompt} {i}")
+
+        with ThreadPoolExecutor(max_workers=min(8, 2 * n_req)) as ex:
+            outs = list(ex.map(one, range(2 * n_req)))
+        wall = _t.perf_counter() - t0
+        toks = sum(o.output_tokens for o in outs)
+        stats = pool.model_stats() or {"models": []}
+        per = {
+            rec["model"]: {
+                "tok_s": round(rec["tokens_total"] / max(wall, 1e-9), 1),
+                "placements": rec["placements"],
+                "kv_pages_total": rec["kv_pages_total"],
+            }
+            for rec in stats["models"]
+        }
+        return {
+            "tok_s": round(toks / max(wall, 1e-9), 1),
+            "wall_s": round(wall, 2),
+            "requests": 2 * n_req,
+            "models": per,
+            "platform": device_kind,
+        }
+    finally:
+        pool.shutdown()
 
 
 def _bench_scheduler(cfg, params, prompt_len, max_new, batch,

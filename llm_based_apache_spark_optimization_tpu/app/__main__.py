@@ -270,6 +270,32 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     if app_cfg.pool_remote and not (args.scheduler and args.dp > 1):
         sys.exit("LSOT_POOL_REMOTE needs --scheduler with --dp > 1 "
                  "(remote replicas are pool slots)")
+    # Multi-model fleet (ISSUE 16, LSOT_MODELS): co-resident checkpoints
+    # in ONE scheduler pool routing on model_id. Takes over assembly
+    # entirely — the --sql-model-path / --error-model-path flags and the
+    # shared-weights alias only apply to the single-model path.
+    if app_cfg.models:
+        from ..serve.modelpool import parse_models_spec
+
+        try:
+            mspecs = parse_models_spec(app_cfg.models)
+        except ValueError as e:
+            sys.exit(f"LSOT_MODELS: {e}")
+        if not args.scheduler:
+            sys.exit("LSOT_MODELS needs --scheduler (model routing is "
+                     "a scheduler-pool property)")
+        if app_cfg.pool_phases or app_cfg.pool_remote:
+            sys.exit("LSOT_MODELS does not combine with "
+                     "LSOT_POOL_PHASES/LSOT_POOL_REMOTE yet (phase "
+                     "roles and remote slots are indexed per replica, "
+                     "not per model)")
+        tiny = [m.model_id for m in mspecs if m.source == "tiny"]
+        if tiny:
+            sys.exit(f"LSOT_MODELS: {tiny} have source 'tiny' — the "
+                     f"random-weight harness serves under --backend "
+                     f"tiny; checkpoint assembly needs hf/gguf paths")
+        return _make_multimodel_checkpoint_service(
+            args, mspecs, max_new_tokens, app_cfg, kv_quant, int4)
 
     def build(src: str, add_bos: bool = True):
         path, tok_dir = (src.split(":", 1) + [None])[:2] if ":" in src else (src, None)
@@ -482,6 +508,117 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
     )
 
 
+def _make_multimodel_checkpoint_service(args, specs, max_new_tokens,
+                                        app_cfg, kv_quant, int4):
+    """LSOT_MODELS + --backend checkpoint: each spec loads its OWN
+    checkpoint (hf dir or gguf blob, `PATH[:TOKDIR]` like the
+    single-model flags), every (model, replica) scheduler is stamped
+    with its model_id and sized to its `hbm` share of the --kv-hbm-gb
+    budget, and ALL of them join ONE SchedulerPool that routes on
+    model. One SchedulerBackend per model (its own tokenizer/template)
+    submits through that shared pool — the in-fleet explainer is just
+    the error model's own registered checkpoint."""
+    if int4:
+        sys.exit("LSOT_MODELS does not combine with --int4 yet (the "
+                 "int4 pack path is single-checkpoint)")
+    from ..checkpoint import load_gguf_checkpoint, load_hf_checkpoint
+    from ..serve.backends import resolve_stop_ids
+    from ..serve.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerBackend,
+        SchedulerPool,
+    )
+    from ..tokenizer import HFTokenizer
+
+    total_budget = int(getattr(args, "kv_hbm_gb", 0.0) * 2**30)
+    supervise = getattr(args, "supervise", True)
+    replica_factories, toks = [], {}
+    for m in specs:
+        src = m.path
+        path, tok_dir = (src.split(":", 1) + [None])[:2] \
+            if ":" in src else (src, None)
+        if path.endswith(".gguf") and tok_dir is None:
+            sys.exit(f"LSOT_MODELS {m.model_id}: GGUF blobs carry no "
+                     f"tokenizer.json — use gguf:PATH.gguf:TOKDIR")
+        tok = HFTokenizer(tok_dir or path)
+        if path.endswith(".gguf"):
+            mcfg, params = load_gguf_checkpoint(path, mesh=None)
+        else:
+            mcfg, params = load_hf_checkpoint(path, mesh=None)
+        if args.int8:
+            from ..ops.quant import quantize_params
+
+            params = quantize_params(params)
+        # The HBM partition: this model's share of ONE arena budget.
+        # 0 = let each scheduler size itself (contiguous-equivalent).
+        budget = int(total_budget * m.hbm_fraction) or None
+
+        def mk(mcfg=mcfg, params=params, tok=tok, budget=budget,
+               mid=m.model_id):
+            # Closes over the already-loaded (and already-quantized)
+            # params: a targeted replica restart re-allocates the KV
+            # arena, never re-reads the checkpoint.
+            return ContinuousBatchingScheduler(
+                mcfg, params, num_slots=args.slots,
+                stop_ids=resolve_stop_ids(mcfg, tok),
+                kv_quant=kv_quant,
+                kv_layout=getattr(args, "kv_layout", "contiguous"),
+                kv_hbm_budget_bytes=budget,
+                kv_overcommit=app_cfg.kv_overcommit,
+                kv_spill=app_cfg.kv_spill,
+                kv_watermark_low=app_cfg.kv_watermark_low,
+                kv_watermark_high=app_cfg.kv_watermark_high,
+                speculative_draft=getattr(args, "speculative", 0),
+                max_queue_depth=app_cfg.max_queue_depth,
+                model_id=mid,
+            )
+
+        for _ in range(m.replicas):
+            replica_factories.append(mk)
+        toks[m.model_id] = tok
+
+    def make_replica(i):
+        return replica_factories[i]()
+
+    def make_pool():
+        return SchedulerPool(
+            [make_replica(i) for i in range(len(replica_factories))],
+            factory=make_replica,
+            max_restarts=app_cfg.replica_max_restarts,
+            router=app_cfg.pool_router,
+            affinity_routing=app_cfg.pool_affinity,
+            model_routing=app_cfg.pool_models,
+        )
+
+    if supervise:
+        from ..serve.supervisor import SupervisedScheduler
+
+        pool = SupervisedScheduler(
+            make_pool, max_restarts=app_cfg.max_restarts,
+            max_entry_replays=app_cfg.max_entry_replays,
+            spill_path=_spill_path(app_cfg, "multimodel"),
+            stall_factor=app_cfg.stall_factor,
+            stall_min_s=app_cfg.stall_min_s,
+            warmup_grace_s=app_cfg.stall_warmup_s,
+            name="scheduler-pool:multimodel",
+        )
+    else:
+        pool = make_pool()
+    svc = GenerationService()
+    for m in specs:
+        svc.register(
+            m.model_id,
+            SchedulerBackend(
+                pool, toks[m.model_id],
+                max_new_tokens=max_new_tokens, add_bos=m.add_bos,
+                deadline_s=app_cfg.deadline_s or None,
+                model_id=m.model_id,
+            ),
+            template=m.template or "completion",
+        )
+    return svc
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(prog="llm_based_apache_spark_optimization_tpu.app")
     ap.add_argument("--api", action="store_true", help="headless JSON API instead of the web UI")
@@ -607,6 +744,20 @@ def main(argv=None) -> None:
         if not args.sql_model_path:
             ap.error("--backend checkpoint requires --sql-model-path")
         service = make_checkpoint_service(args, args.max_new_tokens)
+    elif cfg.models and args.backend == "tiny":
+        # Multi-model tiny fleet (ISSUE 16, LSOT_MODELS with tiny
+        # sources): co-resident random-weight checkpoints in one
+        # model-routing pool — the proof harness for the subsystem the
+        # checkpoint path serves with real weights.
+        from ..serve.factory import assemble_multimodel_service
+
+        try:
+            service, _pool, _registry = assemble_multimodel_service(
+                cfg.models, max_new_tokens=32,
+                supervise=args.supervise, num_slots=args.slots,
+            )
+        except ValueError as e:
+            sys.exit(f"LSOT_MODELS: {e}")
     else:
         # max_new small for the tiny demo model: it babbles bytes, not SQL.
         service = (

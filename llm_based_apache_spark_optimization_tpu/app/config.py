@@ -131,6 +131,22 @@ class AppConfig:
     # weighted 4 takes proportionally more token mass than a tp=1
     # sibling. "" = all 1.0 (the unweighted order, bit for bit).
     replica_weights: str = ""
+    # --- multi-model serving (serve/modelpool.py; README "Serving
+    # multiple models"). Registry spec, ";"-separated entries:
+    #   model_id=source[:path][,hbm=F][,template=T][,replicas=N][,add_bos=B]
+    # e.g. "duckdb-nsql=tiny,hbm=0.7;llama3.2=tiny,hbm=0.3" stands up two
+    # co-resident checkpoints in ONE scheduler pool with the paged-KV
+    # arena partitioned 70/30 between them. Sources: tiny (random-weight
+    # proof harness), hf, gguf. "" = single-model assembly (today's
+    # behavior bit for bit, including the shared-weights error-model
+    # alias).
+    models: str = ""
+    # Model-aware placement for the scheduler pool: requests carrying a
+    # model_id only place on replicas serving that checkpoint (model →
+    # affinity → pressure → weighted least-loaded). 0 reproduces the
+    # model-blind placement order bit for bit; requests with no model_id
+    # are never affected either way.
+    pool_models: bool = True
     # Remote replicas ("1=host:port,3=host:port" — replica INDEX =
     # worker address): those pool slots become SocketTransports to
     # `python -m …serve.remote` workers instead of local schedulers.

@@ -5,7 +5,8 @@ One grammar, two compilation modes:
 - **generic** (`spark_sql_dfa()`): identifiers are any non-reserved word —
   the mode the eval harness scores, covering the evalh fixture suite and
   Spider-style single-table queries: projections (with aggregates and
-  aliases), WHERE (comparisons, `IS [NOT] NULL`, `[NOT] LIKE 'pat%'`),
+  aliases), WHERE (comparisons, `IS [NOT] NULL`, `[NOT] LIKE 'pat%'`,
+  `[NOT] IN (...)`, `[NOT] BETWEEN lo AND hi`),
   GROUP BY/HAVING, ORDER BY (ASC/DESC), LIMIT, JOIN..ON, numeric and
   string literals.
 - **schema-aware** (`spark_sql_dfa(table=..., columns=...)`): the
@@ -52,7 +53,7 @@ RESERVED: Tuple[str, ...] = (
     "SELECT", "DISTINCT", "FROM", "WHERE", "GROUP", "BY", "HAVING",
     "ORDER", "LIMIT", "JOIN", "INNER", "LEFT", "RIGHT", "ON", "AS",
     "AND", "OR", "ASC", "DESC",
-    "IS", "NOT", "NULL", "LIKE",
+    "IS", "NOT", "NULL", "LIKE", "IN", "BETWEEN",
     "SUM", "AVG", "COUNT", "MIN", "MAX",
 )
 
@@ -145,8 +146,20 @@ def _build(table: Optional[str], columns: Optional[Tuple[str, ...]]) -> Re:
                     Opt(Seq(kw("NOT"), WS)), kw("NULL"))
     like_pred = Seq(col_ref, WS, Opt(Seq(kw("NOT"), WS)),
                     kw("LIKE"), WS, string_lit)
+    # [NOT] IN takes a parenthesized non-empty list of scalar literals
+    # or column refs (no nested selects in this subset); [NOT]
+    # BETWEEN lo AND hi keeps WS around its keywords mandatory — the
+    # AND here binds to BETWEEN, which the reference parser
+    # disambiguates by consuming it eagerly (parser.py).
+    scalar = Alt(col_ref, number, string_lit)
+    in_pred = Seq(col_ref, WS, Opt(Seq(kw("NOT"), WS)), kw("IN"), OWS,
+                  Lit("("), OWS, scalar,
+                  Star(Seq(OWS, Lit(","), OWS, scalar)), OWS, Lit(")"))
+    between_pred = Seq(col_ref, WS, Opt(Seq(kw("NOT"), WS)),
+                       kw("BETWEEN"), WS, scalar, WS, kw("AND"), WS,
+                       scalar)
     predicate = Alt(Seq(operand, OWS, cmp, OWS, operand),
-                    null_pred, like_pred)
+                    null_pred, like_pred, in_pred, between_pred)
     condition = Seq(predicate,
                     Star(Seq(WS, Alt(kw("AND"), kw("OR")), WS, predicate)))
 

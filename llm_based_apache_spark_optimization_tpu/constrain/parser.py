@@ -176,10 +176,22 @@ class _Parser:
         else:
             self.col_ref()
 
+    def scalar(self) -> None:
+        """IN-list / BETWEEN bound: literal or column ref, no aggregates
+        (matching the DFA's `scalar` branch)."""
+        tok = self.peek()
+        if tok is None:
+            raise SqlSyntaxError("unexpected end of input in expression")
+        if tok.kind in ("number", "string"):
+            self.take()
+        else:
+            self.col_ref()
+
     def predicate(self) -> None:
         self.operand()
-        # IS [NOT] NULL / [NOT] LIKE 'pattern' — keyword predicates; the
-        # lexer already split words, so (unlike the DFA) `a IS  NULL` with
+        # IS [NOT] NULL / [NOT] LIKE 'pattern' / [NOT] IN (...) /
+        # [NOT] BETWEEN lo AND hi — keyword predicates; the lexer
+        # already split words, so (unlike the DFA) `a IS  NULL` with
         # any whitespace parses. Leniency note: the DFA restricts the
         # left side to a column reference while this parser accepts any
         # operand ("5 IS NULL" parses here, is unspellable there) — safe
@@ -190,16 +202,36 @@ class _Parser:
                 self.take()
             self.expect_kw("NULL")
             return
-        if self.at_kw("NOT", "LIKE"):
+        if self.at_kw("NOT", "LIKE", "IN", "BETWEEN"):
             if self.at_kw("NOT"):
                 self.take()
-            self.expect_kw("LIKE")
-            tok = self.take()
-            if tok.kind != "string":
-                raise SqlSyntaxError(
-                    f"LIKE needs a string pattern at {tok.pos}, "
-                    f"got {tok.text!r}"
-                )
+            if self.at_kw("LIKE"):
+                self.take()
+                tok = self.take()
+                if tok.kind != "string":
+                    raise SqlSyntaxError(
+                        f"LIKE needs a string pattern at {tok.pos}, "
+                        f"got {tok.text!r}"
+                    )
+                return
+            if self.at_kw("IN"):
+                # Parenthesized non-empty scalar list (no nested
+                # selects in this subset).
+                self.take()
+                self.expect_punct("(")
+                self.scalar()
+                while self.at_punct(","):
+                    self.take()
+                    self.scalar()
+                self.expect_punct(")")
+                return
+            # BETWEEN consumes its AND eagerly, so condition()'s
+            # AND/OR loop never mistakes the range conjunction for a
+            # boolean connective.
+            self.expect_kw("BETWEEN")
+            self.scalar()
+            self.expect_kw("AND")
+            self.scalar()
             return
         tok = self.take()
         if tok.kind != "op":

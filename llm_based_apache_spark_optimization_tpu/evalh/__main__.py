@@ -35,6 +35,14 @@ def main(argv=None) -> None:
                     help="run BASELINE configs (all when no KEY given)")
     ap.add_argument("--spider", metavar="DEV_JSON",
                     help="evaluate on real Spider data at this path")
+    ap.add_argument("--explain", nargs="?", metavar="MODEL",
+                    const="llama3.2",  # bare --explain = the fleet's
+                                       # error-analysis model
+                    help="explain stage: route every execute-fail case's "
+                         "engine error through this registered in-fleet "
+                         "model (the same path app/pipeline.explain_error "
+                         "serves) and report explainer latency separately "
+                         "from SQL-generation latency")
     ap.add_argument("--constrain", action="store_true",
                     help="decode under the in-tree Spark-SQL grammar "
                          "(constrain/): every completion is guaranteed to "
@@ -128,6 +136,10 @@ def main(argv=None) -> None:
     )
 
     if args.configs is not None:
+        if args.explain:
+            sys.exit("--explain applies to the suite evaluation (it needs "
+                     "the fixture exec backend to produce engine errors); "
+                     "--configs rows score fixed scenarios")
         if args.constrain:
             # The BASELINE configs are fixed reproduction scenarios; a
             # silently ignored --constrain would print unconstrained
@@ -192,11 +204,25 @@ def main(argv=None) -> None:
     unknown = sorted(set(models) - set(available))
     if unknown:
         sys.exit(f"unknown model(s) {unknown}; available: {available}")
+    if args.explain and exec_backend is None:
+        sys.exit("--explain needs the fixture exec backend for engine "
+                 "errors; it does not combine with --spider")
+    if args.explain and args.explain not in available:
+        sys.exit(f"--explain model {args.explain!r} is not registered; "
+                 f"available: {available}")
     reports = evaluate_models(
         service, models, cases, system,
         max_new_tokens=args.max_new_tokens, exec_backend=exec_backend,
         constrain="spark_sql" if args.constrain else None,
     )
+    if args.explain:
+        from .harness import explain_failures
+
+        reports = {
+            m: explain_failures(service, args.explain, rep,
+                                max_new_tokens=args.max_new_tokens)
+            for m, rep in reports.items()
+        }
     print(format_summary(reports))
 
 

@@ -43,6 +43,14 @@ class CaseResult:
     # just how much. 0.0 = not measured (fakes, the one-program engine).
     ttft_s: float = 0.0
     queue_wait_s: float = 0.0
+    # Explain stage (ISSUE-16): the engine's error text when the generated
+    # statement failed to execute, and the in-fleet explainer's analysis
+    # of it. explain_latency_s is the explainer round trip ALONE — kept
+    # out of latency_s so SQL-gen numbers stay comparable with and
+    # without the stage.
+    exec_error: str = ""
+    explanation: str = ""
+    explain_latency_s: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,6 +126,22 @@ class ModelReport:
             return None
         return 100.0 * sum(judged) / len(judged)
 
+    @property
+    def explained_failures(self) -> int:
+        """Execute-fail cases the explain stage annotated."""
+        return sum(1 for c in self.cases if c.explanation)
+
+    @property
+    def avg_explain_latency_s(self) -> Optional[float]:
+        """Mean explainer round trip over explained cases — reported
+        SEPARATELY from avg_latency_s (SQL generation), so the explain
+        stage never inflates the generation numbers it rides beside.
+        None when the stage didn't run or nothing failed."""
+        vals = [c.explain_latency_s for c in self.cases if c.explanation]
+        if not vals:
+            return None
+        return sum(vals) / len(vals)
+
 
 def _score(case: EvalCase, generated: str, latency_s: float,
            output_tokens: int, exec_backend=None,
@@ -133,9 +157,13 @@ def _score(case: EvalCase, generated: str, latency_s: float,
         # One shared generated-query run scores both execution metrics
         # (execution_outcome — a second identical round trip per case
         # doubled the oracle I/O across the suite).
-        m, gen_ok = execution_outcome(generated, expected, exec_backend)
+        m, gen_ok, gen_err = execution_outcome(generated, expected,
+                                               exec_backend)
         ex = None if m is None else int(m)
         exe = int(gen_ok)
+        err = gen_err
+    else:
+        err = ""
     return CaseResult(
         nl=case.nl,
         generated_sql=generated,
@@ -149,6 +177,7 @@ def _score(case: EvalCase, generated: str, latency_s: float,
         executable=exe,
         ttft_s=ttft_s,
         queue_wait_s=queue_wait_s,
+        exec_error=err,
     )
 
 
@@ -225,6 +254,54 @@ def evaluate_model_batched(
     return ModelReport(model=model, cases=results, wall_clock_s=wall)
 
 
+# Same system prompt app/pipeline.explain_error serves in production —
+# the explain stage measures the same in-fleet path, not a lookalike.
+EXPLAIN_SYSTEM = (
+    "You are an AI that helps troubleshoot Apache Spark errors. "
+    "Provide clear, concise solutions."
+)
+
+
+def explain_failures(
+    service: GenerationService,
+    explainer_model: str,
+    report: ModelReport,
+    max_new_tokens: int = 128,
+) -> ModelReport:
+    """Explain stage: route every execute-fail case's engine error through
+    the in-fleet error-analysis model (ISSUE-16) and return a report with
+    the explanations attached.
+
+    The explainer round trip is timed into explain_latency_s, NEVER into
+    latency_s — SQL-generation latency and explainer latency answer
+    different questions (how fast is NL→SQL vs. how fast is the
+    diagnosis), and folding them together would make constrained-decoding
+    runs look slower exactly when they fail less. The explainer prompt is
+    the same shape app/pipeline.explain_error sends, so what this stage
+    measures is the path production requests take on a failed execute."""
+    out: List[CaseResult] = []
+    for case in report.cases:
+        if case.executable == 0 and case.exec_error:
+            res = service.generate(
+                model=explainer_model,
+                system=EXPLAIN_SYSTEM,
+                prompt=(
+                    f"The following Spark error occurred:\n\n"
+                    f"{case.exec_error}\n\n"
+                    f"Please analyze this error and suggest possible "
+                    f"solutions."
+                ),
+                max_new_tokens=max_new_tokens,
+            )
+            case = dataclasses.replace(
+                case,
+                explanation=res.response.strip() or "(empty explanation)",
+                explain_latency_s=res.latency_s,
+            )
+        out.append(case)
+    return dataclasses.replace(report, cases=out)
+
+
 def evaluate_models(
     service: GenerationService,
     models: Sequence[str],
@@ -270,6 +347,14 @@ def format_summary(reports: Dict[str, ModelReport]) -> str:
         if rep.executable_rate is not None:
             lines.append(
                 f"Executable Rate: {rep.executable_rate:.2f}%"
+            )
+        if rep.avg_explain_latency_s is not None:
+            # Explainer latency is its own line, never folded into
+            # Average Latency (SQL generation) above.
+            lines.append(
+                f"Failures Explained: {rep.explained_failures} "
+                f"(avg explainer latency "
+                f"{rep.avg_explain_latency_s:.4f} sec)"
             )
         lines.append("=" * 72)
     return "\n".join(lines)

@@ -88,11 +88,14 @@ def executes(generated: str, backend) -> bool:
 
 def execution_outcome(
     generated: str, expected: str, backend
-) -> "tuple[Optional[bool], bool]":
-    """(execution match, generated-executes) with the generated statement
-    run AT MOST ONCE — the harness scores both metrics per case, and a
-    second identical round trip per case doubled the oracle I/O across a
-    suite.
+) -> "tuple[Optional[bool], bool, str]":
+    """(execution match, generated-executes, engine error) with the
+    generated statement run AT MOST ONCE — the harness scores both metrics
+    per case, and a second identical round trip per case doubled the
+    oracle I/O across a suite. The third element is the engine's error
+    text when the generated statement failed ("" on success) — the evalh
+    explain stage routes it to the in-fleet error-analysis model, the
+    same trace shape app/pipeline.explain_error handles in serving.
 
     Match semantics (Spider's test-suite convention): run both queries,
     compare columns-count + rows — as a multiset, EXCEPT when the expected
@@ -104,32 +107,35 @@ def execution_outcome(
     import re
 
     got = None
+    gen_err = ""
     if _is_query(generated):
         try:
             got = backend.execute(generated)
             gen_ok = True
-        except Exception:
+        except Exception as e:
             gen_ok = False
+            gen_err = f"{type(e).__name__}: {e}"
     else:
         gen_ok = False
+        gen_err = "statement rejected: not a read-only SELECT/WITH query"
 
     if not _is_query(expected):
-        return None, gen_ok
+        return None, gen_ok, gen_err
     try:
         exp = backend.execute(expected)
     except Exception:
-        return None, gen_ok
+        return None, gen_ok, gen_err
     if not gen_ok:
-        return False, False
+        return False, False, gen_err
     if len(got.columns) != len(exp.columns):
-        return False, True
+        return False, True, ""
 
     def norm(rows):
         return [tuple(_norm_cell(x) for x in r) for r in rows]
 
     if re.search(r"\border\s+by\b", expected, re.IGNORECASE):
-        return norm(got.rows) == norm(exp.rows), True
-    return sorted(norm(got.rows)) == sorted(norm(exp.rows)), True
+        return norm(got.rows) == norm(exp.rows), True, ""
+    return sorted(norm(got.rows)) == sorted(norm(exp.rows)), True, ""
 
 
 def execution_match(

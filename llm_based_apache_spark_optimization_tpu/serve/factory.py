@@ -16,6 +16,49 @@ from typing import Callable, Optional
 from .service import GenerationService
 
 
+def assemble_multimodel_service(
+    spec,
+    *,
+    max_new_tokens: int = 48,
+    supervise: bool = False,
+    num_slots: int = 2,
+    total_pages: int = 0,
+    seed: int = 0,
+):
+    """LSOT_MODELS assembly (ISSUE 16): co-resident checkpoints in ONE
+    scheduler pool that routes on `model_id`.
+
+    `spec` is the `LSOT_MODELS` string (or a pre-parsed list of
+    `ModelSpec`). Only `tiny` sources assemble here — the proof-harness
+    fleet tests, smoke scripts and the bench `multi_model` leg use;
+    `hf`/`gguf` sources carry real checkpoints and assemble through
+    `app/__main__.py --backend checkpoint`, which owns mesh/quant
+    plumbing. Returns `(service, pool, registry)`.
+
+    This path REPLACES `assemble_reference_service` when LSOT_MODELS is
+    set: the error model becomes its own registered checkpoint (the
+    in-fleet explainer) instead of a shared-weights alias of the SQL
+    model. With LSOT_MODELS unset, the alias path below runs unchanged
+    — bit for bit.
+    """
+    from .modelpool import build_tiny_model_service, parse_models_spec
+
+    specs = parse_models_spec(spec) if isinstance(spec, str) else list(spec)
+    if not specs:
+        raise ValueError("LSOT_MODELS is empty")
+    bad = [m.model_id for m in specs if m.source != "tiny"]
+    if bad:
+        raise ValueError(
+            f"models {bad} have hf/gguf sources — assemble real "
+            f"checkpoints through --backend checkpoint (this path "
+            f"builds the tiny proof-harness fleet)"
+        )
+    return build_tiny_model_service(
+        specs, num_slots=num_slots, total_pages=total_pages,
+        max_new_tokens=max_new_tokens, supervise=supervise, seed=seed,
+    )
+
+
 def assemble_reference_service(
     build: Callable[[str, bool], object],
     sql_src: str,

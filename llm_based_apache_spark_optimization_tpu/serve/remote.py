@@ -93,6 +93,7 @@ import numpy as np
 from ..ops.sampling import SamplingParams
 from ..utils.faults import FAULTS, InjectedFault
 from ..utils.observability import resilience
+from .modelpool import UnknownModel
 from .resilience import (
     CircuitBreaker,
     CircuitOpen,
@@ -315,6 +316,7 @@ _ERR_TYPES = {
     "ReplicaUnreachable": ReplicaUnreachable,
     "Quarantined": Quarantined,
     "CircuitOpen": CircuitOpen,
+    "UnknownModel": UnknownModel,
     "ValueError": ValueError,
     "RuntimeError": RuntimeError,
 }
@@ -398,6 +400,10 @@ def request_to_wire(req) -> Dict:
     }
     if req.deadline is not None:
         d["deadline_s"] = max(0.001, float(req.deadline.remaining()))
+    if getattr(req, "model_id", ""):
+        # Multi-model fleets (ISSUE 16): a migrated request's KV pages
+        # are model-specific — the receiving side re-checks the id.
+        d["model_id"] = str(req.model_id)
     if req.constraint is not None:
         d["constrain"] = _constraint_spec(req.constraint)
     if req.spilled is not None:
@@ -435,6 +441,7 @@ def request_from_wire(d: Dict, future: Optional[Future] = None,
                   if d.get("deadline_s") else None),
     )
     req.rid = int(d.get("rid", 0))
+    req.model_id = str(d.get("model_id", "") or "")
     req.generated = [int(t) for t in d.get("generated", [])]
     req.resume_pref = int(d.get("resume_pref", 0))
     req.rng_count = int(d.get("rng_count", 0))
@@ -824,9 +831,11 @@ class LoopbackTransport(_TransportBase):
 
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
-               on_token=None, constraint=None, deadline_s=None, trace=None):
+               on_token=None, constraint=None, deadline_s=None, trace=None,
+               model_id: str = ""):
         if self._unreachable is not None:
             raise self._unreachable
+        extra = {"model_id": model_id} if model_id else {}
         if not FAULTS.active:
             # Fast path: the direct call, byte for byte (same future
             # object, same accounting). The envelope exists for chaos
@@ -835,7 +844,7 @@ class LoopbackTransport(_TransportBase):
             return self.inner.submit(
                 ids, max_new_tokens=max_new_tokens, sampling=sampling,
                 seed=seed, on_token=on_token, constraint=constraint,
-                deadline_s=deadline_s, trace=trace,
+                deadline_s=deadline_s, trace=trace, **extra,
             )
         token = self._next_token()
         gate = self._gate_on_token(on_token)
@@ -845,7 +854,7 @@ class LoopbackTransport(_TransportBase):
                 inner_fut = self.inner.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
                     seed=seed, on_token=gate, constraint=constraint,
-                    deadline_s=deadline_s, trace=trace,
+                    deadline_s=deadline_s, trace=trace, **extra,
                 )
                 return self._chain(token, inner_fut)
 
@@ -989,6 +998,7 @@ def describe_scheduler(sched) -> Dict[str, object]:
         "harvest_lag": int(getattr(sched, "_harvest_lag", 0)),
         "overshoot": int(getattr(sched, "overshoot", 0)),
         "phase_role": str(getattr(sched, "phase_role", "mixed") or "mixed"),
+        "model_id": str(getattr(sched, "model_id", "") or ""),
         "pblock": int(getattr(sched, "_pblock", 0) or 0),
         "page_size": int(getattr(sched, "_page_size", 0) or 0),
         "paged": bool(getattr(sched, "_paged", False)),
@@ -1014,6 +1024,10 @@ def loads_digest_for(sched) -> Dict[str, object]:
         "queued": int(q.qsize()) if q is not None else 0,
         "active_slots": sum(1 for r in slot_req if r is not None),
         "crashed": getattr(sched, "_crash", None) is not None,
+        # Per-model throughput attribution across the wire (ISSUE 16):
+        # the pool's model_stats() sums this beside its local reads.
+        "tokens_total": int(
+            getattr(sched, "_tokens_emitted_total", 0) or 0),
     }
     hint = getattr(sched, "retry_after_hint", None)
     if callable(hint):
@@ -1311,7 +1325,8 @@ class SocketTransport(_TransportBase):
 
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
-               on_token=None, constraint=None, deadline_s=None, trace=None):
+               on_token=None, constraint=None, deadline_s=None, trace=None,
+               model_id: str = ""):
         # `trace` stays host-local: span trees do not cross the wire
         # (the submit→ack wall lands in the client's spans instead).
         del trace
@@ -1323,6 +1338,11 @@ class SocketTransport(_TransportBase):
             "sampling": _sampling_to_wire(sampling),
             "seed": int(seed),
         }
+        if model_id:
+            # Multi-model fleets (ISSUE 16): the worker re-validates the
+            # id against its own checkpoint — a client routed to the
+            # wrong worker fails typed, never decodes on wrong weights.
+            payload["model_id"] = str(model_id)
         if deadline_s is not None:
             payload["deadline_s"] = float(deadline_s)
         if constraint is not None:
@@ -1516,6 +1536,13 @@ class SocketTransport(_TransportBase):
     @property
     def phase_role(self) -> str:
         return str(self._dig("phase_role", "mixed"))
+
+    @property
+    def model_id(self) -> str:
+        """Which checkpoint the remote replica serves (ISSUE 16) —
+        shipped once in the hello digest; the pool's model router
+        filters on it exactly like an in-process replica's attribute."""
+        return str(self._dig("model_id", "") or "")
 
     @property
     def _pblock(self) -> int:
@@ -1762,12 +1789,24 @@ class ReplicaServer:
                         "this replica has no constraint resolver"
                     )
                 constraint = self.constraint_resolver(spec)
+            want_model = str(msg.get("model_id", "") or "")
+            if want_model:
+                have = str(getattr(self.scheduler, "model_id", "") or "")
+                if want_model != have:
+                    # Refuse BEFORE generating: decoding on the wrong
+                    # checkpoint would return fluent garbage, not an error.
+                    raise UnknownModel(
+                        f"worker serves model {have or '<unlabeled>'!r}, "
+                        f"request wants {want_model!r}"
+                    )
+            extra = {"model_id": want_model} if want_model else {}
             fut = self.scheduler.submit(
                 msg["ids"], max_new_tokens=int(msg.get("max_new", 256)),
                 sampling=_sampling_from_wire(msg.get("sampling")),
                 seed=int(msg.get("seed", 0)), on_token=emitter,
                 constraint=constraint,
                 deadline_s=msg.get("deadline_s"),
+                **extra,
             )
             with self._lock:
                 self._live[token] = fut
@@ -1798,6 +1837,14 @@ class ReplicaServer:
                 msg["req"], on_token=None,
                 constraint_resolver=self.constraint_resolver,
             )
+            want_model = str(getattr(req, "model_id", "") or "")
+            if want_model:
+                have = str(getattr(self.scheduler, "model_id", "") or "")
+                if want_model != have:
+                    raise UnknownModel(
+                        f"worker serves model {have or '<unlabeled>'!r}, "
+                        f"requeued request wants {want_model!r}"
+                    )
             # The request's owner is the CLIENT: its server-side future
             # only exists to feed events back over the wire.
             base = len(req.generated)
@@ -1941,6 +1988,7 @@ def _build_worker_scheduler(args):
         kv_page_size=args.kv_page_size or None,
         speculative_draft=args.speculative,
         phase_role=args.phase_role,
+        model_id=getattr(args, "model_id", "") or "",
     )
     tok = ByteTokenizer()
 
@@ -1970,6 +2018,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--speculative", type=int, default=0)
     ap.add_argument("--phase-role", default="mixed",
                     choices=["mixed", "prefill", "decode"])
+    ap.add_argument("--model-id", default="",
+                    help="model identity this worker serves; requests "
+                         "carrying a different model_id fail typed "
+                         "(UnknownModel) instead of decoding on the "
+                         "wrong weights")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
 

@@ -443,6 +443,12 @@ class _Request:
     trace: Optional[object] = None
     admitted_at: float = 0.0
     ready_at: float = 0.0
+    # Multi-model serving (ISSUE 16): the checkpoint this request's KV
+    # was (or will be) written by — stamped at submit from the owning
+    # scheduler, carried on requeue/extract wire frames so a migrated
+    # request can only land on a same-model replica ("" = the
+    # single-model fleet).
+    model_id: str = ""
     # Paged KV (kv_layout="paged"): highest cache position (exclusive) this
     # request's prefill+decode can ever write — admission allocated pages
     # covering exactly [0, page_end), and the ready-time ensure-writable
@@ -611,6 +617,14 @@ class ContinuousBatchingScheduler:
         kv_watermark_low: Optional[float] = None,
         kv_watermark_high: Optional[float] = None,
         phase_role: str = "mixed",
+        # Multi-model serving (ISSUE 16): which registered checkpoint
+        # this replica holds. "" (the default) is the single-model
+        # fleet, bit for bit — the pool only routes on model when a
+        # request names one AND replicas carry ids
+        # (serve/modelpool.py owns the registry; LSOT_POOL_MODELS
+        # gates the routing axis like LSOT_POOL_AFFINITY gates
+        # affinity).
+        model_id: str = "",
     ):
         self.cfg = cfg
         self.mesh = mesh
@@ -638,6 +652,11 @@ class ContinuousBatchingScheduler:
                 f"prefill→decode handoff ships KV pool pages"
             )
         self.phase_role = phase_role
+        self.model_id = str(model_id or "")
+        # Accepted tokens over this scheduler's lifetime (ISSUE 16):
+        # bumped once per harvested round; per-model throughput
+        # attribution reads it (pool.model_stats / lsot_model_*).
+        self._tokens_emitted_total = 0
         # Handoff state. `_handoff_pending` holds (slot, req, tok, epoch)
         # for final chunks whose first token is still on device;
         # `_handoff` is the packed-blob queue the pool drains. Counters
@@ -2903,9 +2922,22 @@ class ContinuousBatchingScheduler:
         # prefill / per-round decode spans into this tree. None (the
         # unsampled fast path) costs nothing anywhere in the loop.
         trace=None,
+        # Multi-model serving (ISSUE 16): the model the request wants.
+        # "" accepts (single-model callers never name one); a non-empty
+        # id must match THIS replica's checkpoint — a mismatch is the
+        # caller's routing bug and fails typed instead of decoding the
+        # prompt against the wrong weights.
+        model_id: str = "",
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
+        if model_id and model_id != self.model_id:
+            from .modelpool import UnknownModel
+
+            raise UnknownModel(
+                f"request names model {model_id!r} but this replica "
+                f"serves {self.model_id or '<unset>'!r}"
+            )
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         if constraint is not None:
@@ -2935,6 +2967,7 @@ class ContinuousBatchingScheduler:
             deadline=(Deadline.after(deadline_s)
                       if deadline_s is not None else None),
             trace=trace,
+            model_id=model_id or self.model_id,
         )
         req.future._lsot_request = req  # cancel() handle
         try:
@@ -4406,6 +4439,10 @@ class ContinuousBatchingScheduler:
         # append; bench prices it.
         ewma = self.heartbeat.expected_round_s()
         round_wall = round(t_harvest - t_issue, 6)
+        # Monotonic accepted-token counter (ISSUE 16): the per-model
+        # tok/s feed — one int add on the harvest path, read by the
+        # pool's model_stats() and the lsot_model_tokens_total family.
+        self._tokens_emitted_total += round_emitted
         rec = {
             "round": self.heartbeat.rounds,
             "occupancy": occupancy,
@@ -4710,6 +4747,11 @@ class _ReplicaState:
     placements: int = 0
     restart_eta: Optional[float] = None
     last_crash: Optional[str] = None
+    #: Multi-model axis (ISSUE 16) beside phase_role: which registered
+    #: checkpoint this replica holds ("" = the single-model fleet).
+    #: Captured at wiring time so placement can filter on it even while
+    #: the scheduler object is mid-restart-swap.
+    model_id: str = ""
 
     #: States a replica can take new work in.
     PLACEABLE = ("ready", "degraded")
@@ -4799,6 +4841,13 @@ class SchedulerPool:
         # LSOT_LEASE_MISSES; lease_s <= 0 disables the monitor.
         lease_s: Optional[float] = None,
         lease_misses: Optional[int] = None,
+        # Multi-model routing (ISSUE 16): requests naming a model_id are
+        # placed only on replicas carrying that checkpoint (model →
+        # affinity → pressure → weighted least-loaded). None reads
+        # LSOT_POOL_MODELS (default ON); 0/False — or requests that
+        # never name a model — reproduce the single-model placement
+        # order bit for bit.
+        model_routing: Optional[bool] = None,
     ):
         if not schedulers:
             raise ValueError("SchedulerPool needs at least one scheduler")
@@ -4865,7 +4914,8 @@ class SchedulerPool:
             fl = getattr(s, "flight", None)
             if fl is not None:
                 fl.replica = label
-            self._states.append(_ReplicaState(label=label))
+            self._states.append(_ReplicaState(
+                label=label, model_id=self._model_id(s)))
             # Disaggregation (ISSUE 13): a prefill-role replica's packed
             # handoffs drain through the pool's phase-aware placement
             # pump (re-wired after every restart swap).
@@ -4886,6 +4936,18 @@ class SchedulerPool:
         self._affinity = bool(affinity_routing)
         self._aff_checked = 0
         self._aff_hits = 0
+        # Multi-model routing flip (ISSUE 16): ON by default, but inert
+        # until a request names a model_id — LSOT_POOL_MODELS=0 makes
+        # even named requests fall through to the model-blind order.
+        if model_routing is None:
+            model_routing = os.environ.get(
+                "LSOT_POOL_MODELS", "1").strip().lower() not in (
+                    "0", "false", "no", "off")
+        self._model_routing = bool(model_routing)
+        # Per-model throughput attribution (model_stats): last observed
+        # (wall, tokens_total) per model, so successive scrapes read a
+        # live tok/s without a sampling thread.
+        self._model_rate: Dict[str, Tuple[float, int]] = {}
         # Heterogeneous replica weights: capacity multipliers by index
         # (missing entries default 1.0; weights must be positive).
         if weights is None:
@@ -5184,6 +5246,11 @@ class SchedulerPool:
             # and its handoff traffic — the router's placement feed and
             # the per-replica lsot_serving_* gauges.
             rec["phase_role"] = self._phase_role(s)
+            # Multi-model axis (ISSUE 16): which checkpoint the replica
+            # holds — the model router's placement feed, carried beside
+            # phase_role in loads/health views (and across the remote
+            # transport via describe_scheduler's digest).
+            rec["model_id"] = st.model_id or self._model_id(s)
             ho = getattr(s, "handoff_stats", None)
             if isinstance(ho, dict):
                 rec["handoff_exports"] = ho["exports"]
@@ -5458,11 +5525,81 @@ class SchedulerPool:
             return {
                 "router": self.router,
                 "affinity_routing": self._affinity,
+                "model_routing": self._model_routing,
                 "weights": list(self._weights),
                 "placements": sum(st.placements for st in self._states),
                 "affinity_checked": self._aff_checked,
                 "affinity_hits": self._aff_hits,
             }
+
+    def model_stats(self) -> Optional[Dict[str, object]]:
+        """Per-model serving aggregation (ISSUE 16): queue depth, live
+        slots, accepted-token throughput and KV pages held, summed over
+        every replica carrying each model_id — the `serving.models`
+        payload behind the `lsot_model_*` Prometheus families. None for
+        single-model fleets (no replica carries an id), which keeps the
+        pre-multi-model /metrics byte-identical."""
+        per: Dict[str, Dict[str, object]] = {}
+        for st, s in self._replica_items():
+            mid = st.model_id or self._model_id(s)
+            if not mid:
+                continue
+            rec = per.setdefault(mid, {
+                "model": mid, "replicas": 0, "placeable": 0,
+                "queued": 0, "active_slots": 0,
+                "pending_new_tokens": 0, "backlog_s": 0.0,
+                "placements": 0, "tokens_total": 0,
+                "kv_pages_total": 0, "kv_pages_in_use": 0,
+            })
+            rec["replicas"] += 1
+            if st.state in _ReplicaState.PLACEABLE:
+                rec["placeable"] += 1
+            secs, toks = self._score(s)
+            rec["backlog_s"] = round(rec["backlog_s"] + secs, 4)
+            rec["pending_new_tokens"] += toks
+            q = getattr(s, "_queue", None)
+            rec["queued"] += q.qsize() if q is not None else 0
+            slot_req = getattr(s, "_slot_req", None) or []
+            rec["active_slots"] += sum(
+                1 for r in slot_req if r is not None)
+            rec["placements"] += st.placements
+            rec["tokens_total"] += int(
+                getattr(s, "_tokens_emitted_total", 0) or 0)
+            pstats = getattr(s, "page_stats", None)
+            if isinstance(pstats, dict):
+                rec["kv_pages_total"] += int(
+                    pstats.get("pages_total", 0) or 0)
+                rec["kv_pages_in_use"] += int(
+                    pstats.get("pages_in_use", 0) or 0)
+            # Remote carriers: the cached loads digest stands in for
+            # the attribute reads a socket transport cannot offer.
+            ld = getattr(s, "loads_digest", None)
+            if callable(ld):
+                try:
+                    d = ld()
+                    rec["queued"] += int(d.get("queued", 0) or 0)
+                    rec["active_slots"] += int(
+                        d.get("active_slots", 0) or 0)
+                    rec["tokens_total"] += int(
+                        d.get("tokens_total", 0) or 0)
+                except Exception:  # noqa: BLE001 — a dying replica
+                    pass
+        if not per:
+            return None
+        # Scrape-to-scrape tok/s: delta of the monotonic accepted-token
+        # counter over the wall between calls (first call reports 0.0).
+        now = time.monotonic()
+        with self._lock:
+            for mid, rec in per.items():
+                prev = self._model_rate.get(mid)
+                total = int(rec["tokens_total"])
+                tok_s = 0.0
+                if prev is not None and now > prev[0]:
+                    tok_s = max(0.0, (total - prev[1]) / (now - prev[0]))
+                self._model_rate[mid] = (now, total)
+                rec["tok_s"] = round(tok_s, 3)
+        return {"models": sorted(per.values(),
+                                 key=lambda r: r["model"])}
 
     def _replica_items(self, states: Optional[Sequence[str]] = None
                        ) -> List[Tuple["_ReplicaState", object]]:
@@ -5499,6 +5636,14 @@ class SchedulerPool:
     @staticmethod
     def _phase_role(s) -> str:
         return getattr(s, "phase_role", "mixed") or "mixed"
+
+    @staticmethod
+    def _model_id(s) -> str:
+        return str(getattr(s, "model_id", "") or "")
+
+    #: Duck-typing flag: callers (SchedulerBackend, the supervisor) only
+    #: forward a model_id to schedulers that understand the axis.
+    supports_model_routing = True
 
     def _wire_handoff(self, idx: int, s) -> None:
         """Point a prefill-role replica's handoff queue at the pool's
@@ -5577,6 +5722,16 @@ class SchedulerPool:
         remaining = (req.deadline.remaining()
                      if req.deadline is not None else None)
         cands = self._placeable()
+        # Multi-model fleets (ISSUE 16): a migrated request's KV pages
+        # were written by the SOURCE model's weights — a cross-model
+        # sibling would decode them into garbage. Same-model targets
+        # only; the in-place fallback (the source itself) always
+        # matches.
+        src_model = self._model_id(src)
+        if self._model_routing and src_model:
+            cands = [c for c in cands
+                     if (c[1].model_id or self._model_id(c[2]))
+                     == src_model]
 
         def ordered(role):
             # Score once per candidate (decorate-sort): backlog_score /
@@ -5645,7 +5800,8 @@ class SchedulerPool:
 
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
-               on_token=None, constraint=None, deadline_s=None, trace=None):
+               on_token=None, constraint=None, deadline_s=None, trace=None,
+               model_id: str = ""):
         """Least-loaded, deadline-aware placement (router="round_robin"
         keeps the pre-fleet rotation): score every placeable replica,
         skip the ones whose backlog would blow this request's deadline,
@@ -5655,12 +5811,39 @@ class SchedulerPool:
         capacity, DeadlineExceeded (504) when every placeable replica's
         backlog exceeds the deadline, Overloaded-with-backoff when the
         whole fleet is mid-restart, and SchedulerCrashed only when the
-        fleet is truly gone."""
+        fleet is truly gone.
+
+        Multi-model placement (ISSUE 16): a request naming `model_id`
+        considers ONLY replicas carrying that checkpoint — ahead of the
+        phase filter, the affinity sort and the load tie-break. Naming a
+        model nobody registered fails typed `UnknownModel` (ValueError →
+        a 4xx at the API layer, never a scheduler crash); a model whose
+        replicas are all mid-drain/restart sheds retryable Overloaded.
+        `model_id=""` (all pre-existing callers) or LSOT_POOL_MODELS=0
+        skips every model check — the single-model placement order, bit
+        for bit."""
+        want_model = model_id if (self._model_routing and model_id) else ""
+        if want_model:
+            with self._lock:
+                carriers = [st.state for st in self._states
+                            if st.model_id == want_model]
+            if not carriers:
+                from .modelpool import UnknownModel
+
+                raise UnknownModel(
+                    f"no replica in the fleet serves model "
+                    f"{want_model!r} (models: "
+                    f"{sorted({st.model_id for st in self._states if st.model_id}) or ['<unset>']})"
+                )
         last_overloaded: Optional[Overloaded] = None
         deadline_blocked: Optional[float] = None
         tried: set = set()
         while True:
             cands = self._placeable(exclude=tried)
+            if want_model:
+                cands = [c for c in cands
+                         if (c[1].model_id or self._model_id(c[2]))
+                         == want_model]
             if not cands:
                 break
             # Phase-aware routing (ISSUE 13): NEW requests are prefill
@@ -5736,10 +5919,14 @@ class SchedulerPool:
                 scored = feasible
             (secs, toks), i, st, sched = scored[0]
             try:
+                # The model kwarg rides only model-named submits: every
+                # pre-existing replica (and the test fleet's duck-typed
+                # fakes) keeps its exact signature on the "" path.
+                extra = {"model_id": want_model} if want_model else {}
                 fut = sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
                     seed=seed, on_token=on_token, constraint=constraint,
-                    deadline_s=deadline_s, trace=trace,
+                    deadline_s=deadline_s, trace=trace, **extra,
                 )
             except ValueError:
                 # Request-shape rejection (oversize prompt): identical on
@@ -5797,8 +5984,31 @@ class SchedulerPool:
             )
             if aff:
                 ev["affinity"] = aff.get(st.label, 0)
+            if want_model:
+                ev["model"] = want_model
             self._pool_flight.event("placement", **ev)
             return fut
+        if want_model and last_overloaded is None \
+                and deadline_blocked is None:
+            # The model IS registered (the pre-loop check passed) but no
+            # carrier is placeable right now: a drain/restart in flight
+            # is retryable backpressure; all-dead is the model-scoped
+            # fleet death. Re-snapshot — the loop's crash handling may
+            # have moved carriers since the pre-loop check.
+            with self._lock:
+                carriers = [st.state for st in self._states
+                            if st.model_id == want_model]
+            if any(s in ("restarting", "draining", "drained")
+                   for s in carriers):
+                raise Overloaded(
+                    f"every replica serving model {want_model!r} is "
+                    f"draining or restarting",
+                    retry_after_s=self.retry_after_hint(),
+                )
+            raise SchedulerCrashed(
+                f"every replica serving model {want_model!r} has "
+                f"crashed or left the fleet"
+            )
         if last_overloaded is not None:
             # Min Retry-After across the full fleet (restart-aware), not
             # whichever replica happened to shed last.
@@ -5998,6 +6208,10 @@ class SchedulerPool:
                 # A rebuilt prefill-role replica needs its handoff pump
                 # re-pointed at the pool (the corpse took the wiring).
                 self._wire_handoff(idx, fresh)
+                # Re-capture the model axis: the factory may rebuild the
+                # replica with (or without) a checkpoint id, and stale
+                # model routing would misplace every named request.
+                st.model_id = self._model_id(fresh)
                 # Degraded until a clean completion lands on it (the
                 # submit-path done-callback promotes it back to ready).
                 st.state = "degraded"
@@ -6049,9 +6263,20 @@ class SchedulerPool:
         if callable(exh):
             pulls.extend(exh())
         if pulls:
+            # Multi-model fleets (ISSUE 16): a draining replica's queued
+            # work can only re-place onto siblings holding the SAME
+            # checkpoint — a cross-model sibling would decode with the
+            # wrong weights. Draining the ONLY replica of a model keeps
+            # the lone-replica degenerate path below: the work stays on
+            # the draining replica and serves out inside the grace.
+            drain_model = st.model_id or self._model_id(sched)
             for req in pulls:
                 target = None
                 cands = self._placeable()
+                if self._model_routing and drain_model:
+                    cands = [c for c in cands
+                             if (c[1].model_id or self._model_id(c[2]))
+                             == drain_model]
                 if cands:
                     target = min(
                         ((self._wscore(i, s), self._penalty(_st, s), i, s)
@@ -6151,6 +6376,7 @@ class SchedulerPool:
                 "replica": st.label,
                 "state": st.state,
                 "phase_role": self._phase_role(s),
+                "model_id": st.model_id or self._model_id(s),
                 "restarts": st.restarts,
                 "max_restarts": self.max_restarts,
                 "stalls": st.stalls,
@@ -6334,6 +6560,7 @@ class SchedulerBackend:
         stop_texts: Sequence[str] = (),
         add_bos: bool = True,
         deadline_s: Optional[float] = None,
+        model_id: str = "",
     ):
         self.scheduler = scheduler.start()
         self.tokenizer = tokenizer
@@ -6344,6 +6571,16 @@ class SchedulerBackend:
         # Default per-request deadline (None = no deadline); a request's
         # own deadline_s overrides it.
         self.deadline_s = deadline_s
+        # Multi-model serving (ISSUE 16): every submit through this
+        # backend names its registered model so a model-aware pool
+        # routes it to the right co-resident checkpoint. "" (the
+        # default) submits model-blind — the single-model fleet's exact
+        # call shape — and the kwarg is forwarded only to schedulers
+        # that understand the axis (duck-typed, like idempotency).
+        self.model_id = str(model_id or "")
+        self._routes_models = bool(
+            getattr(scheduler, "supports_model_routing", False)
+        ) and bool(self.model_id)
         # Idempotency keys need a journal to dedupe against — only the
         # supervised wrapper (serve/supervisor.py) has one.
         self.supports_idempotency = bool(
@@ -6465,6 +6702,18 @@ class SchedulerBackend:
         loads = getattr(self.scheduler, "replica_loads", None)
         if callable(loads):
             out["replicas"] = loads()
+        # Per-model serving aggregation (ISSUE 16): queue depth, tok/s
+        # and KV pages held per co-resident checkpoint — the
+        # lsot_model_* Prometheus families. None (single-model fleets)
+        # adds nothing, keeping the pre-multi-model payload intact.
+        ms = getattr(self.scheduler, "model_stats", None)
+        if callable(ms):
+            try:
+                models = ms()
+            except Exception:  # noqa: BLE001 — a churning fleet mid-read
+                models = None
+            if models:
+                out["models"] = models
         sup = self.health()
         if sup is not None:
             out["supervisor"] = sup
@@ -6766,6 +7015,12 @@ class SchedulerBackend:
             kwargs["constraint_spec"] = constrain
         return kwargs
 
+    def _model_kwargs(self) -> Dict[str, object]:
+        """submit() kwargs for the model axis: present only when this
+        backend is model-scoped AND the scheduler routes on models —
+        bare schedulers and test fakes keep their exact signatures."""
+        return {"model_id": self.model_id} if self._routes_models else {}
+
     def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
         sched = self.scheduler
         overshoot = sched.overshoot
@@ -6819,7 +7074,7 @@ class SchedulerBackend:
             on_token=on_tok, **self._constraint_kwargs(constrain),
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
-            trace=trace,
+            trace=trace, **self._model_kwargs(),
         )
         out_ids: List[int] = []
         emitted = ""
@@ -6921,7 +7176,7 @@ class SchedulerBackend:
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
             trace=tracing.current(),
-            **kwargs,
+            **kwargs, **self._model_kwargs(),
         )
         out = fut.result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
@@ -6956,7 +7211,7 @@ class SchedulerBackend:
                 ids, max_new_tokens=self._budget(len(ids), max_new_tokens),
                 sampling=sampling or self.sampling, seed=seed,
                 on_token=on_tok, **constraint_kwargs,
-                deadline_s=effective_deadline,
+                deadline_s=effective_deadline, **self._model_kwargs(),
             )
             for ids, (on_tok, _) in zip(ids_list, timers)
         ]

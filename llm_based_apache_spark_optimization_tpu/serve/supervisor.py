@@ -158,6 +158,10 @@ class JournalEntry:
     # incarnation after incarnation until the fleet's restart budget is
     # gone.
     crash_replays: int = 0
+    # Multi-model serving (ISSUE 16): the registered model this request
+    # named. Journaled so a crash replay re-places onto the SAME
+    # checkpoint's replicas ("" = model-blind, the single-model shape).
+    model_id: str = ""
 
 
 class SupervisedScheduler:
@@ -472,6 +476,24 @@ class SupervisedScheduler:
         return getattr(self._inner, "phase_role", "mixed")
 
     @property
+    def model_id(self):
+        """Model axis passthrough (ISSUE 16): a supervised single
+        scheduler reports its checkpoint id like a bare one."""
+        return getattr(self._inner, "model_id", "")
+
+    @property
+    def supports_model_routing(self):
+        """Duck-typing flag passthrough: SchedulerBackend forwards a
+        model_id through the supervision layer only when the INNER
+        scheduler routes on it (a pool; bare schedulers validate)."""
+        return bool(getattr(self._inner, "supports_model_routing", False))
+
+    def model_stats(self):
+        """Per-model serving aggregation passthrough (ISSUE 16)."""
+        fn = getattr(self._inner, "model_stats", None)
+        return fn() if callable(fn) else None
+
+    @property
     def transport_stats(self):
         """Replica-transport passthrough (ISSUE 15): the
         serving.transport view and the lsot_transport_* families
@@ -555,6 +577,7 @@ class SupervisedScheduler:
         idempotent: bool = True,
         constraint_spec=None,
         trace=None,
+        model_id: str = "",
     ) -> "Future[List[int]]":
         """Journal + submit. The returned future survives loop crashes: it
         resolves from whichever scheduler incarnation finishes the work.
@@ -634,6 +657,7 @@ class SupervisedScheduler:
                 idempotent=idempotent,
                 future=Future(),
                 trace=trace,
+                model_id=str(model_id or ""),
             )
             self._next_rid += 1
             entry.future._lsot_entry = entry  # cancel() handle
@@ -871,6 +895,8 @@ class SupervisedScheduler:
                     }
                     if e.constraint is not None:
                         rec["constrain"] = e.constraint_spec
+                    if e.model_id:
+                        rec["model_id"] = e.model_id
                     records.append(rec)
             for key, result in self._completed.items():
                 records.append({
@@ -986,6 +1012,7 @@ class SupervisedScheduler:
                     seed=rec.get("seed", 0),
                     deadline_s=rem,
                     idempotency_key=rec.get("idempotency_key"),
+                    model_id=str(rec.get("model_id", "") or ""),
                     **ckw,
                 )
             except Exception:  # noqa: BLE001 — per-record: salvage the rest
@@ -1078,6 +1105,13 @@ class SupervisedScheduler:
             # Forwarded only when sampled: duck-typed inners without the
             # tracing seam (the chaos harness's toy replica) keep working.
             kwargs["trace"] = entry.trace
+        if entry.model_id and getattr(self._inner,
+                                      "supports_model_routing", False):
+            # Model axis (ISSUE 16): replays ride through here too, so a
+            # journaled model-named request re-places onto the same
+            # checkpoint's replicas after a crash — duck-typed inners
+            # without the axis never see the kwarg.
+            kwargs["model_id"] = entry.model_id
         fut = self._inner.submit(
             entry.ids, max_new_tokens=entry.max_new, sampling=entry.sampling,
             seed=entry.seed, on_token=tap,

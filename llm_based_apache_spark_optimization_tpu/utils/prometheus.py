@@ -284,6 +284,41 @@ def _emit_prefix(emit: _Emitter, model: str, pv: Dict) -> None:
                 emit.add(name, labels, n, mtype)
 
 
+def _emit_models(emit: _Emitter, model: str, mv: Dict) -> None:
+    """The multi-model fleet families (ISSUE 16): `serving.models`
+    becomes lsot_model_* gauges/counters labeled model (the BACKEND
+    whose stats block carried the view) × served_model (the co-resident
+    checkpoint the row attributes to) — per-model queue depth, decode
+    occupancy, throughput, and the partitioned KV-page arena each
+    checkpoint holds. Only present on multi-model fleets: a
+    single-model pool's stats omit the block entirely, keeping its
+    /metrics byte-identical."""
+    for rec in mv.get("models") or []:
+        if not isinstance(rec, dict):
+            continue
+        labels = {"model": model,
+                  "served_model": str(rec.get("model") or "")}
+        for key, name, mtype in (
+                ("replicas", "lsot_model_replicas", "gauge"),
+                ("placeable", "lsot_model_placeable_replicas", "gauge"),
+                ("queued", "lsot_model_queue_depth", "gauge"),
+                ("active_slots", "lsot_model_active_slots", "gauge"),
+                ("pending_new_tokens", "lsot_model_pending_new_tokens",
+                 "gauge"),
+                ("backlog_s", "lsot_model_backlog_seconds", "gauge"),
+                ("placements", "lsot_model_placements_total", "counter"),
+                ("tokens_total", "lsot_model_output_tokens_total",
+                 "counter"),
+                ("tok_s", "lsot_model_tokens_per_second", "gauge"),
+                ("kv_pages_total", "lsot_model_kv_pages_total", "gauge"),
+                ("kv_pages_in_use", "lsot_model_kv_pages_in_use",
+                 "gauge"),
+        ):
+            n = _num(rec.get(key))
+            if n is not None:
+                emit.add(name, labels, n, mtype)
+
+
 def _emit_slo(emit: _Emitter, slo: Dict) -> None:
     """The rolling-SLO families (ISSUE 12): per-replica + fleet quantile
     gauges, bad-fraction/burn-rate gauges per window arm, and the 0/1
@@ -368,6 +403,13 @@ def render_prometheus(snapshot: Dict,
             pv = serving.pop("prefix", None)
             if isinstance(pv, dict):
                 _emit_prefix(emit, model, pv)
+            # Multi-model fleet stats render as first-class
+            # model × served_model families (ISSUE 16) so dashboards
+            # split queue depth / tok/s / KV pages by co-resident
+            # checkpoint.
+            mv = serving.pop("models", None)
+            if isinstance(mv, dict):
+                _emit_models(emit, model, mv)
             _flatten_serving(emit, model, "lsot_serving", serving)
     if resilience:
         breakers = resilience.get("breakers") or {}

@@ -1,0 +1,143 @@
+#!/usr/bin/env bash
+# Multi-model serving smoke: the ISSUE-16 model pool end to end on a real
+# booted app.
+#
+# Boots the app with TWO co-resident tiny checkpoints behind one
+# model-aware scheduler pool (LSOT_MODELS spec → assemble_multimodel_service)
+# and asserts the whole contract:
+#
+#   1. /api/generate routes each request to the replica set holding the
+#      model it names — both models answer, with DISTINCT weights (the
+#      same prompt must not produce byte-identical responses, which is
+#      what silently sharing one checkpoint would look like);
+#   2. an unregistered model name fails TYPED (4xx naming the registered
+#      models), never a 500 or a silent fallback to the wrong weights;
+#   3. /metrics?format=prometheus serves the lsot_model_* families with
+#      non-zero per-model counters (placements, output tokens) and the
+#      PARTITIONED page arenas (hbm_fraction split, disjoint totals);
+#   4. the scheduler health/loads views carry model_id per replica —
+#      the feed the fleet dashboard keys on.
+#
+# The default test lane runs the same flow in-process
+# (tests/test_modelpool.py, not marked slow); this script is the focused
+# real-HTTP lane, beside chaos_smoke.sh / remote_smoke.sh / obs_smoke.sh.
+#
+#   scripts/multimodel_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+python - <<'EOF'
+import json
+import urllib.error
+import urllib.request
+
+from llm_based_apache_spark_optimization_tpu.app.api import create_api_app
+from llm_based_apache_spark_optimization_tpu.app.config import AppConfig
+from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+from llm_based_apache_spark_optimization_tpu.serve.factory import (
+    assemble_multimodel_service,
+)
+from llm_based_apache_spark_optimization_tpu.sql import default_backend
+
+SPEC = "sql=tiny,hbm=0.75;explainer=tiny,hbm=0.25"
+service, pool, registry = assemble_multimodel_service(
+    SPEC, max_new_tokens=16, num_slots=2)
+cfg = AppConfig(history_db=":memory:", port=0)
+app = create_api_app(service, default_backend, SQLiteHistory(":memory:"),
+                     cfg)
+server = app.serve(cfg.host, 0, background=True)
+url = f"http://{cfg.host}:{server.server_address[1]}"
+print(f"multimodel_smoke: app up at {url} ({SPEC})")
+
+
+def post(path, body):
+    req = urllib.request.Request(
+        url + path, json.dumps(body).encode(),
+        {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return r.status, json.loads(r.read())
+
+
+def get(path):
+    with urllib.request.urlopen(url + path, timeout=60) as r:
+        return r.status, r.read().decode()
+
+
+# 1. one request per co-resident model; distinct weights answer.
+prompt = "List the three largest fares"
+responses = {}
+for model in ("sql", "explainer"):
+    status, body = post("/api/generate",
+                        {"model": model, "prompt": prompt})
+    assert status == 200 and body["done"], body
+    assert body["model"] == model, body
+    responses[model] = body["response"]
+assert responses["sql"] != responses["explainer"], (
+    "both models answered byte-identically — co-resident checkpoints "
+    "are sharing one set of weights")
+print("multimodel_smoke: step 1 OK (both models answered, distinct "
+      "weights)")
+
+# 2. an unregistered model fails typed, naming what IS registered.
+try:
+    post("/api/generate", {"model": "nope", "prompt": prompt})
+    raise AssertionError("unregistered model did not fail")
+except urllib.error.HTTPError as e:
+    assert 400 <= e.code < 500, f"want 4xx, got {e.code}"
+    detail = e.read().decode()
+    assert "nope" in detail, detail
+print("multimodel_smoke: step 2 OK (unregistered model -> typed 4xx)")
+
+# 3. lsot_model_* families with non-zero counters + partitioned arenas.
+status, text = get("/metrics?format=prometheus")
+assert status == 200
+for fam in ("lsot_model_replicas", "lsot_model_placements_total",
+            "lsot_model_output_tokens_total", "lsot_model_kv_pages_total"):
+    assert fam in text, f"{fam} family missing from exposition"
+
+
+def by_served(name):
+    """Family values keyed by served_model (each registered backend
+    shares the one pool, so the fleet view repeats under every `model`
+    label — the values per served_model must agree)."""
+    import re
+
+    out = {}
+    for line in text.splitlines():
+        if line.startswith(name + "{"):
+            m = re.search(r'served_model="([^"]+)"', line)
+            val = float(line.rsplit(" ", 1)[1])
+            out.setdefault(m.group(1), set()).add(val)
+    return {k: v.pop() for k, v in out.items() if len(v) == 1}
+
+
+placements = by_served("lsot_model_placements_total")
+tokens = by_served("lsot_model_output_tokens_total")
+pages = by_served("lsot_model_kv_pages_total")
+assert set(placements) == {"sql", "explainer"} and \
+    all(v >= 1 for v in placements.values()), \
+    f"per-model placements not non-zero: {placements}"
+assert all(v >= 1 for v in tokens.values()), \
+    f"per-model output tokens not non-zero: {tokens}"
+assert len(pages) == 2 and len(set(pages.values())) == 2, (
+    f"page arenas not partitioned by hbm_fraction: {pages}")
+print(f"multimodel_smoke: step 3 OK (placements {placements}, "
+      f"arenas {pages})")
+
+# 4. health/loads views carry model_id per replica.
+bstats = service.backend_stats()
+mv = (bstats.get("sql") or {}).get("models") or {}
+recs = {r["model"] for r in mv.get("models", [])}
+assert recs == {"sql", "explainer"}, f"model_stats incomplete: {bstats}"
+loads = pool.replica_loads()
+assert loads and all(r.get("model_id") in ("sql", "explainer")
+                     for r in loads), loads
+print(f"multimodel_smoke: step 4 OK (loads carry model_id for "
+      f"{len(loads)} replicas)")
+
+server.shutdown()
+service.close()
+print("MULTIMODEL SMOKE OK")
+EOF

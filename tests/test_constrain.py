@@ -130,6 +130,51 @@ def test_null_and_like_invalid_forms_rejected_by_both():
         assert not is_valid_spark_sql(sql), sql
 
 
+def test_in_and_between_predicates_accepted_by_both():
+    """Grammar-breadth slice (ISSUE 16 satellite): [NOT] IN (...) and
+    [NOT] BETWEEN lo AND hi join the predicate language (grammar +
+    parser; the token-mask compiler again needed no changes — the
+    keywords are plain letters already in the alphabet)."""
+    dfa = spark_sql_dfa()
+    sdfa = spark_sql_dfa("taxi", tuple(TAXI_COLUMNS))
+    good = [
+        "SELECT * FROM taxi WHERE VendorID IN (1, 2, 3)",
+        "SELECT * FROM taxi WHERE extra NOT IN ('a', 'b');",
+        "SELECT VendorID FROM taxi WHERE fare_amount BETWEEN 2 AND 10",
+        "SELECT VendorID FROM taxi WHERE fare_amount NOT BETWEEN -1 "
+        "AND 0.5 AND extra = 'x'",
+        "select * from taxi where trip_distance between 0.5 and 9.5 "
+        "or VendorID in (1) order by trip_distance limit 3;",
+        "SELECT COUNT(*) AS n FROM taxi "
+        "GROUP BY VendorID HAVING VendorID IN (1, 2)",
+        "SELECT * FROM taxi WHERE extra IN (tip_amount, 'c', 3)",
+    ]
+    for sql in good:
+        assert dfa.accepts(sql), sql
+        assert sdfa.accepts(sql), sql
+        parse_spark_sql(sql)  # must not raise
+
+
+def test_in_and_between_invalid_forms_rejected_by_both():
+    dfa = spark_sql_dfa()
+    bad = [
+        "SELECT * FROM taxi WHERE a IN ()",          # empty list
+        "SELECT * FROM taxi WHERE a IN 1, 2",        # parens required
+        "SELECT * FROM taxi WHERE a IN (1,)",        # trailing comma
+        "SELECT * FROM taxi WHERE a BETWEEN 1",      # missing AND hi
+        "SELECT * FROM taxi WHERE a BETWEEN 1 OR 2",  # AND, not OR
+        "SELECT * FROM taxi WHERE a BETWEEN AND 2",  # missing lo
+        "SELECT * FROM taxi WHERE BETWEEN 1 AND 2",  # no operand
+        "SELECT * FROM taxi WHERE a IN (SELECT b FROM taxi)",  # no subquery
+        "SELECT * FROM taxi WHERE a IN (SUM(b))",    # no aggregates in list
+        "SELECT in FROM taxi",                       # IN is reserved now
+        "SELECT between FROM taxi",                  # BETWEEN reserved now
+    ]
+    for sql in bad:
+        assert not dfa.accepts(sql), sql
+        assert not is_valid_spark_sql(sql), sql
+
+
 def test_schema_mode_blocks_unknown_identifiers():
     sdfa = spark_sql_dfa("taxi", tuple(TAXI_COLUMNS))
     # A column not in the schema cannot even be *spelled*.

@@ -1,0 +1,329 @@
+"""Multi-model serving (ISSUE 16): the model registry, model-aware pool
+placement, co-resident checkpoints with partitioned KV arenas, and the
+typed failure modes — the in-process default-lane twin of
+scripts/multimodel_smoke.sh.
+
+Host-only tests drive the placement logic through scripted fake replicas
+(every routing decision inspectable without a device); the co-resident
+serving and remote-mismatch tests build real tiny schedulers on CPU.
+"""
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.serve.modelpool import (
+    ModelSpec,
+    UnknownModel,
+    build_tiny_model_service,
+    parse_models_spec,
+    partition_pages,
+)
+from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+    SchedulerPool,
+)
+
+
+# --------------------------------------------------------------- spec parsing
+
+def test_parse_models_spec_full_format():
+    specs = parse_models_spec(
+        "sql=gguf:/ckpts/nsql.gguf,hbm=0.75,replicas=2;"
+        "explainer=hf:/ckpts/llama,hbm=0.25,template=llama3-chat,add_bos=0"
+    )
+    a, b = specs
+    assert a.model_id == "sql" and a.source == "gguf"
+    assert a.path == "/ckpts/nsql.gguf"
+    assert a.hbm_fraction == 0.75 and a.replicas == 2
+    assert b.model_id == "explainer" and b.source == "hf"
+    assert b.template == "llama3-chat" and b.add_bos is False
+
+
+def test_parse_models_spec_splits_leftover_fractions_equally():
+    # One explicit 0.5; the two silent models split the remaining 0.5.
+    a, b, c = parse_models_spec("x=tiny,hbm=0.5;y=tiny;z=tiny")
+    assert a.hbm_fraction == 0.5
+    assert b.hbm_fraction == pytest.approx(0.25)
+    assert c.hbm_fraction == pytest.approx(0.25)
+    # No explicit fractions: an even split.
+    d, e = parse_models_spec("p=tiny;q=tiny")
+    assert d.hbm_fraction == e.hbm_fraction == pytest.approx(0.5)
+
+
+def test_parse_models_spec_rejects_config_errors():
+    with pytest.raises(ValueError, match="duplicate model id"):
+        parse_models_spec("a=tiny;a=tiny")
+    with pytest.raises(ValueError, match="unknown option"):
+        parse_models_spec("a=tiny,wat=1")
+    with pytest.raises(ValueError, match="expected"):
+        parse_models_spec("just-a-name")
+    with pytest.raises(ValueError):  # two models cannot both hold 80%
+        parse_models_spec("a=tiny,hbm=0.8;b=tiny,hbm=0.8")
+    with pytest.raises(ValueError, match="needs a checkpoint path"):
+        parse_models_spec("a=hf")
+
+
+def test_partition_pages_proportional_with_floor():
+    specs = [ModelSpec("big", hbm_fraction=0.75),
+             ModelSpec("small", hbm_fraction=0.25)]
+    shares = partition_pages(256, specs)
+    assert shares == {"big": 192, "small": 64}
+    assert sum(shares.values()) == 256
+    # A sliver model still gets at least one page.
+    specs = [ModelSpec("whale", hbm_fraction=0.99),
+             ModelSpec("sliver", hbm_fraction=0.01)]
+    shares = partition_pages(16, specs)
+    assert shares["sliver"] >= 1 and sum(shares.values()) == 16
+    with pytest.raises(ValueError, match="cannot hold one page"):
+        partition_pages(1, specs)
+
+
+# ------------------------------------------------------- fake-replica routing
+
+class _FakeModelReplica:
+    """Host-only replica with the pool placement surface plus the ISSUE-16
+    model axis: a model_id stamp, scripted backlog, recorded submits and
+    requeues."""
+
+    def __init__(self, model_id="", secs=0.0):
+        from llm_based_apache_spark_optimization_tpu.serve.flightrecorder import (  # noqa: E501
+            FlightRecorder,
+        )
+
+        self.model_id = model_id
+        self.flight = FlightRecorder(capacity=8)
+        self.secs = secs
+        self.submitted = []
+        self.requeued = []
+        self.queued_reqs = []
+
+    def start(self):
+        return self
+
+    def shutdown(self, timeout=None):
+        pass
+
+    def backlog_score(self):
+        return self.secs, 0
+
+    def retry_after_hint(self):
+        return 1.0
+
+    def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
+               on_token=None, constraint=None, deadline_s=None, trace=None,
+               model_id=""):
+        from concurrent.futures import Future
+
+        if model_id and model_id != self.model_id:
+            raise UnknownModel(
+                f"request names model {model_id!r} but this replica "
+                f"serves {self.model_id!r}"
+            )
+        self.submitted.append(list(ids))
+        fut = Future()
+        fut.set_result(list(ids))
+        return fut
+
+    def extract_queued(self):
+        out, self.queued_reqs = self.queued_reqs, []
+        return out
+
+    def requeue(self, req):
+        self.requeued.append(req)
+
+
+def test_pool_routes_by_model_before_load():
+    """The model filter runs BEFORE the least-loaded ordering: a request
+    naming model `a` lands on the a-replica even when the b-replica is
+    strictly lighter, and the placement event records the model."""
+    heavy_a = _FakeModelReplica("a", secs=9.0)
+    light_b = _FakeModelReplica("b", secs=0.1)
+    pool = SchedulerPool([heavy_a, light_b], model_routing=True)
+    fut = pool.submit([1, 2], model_id="a")
+    assert fut.result() == [1, 2]
+    assert heavy_a.submitted and not light_b.submitted
+    placements = [r for r in pool.flight_snapshot()
+                  if r.get("kind") == "placement"]
+    assert placements[-1]["model"] == "a"
+    # model_id="" keeps the pre-model order: pure backlog.
+    pool.submit([3])
+    assert light_b.submitted
+
+
+def test_pool_unknown_model_fails_typed():
+    """A model nobody serves fails typed UnknownModel — a ValueError
+    subclass, so the API layer's existing handler maps it to a 4xx —
+    naming what IS registered, and no replica sees the request."""
+    a, b = _FakeModelReplica("a"), _FakeModelReplica("b")
+    pool = SchedulerPool([a, b], model_routing=True)
+    with pytest.raises(UnknownModel, match="'nope'") as ei:
+        pool.submit([1], model_id="nope")
+    assert isinstance(ei.value, ValueError)
+    assert "'a'" in str(ei.value) and "'b'" in str(ei.value)
+    assert not a.submitted and not b.submitted
+
+
+def test_pool_models_off_reproduces_placement_order_bit_for_bit():
+    """LSOT_POOL_MODELS=0 (and equally: model_id-less traffic with the
+    flag on) reproduces the model-blind placement order exactly — same
+    replicas chosen in the same sequence as a pool that has never heard
+    of models, no model fields on the placement events."""
+    def fleet():
+        return [_FakeModelReplica("a", secs=2.0),
+                _FakeModelReplica("b", secs=0.5),
+                _FakeModelReplica("a", secs=1.0)]
+
+    def placements(pool):
+        for i in range(6):
+            pool.submit([i + 1])
+        return [r["to"] for r in pool.flight_snapshot()
+                if r.get("kind") == "placement"]
+
+    baseline = placements(SchedulerPool(fleet(), model_routing=False))
+    flag_on = SchedulerPool(fleet(), model_routing=True)
+    assert placements(flag_on) == baseline
+    assert all("model" not in r for r in flag_on.flight_snapshot()
+               if r.get("kind") == "placement")
+
+
+def test_drain_only_replica_of_a_model_keeps_work_on_it():
+    """Draining the ONLY replica of a model must not re-place its queued
+    work onto a sibling serving different weights: the work stays on the
+    draining replica (the lone-replica degenerate drain) and the
+    cross-model sibling never sees a requeue."""
+    only_a = _FakeModelReplica("a")
+    other_b = _FakeModelReplica("b")
+    only_a.queued_reqs = [object(), object()]
+    pool = SchedulerPool([only_a, other_b], model_routing=True)
+    res = pool.drain_replica("r0", deadline_s=0.1)
+    assert res["replaced"] == 0
+    assert len(only_a.requeued) == 2
+    assert not other_b.requeued and not other_b.submitted
+    # Same drain with a same-model sibling: the work DOES migrate.
+    a1, a2 = _FakeModelReplica("a"), _FakeModelReplica("a")
+    a1.queued_reqs = [object()]
+    pool2 = SchedulerPool([a1, a2], model_routing=True)
+    res2 = pool2.drain_replica("r0", deadline_s=0.1)
+    assert res2["replaced"] == 1 and len(a2.requeued) == 1
+
+
+def test_pool_model_all_replicas_draining_sheds_overloaded():
+    """A model whose only replica is mid-drain sheds retryable
+    Overloaded (the client can come back), not UnknownModel (the model
+    IS registered) and not a silent cross-model placement."""
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        Overloaded,
+    )
+
+    only_a = _FakeModelReplica("a")
+    other_b = _FakeModelReplica("b")
+    pool = SchedulerPool([only_a, other_b], model_routing=True)
+    pool.drain_replica("r0", deadline_s=0.05)
+    with pytest.raises(Overloaded):
+        pool.submit([1], model_id="a")
+    assert not other_b.submitted
+
+
+# --------------------------------------------------- co-resident tiny fleet
+
+@pytest.fixture(scope="module")
+def two_model_service():
+    specs = [ModelSpec("sql", hbm_fraction=0.75),
+             ModelSpec("explainer", hbm_fraction=0.25)]
+    svc, pool, registry = build_tiny_model_service(
+        specs, num_slots=2, max_new_tokens=12)
+    yield svc, pool, registry
+    svc.close()
+
+
+def test_co_resident_models_serve_distinct_weights(two_model_service):
+    svc, pool, _ = two_model_service
+    prompt = "List the three largest fares"
+    res = {m: svc.generate(model=m, prompt=prompt, max_new_tokens=12)
+           for m in ("sql", "explainer")}
+    assert all(r.output_tokens > 0 for r in res.values())
+    # Co-resident checkpoints must answer with DISTINCT weights — a
+    # byte-identical pair is what silently sharing one checkpoint under
+    # two names (the pre-ISSUE-16 alias fallback) looks like.
+    assert res["sql"].response != res["explainer"].response
+    loads = pool.replica_loads()
+    assert {r["model_id"] for r in loads} == {"sql", "explainer"}
+
+
+def test_co_resident_arena_partitioned_and_stats(two_model_service):
+    svc, pool, _ = two_model_service
+    ms = pool.model_stats()
+    recs = {r["model"]: r for r in ms["models"]}
+    assert set(recs) == {"sql", "explainer"}
+    # hbm=0.75 / hbm=0.25 split one arena into disjoint page budgets.
+    assert recs["sql"]["kv_pages_total"] == 3 * recs["explainer"]["kv_pages_total"]
+    assert all(r["replicas"] == 1 and r["placements"] >= 1
+               and r["tokens_total"] > 0 for r in recs.values())
+    # The lsot_model_* families render from the same view.
+    from llm_based_apache_spark_optimization_tpu.utils.prometheus import (
+        render_prometheus,
+    )
+
+    text = render_prometheus(svc.metrics_snapshot())
+    assert 'lsot_model_kv_pages_total' in text
+    assert 'served_model="explainer"' in text
+
+
+def test_service_unregistered_model_is_typed_value_error(two_model_service):
+    svc, _, _ = two_model_service
+    # The API layer maps ValueError → 400; the service refuses before
+    # anything reaches the pool.
+    with pytest.raises((KeyError, ValueError), match="not registered"):
+        svc.generate(model="nope", prompt="hi", max_new_tokens=4)
+
+
+def test_model_id_plumb_is_token_identical(two_model_service):
+    """Reconciliation: the model_id axis must not perturb generation —
+    the same prompt+seed produces bit-identical tokens whether the
+    submit names its model or rides the pre-ISSUE-16 signature."""
+    _, pool, _ = two_model_service
+    sched = pool.schedulers[0]
+    ids = [3, 7, 11]
+    plain = sched.submit(ids, max_new_tokens=8, seed=99).result(timeout=300)
+    named = sched.submit(ids, max_new_tokens=8, seed=99,
+                         model_id="sql").result(timeout=300)
+    assert plain == named
+    pooled = pool.submit(ids, max_new_tokens=8, seed=99,
+                         model_id="sql").result(timeout=300)
+    assert pooled == plain
+
+
+def test_remote_submit_with_model_the_worker_lacks(two_model_service):
+    """A remote worker stamped --model-id validates the frame's model_id
+    BEFORE generating: a mismatch fails typed UnknownModel ACROSS the
+    wire (decoding on the wrong weights would return fluent garbage,
+    not an error)."""
+    from llm_based_apache_spark_optimization_tpu.serve.remote import (
+        ReplicaServer,
+        SocketTransport,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        RetryPolicy,
+    )
+
+    _, pool, _ = two_model_service
+    sched = pool.schedulers[0]  # the "sql" replica, already warm
+    srv = ReplicaServer(sched)
+    tr = SocketTransport(
+        srv.address, label="rX",
+        retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.001,
+                                 max_delay_s=0.01),
+        rpc_timeout_s=30.0,
+    )
+    try:
+        assert tr.model_id == "sql"
+        with pytest.raises(UnknownModel, match="explainer"):
+            tr.submit([1, 5, 9], max_new_tokens=4,
+                      model_id="explainer").result(timeout=60)
+        # The matching model generates normally through the same wire.
+        out = tr.submit([1, 5, 9], max_new_tokens=4,
+                        model_id="sql").result(timeout=120)
+        assert out
+    finally:
+        # A transport shutdown is a hangup — the shared pool's warm
+        # scheduler keeps serving the module's other tests.
+        tr.shutdown()
+        srv.close()
