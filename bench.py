@@ -1970,6 +1970,153 @@ def _bench_disagg(cfg, params, n_long: int = 3, n_short: int = 3,
     }
 
 
+def _bench_disagg_remote(cfg, params, n_long: int = 3, n_short: int = 3,
+                         long_prompt: int = 24, short_prompt: int = 6,
+                         long_new: int = 4, short_new: int = 24,
+                         reps: int = 2) -> dict:
+    """Elastic remote disaggregation (ISSUE 17): a remote-PREFILL fleet
+    — a real worker scheduler behind a `ReplicaServer` on a loopback
+    socket, PUSHING each packed KV blob to the pool the moment
+    `_pack_handoffs` retires it — against the same worker serving
+    decode-in-place (mixed role, no migration), over the PR-13 bimodal
+    fixture. Committed figures per shape: TTFT/TPOT percentiles +
+    decode tok/s (`--compare`-gated), plus the remote shape's push
+    ledger: pushed handoffs and bytes, wire→placement p50/p95 ms, and
+    the in-place fallback tally — ZERO on a clean wave is the
+    structural tier-1 assertion (tests/test_bench.py): a remote-prefill
+    request that silently decoded on the worker instead of migrating
+    is the bug this pass exists to price. On a shared-core CPU host
+    both shapes contend for the same silicon AND the same loopback, so
+    the TTFT delta is owed to the chip capture; the structural figures
+    are what the CPU pass proves."""
+    import time as _t
+
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.serve.remote import (
+        ReplicaServer,
+        SocketTransport,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+        SchedulerPool,
+    )
+
+    decode_chunk = 4
+    bucket = max(long_prompt, 16)
+    max_seq = min(bucket + max(long_new, short_new) + 3 * decode_chunk + 8,
+                  cfg.max_seq_len)
+    rng = np.random.default_rng(7)
+    longs = _mk_prompts(cfg, n_long, long_prompt, rng)
+    shorts = _mk_prompts(cfg, n_short, short_prompt, rng)
+    wave = []
+    for i in range(max(n_long, n_short)):
+        if i < n_long:
+            wave.append((longs[i], long_new))
+        if i < n_short:
+            wave.append((shorts[i], short_new))
+
+    def make_replica(role):
+        return ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, max_seq=max_seq,
+            prompt_bucket=bucket, stop_ids=(-1,),
+            decode_chunk=decode_chunk, prefix_cache_blocks=0,
+            kv_layout="paged", kv_page_size=8, phase_role=role,
+        )
+
+    def drive(worker_role, local_role):
+        wsched = make_replica(worker_role)
+        wsched.start()
+        srv = ReplicaServer(wsched)
+        local = make_replica(local_role)
+        local.warmup(long_prompt)
+        local.warmup(short_prompt)
+        pool = SchedulerPool(
+            [SocketTransport(srv.address, label="r0", rpc_timeout_s=30.0),
+             local],
+        )
+        best = None
+        try:
+            with pool:
+                # Compile both sides outside the timed wave: a remote-
+                # prefill warm request pushes through the wire and
+                # compiles the local import scatter too. Submitted
+                # concurrently so least-loaded placement touches BOTH
+                # replicas, not twice the idle one.
+                prime = [pool.submit(ids, max_new_tokens=2)
+                         for ids, _mn in wave[:2]]
+                for f in prime:
+                    f.result(timeout=600)
+                for _ in range(reps):
+                    stamps = [[] for _ in wave]
+                    t0 = _t.perf_counter()
+                    futs = [
+                        pool.submit(ids, max_new_tokens=mn,
+                                    on_token=(lambda _t_, ss=ss:
+                                              ss.append(_t.perf_counter())))
+                        for (ids, mn), ss in zip(wave, stamps)
+                    ]
+                    total = sum(len(f.result(timeout=600)) for f in futs)
+                    wall = _t.perf_counter() - t0
+                    ttfts = [s[0] - t0 for s in stamps if s]
+                    tpots = [(s[-1] - s[0]) / (len(s) - 1)
+                             for s in stamps if len(s) > 1]
+                    if best is None or total / wall > best["decode_tok_s"]:
+                        best = {
+                            "decode_tok_s": total / wall,
+                            "wall_s": round(wall, 3),
+                            "tokens": total,
+                            "ttft_p50_s": round(
+                                float(np.percentile(ttfts, 50)), 4),
+                            "ttft_p95_s": round(
+                                float(np.percentile(ttfts, 95)), 4),
+                            "tpot_p50_s": round(
+                                float(np.percentile(tpots, 50)), 5),
+                            "tpot_p95_s": round(
+                                float(np.percentile(tpots, 95)), 5),
+                        }
+                fl = pool.fleet_stats()
+                wh = wsched.handoff_stats or {}
+                pump = dict(srv._pump_stats)
+        finally:
+            srv.close()
+            wsched.shutdown()
+        best["decode_tok_s"] = round(best["decode_tok_s"], 1)
+        if worker_role == "prefill":
+            # The push ledger: handoffs streamed through the wire, the
+            # wire→placement latency the pump adds on top of the blob
+            # pack, and the "no silent fallback" tally — worker-side
+            # decode-in-place absorptions, whether at the scheduler
+            # (no decode sibling visible) or at the pump (overflow /
+            # backpressure). ZERO on a clean wave is the structural
+            # contract.
+            best["pushed"] = int(fl.get("pushed", 0))
+            best["push_bytes"] = int(fl.get("push_bytes", 0))
+            best["push_place_p50_ms"] = fl.get("push_place_p50_ms", 0.0)
+            best["push_place_p95_ms"] = fl.get("push_place_p95_ms", 0.0)
+            best["inplace_fallbacks"] = int(pump.get("inplace", 0)) \
+                + int(wh.get("inplace_fallbacks", 0) or 0)
+        return best
+
+    remote = drive("prefill", "decode")
+    inplace = drive("mixed", "mixed")
+    return {
+        "requests": len(wave),
+        "long": {"n": n_long, "prompt": long_prompt, "max_new": long_new},
+        "short": {"n": n_short, "prompt": short_prompt,
+                  "max_new": short_new},
+        "remote_prefill": remote,
+        "inplace": inplace,
+        # The headline the chip capture owes: how much TTFT the remote
+        # prefill tier buys the decode tier (positive = remote wins).
+        "ttft_delta_p50_s": round(
+            inplace["ttft_p50_s"] - remote["ttft_p50_s"], 4),
+        "speedup": round(
+            remote["decode_tok_s"] / inplace["decode_tok_s"], 3
+        ) if inplace["decode_tok_s"] else 0.0,
+    }
+
+
 def _bench_multi_model(device_kind) -> dict:
     """Multi-model routing throughput (ISSUE 16): two tiny checkpoints
     co-resident in ONE model-routing SchedulerPool, mixed traffic
@@ -2288,6 +2435,21 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
             out["disagg"] = _bench_disagg(cfg, params)
         except Exception as e:  # noqa: BLE001 — keep the leg's numbers
             out["disagg"] = {"error": str(e)[:200]}
+
+    if os.environ.get("BENCH_SCHED_DISAGG_REMOTE", "1") == "1" \
+            and kv_quant is None:
+        # Elastic remote disaggregation pass (ISSUE 17): remote-PREFILL
+        # worker behind a real loopback ReplicaServer pushing packed KV
+        # blobs to a local decode replica, vs the same worker serving
+        # decode-in-place — TTFT/TPOT percentiles + decode tok/s per
+        # shape, push ledger (count/bytes/wire→placement p50/p95) and
+        # the zero-in-place-fallback proof. Instrument pass, never
+        # fatal; --compare gates its decode_tok_s keys like every
+        # tracked metric.
+        try:
+            out["disagg_remote"] = _bench_disagg_remote(cfg, params)
+        except Exception as e:  # noqa: BLE001 — keep the leg's numbers
+            out["disagg_remote"] = {"error": str(e)[:200]}
 
     if os.environ.get("BENCH_SCHED_PREFIX", "1") == "1" and kv_quant is None:
         # Warm-prefix pass: the reference's ACTUAL serving pattern is the

@@ -466,11 +466,39 @@ def make_checkpoint_service(args, max_new_tokens: int) -> GenerationService:
                 )
             else:
                 pool = make_pool()
-            return SchedulerBackend(
+            backend = SchedulerBackend(
                 pool, tok,
                 max_new_tokens=max_new_tokens, add_bos=add_bos,
                 deadline_s=app_cfg.deadline_s or None,
             )
+            # Elastic fleet membership (ISSUE 17, LSOT_FLEET_WORKERS):
+            # standby `serve.remote` workers join as SocketTransport
+            # decode replicas when the queue EWMA / SLO burn /
+            # kv_pressure signals sustain past the hysteresis window;
+            # scale-down drains-and-removes only autoscaler-added
+            # replicas. The control loop is a daemon thread — it dies
+            # with the process, and a crashed step never takes serving
+            # down with it.
+            if app_cfg.fleet_workers:
+                from ..serve.elastic import FleetAutoscaler
+                from ..serve.factory import standby_spawner
+
+                spawn = standby_spawner(app_cfg.fleet_workers)
+                backend.autoscaler = FleetAutoscaler(
+                    pool, spawn,
+                    fleet_min=(None if app_cfg.fleet_min < 0
+                               else app_cfg.fleet_min),
+                    fleet_max=(app_cfg.fleet_max
+                               if app_cfg.fleet_max >= 0
+                               else len(scheduler_meshes)
+                               + len(spawn.addresses)),
+                    scale_up_q=app_cfg.scale_up_q,
+                    scale_down_q=app_cfg.scale_down_q,
+                    hold_s=app_cfg.scale_hold_s,
+                    interval_s=app_cfg.scale_interval_s,
+                    drain_deadline_s=app_cfg.drain_deadline_s,
+                ).run()
+            return backend
         # Deadline-clamp s/token seed (ROADMAP PR-3 follow-up): an
         # explicit LSOT_STOK_SEED wins; otherwise the last bench
         # artifact's headline converts to a per-step wall. Unseeded, the

@@ -160,6 +160,32 @@ class AppConfig:
     # lease_s <= 0 disables the monitor.
     lease_s: float = 2.0
     lease_misses: int = 3
+    # --- elastic fleet membership (serve/elastic.py; README "Elastic
+    # fleet"). Standby `serve.remote` worker addresses
+    # ("host:port,host:port"): scale-up connects the next unclaimed one
+    # as a SocketTransport replica (join handshake validates page
+    # geometry/model before it is placeable); scale-down rides
+    # drain_replica (drain → re-place → remove, zero lost) and only
+    # ever retires autoscaler-added replicas. "" = autoscaler off.
+    fleet_workers: str = ""
+    # Fleet size bounds: min defaults to the configured fleet size at
+    # startup (never scale below what the operator stood up); max to
+    # min + the standby count. -1 = those defaults.
+    fleet_min: int = -1
+    fleet_max: int = -1
+    # Scale signals + hysteresis (per-serving-replica queued-request
+    # EWMA thresholds; SLO burn and kv_pressure also trigger
+    # scale-up). A direction must hold scale_hold_s continuously to
+    # act; actions are spaced >= scale_interval_s (flap damping).
+    scale_up_q: float = 4.0
+    scale_down_q: float = 0.5
+    scale_hold_s: float = 3.0
+    scale_interval_s: float = 5.0
+    # Push-style handoff pump (serve/remote.py): bound on the in-worker
+    # unacked pushed-handoff window AND the local scheduler handoff
+    # buffer — beyond it the worker decodes in place (typed
+    # backpressure, never loss).
+    pump_depth: int = 32
     # --- liveness / hang detection (serve/watchdog.py; README "Liveness &
     # hangs"). The supervisor's watchdog escalates a BUSY decode loop
     # whose heartbeat age exceeds

@@ -97,6 +97,12 @@ def add_health_routes(app: App, service: GenerationService) -> None:
         fleet = service.fleet_health()
         if fleet:
             body["fleet"] = fleet
+        # Elastic membership (ISSUE 17): size/joins/retires/drain +
+        # pushed-handoff pump ledger per model, so the same probe
+        # answers "did the fleet actually scale" without /metrics.
+        membership = service.fleet_membership()
+        if membership:
+            body["fleet_membership"] = membership
         return Response.json(body)
 
     @app.route("/readyz")

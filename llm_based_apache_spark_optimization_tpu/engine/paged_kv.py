@@ -270,6 +270,30 @@ def handoff_bytes(stacks: Sequence[np.ndarray]) -> int:
     return int(sum(int(np.asarray(s).nbytes) for s in stacks))
 
 
+def blob_meta(stacks: Sequence[np.ndarray]) -> dict:
+    """Self-description of one `export_pages` blob — the pushed-handoff
+    observability/validation record (ISSUE 17): page count + page_size
+    read from the blob's own geometry, whether it carries int8 scales
+    (4 arrays) or compute-dtype pages (2), and the wire bytes. The
+    receiving side of a push compares `page_size` against its own pool
+    BEFORE importing — a geometry mismatch is a typed rejection, not a
+    scatter into the wrong page stride."""
+    arrs = [np.asarray(s) for s in stacks]
+    if not arrs:
+        return {"arrays": 0, "pages": 0, "page_size": 0, "nbytes": 0,
+                "quantized": False}
+    # export_pages layout: [L, n_pages, K, page_size(, H)] per array;
+    # the kps/vps scale arrays of an int8 blob share the page axes.
+    lead = arrs[0]
+    return {
+        "arrays": len(arrs),
+        "pages": int(lead.shape[1]) if lead.ndim >= 2 else 0,
+        "page_size": int(lead.shape[3]) if lead.ndim >= 4 else 0,
+        "nbytes": int(sum(a.nbytes for a in arrs)),
+        "quantized": len(arrs) == 4,
+    }
+
+
 class PageAllocator:
     """Host-side page accounting: free list + per-page refcounts.
 

@@ -62,7 +62,12 @@ def main(argv=None) -> None:
                          "a KV-PRESSURE stage (the real paged scheduler "
                          "under a kv:pressure storm: victims preempt and "
                          "resume token-identical to a pressure-free "
-                         "control), and "
+                         "control), an ELASTIC stage (an all-remote "
+                         "phase-split fleet scales up on a burst, rides "
+                         "out a fleet:spawn failure, a remote-prefill "
+                         "SIGKILL mid-handoff and a scale-down racing "
+                         "in-flight streams — zero lost/duplicated "
+                         "stream tokens), and "
                          "report success-after-retry / shed / degraded "
                          "rates plus restart/replay/lost counts — asserts "
                          "zero hung requests and zero lost acknowledged "

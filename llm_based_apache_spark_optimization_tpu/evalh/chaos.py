@@ -1175,6 +1175,347 @@ def _run_net_stage(seed: int) -> Dict:
     return report
 
 
+#: Per-seed cached control outputs + stage-8 REPORTS for the elastic
+#: stage: own injection scope, fixed specs — a pure function of the
+#: seed. The stage builds the most tiny schedulers of any stage (plus
+#: real socket workers), so the cache matters most here.
+_ELASTIC_CONTROLS: Dict[int, list] = {}
+_ELASTIC_REPORTS: Dict[int, Dict] = {}
+
+
+def _run_elastic_stage(seed: int) -> Dict:
+    """Elastic-fleet chaos (ISSUE 17): a supervised ALL-REMOTE
+    phase-split fleet — one prefill + one decode worker, each a real
+    tiny paged scheduler behind a `ReplicaServer` on a loopback
+    socket — serves greedy, sampled and constrained traffic while the
+    membership machinery takes four faults in a fixed order:
+
+    1. **burst → scale-up**: a 2x request burst raises the remote
+       decode tier's queue-depth EWMA over the scale threshold; the
+       `FleetAutoscaler` (driven by an explicit clock) must JOIN a
+       freshly spawned standby decode worker mid-burst —
+       handshake-validated, placeable, `replica_join` in the pool's
+       flight ring — and every burst request must resolve
+       token-identical to the fault-free control with ≥1 handoff
+       PUSHED through the wire (zero pushes = the pump never ran and
+       the stage proved nothing).
+    2. **partition during scale-up**: `fleet:spawn:1` makes the next
+       spawn attempt fail like an unreachable standby host — a
+       counted non-event (`spawn_failures`), fleet size unchanged,
+       control loop alive, the next wave clean.
+    3. **SIGKILL remote prefill mid-handoff**: the prefill worker's
+       server + scheduler are torn down the moment ≥1 new push of the
+       wave is in flight; the lease must expire, ONLY r0 restart —
+       against a replacement worker — and the journal re-place its
+       work on the decode tier with delivered stream prefixes
+       suppressed: zero lost, zero duplicated stream tokens, outputs
+       identical.
+    4. **scale-down racing in-flight streams**: `retire_replica`
+       fires with a wave in flight; the drain re-places the elastic
+       decode worker's work onto siblings (`replica_retire` in the
+       flight ring) and the wave still resolves token-identical with
+       exactly-once streams on the shrunken fleet.
+
+    Own injection scope, like stages 3-7; the report is cached per
+    seed (fixed specs + own scope make it a pure function of the
+    seed)."""
+    cached = _ELASTIC_REPORTS.get(seed)
+    if cached is not None:
+        return cached
+    import random as _random
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..constrain import get_constraint
+    from ..models import TINY, init_params
+    from ..ops.sampling import SamplingParams
+    from ..serve.elastic import FleetAutoscaler
+    from ..serve.remote import ReplicaServer, SocketTransport
+    from ..serve.resilience import RetryPolicy
+    from ..serve.scheduler import ContinuousBatchingScheduler, SchedulerPool
+    from ..serve.supervisor import SupervisedScheduler
+    from ..tokenizer import ByteTokenizer
+    from ..utils.faults import FAULTS
+
+    params = init_params(TINY, jax.random.key(seed), dtype=jnp.float32)
+    tok = ByteTokenizer()
+    cm = get_constraint("spark_sql", tok, (2,))
+    budget = max(16, cm.min_new_tokens)
+    reqs = [
+        ([1, 5, 9], SamplingParams(), None, 8),
+        ([1, 7, 11], SamplingParams(temperature=0.8, top_p=0.95), None, 8),
+        (tok.encode("SELECT", add_bos=True), SamplingParams(), cm, budget),
+        ([1, 3, 4, 8], SamplingParams(), None, 8),
+    ]
+
+    def resolver(spec):
+        return get_constraint(spec, tok, (2,))
+
+    def make_sched(role):
+        return ContinuousBatchingScheduler(
+            TINY, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+            stop_ids=(2,), max_seq=96, kv_layout="paged", kv_page_size=8,
+            phase_role=role,
+        )
+
+    control = _ELASTIC_CONTROLS.get(seed)
+    if control is None:
+        with make_sched("mixed") as ctl:
+            futs = [ctl.submit(ids, max_new_tokens=mn, sampling=sp,
+                               seed=800 + i, constraint=c)
+                    for i, (ids, sp, c, mn) in enumerate(reqs)]
+            control = [f.result(timeout=300) for f in futs]
+        _ELASTIC_CONTROLS[seed] = control
+
+    all_workers: list = []   # every (server, scheduler) pair, for cleanup
+    live: Dict[str, ReplicaServer] = {}  # role -> newest live worker
+
+    def spawn_worker(role):
+        sched = make_sched(role)
+        sched.start()
+        srv = ReplicaServer(sched, constraint_resolver=resolver)
+        all_workers.append((srv, sched))
+        live[role] = srv
+        return srv
+
+    def transport_to(srv, label):
+        return SocketTransport(
+            srv.address, label=label,
+            retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                                     max_delay_s=0.01),
+            rpc_timeout_s=5.0,
+        )
+
+    spawn_worker("prefill")
+    spawn_worker("decode")
+    rebuilt: list = []
+
+    def rebuild(i):
+        # A targeted restart reconnects to the CURRENT worker of that
+        # role — the replacement host after a SIGKILL.
+        rebuilt.append(i)
+        role = "prefill" if i == 0 else "decode"
+        return transport_to(live[role], f"r{i}")
+
+    def make_pool():
+        return SchedulerPool(
+            [transport_to(live["prefill"], "r0"),
+             transport_to(live["decode"], "r1")],
+            factory=rebuild, max_restarts=3,
+            restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                       max_delay_s=0.05),
+            rng=_random.Random(seed), lease_s=0.05, lease_misses=2,
+        )
+
+    sup = SupervisedScheduler(
+        make_pool, max_restarts=3,
+        restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.001,
+                                   max_delay_s=0.05),
+        rng=_random.Random(seed),
+    ).start()
+    # Pushed CONSTRAINED handoffs recompile their wire spec through the
+    # fleet seam (pool._fleet_constraint -> supervisor -> this).
+    sup.constraint_resolver = resolver
+    pool = sup._inner
+
+    def spawn_standby():
+        return transport_to(spawn_worker("decode"), "r2")
+
+    def submit_all(n=1):
+        streams = [[] for _ in range(n * len(reqs))]
+        futs = []
+        for r in range(n):
+            for i, (ids, sp, c, mn) in enumerate(reqs):
+                j = r * len(reqs) + i
+                futs.append(sup.submit(
+                    ids, max_new_tokens=mn, sampling=sp, seed=800 + i,
+                    constraint=c, on_token=streams[j].append))
+        return futs, streams
+
+    def settle(futs, streams, n=1):
+        outs = []
+        for f in futs:
+            try:
+                outs.append(f.result(timeout=300))
+            except Exception:  # noqa: BLE001 — lost, counted below
+                outs.append(None)
+        want = control * n
+        return {
+            "requests": len(futs),
+            "lost": sum(1 for o in outs if o is None),
+            "mismatched": sum(1 for o, c in zip(outs, want)
+                              if o is not None and o != c),
+            # Exactly-once streaming: every delivered stream must be a
+            # PREFIX of its final result.
+            "stream_violations": sum(1 for s, o in zip(streams, outs)
+                                     if o is not None and s != o[: len(s)]),
+        }
+
+    waves: Dict[str, Dict] = {}
+    auto = FleetAutoscaler(
+        pool, spawn_standby, fleet_min=2, fleet_max=3, scale_up_q=1.0,
+        scale_down_q=-1.0, hold_s=0.0, interval_s=0.0,
+        drain_deadline_s=10.0,
+    )
+    auto2 = FleetAutoscaler(
+        pool, spawn_standby, fleet_min=2, fleet_max=6, scale_up_q=0.0,
+        scale_down_q=-1.0, hold_s=0.0, interval_s=0.0,
+    )
+    try:
+        # Leg 1 — burst -> scale-up, stepped on an explicit clock while
+        # the burst is in flight (the queued EWMA crosses the threshold
+        # as soon as a ping digest refreshes the remote backlog).
+        futs, streams = submit_all(n=2)
+        t, fired = 0.0, None
+        step_deadline = _time.monotonic() + 120.0
+        while fired != "up" and _time.monotonic() < step_deadline:
+            fired = auto.step(t)
+            t += 0.05
+            _time.sleep(0.02)
+        waves["burst"] = settle(futs, streams, n=2)
+        size_after_up = int(pool.fleet_stats()["size"])
+
+        # Leg 2 — partition during scale-up: the spawn attempt fails
+        # like an unreachable standby host; a counted non-event.
+        FAULTS.configure("fleet:spawn:1", seed)
+        auto2.step(0.0)
+        FAULTS.clear()
+        size_after_fail = int(pool.fleet_stats()["size"])
+
+        # Leg 3 — SIGKILL the remote prefill worker the moment a NEW
+        # push of this wave is in flight. The replacement worker is
+        # spawned BEFORE the kill: the pool's live transport still
+        # targets the old address (nothing places on the standby until
+        # the rebuild), but the lease-expiry rebuild finds an
+        # already-accepting host on its FIRST attempt — spawning after
+        # the kill races scheduler boot against the restart budget and
+        # can exhaust it into a spurious whole-pool escalation.
+        h0 = sup.health()
+        r_before = {r["replica"]: int(r.get("restarts", 0))
+                    for r in h0.get("replicas", [])}
+        pushed_before = int(pool.fleet_stats()["pushed"])
+        pf_srv, pf_sched = all_workers[0]
+        spawn_worker("prefill")
+        futs, streams = submit_all()
+        kill_deadline = _time.monotonic() + 60.0
+        while (int(pool.fleet_stats()["pushed"]) == pushed_before
+               and not all(f.done() for f in futs)
+               and _time.monotonic() < kill_deadline):
+            _time.sleep(0.002)
+        pf_srv.close()
+        pf_sched.shutdown()
+        waves["kill"] = settle(futs, streams)
+        heal_deadline = _time.monotonic() + 30.0
+        h = sup.health()
+        while _time.monotonic() < heal_deadline:
+            reps = {r["replica"]: r for r in h.get("replicas", [])}
+            r0 = reps.get("r0", {})
+            if (int(r0.get("restarts", 0)) > r_before.get("r0", 0)
+                    and r0.get("state") in ("ready", "degraded")):
+                break
+            _time.sleep(0.02)
+            h = sup.health()
+        reps = {r["replica"]: r for r in h.get("replicas", [])}
+
+        # Leg 4 — forced scale-down racing the in-flight wave: the
+        # drain re-places the elastic worker's work onto the siblings.
+        futs, streams = submit_all()
+        retired = pool.retire_replica(deadline_s=10.0)
+        waves["retire"] = settle(futs, streams)
+
+        fl = pool.fleet_stats()
+        ring_kinds = {r.get("kind") for r in pool.flight_snapshot()}
+        health_final = sup.health()
+    finally:
+        FAULTS.clear()
+        sup.shutdown()
+        for srv, sched in all_workers:
+            srv.close()
+            sched.shutdown()
+
+    report = {
+        "requests": sum(w["requests"] for w in waves.values()),
+        "request_classes": ["greedy", "sampled", "constrained", "greedy"],
+        "waves": waves,
+        "pushed_handoffs": int(fl["pushed"]),
+        "scale_ups": int(auto.stats()["ups"]),
+        "spawn_failures": int(auto2.stats()["spawn_failures"]),
+        "size_after_scale_up": size_after_up,
+        "size_after_spawn_failure": size_after_fail,
+        "retired": (retired or {}).get("replica"),
+        "joins": int(fl["joins"]),
+        "retires": int(fl["retires"]),
+        "prefill_restarts": int(reps.get("r0", {}).get("restarts", 0))
+        - r_before.get("r0", 0),
+        "sibling_restarts": sum(
+            int(reps.get(lbl, {}).get("restarts", 0)) - r_before.get(lbl, 0)
+            for lbl in ("r1", "r2")),
+        "pool_restarts": int(health_final["restarts"]),
+        "lost": sum(w["lost"] for w in waves.values()),
+        "mismatched": sum(w["mismatched"] for w in waves.values()),
+        "stream_violations": sum(w["stream_violations"]
+                                 for w in waves.values()),
+        "fleet_serving": int(fl["serving"]),
+    }
+    assert report["scale_ups"] >= 1 and size_after_up == 3, (
+        "the burst never scaled the fleet up — the queue-EWMA signal or "
+        "the join path is broken"
+    )
+    assert report["pushed_handoffs"] >= 1, (
+        "no handoff was PUSHED through the wire — the pump never ran; "
+        "everything fell back to decode-in-place and the stage proved "
+        "nothing"
+    )
+    assert report["spawn_failures"] == 1, (
+        "fleet:spawn never fired — the partition-during-scale-up path "
+        "was not exercised"
+    )
+    assert size_after_fail == size_after_up, (
+        "a FAILED spawn changed the fleet size — the degraded path must "
+        "keep serving at the current membership"
+    )
+    assert report["prefill_restarts"] >= 1, (
+        "killing the remote prefill worker never expired its lease — "
+        "the SIGKILL was not detected"
+    )
+    assert report["sibling_restarts"] == 0, (
+        f"{report['sibling_restarts']} sibling restart(s): the prefill "
+        f"worker's death escalated beyond its own replica"
+    )
+    assert report["pool_restarts"] == 0, (
+        "the SUPERVISOR's whole-pool restart fired for a single-worker "
+        "death — recovery must stay targeted"
+    )
+    assert report["retired"] is not None and report["retires"] == 1, (
+        "retire_replica retired nothing — the elastic worker was not "
+        "eligible for scale-down"
+    )
+    assert report["fleet_serving"] == 2, (
+        f"{report['fleet_serving']} serving replicas after scale-down — "
+        f"expected the base fleet of 2"
+    )
+    assert report["lost"] == 0, (
+        f"{report['lost']} request(s) lost across scale-up, spawn "
+        f"failure, worker SIGKILL and scale-down — elastic membership "
+        f"shed acknowledged work"
+    )
+    assert report["mismatched"] == 0, (
+        f"{report['mismatched']} request(s) diverged from the fault-free "
+        f"control — the elastic fleet is not token-identical"
+    )
+    assert report["stream_violations"] == 0, (
+        f"{report['stream_violations']} stream(s) delivered duplicated/"
+        f"reordered tokens across the membership churn"
+    )
+    assert "replica_join" in ring_kinds and "replica_retire" in ring_kinds, (
+        "the pool's flight ring carries no join/retire lifecycle events"
+    )
+    _ELASTIC_REPORTS[seed] = report
+    return report
+
+
 def run_chaos(
     spec: Optional[str] = None,
     seed: int = 0,
@@ -1342,6 +1683,15 @@ def run_chaos(
     # token-identical to a fault-free control, zero lost, zero
     # duplicated stream tokens. Own injection scope, like stages 3-6.
     net_report = _run_net_stage(seed)
+    # Stage 8 — elastic membership: an all-remote phase-split fleet
+    # (real socket workers) under the full membership chaos menu —
+    # burst-driven scale-up, an injected `fleet:spawn` failure standing
+    # in for a partition during scale-up, SIGKILL of the remote prefill
+    # worker mid-handoff, and a forced scale-down racing in-flight
+    # streams — every wave token-identical to a fault-free control,
+    # zero lost, zero duplicated stream tokens, only the affected
+    # replica restarted. Own injection scope, like stages 3-7.
+    elastic_report = _run_elastic_stage(seed)
     requests = rounds * len(FOUR_QUERY_SUITE)
     hung = requests - sum(outcomes.values())
     hung += scheduler_report["unresolved"]
@@ -1350,6 +1700,7 @@ def run_chaos(
     hung += pressure_report["lost"]
     hung += disagg_report["lost"]
     hung += sum(w["lost"] for w in net_report["waves"].values())
+    hung += elastic_report["lost"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
     # Wall-clock figures are non-deterministic by nature: lifted OUT of
     # the scheduler stage's report so the seeded-replay determinism
@@ -1367,6 +1718,7 @@ def run_chaos(
         "kv_pressure": pressure_report,
         "disagg": disagg_report,
         "transport": net_report,
+        "elastic": elastic_report,
         "latency": latency,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)

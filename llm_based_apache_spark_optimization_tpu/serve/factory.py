@@ -99,3 +99,48 @@ def assemble_reference_service(
         svc.register("mistral", build(mistral_src, True),
                      template="mistral-instruct")
     return svc
+
+
+def standby_spawner(spec=None, *, label_prefix: str = "standby",
+                    connect_timeout_s: float = 5.0):
+    """Elastic fleet spawn source (ISSUE 17): turn `LSOT_FLEET_WORKERS`
+    — a comma-separated list of standby `serve.remote` worker addresses
+    ("host:port,host:port") — into the `spawn` callable a
+    `FleetAutoscaler` pops from on scale-up.
+
+    Each call connects a `SocketTransport` to the NEXT unclaimed
+    address and returns it (the pool's `add_replica` then runs the
+    page-geometry/model join handshake); `None` once every standby is
+    claimed — the autoscaler counts that as `spawn_empty` and the fleet
+    stays at its current size. A standby that refuses the connection
+    raises, which the autoscaler (and its chaos `fleet:spawn` seam)
+    degrades to a counted spawn failure. Addresses are claimed
+    permanently: a retired worker's process was told to drain, so its
+    address is not silently reused."""
+    import os as _os
+    import threading as _threading
+
+    from .remote import SocketTransport
+
+    raw = spec if spec is not None else _os.environ.get(
+        "LSOT_FLEET_WORKERS", "")
+    if isinstance(raw, str):
+        addrs = [a.strip() for a in raw.split(",") if a.strip()]
+    else:
+        addrs = [str(a) for a in raw]
+    lock = _threading.Lock()
+    state = {"next": 0}
+
+    def spawn():
+        with lock:
+            i = state["next"]
+            if i >= len(addrs):
+                return None
+            state["next"] = i + 1
+        return SocketTransport(
+            addrs[i], label=f"{label_prefix}{i}",
+            connect_timeout_s=connect_timeout_s,
+        )
+
+    spawn.addresses = tuple(addrs)
+    return spawn

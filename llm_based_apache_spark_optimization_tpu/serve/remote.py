@@ -83,13 +83,14 @@ import struct
 import threading
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from concurrent.futures import Future, InvalidStateError
 from concurrent.futures import TimeoutError as _FutTimeout
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..engine.paged_kv import blob_meta
 from ..ops.sampling import SamplingParams
 from ..utils.faults import FAULTS, InjectedFault
 from ..utils.observability import resilience
@@ -1107,6 +1108,21 @@ class SocketTransport(_TransportBase):
         self._digest: Dict[str, object] = {}
         self._load: Dict[str, object] = {}
         self._cfg = None
+        # Push-style handoff pump, client side (ISSUE 17): a prefill-role
+        # worker streams each packed handoff here as an ev frame the
+        # moment _pack_handoffs retires it; this side acks, dedups by
+        # push id, rebinds the request to its client-side owner, and
+        # buffers it for the pool's pump — so a SocketTransport drains
+        # exactly like a local prefill scheduler's handoff queue.
+        self._on_handoff_cb: Optional[Callable[[], None]] = None
+        self.constraint_resolver: Optional[Callable] = None
+        self._ho_lock = threading.Lock()
+        self._pushed: "deque" = deque()
+        self._ho_seen: "OrderedDict[str, None]" = OrderedDict()
+        self._ho_event = threading.Event()
+        self._ho_thread: Optional[threading.Thread] = None
+        self._push_stats: Dict[str, float] = {
+            "pushed": 0, "push_bytes": 0, "dup_pushes": 0}
         self._connect()
 
     # ---- connection management
@@ -1214,6 +1230,9 @@ class SocketTransport(_TransportBase):
                 sub.delivered += 1
                 self._emit(sub, int(msg["t"]))
             return
+        if ev == "handoff":
+            self._on_push(msg)
+            return
         if ev == "done":
             sub = self._sub(msg.get("sub"), pop=True)
             if sub is None:
@@ -1265,6 +1284,196 @@ class SocketTransport(_TransportBase):
             if pop:
                 return self._subs.pop(str(token), None)
             return self._subs.get(str(token))
+
+    # ---- push-style handoff pump (client side, ISSUE 17)
+
+    #: Bounded dedup memory for push ids. 1024 covers many full push
+    #: windows (LSOT_PUMP_DEPTH defaults to 32); an id evicted from here
+    #: has long since been placed, so a re-push that stale is impossible
+    #: short of a partition longer than the request's own deadline.
+    _HO_SEEN_CAP = 1024
+
+    @property
+    def on_handoff(self):
+        """Settable pump seam — the pool wires its `_pump_handoffs` here
+        exactly as it does for a local prefill scheduler (`hasattr` duck
+        typing). Setting a callback wakes the pump thread so pushes that
+        arrived before the wiring drain immediately."""
+        return self._on_handoff_cb
+
+    @on_handoff.setter
+    def on_handoff(self, cb) -> None:
+        self._on_handoff_cb = cb
+        if cb is not None:
+            self._kick_pump()
+
+    def _on_push(self, msg: Dict) -> None:
+        """One pushed handoff arrived (ev frame, not an rpc): ack first —
+        acks are idempotent and the server re-pushes on every reconnect
+        until one lands — then dedup by push id, rebind the wire request
+        to its client-side owner (original future/on_token from the sub
+        this side kept), and buffer it for the pool pump."""
+        ho = str(msg.get("ho"))
+        self._ack_push(ho)
+        if self._closed or self._unreachable is not None:
+            return
+        if FAULTS.site_active(self._partition_site):
+            return  # blackholed; the server re-pushes after the heal
+        with self._ho_lock:
+            if ho in self._ho_seen:
+                self._push_stats["dup_pushes"] += 1
+                return
+            self._ho_seen[ho] = None
+            while len(self._ho_seen) > self._HO_SEEN_CAP:
+                self._ho_seen.popitem(last=False)
+        token = msg.get("sub")
+        sub = self._sub(token, pop=True)
+        # The request leaves this replica's ownership: its future must
+        # not fail if THIS transport later goes unreachable — whichever
+        # replica the pool re-places it on owns it from here.
+        with self._pending_lock:
+            self._pending.pop(str(token), None)
+        try:
+            req = self._absorb_push(sub, msg.get("req") or {})
+        except Exception as e:  # noqa: BLE001 — e.g. no constraint resolver
+            if sub is not None:
+                try:
+                    sub.future.set_exception(e)
+                except InvalidStateError:
+                    pass
+            return
+        blob = getattr(req, "spilled", None)
+        nbytes = blob_meta(blob)["nbytes"] if blob else 0
+        if req.handoff is None:
+            req.handoff = {}
+        # Same-process receive stamp: the pool's _place_handoff turns it
+        # into the push→placed latency the fleet metrics export (worker
+        # clocks are not comparable across hosts; this one is ours).
+        req.handoff["t_recv"] = time.perf_counter()
+        with self._ho_lock:
+            self._push_stats["pushed"] += 1
+            self._push_stats["push_bytes"] += nbytes
+            self._pushed.append(req)
+        self._kick_pump()
+
+    def _ack_push(self, ho: str) -> None:
+        """Fire-and-forget: a lost ack costs one redundant re-push after
+        the next reconnect (deduped above), never a double decode."""
+        sock = self._sock
+        if sock is None:
+            return
+        try:
+            frame = encode_frame({"op": "handoff_ack", "seq": 0, "ho": ho},
+                                 self._encoding)
+            with self._send_lock:
+                sock.sendall(frame)
+        except OSError:
+            pass
+
+    def _absorb_push(self, sub: Optional[_Sub], entry: Dict):
+        """Bind a pushed wire request to its client-side owner, then
+        reconcile the delivered stream cursor: a connection gap may have
+        eaten token events between the worker's first-token commit and
+        the push, and the wire form's committed prefix is authoritative
+        — deliver the gap here so the consumer's stream stays an exact
+        prefix of the final result."""
+        if sub is not None and sub.req is not None:
+            # A requeued request came back as a handoff: same object,
+            # updated server-side progress (mirrors _rebind).
+            req = sub.req
+            upd = request_from_wire(entry, future=req.future,
+                                    on_token=req.on_token,
+                                    constraint_resolver=lambda s,
+                                    _c=req.constraint: _c)
+            req.generated = upd.generated
+            req.resume_pref = upd.resume_pref
+            req.rng_count = upd.rng_count
+            req.spilled = upd.spilled
+            req.handoff = upd.handoff
+        else:
+            fut = sub.future if sub is not None else Future()
+            tokcb = sub.on_token if sub is not None else None
+            req = request_from_wire(entry, future=fut, on_token=tokcb,
+                                    constraint_resolver=self._push_resolver)
+        if sub is not None:
+            for t in req.generated[sub.delivered:]:
+                sub.delivered += 1
+                req.emit(t)
+        return req
+
+    def _push_resolver(self, spec):
+        r = self.constraint_resolver
+        if r is None:
+            raise ValueError(
+                "pushed constrained handoff needs a client-side "
+                "constraint resolver (SchedulerBackend wires one through "
+                "the pool; set transport.constraint_resolver on raw "
+                "fleets)"
+            )
+        return r(spec)
+
+    def _kick_pump(self) -> None:
+        if self._on_handoff_cb is None:
+            return  # nothing drains push-style; extract_handoffs() pulls
+        with self._ho_lock:
+            t = self._ho_thread
+            if t is None or not t.is_alive():
+                t = threading.Thread(target=self._pump_loop, daemon=True,
+                                     name=f"lsot-push-pump-{self.label}")
+                self._ho_thread = t
+                t.start()
+        self._ho_event.set()
+
+    def _pump_loop(self) -> None:
+        """Off-reader-thread drain: fire the pool's on_handoff exactly
+        like a local prefill scheduler's _pack_handoffs does, with the
+        same decode-in-place fallback — if the pump raises, the buffered
+        handoffs requeue back to the worker, which imports the blob and
+        finishes the decode itself."""
+        while not self._closed:
+            if not self._ho_event.wait(timeout=0.25):
+                continue
+            self._ho_event.clear()
+            cb = self._on_handoff_cb
+            with self._ho_lock:
+                depth = len(self._pushed)
+            if cb is None or not depth:
+                continue
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — mirror _pack_handoffs' fallback
+                for req in self.drain_pushed_handoffs():
+                    try:
+                        self.requeue(req)
+                    except Exception as e:  # noqa: BLE001
+                        try:
+                            req.future.set_exception(e)
+                        except InvalidStateError:
+                            pass
+
+    def drain_pushed_handoffs(self) -> List[object]:
+        """The pool pump's drain: ONLY the locally-buffered pushes, no
+        rpc — the steady-state path never polls the worker. The
+        rpc-sweeping extract_handoffs below is the lifecycle drain,
+        where completeness beats latency."""
+        out: List[object] = []
+        with self._ho_lock:
+            while self._pushed:
+                out.append(self._pushed.popleft())
+        return out
+
+    @property
+    def push_pump_stats(self) -> Dict[str, object]:
+        """Client-side pump counters + the worker's own pump digest
+        (piggybacked on acks) — the `lsot_fleet_*` pushed-handoff
+        families read from here."""
+        with self._ho_lock:
+            out: Dict[str, object] = dict(self._push_stats)
+            out["depth"] = len(self._pushed)
+        srv = self._load.get("pump")
+        if isinstance(srv, dict):
+            out["worker"] = dict(srv)
+        return out
 
     # ---- raw rpc
 
@@ -1438,10 +1647,37 @@ class SocketTransport(_TransportBase):
         return self._rebind(ack.get("reqs") or [])
 
     def extract_handoffs(self) -> List[object]:
+        """Lifecycle drain (drain_replica / scale-down). For a push-
+        capable worker the steady state never reaches this rpc — the
+        pump owns the queue — but a drain must also sweep the push
+        window (sent, not yet acked: the conn may have died mid-frame),
+        so the rpc stays, with entries this side already absorbed
+        deduped away by their push ids. Legacy (pre-push) workers keep
+        the original pull semantics unchanged."""
         self._stats.bump("extract_handoffs")
-        ack = self._rpc_raw("extract_handoffs", {},
-                            timeout=self._rpc_timeout_s)
-        return self._rebind(ack.get("reqs") or [])
+        out = self.drain_pushed_handoffs()
+        if not self._dig("push_handoffs", False):
+            ack = self._rpc_raw("extract_handoffs", {},
+                                timeout=self._rpc_timeout_s)
+            return out + self._rebind(ack.get("reqs") or [])
+        try:
+            ack = self._rpc_raw("extract_handoffs", {},
+                                timeout=self._rpc_timeout_s)
+        except TransportError:
+            # Unreachable worker: the lease/journal replay machinery owns
+            # whatever is still on that host; the local buffer is what a
+            # drain can truthfully deliver.
+            return out
+        fresh = []
+        for entry in ack.get("reqs") or []:
+            ho = entry.get("ho")
+            if ho is not None:
+                with self._ho_lock:
+                    if str(ho) in self._ho_seen:
+                        continue  # absorbed via the push path already
+                    self._ho_seen[str(ho)] = None
+            fresh.append(entry)
+        return out + self._rebind(fresh)
 
     def _rebind(self, wire_reqs: List[Dict]) -> List[object]:
         out = []
@@ -1611,6 +1847,7 @@ class SocketTransport(_TransportBase):
         serving (other controllers, or a reconnect after a partition
         heals) — a transport shutdown is a hangup, not a teardown."""
         self._closed = True
+        self._ho_event.set()  # wake the push pump so it can exit
         self._drop_connection()
         self._breaker.unregister()
 
@@ -1632,7 +1869,9 @@ class ReplicaServer:
     router sees live placement signals."""
 
     def __init__(self, scheduler, host: str = "127.0.0.1", port: int = 0,
-                 constraint_resolver: Optional[Callable] = None):
+                 constraint_resolver: Optional[Callable] = None,
+                 push_handoffs: bool = True,
+                 pump_depth: Optional[int] = None):
         self.scheduler = scheduler
         self.constraint_resolver = constraint_resolver
         self._ledger = _TokenLedger()
@@ -1641,6 +1880,24 @@ class ReplicaServer:
         self._reqs: Dict[str, object] = {}      # token -> _Request
         self._sinks: Dict[str, "_ConnSink"] = {}  # token -> event sink
         self._closed = False
+        # Push-style handoff pump, server side (ISSUE 17): wire the
+        # scheduler's on_handoff so _pack_handoffs streams each packed
+        # blob to its client the moment it retires, instead of parking
+        # it for a pull that a remote pool never issues. `pump_depth`
+        # bounds the pushed-but-unacked window: beyond it (or with no
+        # live client connection) the handoff requeues right back into
+        # this scheduler, which imports the blob and decodes in place.
+        if pump_depth is None:
+            pump_depth = int(os.environ.get("LSOT_PUMP_DEPTH", "32") or 32)
+        self._pump_depth = max(1, int(pump_depth))
+        self._push = bool(push_handoffs) and hasattr(
+            self._view(), "on_handoff")
+        self._unacked: "OrderedDict[str, Tuple[str, object]]" = OrderedDict()
+        self._ho_seq = 0
+        self._pump_stats: Dict[str, int] = {
+            "pushed": 0, "push_bytes": 0, "acked": 0, "repushed": 0,
+            "inplace": 0, "backpressure": 0}
+        self._maybe_wire_pump()
         self._conns: List[socket.socket] = []
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()[:2]
@@ -1738,14 +1995,39 @@ class ReplicaServer:
             except OSError:
                 pass
 
+    def _view(self):
+        """The scheduler the digests describe: a supervised worker
+        (`--supervise`) swaps its inner loop on restart, so the live
+        inner — not the wrapper — is what admission arithmetic and the
+        pump must read. Raw schedulers view as themselves."""
+        return getattr(self.scheduler, "_inner", None) or self.scheduler
+
+    def _maybe_wire_pump(self) -> None:
+        """(Re)wire on_handoff onto the live inner: a supervised
+        worker's restart builds a fresh scheduler with on_handoff=None
+        (handoffs would silently decode in place forever) — this runs
+        per handled message, so the pump self-heals one rpc after any
+        restart."""
+        if not self._push or self._closed:
+            return
+        v = self._view()
+        if getattr(v, "on_handoff", False) is not self._pump_handoffs \
+                and hasattr(v, "on_handoff"):
+            v.on_handoff = self._pump_handoffs
+
     def _handle(self, msg: Dict, sink: "_ConnSink") -> None:
         op = str(msg.get("op", ""))
         seq = int(msg.get("seq", 0))
+        self._maybe_wire_pump()
         try:
             ack = self._dispatch(op, msg, sink)
             ack = dict(ack or {})
-            ack.update({"re": seq, "ok": True,
-                        "load": loads_digest_for(self.scheduler)})
+            load = loads_digest_for(self._view())
+            if self._push:
+                with self._lock:
+                    load["pump"] = dict(self._pump_stats,
+                                        window=len(self._unacked))
+            ack.update({"re": seq, "ok": True, "load": load})
             sink.send(ack)
         except BaseException as e:  # noqa: BLE001 — every error answers typed
             sink.send({"re": seq, "ok": False, "err": _encode_error(e)})
@@ -1758,9 +2040,16 @@ class ReplicaServer:
                     f"v{msg.get('client_version')}, this replica "
                     f"v{PROTOCOL_VERSION}"
                 )
-            return {"digest": describe_scheduler(self.scheduler)}
+            digest = describe_scheduler(self._view())
+            digest["push_handoffs"] = bool(self._push)
+            if self._push:
+                # A reconnect retries the push window on the fresh
+                # connection: the client dedups by push id, so the worst
+                # case is wasted bytes, never a double decode.
+                self._repush_unacked(sink)
+            return {"digest": digest}
         if op == "ping":
-            crash = getattr(self.scheduler, "_crash", None)
+            crash = getattr(self._view(), "_crash", None)
             if crash is not None:
                 raise SchedulerCrashed(f"replica loop crashed: {crash}")
             return {}
@@ -1772,9 +2061,81 @@ class ReplicaServer:
             return self._op_requeue(msg, sink)
         if op == "cancel":
             return self._op_cancel(msg)
+        if op == "handoff_ack":
+            return self._op_handoff_ack(msg)
         if op in ("extract_queued", "extract_handoffs"):
             return self._op_extract(op)
         raise RuntimeError(f"unknown rpc op {op!r}")
+
+    # ---- push-style handoff pump (server side, ISSUE 17)
+
+    def _pump_handoffs(self) -> None:
+        """scheduler.on_handoff: runs on the scheduler loop thread the
+        moment _pack_handoffs retires a batch of prefills. Each packed
+        handoff streams to its client as an ev frame carrying the full
+        wire request (KV blob, rng/resume state, deadline remaining);
+        the frame is deduped client-side by push id and re-pushed on
+        every reconnect until acked."""
+        for req in self.scheduler.extract_handoffs():
+            self._push_one(req)
+
+    def _push_one(self, req) -> None:
+        with self._lock:
+            token = next(
+                (t for t, r in self._reqs.items() if r is req), None)
+            sink = self._sinks.get(token) if token is not None else None
+            window_full = len(self._unacked) >= self._pump_depth
+        if (token is None or sink is None or sink.dead
+                or window_full or self._closed):
+            # No live client, or the push window is full: decode in
+            # place — re-admission imports the blob right back into this
+            # scheduler, the PR-13 fallback the pump must preserve.
+            self._pump_stats[
+                "backpressure" if window_full else "inplace"] += 1
+            try:
+                self.scheduler.requeue(req)
+            except Exception as e:  # noqa: BLE001 — fail typed, never drop
+                try:
+                    req.future.set_exception(e)
+                except InvalidStateError:
+                    pass
+            return
+        with self._lock:
+            self._ho_seq += 1
+            ho = f"{token}#ho{self._ho_seq}"
+            self._unacked[ho] = (token, req)
+        blob = getattr(req, "spilled", None)
+        self._pump_stats["pushed"] += 1
+        self._pump_stats["push_bytes"] += (
+            int(sum(int(np.asarray(a).nbytes) for a in blob))
+            if blob else 0)
+        sink.send({"ev": "handoff", "sub": token, "ho": ho,
+                   "req": request_to_wire(req)})
+
+    def _repush_unacked(self, sink: "_ConnSink") -> None:
+        with self._lock:
+            entries = list(self._unacked.items())
+            for _ho, (token, _req) in entries:
+                self._sinks[token] = sink
+        for ho, (token, req) in entries:
+            self._pump_stats["repushed"] += 1
+            sink.send({"ev": "handoff", "sub": token, "ho": ho,
+                       "req": request_to_wire(req)})
+
+    def _op_handoff_ack(self, msg: Dict) -> Dict:
+        ho = str(msg.get("ho"))
+        with self._lock:
+            entry = self._unacked.pop(ho, None)
+            if entry is not None:
+                # The client owns the request now: drop every server-side
+                # trace so the abandoned inner future cannot leak.
+                token = entry[0]
+                self._reqs.pop(token, None)
+                self._live.pop(token, None)
+                self._sinks.pop(token, None)
+        if entry is not None:
+            self._pump_stats["acked"] += 1
+        return {}
 
     def _op_submit(self, msg: Dict, sink: "_ConnSink") -> Dict:
         token = str(msg.get("tok"))
@@ -1877,18 +2238,30 @@ class ReplicaServer:
 
     def _op_extract(self, op: str) -> Dict:
         fn = getattr(self.scheduler, op, None)
-        reqs = fn() if callable(fn) else []
+        tagged = [(None, r) for r in (fn() if callable(fn) else [])]
+        if op == "extract_handoffs":
+            # A drain sweeps the push window too: a pushed-but-unacked
+            # handoff may never have reached the client (conn died
+            # mid-frame) and a drain must be complete. Entries keep
+            # their push id so a client that DID absorb the push dedups
+            # them away instead of double-placing.
+            with self._lock:
+                unacked, self._unacked = self._unacked, OrderedDict()
+            tagged = [(ho, req) for ho, (_t, req) in unacked.items()] + tagged
         out = []
         with self._lock:
             tok_by_req = {id(r): t for t, r in self._reqs.items()}
-        for req in reqs:
+        for ho, req in tagged:
             token = tok_by_req.get(id(req))
             with self._lock:
                 if token is not None:
                     self._reqs.pop(token, None)
                     self._live.pop(token, None)
                     self._sinks.pop(token, None)
-            out.append({"tok": token, "req": request_to_wire(req)})
+            entry = {"tok": token, "req": request_to_wire(req)}
+            if ho is not None:
+                entry["ho"] = ho
+            out.append(entry)
         return {"reqs": out}
 
     class _Emitter:
@@ -1927,7 +2300,7 @@ class ReplicaServer:
         if sink is None:
             return
         msg: Dict = {"ev": "done", "sub": token,
-                     "load": loads_digest_for(self.scheduler)}
+                     "load": loads_digest_for(self._view())}
         exc = fut.exception()
         if exc is None:
             msg.update({"ok": True, "val": [int(t) for t in fut.result()]})
@@ -1951,6 +2324,10 @@ class _ConnSink:
         self._dead = False
         self._enc = default_encoding() if encoding is None else encoding
 
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
     def send(self, msg: Dict) -> None:
         if self._dead:
             return
@@ -1966,11 +2343,15 @@ class _ConnSink:
 
 
 def _build_worker_scheduler(args):
-    """The proof-harness replica: a tiny random-weight scheduler on this
-    host's devices. Production deployments point LSOT_POOL_REMOTE at
-    workers that build from real checkpoints with their own serving
-    config — this entrypoint exists so a multi-host fleet can be stood
-    up and chaos-tested without shipping weights around."""
+    """Build the worker's scheduler from its spec. `--from-hf`/
+    `--from-gguf` load a real checkpoint with the full AppConfig-
+    equivalent serving surface (kv quant/layout/HBM budget, speculative
+    draft, watchdog supervision) — a remote tier runs the same engine
+    bytes as the local one. Without a checkpoint flag the worker builds
+    the tiny random-weight proof-harness replica, so a multi-host fleet
+    can be stood up and chaos-tested without shipping weights around."""
+    if getattr(args, "from_hf", "") or getattr(args, "from_gguf", ""):
+        return _build_checkpoint_scheduler(args)
     import jax
     import jax.numpy as jnp
 
@@ -1997,7 +2378,100 @@ def _build_worker_scheduler(args):
 
         return get_constraint(spec, tok, (2,))
 
-    return sched, resolver
+    return _maybe_supervise(sched, args), resolver
+
+
+def _maybe_supervise(sched, args) -> object:
+    """`--supervise`: wrap the worker's scheduler in the in-process crash
+    supervisor (watchdog stall detection + journal replay), so a decode-
+    loop crash on the worker host restarts locally instead of waiting
+    for the pool's lease to expire and re-prefill on a sibling."""
+    if not getattr(args, "supervise", False):
+        return sched
+    from .supervisor import SupervisedScheduler
+
+    fresh = [sched]
+
+    def make():
+        if fresh:
+            return fresh.pop()
+        return _rebuild_worker_scheduler(args)
+
+    return SupervisedScheduler(
+        make, max_restarts=int(getattr(args, "max_restarts", 5)),
+        stall_factor=float(getattr(args, "stall_factor", 16.0)),
+        stall_min_s=float(getattr(args, "stall_min_s", 10.0)),
+        warmup_grace_s=float(getattr(args, "stall_warmup_s", 0.0)),
+        name=f"remote-worker:{getattr(args, 'model_id', '') or 'tiny'}",
+    )
+
+
+def _rebuild_worker_scheduler(args):
+    """Supervisor restart factory: rebuild the inner scheduler from the
+    same spec (checkpoint params reload from disk — a worker restart is
+    rare enough that one disk read beats pinning a second params copy)."""
+    import argparse as _ap
+
+    plain = _ap.Namespace(**{**vars(args), "supervise": False})
+    sched, _resolver = _build_worker_scheduler(plain)
+    return sched
+
+
+def _build_checkpoint_scheduler(args):
+    """Real-checkpoint worker (ISSUE 17): the same recipe
+    `SchedulerBackend.from_hf_checkpoint`/`from_gguf` cooks for local
+    serving, built here as a raw scheduler for ReplicaServer — phase
+    role and model identity stamped so the pool's placement and the
+    wire's model validation see a first-class replica."""
+    import jax.numpy as jnp
+
+    from ..tokenizer import HFTokenizer
+    from .backends import resolve_stop_ids
+    from .scheduler import ContinuousBatchingScheduler
+
+    if args.from_hf and args.from_gguf:
+        raise ValueError("pick one of --from-hf / --from-gguf")
+    if args.from_hf:
+        from ..checkpoint import load_hf_checkpoint
+
+        cfg, params = load_hf_checkpoint(args.from_hf, dtype=jnp.bfloat16)
+        tok = HFTokenizer(args.tokenizer or args.from_hf)
+    else:
+        from ..checkpoint import load_gguf_checkpoint
+
+        if not args.tokenizer:
+            raise ValueError(
+                "--from-gguf needs --tokenizer DIR (GGUF blobs carry no "
+                "tokenizer.json)"
+            )
+        cfg, params = load_gguf_checkpoint(args.from_gguf)
+        tok = HFTokenizer(args.tokenizer)
+    if args.int8:
+        from ..ops.quant import quantize_params
+
+        params = quantize_params(params)
+    stop_ids = resolve_stop_ids(cfg, tok)
+    sched = ContinuousBatchingScheduler(
+        cfg, params, num_slots=args.num_slots,
+        decode_chunk=args.decode_chunk, prompt_bucket=args.prompt_bucket,
+        stop_ids=stop_ids, max_seq=args.max_seq,
+        kv_layout=args.kv_layout,
+        kv_page_size=args.kv_page_size or None,
+        kv_quant=(args.kv_quant or None),
+        kv_hbm_budget_bytes=(int(args.kv_hbm_gb * (1 << 30))
+                             if args.kv_hbm_gb else None),
+        kv_pages=(args.kv_pages or None),
+        speculative_draft=args.speculative,
+        phase_role=args.phase_role,
+        model_id=args.model_id or "",
+    )
+
+    def resolver(spec):
+        from ..constrain import get_constraint
+
+        return get_constraint(spec, tok, stop_ids)
+
+    return _maybe_supervise(sched, args), resolver
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -2024,13 +2498,52 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "(UnknownModel) instead of decoding on the "
                          "wrong weights")
     ap.add_argument("--seed", type=int, default=0)
+    # Real-checkpoint spec (ISSUE 17): the AppConfig-equivalent surface.
+    ap.add_argument("--from-hf", default="", metavar="DIR",
+                    help="serve a real HF checkpoint directory instead "
+                         "of the tiny proof-harness model")
+    ap.add_argument("--from-gguf", default="", metavar="PATH",
+                    help="serve a GGUF blob (pair with --tokenizer DIR)")
+    ap.add_argument("--tokenizer", default="", metavar="DIR",
+                    help="tokenizer directory (defaults to --from-hf dir)")
+    ap.add_argument("--int8", action="store_true",
+                    help="int8 weight-only quantization at load")
+    ap.add_argument("--kv-quant", default="", choices=["", "int8"],
+                    help="quantize the persistent KV cache")
+    ap.add_argument("--kv-hbm-gb", type=float, default=0.0,
+                    help="paged-KV HBM budget in GiB (0 = default sizing)")
+    ap.add_argument("--kv-pages", type=int, default=0,
+                    help="explicit paged-KV pool size in pages")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the scheduler under the in-process crash "
+                         "supervisor (watchdog + journal replay)")
+    ap.add_argument("--max-restarts", type=int, default=5)
+    ap.add_argument("--stall-factor", type=float, default=16.0)
+    ap.add_argument("--stall-min-s", type=float, default=10.0)
+    ap.add_argument("--stall-warmup-s", type=float, default=0.0)
+    ap.add_argument("--slo-ttft-ms", type=float, default=0.0)
+    ap.add_argument("--slo-tpot-ms", type=float, default=0.0)
+    ap.add_argument("--slo-queue-wait-ms", type=float, default=0.0)
+    ap.add_argument("--no-push-handoffs", action="store_true",
+                    help="legacy pull-only handoff drain (pre-push pools)")
+    ap.add_argument("--pump-depth", type=int, default=0,
+                    help="bound on pushed-but-unacked handoffs before "
+                         "decode-in-place backpressure (0 = "
+                         "LSOT_PUMP_DEPTH, default 32)")
     args = ap.parse_args(argv)
 
+    if args.slo_ttft_ms or args.slo_tpot_ms or args.slo_queue_wait_ms:
+        from ..utils import slo
+
+        slo.reconfigure(ttft_ms=args.slo_ttft_ms, tpot_ms=args.slo_tpot_ms,
+                        queue_wait_ms=args.slo_queue_wait_ms)
     sched, resolver = _build_worker_scheduler(args)
     sched.warmup()
     sched.start()
     server = ReplicaServer(sched, host=args.host, port=args.port,
-                           constraint_resolver=resolver)
+                           constraint_resolver=resolver,
+                           push_handoffs=not args.no_push_handoffs,
+                           pump_depth=(args.pump_depth or None))
     # The smoke script greps this line for the bound port.
     print(f"lsot-remote-worker listening on {server.address}", flush=True)
     try:

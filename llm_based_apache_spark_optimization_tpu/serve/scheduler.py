@@ -664,6 +664,13 @@ class ContinuousBatchingScheduler:
         self._handoff: "deque[_Request]" = deque()
         self._handoff_pending: list = []
         self.on_handoff: Optional[Callable[[], None]] = None
+        # Bounded in-worker handoff buffer (ISSUE 17): when the pump's
+        # consumer falls behind and the packed queue reaches this depth,
+        # further handoffs decode in place instead of piling up blobs
+        # (each one pins exported pages' worth of host memory).
+        self._pump_depth = int(os.environ.get("LSOT_PUMP_DEPTH", "32")
+                               or 32)
+        self._ho_backpressure = 0
         self._ho_exports = 0
         self._ho_imports = 0
         self._ho_inplace = 0
@@ -1863,6 +1870,13 @@ class ContinuousBatchingScheduler:
             if self.on_handoff is None:
                 self._arm_inplace(slot, req)
                 continue
+            if self._pump_depth and len(self._handoff) >= self._pump_depth:
+                # Bounded buffer: the pump's consumer is behind by a full
+                # window of packed blobs — decoding in place is cheaper
+                # than pinning more exported pages on the host.
+                self._ho_backpressure += 1
+                self._arm_inplace(slot, req)
+                continue
             self._export_handoff(slot, req)
             packed += 1
         if packed:
@@ -2016,6 +2030,7 @@ class ContinuousBatchingScheduler:
             "wait_s_sum": round(self._ho_wait_sum, 6),
             "wait_count": self._ho_wait_count,
             "queued_handoffs": len(self._handoff),
+            "backpressure": self._ho_backpressure,
         }
 
     @property
@@ -4975,6 +4990,25 @@ class SchedulerPool:
         # thread inside an XLA compile when the process exits (a C++
         # abort at interpreter teardown, seen in the chaos suites).
         self._restart_threads: List[threading.Thread] = []
+        # Elastic fleet membership (ISSUE 17): lifecycle counters +
+        # push-handoff latency ledger behind fleet_stats()/lsot_fleet_*,
+        # plus the constraint-resolver seam a pushed constrained handoff
+        # needs when its target is a remote transport (the wire carries
+        # the spec; the receiving client rebuilds the matcher).
+        self.constraint_resolver: Optional[Callable] = None
+        self._fleet_joins = 0
+        self._fleet_retires = 0
+        self._fleet_drain_s_sum = 0.0
+        self._fleet_drain_count = 0
+        self._push_lat = deque(maxlen=4096)
+        # Indices the autoscaler added — only these are eligible for
+        # scale-down, so an operator-configured replica never retires.
+        self._elastic: set = set()
+        # Startup handshake (ISSUE 17): a remote joiner whose page
+        # geometry / model set cannot co-serve this fleet is marked dead
+        # BEFORE placement can route a request into it.
+        for i, s in enumerate(self.schedulers):
+            self._validate_join(i, s)
 
     # Admission-arithmetic surface, so SchedulerBackend can wrap a pool the
     # same way it wraps one scheduler (replicas are homogeneous: same cfg,
@@ -5190,8 +5224,6 @@ class SchedulerPool:
             rec: Dict[str, object] = {
                 "replica": st.label,
                 "state": st.state,
-                "queued": q.qsize() if q is not None else 0,
-                "active_slots": sum(1 for r in slot_req if r is not None),
                 "num_slots": getattr(s, "num_slots", 0),
                 "expected_round_s": hb_snap.get("expected_round_s"),
                 "crashed": getattr(s, "_crash", None) is not None,
@@ -5201,6 +5233,17 @@ class SchedulerPool:
                 "backlog_s": round(secs, 4),
                 "pending_new_tokens": toks,
             }
+            # Queue depth / live slots: read in-process when the replica
+            # is local; a socket transport has neither attribute, so the
+            # keys stay unset here and the loads-digest merge below fills
+            # them from the worker's piggybacked numbers — the elastic
+            # autoscaler's queue-EWMA signal (serve/elastic.py) must see
+            # REMOTE decode backlog, not a shadowing local zero.
+            if q is not None:
+                rec["queued"] = q.qsize()
+            if slot_req:
+                rec["active_slots"] = sum(
+                    1 for r in slot_req if r is not None)
             hint = getattr(s, "retry_after_hint", None)
             if callable(hint) and st.state in _ReplicaState.PLACEABLE:
                 try:
@@ -5287,6 +5330,10 @@ class SchedulerPool:
                         rec.setdefault(k, v)
                 except Exception:  # noqa: BLE001 — a dying replica mid-read
                     pass
+            # Key-presence contract: every record carries the load pair
+            # even when neither the local read nor the digest had it.
+            rec.setdefault("queued", 0)
+            rec.setdefault("active_slots", 0)
             # Transport attribution: which wire this replica is behind
             # and how it is behaving (rpc/retry/timeout totals, lease
             # state) — the per-replica half of serving.transport.
@@ -5651,6 +5698,81 @@ class SchedulerPool:
         every targeted-restart swap)."""
         if self._phase_role(s) == "prefill" and hasattr(s, "on_handoff"):
             s.on_handoff = partial(self._pump_handoffs, idx)
+        # Pushed constrained handoffs (ISSUE 17): the wire carries only
+        # the constraint SPEC — the receiving transport rebuilds the
+        # matcher through the pool's resolver seam (set by
+        # SchedulerBackend; raw fleets may set pool.constraint_resolver
+        # directly).
+        if (getattr(s, "is_remote", False)
+                and getattr(s, "constraint_resolver", "absent") is None):
+            s.constraint_resolver = self._fleet_constraint
+
+    def _fleet_constraint(self, spec):
+        """Resolver seam for constrained requests re-materialized from
+        the wire (pushed handoffs): delegates to whatever the owning
+        backend installed, failing typed when nothing did."""
+        fn = self.constraint_resolver
+        if fn is None:
+            raise ValueError(
+                "pushed constrained handoff needs a constraint resolver: "
+                "set pool.constraint_resolver (SchedulerBackend does this "
+                "automatically)"
+            )
+        return fn(spec)
+
+    def _join_compat(self, s) -> Optional[str]:
+        """Startup-handshake compatibility check for a REMOTE joiner
+        (ISSUE 17): a pushed KV blob's pages must be importable by every
+        decode target, so a joiner whose page geometry disagrees with
+        the fleet's — or whose checkpoint no local sibling carries —
+        cannot be made placeable. Returns a reason string, or None when
+        compatible. Local replicas are trusted: they were built by the
+        same factory that built the fleet."""
+        if not getattr(s, "is_remote", False):
+            return None
+        try:
+            ref = None
+            for other in self.schedulers:
+                if other is not s and not getattr(other, "is_remote",
+                                                  False):
+                    ref = other
+                    break
+            if ref is None:
+                return None  # all-remote fleet: nothing to disagree with
+            r_paged = bool(getattr(s, "_paged", False))
+            l_paged = bool(getattr(ref, "_paged", False))
+            if r_paged != l_paged:
+                return (f"paged={r_paged} vs fleet paged={l_paged}")
+            r_ps = int(getattr(s, "_page_size", 0) or 0)
+            l_ps = int(getattr(ref, "_page_size", 0) or 0)
+            if r_paged and r_ps and l_ps and r_ps != l_ps:
+                return f"page_size={r_ps} vs fleet page_size={l_ps}"
+            want = str(getattr(s, "model_id", "") or "")
+            have = {str(self._model_id(other) or "")
+                    for other in self.schedulers if other is not s}
+            have.discard("")
+            if want and have and want not in have:
+                return (f"model_id={want!r} not served by this fleet "
+                        f"({sorted(have)})")
+        except Exception as e:  # noqa: BLE001 — unreachable joiner
+            return f"handshake read failed: {e!r}"
+        return None
+
+    def _validate_join(self, idx: int, s) -> bool:
+        """Run the join handshake for replica `idx`; an incompatible
+        joiner is marked dead (never placeable) with the reason in its
+        crash slot and a flight event — the pool keeps serving on the
+        rest of the fleet."""
+        reason = self._join_compat(s)
+        if reason is None:
+            return True
+        st = self._states[idx]
+        with self._lock:
+            st.state = "dead"
+            st.last_crash = f"join rejected: {reason}"
+        self._pool_flight.event(
+            "replica_join_rejected", replica=st.label, reason=reason)
+        return False
 
     def _penalty(self, st: "_ReplicaState", s) -> int:
         """Pressure-aware placement (ISSUE 13 satellite): deprioritize a
@@ -5702,7 +5824,13 @@ class SchedulerPool:
         plus a queue put, so the pump costs the prefill loop
         microseconds, and there is no polling thread to fall behind."""
         src = self.schedulers[src_idx]
-        ex = getattr(src, "extract_handoffs", None)
+        # One drain path (ISSUE 17): a push-capable transport buffers
+        # blobs the worker streamed to us — drain that buffer directly.
+        # extract_handoffs survives only as the legacy pull RPC for
+        # pre-push workers and the drain/reconcile sweep.
+        ex = getattr(src, "drain_pushed_handoffs", None)
+        if not callable(ex):
+            ex = getattr(src, "extract_handoffs", None)
         if not callable(ex):
             return
         for req in ex():
@@ -5756,12 +5884,28 @@ class SchedulerPool:
         # req.handoff) and requeue reassigns rid the moment rq(req)
         # returns.
         pages = (req.handoff or {}).get("pages", 0)
+        t_recv = (req.handoff or {}).get("t_recv")
         rid = req.rid
+        starved = 0
         for i, st, s in targets:
             if remaining is not None and s is not src:
                 secs, _ = self._score(s)
                 if secs >= remaining:
                     continue  # its backlog alone would burn the deadline
+            if s is not src:
+                # Page-starved targets (ISSUE 17): a decode sibling with
+                # zero free pages would park this blob in its page-wait
+                # queue — behind the very storm that starved it. Skip it;
+                # if EVERY target is starved the failure below is typed
+                # Overloaded, not a crash.
+                try:
+                    pstats = getattr(s, "page_stats", None)
+                    if (pstats
+                            and int(pstats.get("pages_free", 1) or 0) <= 0):
+                        starved += 1
+                        continue
+                except Exception:  # noqa: BLE001 — dying replica mid-read
+                    pass
             rq = getattr(s, "requeue", None)
             if not callable(rq):
                 continue
@@ -5771,11 +5915,30 @@ class SchedulerPool:
                 continue
             with self._lock:
                 st.placements += 1
+                # Pushed-handoff latency ledger (ISSUE 17): the receiving
+                # transport stamps t_recv the moment the blob leaves the
+                # wire; placement closes the window lsot_fleet_push
+                # latency summaries render.
+                if t_recv is not None:
+                    try:
+                        self._push_lat.append(
+                            max(0.0, time.perf_counter() - float(t_recv)))
+                    except (TypeError, ValueError):
+                        pass
             self._pool_flight.event(
                 "handoff_place", to=st.label,
                 src=self._states[src_idx].label, rid=rid,
                 pages=pages, inplace=s is src,
             )
+            return
+        if starved:
+            # Capacity exhaustion, not a crash: every decode target is
+            # page-waiting AND the source could not take it back. Typed
+            # backpressure tells the client to retry after the storm.
+            req.future.set_exception(Overloaded(
+                "every decode target is page-waiting; prefill→decode "
+                "handoff rejected under KV pressure"
+            ))
             return
         # Not even the (live — we are on its worker thread) source could
         # take it back: fail typed so the supervisor's journal replays it
@@ -6240,6 +6403,7 @@ class SchedulerPool:
         semantics at the pool level are untouched — this is the
         one-replica twin of the supervisor's drain."""
         idx = self._resolve_idx(replica)
+        t_drain0 = time.perf_counter()
         with self._lock:
             st = self._states[idx]
             if st.state in ("draining", "removed"):
@@ -6326,6 +6490,10 @@ class SchedulerPool:
             # mark a live replica drained out from under them.
             if st.state == "draining":
                 st.state = "removed" if remove else "drained"
+            # Fleet drain ledger (ISSUE 17): scale-down rides this path,
+            # so its cost shows up as lsot_fleet_drain_seconds.
+            self._fleet_drain_s_sum += time.perf_counter() - t_drain0
+            self._fleet_drain_count += 1
         self._pool_flight.event("replica_drained", replica=st.label,
                                 replaced=replaced, finished=finished,
                                 removed=remove)
@@ -6347,6 +6515,110 @@ class SchedulerPool:
         """Drain + permanently remove one replica from the fleet."""
         return self.drain_replica(replica, deadline_s=deadline_s,
                                   remove=True)
+
+    # ------------------------------------------- elastic membership (17)
+
+    def add_replica(self, scheduler, label: Optional[str] = None,
+                    weight: float = 1.0, elastic: bool = True) -> str:
+        """Join ONE replica to a LIVE fleet: append + wire the handoff
+        pump and constraint seam, run the startup handshake, and (if the
+        joiner brought a lease surface) make sure the lease monitor is
+        running. Returns the new replica's label. A joiner failing the
+        page-geometry/model handshake stays visible in /healthz as dead
+        with the reason — it is never placeable, and the fleet keeps
+        serving. `elastic=True` marks it retirable by scale-down;
+        operator-configured replicas never retire."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("cannot add a replica to a closed pool")
+            idx = len(self.schedulers)
+            lbl = label or f"r{idx}"
+            fl = getattr(scheduler, "flight", None)
+            if fl is not None:
+                fl.replica = lbl
+            self.schedulers.append(scheduler)
+            self._states.append(_ReplicaState(
+                label=lbl, model_id=self._model_id(scheduler)))
+            self._weights.append(max(1e-9, float(weight)))
+            if elastic:
+                self._elastic.add(idx)
+            self._fleet_joins += 1
+        self._wire_handoff(idx, scheduler)
+        ok = self._validate_join(idx, scheduler)
+        self._pool_flight.event(
+            "replica_join", replica=lbl, elastic=bool(elastic),
+            accepted=ok, phase_role=self._phase_role(scheduler))
+        # The lease monitor tolerates list growth (it snapshots the state
+        # list under the lock each tick) — (re)arm it in case the joiner
+        # is the fleet's first remote.
+        self._maybe_start_lease()
+        return lbl
+
+    def retire_replica(self, replica=None,
+                       deadline_s: Optional[float] = None
+                       ) -> Optional[Dict[str, object]]:
+        """Scale-down: drain-and-remove ONE autoscaler-added replica —
+        drain → re-place → remove rides drain_replica, so acknowledged
+        work re-places onto siblings and ZERO requests are lost. With
+        `replica=None`, picks the least-loaded serving elastic replica.
+        Returns the drain report, or None when nothing is retirable
+        (operator-configured replicas are never eligible)."""
+        if replica is not None:
+            idx = self._resolve_idx(replica)
+            if idx not in self._elastic:
+                return None
+        else:
+            with self._lock:
+                cands = [i for i in self._elastic
+                         if self._states[i].state
+                         in _ReplicaState.PLACEABLE]
+            if not cands:
+                return None
+            idx = min(cands, key=lambda i: (
+                self._wscore(i, self.schedulers[i]), i))
+        out = self.drain_replica(idx, deadline_s=deadline_s, remove=True)
+        with self._lock:
+            self._elastic.discard(idx)
+            self._fleet_retires += 1
+        self._pool_flight.event("replica_retire",
+                                replica=out.get("replica"),
+                                replaced=out.get("replaced"))
+        return out
+
+    def fleet_stats(self) -> Dict[str, object]:
+        """The `fleet` block in /healthz and /metrics (lsot_fleet_*):
+        live membership, join/retire/drain lifecycle counters, and the
+        pushed-handoff ledger (depth, bytes, wire→placement latency)."""
+        with self._lock:
+            states = [st.state for st in self._states]
+            out: Dict[str, object] = {
+                "size": len(states),
+                "serving": sum(1 for s in states
+                               if s in _ReplicaState.PLACEABLE),
+                "elastic": len(self._elastic),
+                "joins": self._fleet_joins,
+                "retires": self._fleet_retires,
+                "drain_s_sum": round(self._fleet_drain_s_sum, 6),
+                "drain_count": self._fleet_drain_count,
+            }
+            lat = sorted(self._push_lat)
+        out.update({"pushed": 0, "push_bytes": 0, "pump_depth": 0,
+                    "push_placed": len(lat)})
+        if lat:
+            out["push_place_p50_ms"] = round(
+                lat[int(0.50 * (len(lat) - 1))] * 1e3, 3)
+            out["push_place_p95_ms"] = round(
+                lat[int(0.95 * (len(lat) - 1))] * 1e3, 3)
+        for s in self.schedulers:
+            pp = getattr(s, "push_pump_stats", None)
+            if isinstance(pp, dict):
+                out["pushed"] += int(pp.get("pushed", 0) or 0)
+                out["push_bytes"] += int(pp.get("push_bytes", 0) or 0)
+                out["pump_depth"] += int(pp.get("depth", 0) or 0)
+                w = pp.get("worker")
+                if isinstance(w, dict):
+                    out["pump_depth"] += int(w.get("window", 0) or 0)
+        return out
 
     def stalled_replicas(self, factor: float, floor_s: float) -> List[str]:
         """Labels of SERVING replicas whose busy heartbeat has gone stale
@@ -6425,6 +6697,10 @@ class SchedulerPool:
             "replicas": reps,
             "restarts": sum(int(r["restarts"]) for r in reps),
             "stalls": sum(int(r["stalls"]) for r in reps),
+            # Elastic membership view (ISSUE 17): size/joins/retires/
+            # drain ledger + the pushed-handoff pump depth, so one
+            # /healthz probe answers "did the fleet actually scale".
+            "fleet": self.fleet_stats(),
         }
 
     @property
@@ -6714,6 +6990,17 @@ class SchedulerBackend:
                 models = None
             if models:
                 out["models"] = models
+        # Elastic fleet membership (ISSUE 17): size/joins/retires/drain
+        # ledger + pushed-handoff depth/bytes/latency — rendered as the
+        # lsot_fleet_* families (utils/prometheus.py).
+        fs2 = getattr(self.scheduler, "fleet_stats", None)
+        if callable(fs2):
+            try:
+                fleet = fs2()
+            except Exception:  # noqa: BLE001 — a churning fleet mid-read
+                fleet = None
+            if fleet:
+                out["fleet"] = fleet
         sup = self.health()
         if sup is not None:
             out["supervisor"] = sup

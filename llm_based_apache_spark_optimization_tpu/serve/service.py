@@ -348,6 +348,27 @@ class GenerationService:
                     out[e.name] = reps
         return out
 
+    def fleet_membership(self) -> Dict[str, Dict[str, object]]:
+        """Elastic-membership view per model (ISSUE 17): the pool's
+        fleet_stats() — size/serving/elastic counts, join/retire/drain
+        lifecycle counters, pushed-handoff pump depth/bytes/latency —
+        beside the per-replica lifecycle above. Empty for backends
+        without a fleet. Surfaced on /healthz."""
+        out: Dict[str, Dict[str, object]] = {}
+        with self._lock:
+            entries = list(self._models.values())
+        for e in entries:
+            sched = getattr(e.backend, "scheduler", None)
+            fn = getattr(sched, "fleet_stats", None)
+            if callable(fn):
+                try:
+                    stats = fn()
+                except Exception:  # noqa: BLE001 — a churning fleet mid-read
+                    continue
+                if stats:
+                    out[e.name] = stats
+        return out
+
     def supports_idempotency(self, model: str) -> bool:
         """Can `model`'s backend dedupe an idempotency key against a
         journal? The drain gate uses this to decide whether a keyed

@@ -562,6 +562,28 @@ class SupervisedScheduler:
         fn = getattr(self._inner, "replica_health", None)
         return fn() if callable(fn) else []
 
+    # Elastic membership passthroughs (ISSUE 17): the autoscaler and the
+    # app's fleet endpoints address the pool through the supervision
+    # layer — joins/retires hit the LIVE inner (re-resolved per call, so
+    # they keep working across full-restart swaps).
+    def add_replica(self, scheduler, label: Optional[str] = None,
+                    weight: float = 1.0, elastic: bool = True) -> str:
+        fn = getattr(self._inner, "add_replica", None)
+        if not callable(fn):
+            raise ValueError("inner scheduler has no replica fleet")
+        return fn(scheduler, label=label, weight=weight, elastic=elastic)
+
+    def retire_replica(self, replica=None,
+                       deadline_s: Optional[float] = None
+                       ) -> Optional[Dict[str, object]]:
+        fn = getattr(self._inner, "retire_replica", None)
+        return (fn(replica, deadline_s=deadline_s)
+                if callable(fn) else None)
+
+    def fleet_stats(self) -> Optional[Dict[str, object]]:
+        fn = getattr(self._inner, "fleet_stats", None)
+        return fn() if callable(fn) else None
+
     # ---------------------------------------------------------------- client
 
     def submit(
@@ -746,6 +768,16 @@ class SupervisedScheduler:
                 out["replicas"] = rh()
             except Exception:  # noqa: BLE001 — a churning pool mid-read
                 pass
+        # Elastic membership (ISSUE 17): the fleet size/joins/retires/
+        # pump ledger rides the same probe.
+        fs = getattr(self._inner, "fleet_stats", None)
+        if callable(fs):
+            try:
+                fleet = fs()
+            except Exception:  # noqa: BLE001 — a churning pool mid-read
+                fleet = None
+            if fleet:
+                out["fleet"] = fleet
         return out
 
     @property
@@ -1446,6 +1478,24 @@ class SupervisedScheduler:
         if getattr(inner, "supports_replica_restart", False):
             inner.on_replica_restart = self._on_replica_restarted
             inner.on_replica_drained = self._replay_replica
+            # Pushed constrained handoffs (ISSUE 17): the pool resolves
+            # wire constraint SPECs through the supervisor's resolver
+            # (installed by SchedulerBackend, the tokenizer owner).
+            # Bound late so a resolver set AFTER start() still reaches
+            # every inner rebuild.
+            if hasattr(inner, "constraint_resolver"):
+                inner.constraint_resolver = self._resolve_fleet_constraint
+
+    def _resolve_fleet_constraint(self, spec):
+        """Late-bound spec→tables resolver for the inner pool (pushed
+        handoffs re-materialized from the wire)."""
+        fn = self.constraint_resolver
+        if fn is None:
+            raise ValueError(
+                "constrained handoff spec needs a constraint_resolver "
+                "(SchedulerBackend installs one)"
+            )
+        return fn(spec)
 
     def _on_replica_restarted(self, label: str) -> None:
         """A targeted replica rebuild just landed: re-open the warmup

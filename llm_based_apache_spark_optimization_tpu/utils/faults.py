@@ -66,6 +66,13 @@ lease-expiry → targeted-restart → journal-replay recovery path
 reads `FAULTS.site_active(site)`, which never draws — concurrent
 stream deliveries must not perturb the seeded schedule.
 
+**Fleet-membership site** (ISSUE 17): `fleet:spawn:p` fires inside the
+autoscaler's scale-up attempt (serve/elastic.py) BEFORE the standby
+worker is contacted — an injected spawn failure must degrade to "keep
+serving at the current fleet size" (a counted non-event in
+autoscaler.stats()), never wedge the control loop or lose a request
+(`evalh --chaos` stage 8's partition-during-scale-up leg).
+
 Injection points call `FAULTS.check("site:point")`, which raises
 `InjectedFault` (a ConnectionError subclass, so connect-phase retry
 classifiers treat it exactly like a real refused connection) — or, for a

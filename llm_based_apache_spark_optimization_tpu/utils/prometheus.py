@@ -284,6 +284,36 @@ def _emit_prefix(emit: _Emitter, model: str, pv: Dict) -> None:
                 emit.add(name, labels, n, mtype)
 
 
+def _emit_fleet(emit: _Emitter, model: str, fl: Dict) -> None:
+    """The elastic-membership families (ISSUE 17): `serving.fleet`
+    becomes lsot_fleet_* gauges/counters labeled model — live fleet
+    size and serving/elastic counts, join/retire lifecycle totals, the
+    drain-duration ledger scale-down rides, and the pushed-handoff
+    pump's depth/bytes/latency (wire-receive → pool placement)."""
+    labels = {"model": model}
+    for key, name, mtype in (
+            ("size", "lsot_fleet_size", "gauge"),
+            ("serving", "lsot_fleet_serving", "gauge"),
+            ("elastic", "lsot_fleet_elastic", "gauge"),
+            ("joins", "lsot_fleet_joins_total", "counter"),
+            ("retires", "lsot_fleet_retires_total", "counter"),
+            ("drain_s_sum", "lsot_fleet_drain_seconds_sum", "counter"),
+            ("drain_count", "lsot_fleet_drain_count", "counter"),
+            ("pushed", "lsot_fleet_pushed_handoffs_total", "counter"),
+            ("push_bytes", "lsot_fleet_pushed_handoff_bytes_total",
+             "counter"),
+            ("pump_depth", "lsot_fleet_pump_depth", "gauge"),
+            ("push_placed", "lsot_fleet_push_placed_total", "counter"),
+            ("push_place_p50_ms", "lsot_fleet_push_place_p50_ms",
+             "gauge"),
+            ("push_place_p95_ms", "lsot_fleet_push_place_p95_ms",
+             "gauge"),
+    ):
+        n = _num(fl.get(key))
+        if n is not None:
+            emit.add(name, labels, n, mtype)
+
+
 def _emit_models(emit: _Emitter, model: str, mv: Dict) -> None:
     """The multi-model fleet families (ISSUE 16): `serving.models`
     becomes lsot_model_* gauges/counters labeled model (the BACKEND
@@ -410,6 +440,12 @@ def render_prometheus(snapshot: Dict,
             mv = serving.pop("models", None)
             if isinstance(mv, dict):
                 _emit_models(emit, model, mv)
+            # Elastic-membership stats render as first-class model-level
+            # families (ISSUE 17) so dashboards watch fleet size /
+            # join-retire churn / pushed-handoff latency directly.
+            fl = serving.pop("fleet", None)
+            if isinstance(fl, dict):
+                _emit_fleet(emit, model, fl)
             _flatten_serving(emit, model, "lsot_serving", serving)
     if resilience:
         breakers = resilience.get("breakers") or {}

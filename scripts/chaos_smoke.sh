@@ -52,12 +52,25 @@ python -m pytest tests -q -m chaos -p no:cacheprovider "$@"
 # scheduler-side submit counts), duplicated deliveries absorbed, and a
 # partition detected by LEASE expiry with only the partitioned
 # replica restarted and its journaled work re-placed — every wave
-# token-identical to a fault-free control. run_chaos asserts all six;
-# the JSON summary shows restarts/replayed/lost, the watchdog stage's
+# token-identical to a fault-free control — and, stage 8, the ELASTIC
+# lane (ISSUE 17): an all-remote phase-split fleet (real socket
+# workers) must scale UP on a queue-depth burst (standby decode worker
+# joined mid-burst via the handshake-validated add_replica path, ≥1
+# handoff PUSHED through the wire), ride out an injected fleet:spawn
+# failure (the partition-during-scale-up stand-in: a counted
+# non-event, fleet size unchanged), survive a SIGKILL of the remote
+# prefill worker mid-handoff (lease expiry, ONLY r0 restarted, journal
+# re-prefill on the decode tier with delivered stream prefixes
+# suppressed), and retire a replica WHILE streams are in flight
+# (drain → re-place → remove, replica_retire in the flight ring) —
+# every wave token-identical, zero lost, zero duplicated stream
+# tokens. run_chaos asserts all seven scenario stages; the JSON
+# summary shows restarts/replayed/lost, the watchdog stage's
 # stalls/detection bound, the fleet stage's per-replica restart
 # attribution, the kv_pressure stage's preemption tally, the disagg
-# stage's handoff/crash/restart attribution, and the transport stage's
-# per-wave fault/idempotency/lease accounting.
+# stage's handoff/crash/restart attribution, the transport stage's
+# per-wave fault/idempotency/lease accounting, and the elastic stage's
+# scale-up/spawn-failure/retire ledger.
 LSOT_FAULTS= python -m llm_based_apache_spark_optimization_tpu.evalh \
   --chaos "ollama:connect:0.5,sql:exec:1,sched:crash:0.2" \
   --chaos-seed "${LSOT_FAULTS_SEED}"

@@ -25,7 +25,21 @@
 #      replica — zero acknowledged requests lost, outputs still
 #      token-identical.
 #
-# The default test lane runs the same flow in-process
+# Then the PREFILL-worker leg (ISSUE 17, push-style handoffs): a second
+# OS-process worker boots with `--phase-role prefill`, a fresh fleet
+# puts it at r0 beside a local decode replica, and:
+#
+#   6. the hello wires the PUSH pump — traffic submitted to the fleet
+#      prefills on the remote worker and each packed KV blob is PUSHED
+#      to this process the moment it retires (≥1 pushed handoff in
+#      fleet_stats, no pull RPC);
+#   7. SIGKILL lands on the prefill worker MID-HANDOFF (the moment ≥1
+#      push of the wave is in flight): the lease expires, only r0
+#      churns, and the journal re-prefills the lost work on the decode
+#      SIBLING with already-delivered stream prefixes suppressed —
+#      zero lost, streams exactly-once, outputs token-identical.
+#
+# The default test lane runs the same flows in-process
 # (tests/test_remote_smoke.py, not marked slow); this script is the
 # focused real-process lane, beside chaos_smoke.sh / obs_smoke.sh.
 #
@@ -168,6 +182,148 @@ try:
     assert h["lost"] == 0, f"{h['lost']} acknowledged request(s) lost"
     print(f"step 5 OK: worker SIGKILL -> lease expired, r1 restarts="
           f"{reps['r1']['restarts']}, lost={h['lost']}, outputs identical")
+finally:
+    sup.shutdown()
+print("DECODE-WORKER LEG OK")
+EOF
+
+# ---------------------------------------------------------------- leg 2
+# PREFILL worker (ISSUE 17): push-style handoffs from a real second
+# process, then SIGKILL mid-handoff -> journal re-prefill on the local
+# decode sibling.
+PF_LOG="$(mktemp)"
+trap 'kill "$WORKER_PID" "$PF_PID" 2>/dev/null || true; rm -f "$WORKER_LOG" "$PF_LOG"' EXIT
+
+python -m llm_based_apache_spark_optimization_tpu.serve.remote \
+  --port 0 --num-slots 2 --decode-chunk 4 --prompt-bucket 8 \
+  --max-seq 96 --kv-layout paged --kv-page-size 8 \
+  --phase-role prefill >"$PF_LOG" 2>&1 &
+PF_PID=$!
+
+PF_ADDR=""
+for _ in $(seq 1 120); do
+  PF_ADDR="$(grep -oE 'listening on [0-9.:]+' "$PF_LOG" | awk '{print $3}' || true)"
+  [ -n "$PF_ADDR" ] && break
+  kill -0 "$PF_PID" 2>/dev/null || { cat "$PF_LOG"; exit 1; }
+  sleep 1
+done
+[ -n "$PF_ADDR" ] || { echo "prefill worker never bound"; cat "$PF_LOG"; exit 1; }
+echo "remote prefill worker at $PF_ADDR (pid $PF_PID)"
+
+LSOT_REMOTE_ADDR="$PF_ADDR" LSOT_REMOTE_PID="$PF_PID" python - <<'EOF'
+import os
+import random
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+
+from llm_based_apache_spark_optimization_tpu.models import TINY, init_params
+from llm_based_apache_spark_optimization_tpu.serve.remote import (
+    SocketTransport,
+)
+from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+    RetryPolicy,
+)
+from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+    ContinuousBatchingScheduler,
+    SchedulerPool,
+)
+from llm_based_apache_spark_optimization_tpu.serve.supervisor import (
+    SupervisedScheduler,
+)
+
+addr = os.environ["LSOT_REMOTE_ADDR"]
+worker_pid = int(os.environ["LSOT_REMOTE_PID"])
+params = init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+
+
+def mk(role):
+    return ContinuousBatchingScheduler(
+        TINY, params, num_slots=2, decode_chunk=4, prompt_bucket=8,
+        stop_ids=(2,), max_seq=96, kv_layout="paged", kv_page_size=8,
+        phase_role=role,
+    )
+
+
+reqs = [[1, 5, 9 + i] for i in range(4)]
+with mk("mixed") as ctl:
+    want = [ctl.submit(ids, max_new_tokens=8, seed=40 + i).result(timeout=300)
+            for i, ids in enumerate(reqs)]
+
+
+def make_replica(i):
+    if i == 0:
+        # The rebuild reconnects to the SAME (dead) address: r0 churns
+        # until its restart budget runs out while the decode sibling
+        # carries the re-prefilled work — the recovery under test.
+        return SocketTransport(
+            addr, label="r0",
+            retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                                     max_delay_s=0.05),
+        )
+    return mk("decode")
+
+
+def make_pool():
+    return SchedulerPool(
+        [make_replica(0), make_replica(1)], factory=make_replica,
+        max_restarts=3,
+        restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                   max_delay_s=0.1),
+        rng=random.Random(0), lease_s=0.2, lease_misses=2,
+    )
+
+
+sup = SupervisedScheduler(
+    make_pool, max_restarts=3,
+    restart_policy=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                               max_delay_s=0.1),
+    rng=random.Random(0),
+).start()
+try:
+    pool = sup._inner
+    # Step 6: clean wave — every handoff PUSHED the moment it retires.
+    streams = [[] for _ in reqs]
+    futs = [sup.submit(ids, max_new_tokens=8, seed=40 + i,
+                       on_token=streams[i].append)
+            for i, ids in enumerate(reqs)]
+    outs = [f.result(timeout=300) for f in futs]
+    assert outs == want, f"pushed-handoff outputs diverged: {outs} != {want}"
+    assert streams == outs, "streamed tokens != final results"
+    fl = pool.fleet_stats()
+    assert int(fl["pushed"]) >= 1, \
+        f"no handoff was PUSHED through the wire: {fl}"
+    print(f"step 6 OK: {len(outs)} requests, {fl['pushed']} pushed "
+          f"handoffs ({fl['push_bytes']} bytes), token-identical")
+
+    # Step 7: SIGKILL the prefill worker the moment a NEW push of this
+    # wave is in flight; the journal must re-prefill on the decode
+    # sibling with delivered prefixes suppressed.
+    pushed_before = int(fl["pushed"])
+    streams2 = [[] for _ in reqs]
+    futs2 = [sup.submit(ids, max_new_tokens=8, seed=40 + i,
+                        on_token=streams2[i].append)
+             for i, ids in enumerate(reqs)]
+    deadline = time.monotonic() + 60
+    while (int(pool.fleet_stats()["pushed"]) == pushed_before
+           and not all(f.done() for f in futs2)
+           and time.monotonic() < deadline):
+        time.sleep(0.002)
+    os.kill(worker_pid, signal.SIGKILL)
+    outs2 = [f.result(timeout=300) for f in futs2]
+    assert outs2 == want, f"post-kill outputs diverged: {outs2} != {want}"
+    assert streams2 == outs2, \
+        "re-prefill delivered duplicated/missing stream tokens"
+    h = sup.health()
+    assert h["lost"] == 0, f"{h['lost']} acknowledged request(s) lost"
+    reps = {r["replica"]: r for r in h.get("replicas", [])}
+    assert int(reps.get("r1", {}).get("restarts", 0)) == 0, \
+        "the decode sibling restarted — recovery was not targeted"
+    print(f"step 7 OK: prefill worker SIGKILL mid-handoff -> journal "
+          f"re-prefill on the decode sibling, lost={h['lost']}, "
+          f"streams exactly-once")
 finally:
     sup.shutdown()
 print("REMOTE SMOKE OK")

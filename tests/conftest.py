@@ -29,6 +29,7 @@ in tier-1, so they follow three rules:
 """
 
 import os
+import tempfile
 
 # Force CPU: the ambient environment pins jax to the 'axon' TPU tunnel (its
 # sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") in every
@@ -45,6 +46,21 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compilation cache: scheduler instances build fresh
+# @jax.jit closures, so every ContinuousBatchingScheduler construction
+# would otherwise recompile byte-identical programs (the cache keys on
+# the lowered module hash, not function identity). Tier-1 builds
+# dozens of schedulers from a handful of configs; deduping the
+# compiles is the difference between the suite fitting its wall-clock
+# budget and not. LSOT_XLA_CACHE_DIR overrides; empty disables.
+_cache_dir = os.environ.get(
+    "LSOT_XLA_CACHE_DIR",
+    os.path.join(tempfile.gettempdir(), "lsot_xla_cache"),
+)
+if _cache_dir:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

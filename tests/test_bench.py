@@ -425,6 +425,55 @@ def test_disagg_pass_structural_on_cpu():
     assert "speedup" in out
 
 
+def test_disagg_remote_pass_structural_on_cpu():
+    """ISSUE 17 bench leg: the disagg_remote pass runs a remote-PREFILL
+    worker behind a real loopback ReplicaServer — every handoff PUSHED
+    through the wire — beside a local decode replica, against the same
+    worker serving decode-in-place. On this shared-core host the
+    structural assertions are the contract: every token served in both
+    shapes, the clean wave rode ≥1 pushed handoff with ZERO in-place
+    fallbacks (a remote-prefill request silently decoding on the worker
+    is the bug the pass exists to price), the push ledger and the
+    --compare-gated keys present. The TTFT delta is owed to the chip
+    capture."""
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(Path(BENCH).parent))
+    from bench import _bench_disagg_remote
+
+    from llm_based_apache_spark_optimization_tpu.models import (
+        TINY,
+        init_params,
+    )
+
+    params = init_params(TINY, jax.random.key(0), dtype=jnp.float32)
+    out = _bench_disagg_remote(TINY, params)
+    assert out["requests"] == 6
+    total = (out["long"]["n"] * out["long"]["max_new"]
+             + out["short"]["n"] * out["short"]["max_new"])
+    for leg in ("remote_prefill", "inplace"):
+        rec = out[leg]
+        assert rec["tokens"] == total  # every token served, none dropped
+        assert rec["decode_tok_s"] > 0 and rec["wall_s"] > 0
+        for k in ("ttft_p50_s", "ttft_p95_s", "tpot_p50_s", "tpot_p95_s"):
+            assert rec[k] >= 0.0
+        assert rec["ttft_p95_s"] >= rec["ttft_p50_s"]
+    # The remote shape's push ledger: the wire actually carried packed
+    # KV blobs (pushed handoffs + bytes), placement latency percentiles
+    # are coherent, and NOTHING fell back to decode-in-place on the
+    # worker — the zero-lost/zero-silent-fallback structural proof.
+    rp = out["remote_prefill"]
+    assert rp["pushed"] >= 1
+    assert rp["push_bytes"] > 0
+    assert rp["push_place_p95_ms"] >= rp["push_place_p50_ms"] >= 0.0
+    assert rp["inplace_fallbacks"] == 0
+    # The in-place shape never touches the push ledger.
+    assert "pushed" not in out["inplace"]
+    assert "ttft_delta_p50_s" in out
+    assert "speedup" in out
+
+
 def test_kv_pressure_pass_overcommit_sustains_more_concurrency():
     """ISSUE 10 bench leg: at a FIXED page pool, overcommit admission
     sustains STRICTLY more concurrent requests than exact-envelope

@@ -1,0 +1,217 @@
+"""FleetAutoscaler control-loop units (ISSUE 17) — host-only, no jax:
+the hysteresis hold window, the action cooldown, the [fleet_min,
+fleet_max] bounds, spawn-failure / spawn-empty degradation (the fleet
+keeps serving at its current size), the elastic-only retire contract
+(operator-configured replicas never retire), and the KV-pressure
+signal. The pool is a toy fake exposing exactly the surface the
+autoscaler reads — fleet_stats / replica_loads / page_stats /
+add_replica / retire_replica — so these tests pin the CONTROL LAW;
+the end-to-end membership lifecycle over real socket workers lives in
+evalh/chaos.py stage 8 and tests/test_remote_smoke.py.
+"""
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.serve.elastic import (
+    FleetAutoscaler,
+)
+from llm_based_apache_spark_optimization_tpu.utils.faults import FAULTS
+
+
+class FakePool:
+    """The minimal fleet surface the autoscaler consumes. `queued` is
+    TOTAL queued requests across the fleet (the autoscaler divides by
+    serving count itself)."""
+
+    def __init__(self, serving=2, queued=0, elastic=0):
+        self.serving = serving
+        self.queued = queued
+        self.elastic = elastic
+        self.page_stats = None
+        self.added = []
+        self.retired = []
+
+    def fleet_stats(self):
+        return {"size": self.serving, "serving": self.serving,
+                "elastic": self.elastic}
+
+    def replica_loads(self):
+        base, extra = divmod(self.queued, max(1, self.serving))
+        return [
+            {"replica": f"r{i}", "queued": base + (1 if i < extra else 0),
+             "active_slots": 0}
+            for i in range(self.serving)
+        ]
+
+    def add_replica(self, replica, label=None, weight=1.0, elastic=True):
+        self.serving += 1
+        if elastic:
+            self.elastic += 1
+        lbl = label or f"r{self.serving - 1}"
+        self.added.append((lbl, replica, elastic))
+        return lbl
+
+    def retire_replica(self, replica=None, deadline_s=None):
+        if self.elastic <= 0:
+            return None  # the real pool: operator replicas never retire
+        self.serving -= 1
+        self.elastic -= 1
+        out = {"replica": f"r{self.serving}", "deadline_s": deadline_s}
+        self.retired.append(out)
+        return out
+
+
+def mk(pool, **kw):
+    kw.setdefault("fleet_min", 1)
+    kw.setdefault("fleet_max", 8)
+    kw.setdefault("scale_up_q", 2.0)
+    kw.setdefault("scale_down_q", 0.5)
+    kw.setdefault("hold_s", 0.0)
+    kw.setdefault("interval_s", 0.0)
+    # Instantaneous EWMA: each step sees the fake's current depth, so
+    # the hysteresis tests exercise the HOLD window, not the filter.
+    kw.setdefault("ewma_alpha", 1.0)
+    return FleetAutoscaler(pool, lambda: object(), **kw)
+
+
+def test_scale_up_requires_continuous_hold():
+    pool = FakePool(serving=2, queued=10)  # depth 5 >= 2.0
+    auto = mk(pool, hold_s=2.0)
+    assert auto.step(0.0) is None  # signal just appeared
+    assert auto.step(1.0) is None  # held 1s < 2s
+    assert auto.step(2.0) == "up"  # held 2s — fire
+    assert pool.serving == 3 and len(pool.added) == 1
+    assert pool.added[0][2] is True  # joined as elastic
+
+
+def test_hold_resets_when_signal_drops():
+    pool = FakePool(serving=2, queued=10)
+    auto = mk(pool, hold_s=2.0)
+    assert auto.step(0.0) is None
+    pool.queued = 0  # burst evaporated mid-hold
+    assert auto.step(1.0) is None
+    pool.queued = 10  # back — but the clock restarts
+    assert auto.step(2.0) is None
+    assert auto.step(3.0) is None
+    assert auto.step(4.0) == "up"
+    assert pool.serving == 3
+
+
+def test_cooldown_separates_consecutive_actions():
+    pool = FakePool(serving=2, queued=20)
+    auto = mk(pool, interval_s=10.0)
+    assert auto.step(0.0) == "up"
+    assert auto.step(1.0) is None   # inside cooldown
+    assert auto.step(9.9) is None
+    assert auto.step(10.0) == "up"  # cooldown elapsed
+    assert pool.serving == 4
+
+
+def test_fleet_max_caps_scale_up():
+    pool = FakePool(serving=3, queued=100)
+    auto = mk(pool, fleet_max=3)
+    for t in range(5):
+        assert auto.step(float(t)) is None
+    assert pool.serving == 3 and not pool.added
+
+
+def test_fleet_min_floors_scale_down():
+    pool = FakePool(serving=2, queued=0, elastic=2)
+    auto = mk(pool, fleet_min=2)
+    for t in range(5):
+        assert auto.step(float(t)) is None
+    assert pool.serving == 2 and not pool.retired
+
+
+def test_scale_down_rides_retire_with_drain_deadline():
+    pool = FakePool(serving=3, queued=0, elastic=1)
+    auto = mk(pool, fleet_min=2, drain_deadline_s=7.5)
+    assert auto.step(0.0) == "down"
+    assert pool.serving == 2
+    assert pool.retired[0]["deadline_s"] == 7.5
+    assert auto.stats()["downs"] == 1
+
+
+def test_operator_replicas_never_retire():
+    # Nothing elastic in the fleet: the pool refuses the retire and the
+    # autoscaler records NO down — serving size untouched.
+    pool = FakePool(serving=3, queued=0, elastic=0)
+    auto = mk(pool, fleet_min=1)
+    assert auto.step(0.0) is None
+    assert pool.serving == 3 and not pool.retired
+    assert auto.stats()["downs"] == 0
+
+
+def test_injected_spawn_failure_degrades_not_wedges(monkeypatch):
+    pool = FakePool(serving=2, queued=20)
+    auto = mk(pool)
+    FAULTS.configure("fleet:spawn:1", 0)
+    try:
+        assert auto.step(0.0) is None  # wanted up, spawn failed
+    finally:
+        FAULTS.clear()
+    assert pool.serving == 2 and not pool.added
+    assert auto.stats()["spawn_failures"] == 1
+    # The loop is not wedged: the next tick (cooldown already elapsed
+    # with interval_s=0) succeeds against a healthy spawner.
+    assert auto.step(1.0) == "up"
+    assert pool.serving == 3
+
+
+def test_dead_standby_spawn_exception_counts_as_failure():
+    pool = FakePool(serving=2, queued=20)
+
+    def dead_spawn():
+        raise ConnectionError("standby host is gone")
+
+    auto = FleetAutoscaler(pool, dead_spawn, fleet_min=1, fleet_max=8,
+                           scale_up_q=2.0, scale_down_q=0.5,
+                           hold_s=0.0, interval_s=0.0, ewma_alpha=1.0)
+    assert auto.step(0.0) is None
+    assert auto.stats()["spawn_failures"] == 1
+    assert pool.serving == 2
+
+
+def test_spawn_empty_is_counted_not_an_up():
+    pool = FakePool(serving=2, queued=20)
+    auto = FleetAutoscaler(pool, lambda: None, fleet_min=1, fleet_max=8,
+                           scale_up_q=2.0, scale_down_q=0.5,
+                           hold_s=0.0, interval_s=0.0, ewma_alpha=1.0)
+    assert auto.step(0.0) is None
+    st = auto.stats()
+    assert st["spawn_empty"] == 1 and st["ups"] == 0
+    assert pool.serving == 2
+
+
+def test_kv_pressure_scales_up_with_empty_queue():
+    pool = FakePool(serving=2, queued=0)
+    pool.page_stats = {"pages_withheld": 3}
+    auto = mk(pool)
+    assert auto.step(0.0) == "up"
+    assert pool.serving == 3
+    # Pressure also VETOES scale-down: with the fleet already at max
+    # (up impossible) and the queue empty, withheld pages alone hold
+    # the size; relieving them lets the retire fire.
+    pool.elastic = 1
+    auto2 = mk(pool, fleet_min=1, fleet_max=pool.serving)
+    pool.page_stats = {"pages_withheld": 1}
+    assert auto2.step(0.0) is None
+    pool.page_stats = {"pages_withheld": 0}
+    assert auto2.step(1.0) == "down"
+
+
+def test_min_greater_than_max_rejected():
+    with pytest.raises(ValueError):
+        mk(FakePool(), fleet_min=5, fleet_max=3)
+
+
+def test_stats_surface_knobs_and_signal():
+    pool = FakePool(serving=2, queued=4)
+    auto = mk(pool, fleet_min=1, fleet_max=6, hold_s=1.5)
+    auto.step(0.0)
+    st = auto.stats()
+    assert st["fleet_min"] == 1 and st["fleet_max"] == 6
+    assert st["hold_s"] == 1.5
+    assert st["steps"] == 1
+    assert st["signal"]["queue_ewma"] == 2.0
+    assert st["signal"]["serving"] == 2

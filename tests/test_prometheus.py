@@ -331,6 +331,49 @@ def test_render_transport_families():
     assert any(n == "lsot_transport_rpcs_total" for n, _, _ in samples)
 
 
+def test_render_fleet_families():
+    """ISSUE-17 golden: serving.fleet renders as the lsot_fleet_*
+    membership families — size/serving/elastic gauges, join/retire/
+    drain lifecycle counters, and the pushed-handoff pump's
+    depth/bytes/latency — not path-flattened serving gauges."""
+    fleet = {
+        "size": 4, "serving": 3, "elastic": 1,
+        "joins": 2, "retires": 1,
+        "drain_s_sum": 0.75, "drain_count": 1,
+        "pushed": 12, "push_bytes": 65536, "pump_depth": 2,
+        "push_placed": 12, "push_place_p50_ms": 1.5,
+        "push_place_p95_ms": 4.25,
+    }
+    snap = {"m": {"requests": 1, "serving": {"fleet": fleet}}}
+    text = render_prometheus(snap)
+    types, samples = parse_exposition(text)
+    assert types["lsot_fleet_size"] == "gauge"
+    assert types["lsot_fleet_joins_total"] == "counter"
+    assert types["lsot_fleet_retires_total"] == "counter"
+    assert types["lsot_fleet_drain_seconds_sum"] == "counter"
+    assert types["lsot_fleet_pushed_handoffs_total"] == "counter"
+    assert types["lsot_fleet_pushed_handoff_bytes_total"] == "counter"
+    assert types["lsot_fleet_pump_depth"] == "gauge"
+    assert types["lsot_fleet_push_place_p95_ms"] == "gauge"
+    by = {n: (v, l) for n, l, v in samples}
+    assert by["lsot_fleet_size"][0] == 4
+    assert by["lsot_fleet_serving"][0] == 3
+    assert by["lsot_fleet_elastic"][0] == 1
+    assert by["lsot_fleet_joins_total"][0] == 2
+    assert by["lsot_fleet_retires_total"][0] == 1
+    assert by["lsot_fleet_drain_seconds_sum"][0] == 0.75
+    assert by["lsot_fleet_pushed_handoffs_total"][0] == 12
+    assert by["lsot_fleet_pushed_handoff_bytes_total"][0] == 65536
+    assert by["lsot_fleet_pump_depth"][0] == 2
+    assert by["lsot_fleet_push_place_p50_ms"][0] == 1.5
+    assert by["lsot_fleet_push_place_p95_ms"][0] == 4.25
+    v, labels = by["lsot_fleet_size"]
+    assert labels == {"model": "m"}
+    # Nothing fleet-shaped leaked through the generic flattener.
+    assert not any(n.startswith("lsot_serving_fleet")
+                   for n, _, _ in samples)
+
+
 def test_render_slo_families():
     """ISSUE-12 golden: the top-level "slo" snapshot renders burn-rate /
     bad-fraction gauges per window arm, quantile gauges, the 0/1 burning
