@@ -767,6 +767,20 @@ def main(argv=None) -> None:
                     window_s=cfg.slo_window_s, target=cfg.slo_target)
     traceprof.reconfigure_profile(profile_dir=cfg.profile_dir or None,
                                   rounds=cfg.profile_rounds)
+    # Multi-tenant front door (ISSUE 18): the admission controller's
+    # buckets and per-class default deadlines resolve through AppConfig
+    # — LSOT_QOS / LSOT_TENANT_RATE / LSOT_TENANT_BURST /
+    # LSOT_QOS_DEADLINE_* are documented knobs with a reconfigure seam.
+    # (LSOT_TENANT_WEIGHTS / LSOT_PREFIX_TENANT_NS are read by each
+    # scheduler at construction, which happens below this line.)
+    from ..serve.qos import ADMISSION
+
+    ADMISSION.reconfigure(
+        enabled=cfg.qos, rate=cfg.tenant_rate, burst=cfg.tenant_burst,
+        deadlines={"interactive": cfg.qos_deadline_interactive,
+                   "batch": cfg.qos_deadline_batch,
+                   "replay": cfg.qos_deadline_replay},
+    )
 
     if args.backend == "checkpoint":
         if not args.sql_model_path:

@@ -25,6 +25,7 @@ from ..serve.resilience import (
     Overloaded,
     SchedulerCrashed,
 )
+from ..serve.qos import normalize_qos
 from ..serve.service import GenerationService
 from ..sql.backend import SQLBackend
 from ..utils import tracing
@@ -262,6 +263,18 @@ def create_api_app(
                           "{\"table\": ..., \"columns\": [...str...]}"},
                 status=400,
             )
+        # Multi-tenant front door (ISSUE 18): tenant and qos class ride
+        # the X-Lsot-Tenant / X-Lsot-Qos headers (gateway-injected, so
+        # they win) or the JSON body; unlabeled traffic stays the ""
+        # default tenant. An unknown class is the client's error — 400
+        # here, never a mid-stream line.
+        tenant = str(req.environ.get("HTTP_X_LSOT_TENANT", "")
+                     or data.get("tenant", "") or "").strip()
+        try:
+            qos = normalize_qos(str(req.environ.get("HTTP_X_LSOT_QOS", "")
+                                    or data.get("qos", "") or ""))
+        except ValueError as e:
+            return Response.json({"error": str(e)}, status=400)
         # Resolve the model BEFORE streaming: once the NDJSON generator is
         # returned, 200 headers are already on the wire and a late KeyError
         # could only abort the body — the 404 must fire here.
@@ -284,7 +297,7 @@ def create_api_app(
                         model, prompt, system=system, max_new_tokens=max_new,
                         constrain=constrain, deadline_s=deadline_s,
                         idempotency_key=idempotency_key,
-                        request_id=request_id,
+                        request_id=request_id, tenant=tenant, qos=qos,
                     )
                 return Response.json({
                     "model": model, "response": res.response, "done": True,
@@ -311,7 +324,7 @@ def create_api_app(
             inner = service.generate_stream(
                 model, prompt, system=system, max_new_tokens=max_new,
                 constrain=constrain, deadline_s=deadline_s,
-                request_id=request_id,
+                request_id=request_id, tenant=tenant, qos=qos,
             )
             try:
                 with tracing.use(trace):

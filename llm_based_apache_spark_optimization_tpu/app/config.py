@@ -249,6 +249,30 @@ class AppConfig:
     # export dir, else a tempdir).
     profile_rounds: int = 8
     profile_dir: str = ""
+    # --- multi-tenant front door (serve/qos.py; README "Multi-tenant
+    # front door"). Requests carry `tenant` + `qos`
+    # (interactive|batch|replay) via X-Lsot-Tenant/X-Lsot-Qos headers or
+    # JSON fields. qos=False reproduces the single-tenant admission
+    # order bit for bit (no buckets, FIFO page-wait, shared prefix
+    # registry).
+    qos: bool = True
+    # Per-(tenant, class) token-bucket budgets: "2" = 2 req/s for every
+    # class, "2,interactive=4" overrides per class. "" = no rate
+    # ceiling (WFQ fairness still applies). Burst defaults to 2s of
+    # rate when unset.
+    tenant_rate: str = ""
+    tenant_burst: str = ""
+    # WFQ weights ("tenantA=4,tenantB=1"); unlisted tenants weigh 1.0.
+    tenant_weights: str = ""
+    # Per-tenant prefix-cache namespaces: off = today's shared registry
+    # bit for bit (cross-tenant prefix reuse allowed again).
+    prefix_tenant_ns: bool = True
+    # Per-class default deadline in seconds, applied only when the
+    # request carries none ("interactive gets the tighter budget"). 0 =
+    # no class default.
+    qos_deadline_interactive: float = 0.0
+    qos_deadline_batch: float = 0.0
+    qos_deadline_replay: float = 0.0
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

@@ -332,7 +332,8 @@ class TestClient:
         self.cookies: Dict[str, str] = {}
 
     def request(self, method: str, path: str, body: bytes = b"",
-                content_type: str = "", query: str = "") -> "TestResponse":
+                content_type: str = "", query: str = "",
+                headers: Optional[Dict[str, str]] = None) -> "TestResponse":
         environ = {
             "REQUEST_METHOD": method,
             "PATH_INFO": path,
@@ -342,6 +343,9 @@ class TestClient:
             "wsgi.input": io.BytesIO(body),
             "HTTP_COOKIE": "; ".join(f"{k}={v}" for k, v in self.cookies.items()),
         }
+        # Extra request headers (e.g. X-Lsot-Tenant) in WSGI environ form.
+        for name, value in (headers or {}).items():
+            environ["HTTP_" + name.upper().replace("-", "_")] = value
         captured: Dict[str, Any] = {}
 
         def start_response(status, headers):
@@ -363,9 +367,11 @@ class TestClient:
     def get(self, path: str, query: str = "") -> "TestResponse":
         return self.request("GET", path, query=query)
 
-    def post_json(self, path: str, obj: Any) -> "TestResponse":
+    def post_json(self, path: str, obj: Any,
+                  headers: Optional[Dict[str, str]] = None) -> "TestResponse":
         return self.request(
-            "POST", path, jsonlib.dumps(obj).encode(), "application/json"
+            "POST", path, jsonlib.dumps(obj).encode(), "application/json",
+            headers=headers,
         )
 
     def post_multipart(self, path: str, fields: Dict[str, str],

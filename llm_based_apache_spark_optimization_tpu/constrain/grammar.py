@@ -160,8 +160,18 @@ def _build(table: Optional[str], columns: Optional[Tuple[str, ...]]) -> Re:
                        scalar)
     predicate = Alt(Seq(operand, OWS, cmp, OWS, operand),
                     null_pred, like_pred, in_pred, between_pred)
-    condition = Seq(predicate,
-                    Star(Seq(WS, Alt(kw("AND"), kw("OR")), WS, predicate)))
+    # WHERE/HAVING conditions allow ONE level of parenthesized boolean
+    # grouping — `( pred OR pred ) AND pred` — which covers the common
+    # precedence-fixing shape without making the regular grammar try to
+    # count nesting depth (a DFA cannot balance unbounded parens; the
+    # reference parser accepts the same bounded depth, tested together
+    # in tests/test_constrain.py). JOIN..ON keeps a bare predicate.
+    bool_chain = Seq(predicate,
+                     Star(Seq(WS, Alt(kw("AND"), kw("OR")), WS, predicate)))
+    group_term = Seq(Lit("("), OWS, bool_chain, OWS, Lit(")"))
+    bool_term = Alt(predicate, group_term)
+    condition = Seq(bool_term,
+                    Star(Seq(WS, Alt(kw("AND"), kw("OR")), WS, bool_term)))
 
     sel_item = Seq(Alt(func_call, col_ref),
                    Opt(Seq(WS, kw("AS"), WS, ident)))

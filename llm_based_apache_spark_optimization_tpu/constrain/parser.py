@@ -240,11 +240,24 @@ class _Parser:
             )
         self.operand()
 
+    def bool_term(self) -> None:
+        """One term of a WHERE/HAVING condition: a bare predicate or a
+        parenthesized AND/OR chain — `( pred OR pred ) AND pred`.
+        Leniency note: the parser recurses, so arbitrarily NESTED parens
+        parse here while the DFA (which cannot count) accepts exactly
+        one level — safe in the guaranteed direction, DFA ⊆ parser."""
+        if self.at_punct("("):
+            self.take()
+            self.condition()
+            self.expect_punct(")")
+        else:
+            self.predicate()
+
     def condition(self) -> None:
-        self.predicate()
+        self.bool_term()
         while self.at_kw("AND", "OR"):
             self.take()
-            self.predicate()
+            self.bool_term()
 
     def sel_item(self) -> None:
         if self.at_kw(*_AGGS):

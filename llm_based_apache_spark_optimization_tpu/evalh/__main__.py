@@ -67,7 +67,12 @@ def main(argv=None) -> None:
                          "out a fleet:spawn failure, a remote-prefill "
                          "SIGKILL mid-handoff and a scale-down racing "
                          "in-flight streams — zero lost/duplicated "
-                         "stream tokens), and "
+                         "stream tokens), and a QOS stage (a storm "
+                         "tenant's backlog against a quiet tenant on "
+                         "the real WFQ scheduler: quiet-tenant TTFT p95 "
+                         "within tolerance of a storm-free control, "
+                         "every request token-identical to the "
+                         "LSOT_QOS=0 run), and "
                          "report success-after-retry / shed / degraded "
                          "rates plus restart/replay/lost counts — asserts "
                          "zero hung requests and zero lost acknowledged "

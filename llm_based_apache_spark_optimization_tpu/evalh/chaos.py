@@ -1516,6 +1516,164 @@ def _run_elastic_stage(seed: int) -> Dict:
     return report
 
 
+_QOS_REPORTS: Dict[int, Dict] = {}
+
+
+def _run_qos_stage(seed: int) -> Dict:
+    """Multi-tenant storm chaos (ISSUE 18): tenant A floods a REAL tiny
+    paged scheduler with long-prompt batch requests (the harness-scale
+    stand-in for the 100k-token-prompt storm) while tenant B submits a
+    few short interactive requests behind the backlog. With QoS on
+    (WFQ at admission + `_page_wait`), B's p95 TTFT must stay within
+    tolerance of a storm-free control while A absorbs the degradation
+    (A's p95 ≥ B's p95); zero acknowledged requests lost. A second
+    drive with `LSOT_QOS=0` reconciles at the TOKEN level: the
+    off-switch run's outputs must be identical per request (per-request
+    seeded RNG makes tokens order-independent — any divergence means
+    the off path executed QoS code), and the scheduler must report no
+    QoS state at all. Own injection-free scope; builds tiny jax
+    schedulers on CPU like the pressure/disagg stages; the report is
+    cached per seed so repeated run_chaos calls pay the builds once."""
+    cached = _QOS_REPORTS.get(seed)
+    if cached is not None:
+        return cached
+    import os as _os
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import TINY, init_params
+    from ..ops.sampling import SamplingParams
+    from ..serve.scheduler import ContinuousBatchingScheduler
+
+    params = init_params(TINY, jax.random.key(seed), dtype=jnp.float32)
+
+    # Tenant A's storm: long prompts, decode-heavy; tenant B: short
+    # interactive probes. Every request is greedy with its own seed, so
+    # outputs are pure functions of (ids, max_new, seed) — the token
+    # reconciliation anchor.
+    storm = [([1] + [3 + (i + j) % 7 for j in range(40)], 24, 500 + i)
+             for i in range(6)]
+    quiet = [([1, 5, 9], 8, 900), ([1, 7, 11], 8, 901)]
+
+    def drive(qos_on: bool, include_storm: bool):
+        saved = _os.environ.get("LSOT_QOS")
+        _os.environ["LSOT_QOS"] = "1" if qos_on else "0"
+        try:
+            sched = ContinuousBatchingScheduler(
+                TINY, params, num_slots=2, decode_chunk=4,
+                prompt_bucket=8, stop_ids=(2,), max_seq=96,
+                kv_layout="paged", kv_page_size=8, kv_pages=24,
+            )
+        finally:
+            if saved is None:
+                _os.environ.pop("LSOT_QOS", None)
+            else:
+                _os.environ["LSOT_QOS"] = saved
+        ttft: Dict[str, float] = {}
+        outs: Dict[str, object] = {}
+        with sched:
+            subs = []
+            if include_storm:
+                subs += [(f"a{i}", "stormy", "batch", ids, mn, sd)
+                         for i, (ids, mn, sd) in enumerate(storm)]
+            subs += [(f"b{i}", "quiet", "interactive", ids, mn, sd)
+                     for i, (ids, mn, sd) in enumerate(quiet)]
+            t0 = _time.perf_counter()
+
+            def tap(key):
+                def on_token(_tok, _key=key):
+                    ttft.setdefault(_key, _time.perf_counter() - t0)
+                return on_token
+
+            futs = [
+                (key, sched.submit(
+                    ids, max_new_tokens=mn, sampling=SamplingParams(),
+                    seed=sd, on_token=tap(key), tenant=tenant, qos=qos))
+                for key, tenant, qos, ids, mn, sd in subs
+            ]
+            for key, f in futs:
+                try:
+                    outs[key] = f.result(timeout=300)
+                except Exception:  # noqa: BLE001 — lost, counted below
+                    outs[key] = None
+            qstats = sched.qos_stats()
+        return outs, ttft, qstats
+
+    def p95(vals):
+        vals = sorted(vals)
+        return vals[max(0, int(0.95 * len(vals)) - (1 if len(vals) else 0))] \
+            if vals else 0.0
+
+    # Storm-free control: tenant B alone — the baseline its stormy-run
+    # TTFT is held against.
+    control_outs, control_ttft, _ = drive(qos_on=True, include_storm=False)
+    storm_outs, storm_ttft, qstats = drive(qos_on=True, include_storm=True)
+    off_outs, _off_ttft, off_qstats = drive(qos_on=False,
+                                            include_storm=True)
+
+    lost = sum(1 for o in storm_outs.values() if o is None)
+    lost += sum(1 for o in control_outs.values() if o is None)
+    lost += sum(1 for o in off_outs.values() if o is None)
+    mismatched = sum(
+        1 for k in storm_outs
+        if storm_outs[k] is not None and off_outs.get(k) is not None
+        and storm_outs[k] != off_outs[k]
+    )
+    mismatched += sum(
+        1 for k in control_outs
+        if control_outs[k] is not None and storm_outs.get(k) is not None
+        and control_outs[k] != storm_outs[k]
+    )
+    control_p95 = p95([control_ttft[k] for k in control_ttft])
+    quiet_p95 = p95([v for k, v in storm_ttft.items()
+                     if k.startswith("b")])
+    stormy_p95 = p95([v for k, v in storm_ttft.items()
+                      if k.startswith("a")])
+    report = {
+        "storm_requests": len(storm),
+        "quiet_requests": len(quiet),
+        "lost": lost,
+        "mismatched": mismatched,
+        "control_p95_ttft_s": round(control_p95, 4),
+        "quiet_p95_ttft_s": round(quiet_p95, 4),
+        "stormy_p95_ttft_s": round(stormy_p95, 4),
+        "qos_off_state_clean": off_qstats is None,
+        "tenants_tracked": sorted((qstats or {}).get("submitted", {})),
+    }
+    assert lost == 0, (
+        f"{lost} request(s) never completed across the tenant storm "
+        f"drives — the front door lost acknowledged work"
+    )
+    assert mismatched == 0, (
+        f"{mismatched} request(s) diverged between QoS-on, QoS-off and "
+        f"control drives — tenant isolation broke the token-level "
+        f"determinism contract"
+    )
+    assert off_qstats is None, (
+        "LSOT_QOS=0 scheduler still reports QoS state — the off-switch "
+        "is not reproducing the pre-QoS path"
+    )
+    # Isolation contract: the storm moves tenant A's p95, not B's. The
+    # tolerance is generous (host-timing noise on shared CI), but FIFO
+    # head-of-line blocking fails it by an order of magnitude: B behind
+    # A's whole backlog would wait the storm's full decode wall.
+    tol = max(3.0 * control_p95, control_p95 + 1.0)
+    assert quiet_p95 <= tol, (
+        f"quiet tenant p95 TTFT {quiet_p95:.3f}s exceeds tolerance "
+        f"{tol:.3f}s (storm-free control {control_p95:.3f}s) — the storm "
+        f"tenant head-of-line-blocked the interactive tenant"
+    )
+    assert stormy_p95 >= quiet_p95, (
+        f"storm tenant p95 TTFT {stormy_p95:.3f}s beat the quiet "
+        f"tenant's {quiet_p95:.3f}s — the degradation landed on the "
+        f"wrong tenant"
+    )
+    _QOS_REPORTS[seed] = report
+    return report
+
+
 def run_chaos(
     spec: Optional[str] = None,
     seed: int = 0,
@@ -1692,6 +1850,13 @@ def run_chaos(
     # zero lost, zero duplicated stream tokens, only the affected
     # replica restarted. Own injection scope, like stages 3-7.
     elastic_report = _run_elastic_stage(seed)
+    # Stage 9 — multi-tenant storm: tenant A floods a real paged
+    # scheduler with long-prompt batch requests while tenant B's
+    # interactive probes arrive behind the backlog — WFQ must keep B's
+    # p95 TTFT within tolerance of a storm-free control while A absorbs
+    # the degradation; zero lost; an LSOT_QOS=0 drive reconciles
+    # token-for-token (off-switch discipline). Own injection-free scope.
+    qos_report = _run_qos_stage(seed)
     requests = rounds * len(FOUR_QUERY_SUITE)
     hung = requests - sum(outcomes.values())
     hung += scheduler_report["unresolved"]
@@ -1701,6 +1866,7 @@ def run_chaos(
     hung += disagg_report["lost"]
     hung += sum(w["lost"] for w in net_report["waves"].values())
     hung += elastic_report["lost"]
+    hung += qos_report["lost"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
     # Wall-clock figures are non-deterministic by nature: lifted OUT of
     # the scheduler stage's report so the seeded-replay determinism
@@ -1719,6 +1885,7 @@ def run_chaos(
         "disagg": disagg_report,
         "transport": net_report,
         "elastic": elastic_report,
+        "qos": qos_report,
         "latency": latency,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)

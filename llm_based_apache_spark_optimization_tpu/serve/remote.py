@@ -405,6 +405,13 @@ def request_to_wire(req) -> Dict:
         # Multi-model fleets (ISSUE 16): a migrated request's KV pages
         # are model-specific — the receiving side re-checks the id.
         d["model_id"] = str(req.model_id)
+    if getattr(req, "tenant", ""):
+        # Tenant axis (ISSUE 18): migrated/requeued requests keep their
+        # attribution so the receiving replica's WFQ charges the right
+        # tenant. Optional on the wire — old workers ignore it.
+        d["tenant"] = str(req.tenant)
+    if getattr(req, "qos", ""):
+        d["qos"] = str(req.qos)
     if req.constraint is not None:
         d["constrain"] = _constraint_spec(req.constraint)
     if req.spilled is not None:
@@ -443,6 +450,8 @@ def request_from_wire(d: Dict, future: Optional[Future] = None,
     )
     req.rid = int(d.get("rid", 0))
     req.model_id = str(d.get("model_id", "") or "")
+    req.tenant = str(d.get("tenant", "") or "")
+    req.qos = str(d.get("qos", "") or "")
     req.generated = [int(t) for t in d.get("generated", [])]
     req.resume_pref = int(d.get("resume_pref", 0))
     req.rng_count = int(d.get("rng_count", 0))
@@ -774,6 +783,13 @@ class LoopbackTransport(_TransportBase):
                              rng, sleep)
         self._ledger = _TokenLedger()
 
+    @property
+    def supports_qos(self):
+        """Tenant/qos passthrough (ISSUE 18): a loopback replica is as
+        QoS-capable as the scheduler it wraps — duck-typed fakes in the
+        chaos/test fleets never see the kwargs."""
+        return bool(getattr(self.inner, "supports_qos", False))
+
     # Everything the pool/supervisor reads duck-typed passes through —
     # the transport is an address, not a filter.
     def __getattr__(self, name):
@@ -833,10 +849,13 @@ class LoopbackTransport(_TransportBase):
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
                on_token=None, constraint=None, deadline_s=None, trace=None,
-               model_id: str = ""):
+               model_id: str = "", tenant: str = "", qos: str = ""):
         if self._unreachable is not None:
             raise self._unreachable
         extra = {"model_id": model_id} if model_id else {}
+        if (tenant or qos) and getattr(self.inner, "supports_qos", False):
+            extra["tenant"] = tenant
+            extra["qos"] = qos
         if not FAULTS.active:
             # Fast path: the direct call, byte for byte (same future
             # object, same accounting). The envelope exists for chaos
@@ -1085,6 +1104,12 @@ class SocketTransport(_TransportBase):
     #: LEASE is their liveness authority and loads_digest their metrics.
     heartbeat = None
     flight = None
+
+    #: Tenant/qos ride the wire as OPTIONAL payload fields (ISSUE 18):
+    #: the worker re-gates on its own scheduler's `supports_qos`, and a
+    #: worker predating the axis simply ignores the extra keys — so the
+    #: client side can always offer them.
+    supports_qos = True
 
     def __init__(self, address, label: str = "r0",
                  connect_timeout_s: float = 5.0, retry_policy=None,
@@ -1535,7 +1560,7 @@ class SocketTransport(_TransportBase):
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
                on_token=None, constraint=None, deadline_s=None, trace=None,
-               model_id: str = ""):
+               model_id: str = "", tenant: str = "", qos: str = ""):
         # `trace` stays host-local: span trees do not cross the wire
         # (the submit→ack wall lands in the client's spans instead).
         del trace
@@ -1552,6 +1577,12 @@ class SocketTransport(_TransportBase):
             # id against its own checkpoint — a client routed to the
             # wrong worker fails typed, never decodes on wrong weights.
             payload["model_id"] = str(model_id)
+        if tenant:
+            # Tenant axis (ISSUE 18): optional wire fields — a worker
+            # missing them defaults to the unlabeled path.
+            payload["tenant"] = str(tenant)
+        if qos:
+            payload["qos"] = str(qos)
         if deadline_s is not None:
             payload["deadline_s"] = float(deadline_s)
         if constraint is not None:
@@ -2161,6 +2192,15 @@ class ReplicaServer:
                         f"request wants {want_model!r}"
                     )
             extra = {"model_id": want_model} if want_model else {}
+            tenant = str(msg.get("tenant", "") or "")
+            qos = str(msg.get("qos", "") or "")
+            if (tenant or qos) and getattr(self.scheduler, "supports_qos",
+                                           False):
+                # Tenant axis (ISSUE 18): re-gated HERE so a labeled
+                # frame landing on a qos-blind scheduler (old worker,
+                # duck-typed fake) defaults sanely to unlabeled.
+                extra["tenant"] = tenant
+                extra["qos"] = qos
             fut = self.scheduler.submit(
                 msg["ids"], max_new_tokens=int(msg.get("max_new", 256)),
                 sampling=_sampling_from_wire(msg.get("sampling")),

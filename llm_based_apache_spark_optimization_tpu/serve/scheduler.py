@@ -310,14 +310,19 @@ def prefix_digest(ids: Sequence[int]) -> str:
     ).hexdigest()
 
 
-def prefix_chain_digests(ids: Sequence[int], block: int) -> List[str]:
+def prefix_chain_digests(ids: Sequence[int], block: int,
+                         ns: Sequence[int] = ()) -> List[str]:
     """Digests of every whole-block prefix of a prompt (the hash-chain
     keys' content addresses): what a cache-aware router hands to
     `SchedulerPool.prefix_affinity` — a replica holding ANY chain prefix
     of the request saves that much re-prefill, so affinity matches on
-    the whole chain, not just the longest prefix."""
+    the whole chain, not just the longest prefix. `ns` is the tenant
+    namespace salt (ISSUE 18): when per-tenant prefix namespacing is on,
+    the router salts here exactly as admission salts its cache keys, so
+    fleet-wide affinity still matches — within one tenant only."""
+    base = tuple(ns)
     return [
-        prefix_digest(ids[: (j + 1) * block])
+        prefix_digest(base + tuple(ids[: (j + 1) * block]))
         for j in range(max(0, (len(ids) - 1) // block))
     ]
 
@@ -507,6 +512,17 @@ class _Request:
     prefix_digest: str = ""
     tokens_reused: int = 0
     prefill_s_saved: float = 0.0
+    # Multi-tenant QoS (ISSUE 18). `tenant`/`qos` ride the request from
+    # the HTTP layer through pool/supervisor/remote-wire; "" = unlabeled
+    # (the single-tenant shape, untouched by every QoS-off path). `vft`
+    # is the WFQ virtual finish time stamped at submit; `ns` is the
+    # tenant's prefix-cache namespace salt (two int32s prepended to
+    # every cache key/digest — () for unlabeled traffic, so its keys
+    # stay bit-for-bit identical to the shared registry).
+    tenant: str = ""
+    qos: str = ""
+    vft: float = 0.0
+    ns: Tuple[int, ...] = ()
 
     @property
     def full_ids(self) -> List[int]:
@@ -590,6 +606,12 @@ class ContinuousBatchingScheduler:
     `submit()` is thread-safe and returns a Future of generated token ids
     (stop token stripped). A daemon thread owns all device work.
     """
+
+    #: Duck-typing flag (ISSUE 18): callers (SchedulerBackend, the
+    #: supervisor, transports) only forward tenant/qos kwargs to
+    #: schedulers that understand the axis — test fakes and older
+    #: signatures keep working untouched.
+    supports_qos = True
 
     def __init__(
         self,
@@ -1248,6 +1270,33 @@ class ContinuousBatchingScheduler:
         self._pending_new_tokens = 0
         self._stok_ewma: Optional[float] = None
 
+        # Multi-tenant QoS (ISSUE 18): weighted-fair queueing at admission
+        # and _page_wait. `LSOT_QOS=0` switches every QoS path off — the
+        # FIFO admission order, prefix-cache key shapes, and preemption
+        # victim choice then reproduce the pre-QoS scheduler bit-for-bit
+        # (reconciliation-tested at the token level). With QoS on, the
+        # worker drains the submit queue into `_ready` and serves the
+        # smallest virtual finish time: vft = max(global virtual time,
+        # tenant's last vft) + (prompt+budget tokens)/weight — start-time
+        # fair queueing, so a storm tenant's backlog inflates only its
+        # OWN virtual clock and cannot head-of-line-block a light tenant.
+        # `_ready` and the WFQ ledgers are touched only under
+        # `_submit_lock` (extract_queued races the worker during drains).
+        from .qos import (parse_tenant_weights as _ptw,
+                          prefix_tenant_ns_enabled as _pns,
+                          qos_enabled as _qen)
+        self._qos = _qen()
+        self._tenant_weights: Dict[str, float] = (
+            _ptw(os.environ.get("LSOT_TENANT_WEIGHTS", ""))
+            if self._qos else {}
+        )
+        self._prefix_tenant_ns = self._qos and _pns()
+        self._wfq_vt = 0.0
+        self._wfq_last: Dict[str, float] = {}
+        self._ready: List[_Request] = []
+        self._tenant_submitted: Dict[str, float] = {}
+        self._tenant_preempted: Dict[str, float] = {}
+
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         self._prefill_q: "deque[Tuple[int, _Request]]" = deque()
         self._thread: Optional[threading.Thread] = None
@@ -1597,18 +1646,29 @@ class ContinuousBatchingScheduler:
         if not self._page_wait:
             return
         keep: "deque[_Request]" = deque()
+        expired: List[_Request] = []
         while self._page_wait:
             req = self._page_wait.popleft()
             if req.cancelled:
                 self._observe_terminal(req)
                 req.future.set_result(req.generated)
             elif req.past_deadline():
-                resilience.inc("deadline_expired")
-                self._observe_terminal(req, error="DeadlineExceeded")
-                req.future.set_exception(req.deadline_error())
+                expired.append(req)
             else:
                 keep.append(req)
         self._page_wait = keep
+        # Expiry surfaces in DEADLINE order even when WFQ reorders the
+        # SERVICE order (ISSUE 18 satellite): under QoS the deque is no
+        # longer deadline-monotone — a heavy tenant's earlier-expiring
+        # waiter can sit behind a light tenant's — and anything pairing
+        # 504s with submit deadlines (clients racing timeouts, the chaos
+        # harness's loss accounting) relies on earliest-first failure.
+        expired.sort(key=lambda r: (r.deadline.expires_at
+                                    if r.deadline is not None else 0.0))
+        for req in expired:
+            resilience.inc("deadline_expired")
+            self._observe_terminal(req, error="DeadlineExceeded")
+            req.future.set_exception(req.deadline_error())
 
     def _preempt_slot(self, slot: int) -> None:
         """Victim preemption: release the slot's pages and park the
@@ -1663,6 +1723,10 @@ class ContinuousBatchingScheduler:
         self._free_slot_pages(slot)
         self._page_alloc.note_preempt()
         resilience.inc("kv_preemptions")
+        if self._qos:
+            from .qos import bounded_bump
+            with self._submit_lock:
+                bounded_bump(self._tenant_preempted, req.tenant)
         # Open a parked interval for the request trace tree (closed at
         # resume; flush_spans exports it as a "sched.preempted" span).
         req.parked.append([time.perf_counter(), 0.0])
@@ -1689,7 +1753,21 @@ class ContinuousBatchingScheduler:
             ]
             if not victims:
                 return None
-            victims.sort()
+            if self._qos:
+                # QoS enforcement arm (ISSUE 18): prefer evicting the
+                # tenant holding the most WEIGHTED slot share — the one
+                # over its fair allocation — before falling back to the
+                # cheapest-recompute tie-break. QoS off keeps the exact
+                # pre-QoS (fewest-generated, lowest-slot) choice.
+                share: Dict[str, float] = {}
+                for r in self._slot_req:
+                    if r is not None:
+                        t = r.tenant
+                        share[t] = share.get(t, 0.0) + 1.0 / self._wfq_weight(t)
+                victims.sort(key=lambda v: (
+                    -share.get(self._slot_req[v[1]].tenant, 0.0), v[0], v[1]))
+            else:
+                victims.sort()
             self._preempt_slot(victims[0][1])
 
     def _topup_pages(self) -> None:
@@ -2943,6 +3021,12 @@ class ContinuousBatchingScheduler:
         # caller's routing bug and fails typed instead of decoding the
         # prompt against the wrong weights.
         model_id: str = "",
+        # Multi-tenant QoS (ISSUE 18): the tenant the request bills to
+        # and its service class (interactive|batch|replay). "" = the
+        # unlabeled single-tenant shape; with LSOT_QOS=0 both are
+        # carried but never consulted.
+        tenant: str = "",
+        qos: str = "",
     ) -> "Future[List[int]]":
         if not ids:
             raise ValueError("empty prompt")
@@ -2983,6 +3067,7 @@ class ContinuousBatchingScheduler:
                       if deadline_s is not None else None),
             trace=trace,
             model_id=model_id or self.model_id,
+            tenant=str(tenant or ""), qos=str(qos or ""),
         )
         req.future._lsot_request = req  # cancel() handle
         try:
@@ -3008,7 +3093,8 @@ class ContinuousBatchingScheduler:
             # qsize() counts requests not yet pulled into slots/prefill —
             # the true backlog a new request would wait behind.
             if self.max_queue_depth and \
-                    self._queue.qsize() >= self.max_queue_depth:
+                    self._queue.qsize() + len(self._ready) \
+                    >= self.max_queue_depth:
                 resilience.inc("shed")
                 raise Overloaded(
                     f"scheduler queue at capacity "
@@ -3022,6 +3108,8 @@ class ContinuousBatchingScheduler:
             req.rid = self._rid_seq
             req.future._lsot_replica = self.flight.replica
             req.submitted_at = time.perf_counter()
+            if self._qos:
+                self._stamp_qos_locked(req)
             self._pending_new_tokens += req.max_new
             self._pending_prompt_tokens += len(req.ids)
             self._queue.put(req)
@@ -3060,6 +3148,111 @@ class ContinuousBatchingScheduler:
                 cb()
             except Exception:  # noqa: BLE001 — cancel of the unreachable is moot
                 pass
+
+    # ------------------------------------------------------ multi-tenant WFQ
+
+    def _wfq_weight(self, tenant: str) -> float:
+        """WFQ weight for a tenant (LSOT_TENANT_WEIGHTS; 1.0 default —
+        including the unlabeled "" tenant, which competes as one tenant)."""
+        w = self._tenant_weights.get(tenant, 1.0)
+        return w if w > 0 else 1.0
+
+    def _stamp_qos_locked(self, req: _Request) -> None:
+        """Stamp the WFQ virtual finish time and the tenant's prefix
+        namespace salt (callers hold _submit_lock; QoS on only).
+
+        Start-time fair queueing: a request starts at max(global virtual
+        time, its tenant's last finish) and finishes cost/weight later,
+        cost = prompt + budget tokens. A tenant submitting a storm only
+        advances its OWN clock — its k-th queued request finishes k
+        virtual-costs out, while a light tenant's next request starts at
+        the global clock and is served ahead of the whole backlog."""
+        from .qos import bounded_bump, tenant_salt
+        cost = (len(req.ids) + req.max_new) / self._wfq_weight(req.tenant)
+        req.vft = max(self._wfq_vt, self._wfq_last.get(req.tenant, 0.0)) + cost
+        self._wfq_last[req.tenant] = req.vft
+        if len(self._wfq_last) > 128:
+            # Idle-tenant ledger hygiene: a finish time at/behind the
+            # global clock no longer orders anything.
+            self._wfq_last = {t: v for t, v in self._wfq_last.items()
+                              if v > self._wfq_vt}
+        if self._prefix_tenant_ns and req.tenant:
+            req.ns = tenant_salt(req.tenant)
+        bounded_bump(self._tenant_submitted, req.tenant)
+
+    def _drain_ready(self) -> None:
+        """Move every queued submit into the WFQ ready pool (worker
+        thread; QoS on only). queue.Queue hands each item to exactly one
+        consumer, so this never duplicates against extract_queued."""
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if req is None:
+                continue
+            with self._submit_lock:
+                self._ready.append(req)
+
+    def _ready_pop(self) -> Optional[_Request]:
+        """Serve the smallest virtual finish time (rid tie-break keeps
+        same-tenant FIFO and determinism) and advance the global virtual
+        clock to it."""
+        with self._submit_lock:
+            if not self._ready:
+                return None
+            i = min(range(len(self._ready)),
+                    key=lambda j: (self._ready[j].vft, self._ready[j].rid))
+            req = self._ready.pop(i)
+            self._wfq_vt = max(self._wfq_vt, req.vft)
+            return req
+
+    def _page_wait_pop(self) -> _Request:
+        """Next page-starved waiter to re-try admission. QoS off: FIFO
+        popleft — the pre-QoS order bit-for-bit. QoS on: preempted
+        victims still resume ahead of never-admitted waiters (they were
+        admitted first and hold delivered tokens), then smallest virtual
+        finish time — a storm tenant's parked backlog cannot
+        head-of-line-block a light tenant's waiter."""
+        pw = self._page_wait
+        if not self._qos or len(pw) == 1:
+            return pw.popleft()
+        best = min(range(len(pw)),
+                   key=lambda i: (0 if pw[i].preempted else 1,
+                                  pw[i].vft, i))
+        if best == 0:
+            req = pw.popleft()
+        else:
+            pw.rotate(-best)
+            req = pw.popleft()
+            pw.rotate(best)
+        self._wfq_vt = max(self._wfq_vt, req.vft)
+        return req
+
+    def qos_stats(self) -> Optional[Dict[str, object]]:
+        """Per-tenant WFQ/admission counters for /metrics (the
+        lsot_tenant_* families): None when QoS is off — the pre-QoS
+        payload byte-for-byte."""
+        if not self._qos:
+            return None
+        with self._submit_lock:
+            backlog: Dict[str, int] = {}
+            for r in self._ready:
+                key = r.tenant or "default"
+                backlog[key] = backlog.get(key, 0) + 1
+            out: Dict[str, object] = {
+                "virtual_time": round(self._wfq_vt, 3),
+                "ready": len(self._ready),
+                # Contiguous layouts have no page-wait deque at all.
+                "page_wait": len(getattr(self, "_page_wait", ())),
+                "submitted": dict(self._tenant_submitted),
+                "preempted": dict(self._tenant_preempted),
+            }
+            if self._tenant_weights:
+                out["weights"] = dict(self._tenant_weights)
+            if backlog:
+                out["backlog"] = backlog
+            return out
 
     @property
     def overshoot(self) -> int:
@@ -3160,7 +3353,9 @@ class ContinuousBatchingScheduler:
         ewma = self._svc_ewma
         if ewma is None:
             return 1.0
-        depth = self._queue.qsize() + 1  # the retry waits behind itself too
+        # The retry waits behind itself too; under QoS the WFQ ready pool
+        # is backlog the queue alone no longer counts.
+        depth = self._queue.qsize() + len(self._ready) + 1
         return float(min(60.0, max(1.0, depth * ewma / max(1, self.num_slots))))
 
     def backlog_score(self) -> Tuple[float, int]:
@@ -3213,8 +3408,15 @@ class ContinuousBatchingScheduler:
                 break
             if req is not None:
                 out.append(req)
-        if out:
-            with self._submit_lock:
+        with self._submit_lock:
+            # Under QoS the worker stages queued submits in the WFQ ready
+            # pool — those are still queued-not-yet-admitted and must
+            # leave with the drain (the lock serializes against the
+            # worker's own _drain_ready/_ready_pop).
+            if self._ready:
+                out.extend(self._ready)
+                self._ready.clear()
+            if out:
                 self._pending_new_tokens = max(
                     0, self._pending_new_tokens
                     - sum(r.max_new for r in out)
@@ -3273,6 +3475,11 @@ class ContinuousBatchingScheduler:
             self._rid_seq += 1
             req.rid = self._rid_seq
             req.future._lsot_replica = self.flight.replica
+            if self._qos:
+                # Re-placed requests re-enter THIS replica's virtual
+                # clock (vft from another replica's clock is meaningless
+                # here) and re-derive the prefix namespace locally.
+                self._stamp_qos_locked(req)
             self._pending_new_tokens += req.max_new
             self._pending_prompt_tokens += len(req.ids)
             self._queue.put(req)
@@ -3378,10 +3585,14 @@ class ContinuousBatchingScheduler:
         # guess available (there is no match to name); once the prefix
         # publishes and hits, later admissions converge on the matched
         # digest, so the reuse-distance ring sees the recurrence.
+        # `req.ns` (the tenant namespace salt, ISSUE 18) prefixes every
+        # key/digest exactly as the cache-key sites do: a tenant's digest
+        # only ever joins against its own namespace. () for unlabeled
+        # traffic — the shared-registry digests, unchanged.
         if hit:
-            digest = self._digest_for(tuple(ids[:reuse]))
+            digest = self._digest_for(req.ns + tuple(ids[:reuse]))
         elif max_blocks:
-            digest = self._digest_for(tuple(ids[: max_blocks * pb]))
+            digest = self._digest_for(req.ns + tuple(ids[: max_blocks * pb]))
         else:
             digest = ""
         flops = secs = 0.0
@@ -3415,7 +3626,7 @@ class ContinuousBatchingScheduler:
                 self._prefix_reused_tokens += reuse
                 self._prefix_flops_saved += flops
                 self._prefix_s_saved += secs
-                meta = self._prefix_meta.get(tuple(ids[:reuse]))
+                meta = self._prefix_meta.get(req.ns + tuple(ids[:reuse]))
                 if meta is not None:
                     meta["hits"] += 1
                     meta["last_hit_round"] = self.heartbeat.rounds
@@ -3695,9 +3906,13 @@ class ContinuousBatchingScheduler:
         # a shared prefix mapping would be overwritten, so they skip the
         # prefix cache entirely (the pages already hold the prefix).
         if self._prefix_cache_blocks and req.spilled is None:
+            # Every lookup keys through the request's tenant namespace
+            # salt (`req.ns`, ISSUE 18): a tenant can only ever match —
+            # or evict — entries its own admissions published. () for
+            # unlabeled traffic keeps the shared-registry keys exact.
             max_blocks = (plen - 1) // pb
             while n < max_blocks and \
-                    tuple(ids[: (n + 1) * pb]) in self._prefix_pages:
+                    req.ns + tuple(ids[: (n + 1) * pb]) in self._prefix_pages:
                 n += 1
             # Same chunk-envelope cap as the contiguous path: a reuse
             # offset shifts every chunk start, and the final chunk's
@@ -3727,7 +3942,7 @@ class ContinuousBatchingScheduler:
         ))
         need_pages = pages_for_tokens(need_end, ps)
         full = reuse // ps
-        entry = (self._prefix_pages.get(tuple(ids[:reuse]))
+        entry = (self._prefix_pages.get(req.ns + tuple(ids[:reuse]))
                  if reuse else None)
         shared = list(entry[:full]) if entry else []
         boundary_src = entry[full] if (entry and reuse % ps) else None
@@ -3770,7 +3985,7 @@ class ContinuousBatchingScheduler:
         if reuse:
             req.prefilled = reuse
             for j in range(n):  # LRU touch along the matched chain
-                key = tuple(ids[: (j + 1) * pb])
+                key = req.ns + tuple(ids[: (j + 1) * pb])
                 if key in self._prefix_pages:
                     self._prefix_pages.move_to_end(key)
         if self._prefix_cache_blocks and req.spilled is None:
@@ -3835,7 +4050,9 @@ class ContinuousBatchingScheduler:
             max_blocks = (len(req.ids) - 1) // pb
             n = 0
             while n < max_blocks:
-                if tuple(req.ids[: (n + 1) * pb]) not in self._prefix_cache:
+                # Tenant-namespaced key (req.ns, ISSUE 18): () unlabeled.
+                if req.ns + tuple(req.ids[: (n + 1) * pb]) \
+                        not in self._prefix_cache:
                     break
                 n += 1
             # Cap reuse so the chunk envelope stays inside the cache: the
@@ -3851,7 +4068,7 @@ class ContinuousBatchingScheduler:
             while n and self._chunk_end(n * pb, len(req.ids)) > s_cache:
                 n -= 1
             for j in range(n):
-                key = tuple(req.ids[: (j + 1) * pb])
+                key = req.ns + tuple(req.ids[: (j + 1) * pb])
                 blocks = self._prefix_cache[key]
                 self._prefix_cache.move_to_end(key)  # LRU touch
                 self._cache = self._restore_block_fn(
@@ -4081,7 +4298,7 @@ class ContinuousBatchingScheduler:
         chunk is a bucket = multiple of pblock)."""
         pb = self._pblock
         for b0 in range(chunk_start // pb, req.prefilled // pb):
-            key = tuple(req.ids[: (b0 + 1) * pb])
+            key = req.ns + tuple(req.ids[: (b0 + 1) * pb])
             if key in self._prefix_cache:
                 self._prefix_cache.move_to_end(key)
                 continue
@@ -4114,7 +4331,7 @@ class ContinuousBatchingScheduler:
         pb, ps = self._pblock, self._page_size
         ids = req.full_ids
         for b0 in range(chunk_start // pb, req.prefilled // pb):
-            key = tuple(ids[: (b0 + 1) * pb])
+            key = req.ns + tuple(ids[: (b0 + 1) * pb])
             if key in self._prefix_pages:
                 self._prefix_pages.move_to_end(key)
                 continue
@@ -4579,6 +4796,9 @@ class ContinuousBatchingScheduler:
         with self._submit_lock:
             self._closed = True
             self._pending_new_tokens = 0
+            ready, self._ready = self._ready, []
+        for req in ready:  # staged in the WFQ pool when the loop died
+            req.future.set_exception(exc)
         self._prefill_q.clear()  # their requests fail via the slot sweep below
         self._pending.clear()    # in-flight rounds: futures fail below
         self._first_pending = []
@@ -4626,6 +4846,7 @@ class ContinuousBatchingScheduler:
             or (self._paged and self._page_wait)
             or any(r is not None for r in self._slot_req)
             or not self._queue.empty()
+            or self._ready
         )
 
     def _loop(self) -> None:
@@ -4670,12 +4891,22 @@ class ContinuousBatchingScheduler:
                     self._install_constraint(req.constraint)
                 else:
                     if self._paged and self._page_wait:
-                        # Page-starved requests re-admit FIFO ahead of
-                        # the queue the moment retirements free pages;
-                        # they already passed grammar routing once, and
-                        # re-routing below keeps them correct if the
+                        # Page-starved requests re-admit ahead of the
+                        # queue the moment retirements free pages — FIFO
+                        # with QoS off, WFQ order (victims first) with it
+                        # on. They already passed grammar routing once,
+                        # and re-routing below keeps them correct if the
                         # installed grammar changed meanwhile.
-                        req = self._page_wait.popleft()
+                        req = self._page_wait_pop()
+                    elif self._qos:
+                        # WFQ admission (ISSUE 18): stage every queued
+                        # submit in the ready pool, serve the smallest
+                        # virtual finish time. QoS off takes the exact
+                        # pre-QoS get_nowait path below.
+                        self._drain_ready()
+                        req = self._ready_pop()
+                        if req is None:
+                            break
                     else:
                         try:
                             req = self._queue.get_nowait()
@@ -4724,7 +4955,7 @@ class ContinuousBatchingScheduler:
                 self._harvest_firsts()
                 if self._prefill_q or self._constraint_wait or any(
                     r is not None for r in self._slot_req
-                ) or (self._paged and self._page_wait):
+                ) or (self._paged and self._page_wait) or self._ready:
                     continue  # harvests freed work — go admit/issue again
                 try:
                     req = self._queue.get(timeout=0.05)
@@ -5544,17 +5775,26 @@ class SchedulerPool:
             return secs, toks
         return secs / w, toks / w
 
-    def _affinity_scores(self, ids) -> Dict[str, int]:
+    def _affinity_scores(self, ids, tenant: str = "") -> Dict[str, int]:
         """The cache-aware routing lookup for one submit (ISSUE 15):
         the request's chain-prefix digests scored against every
         placeable replica's resident set via `prefix_affinity`. Empty
         when routing is off, the prompt is shorter than one block, or
         nobody holds anything — every one of which leaves the placement
-        sort exactly where it was."""
+        sort exactly where it was. With per-tenant prefix namespacing on
+        (ISSUE 18), the lookup salts its digests with the request's
+        tenant exactly as replica admission salts its cache keys —
+        affinity keeps matching, within one tenant only."""
         block = int(getattr(self.schedulers[0], "_pblock", 0) or 0)
         if not block:
             return {}
-        digests = prefix_chain_digests(ids, block)
+        ns: Tuple[int, ...] = ()
+        if tenant:
+            from .qos import (prefix_tenant_ns_enabled, qos_enabled,
+                              tenant_salt)
+            if qos_enabled() and prefix_tenant_ns_enabled():
+                ns = tenant_salt(tenant)
+        digests = prefix_chain_digests(ids, block, ns)
         if not digests:
             return {}
         scored = self.prefix_affinity(digests)
@@ -5691,6 +5931,8 @@ class SchedulerPool:
     #: Duck-typing flag: callers (SchedulerBackend, the supervisor) only
     #: forward a model_id to schedulers that understand the axis.
     supports_model_routing = True
+    #: Same duck-typing for the tenant/qos axis (ISSUE 18).
+    supports_qos = True
 
     def _wire_handoff(self, idx: int, s) -> None:
         """Point a prefill-role replica's handoff queue at the pool's
@@ -5961,10 +6203,28 @@ class SchedulerPool:
                 per.append(rec)
         return {"replicas": per} if per else None
 
+    def qos_stats(self) -> Optional[Dict[str, object]]:
+        """Per-replica WFQ/admission counters (ISSUE 18): None when no
+        replica runs QoS — the pre-QoS payload byte-for-byte."""
+        per = []
+        for st, s in self._replica_items():
+            fn = getattr(s, "qos_stats", None)
+            if not callable(fn):
+                continue
+            try:
+                qs = fn()
+            except Exception:  # noqa: BLE001 — a churning fleet mid-read
+                continue
+            if qs:
+                rec = dict(qs)
+                rec["replica"] = st.label
+                per.append(rec)
+        return {"replicas": per} if per else None
+
     def submit(self, ids, max_new_tokens: int = 256,
                sampling: SamplingParams = SamplingParams(), seed: int = 0,
                on_token=None, constraint=None, deadline_s=None, trace=None,
-               model_id: str = ""):
+               model_id: str = "", tenant: str = "", qos: str = ""):
         """Least-loaded, deadline-aware placement (router="round_robin"
         keeps the pre-fleet rotation): score every placeable replica,
         skip the ones whose backlog would blow this request's deadline,
@@ -6041,8 +6301,8 @@ class SchedulerPool:
                 # With LSOT_POOL_AFFINITY=0 (no lookup, no events) and
                 # all-1.0 weights this is the pre-affinity order bit
                 # for bit.
-                aff = (self._affinity_scores(ids) if self._affinity
-                       else {})
+                aff = (self._affinity_scores(ids, tenant)
+                       if self._affinity else {})
                 # Scores stay RAW (deadline feasibility + the 504 hint
                 # below compare wall-clock backlog); the capacity weight
                 # applies only inside the ordering key.
@@ -6084,8 +6344,14 @@ class SchedulerPool:
             try:
                 # The model kwarg rides only model-named submits: every
                 # pre-existing replica (and the test fleet's duck-typed
-                # fakes) keeps its exact signature on the "" path.
+                # fakes) keeps its exact signature on the "" path. Same
+                # for the tenant/qos axis (ISSUE 18): forwarded only to
+                # replicas that declare `supports_qos`.
                 extra = {"model_id": want_model} if want_model else {}
+                if (tenant or qos) and getattr(sched, "supports_qos",
+                                               False):
+                    extra["tenant"] = tenant
+                    extra["qos"] = qos
                 fut = sched.submit(
                     ids, max_new_tokens=max_new_tokens, sampling=sampling,
                     seed=seed, on_token=on_token, constraint=constraint,
@@ -6862,6 +7128,10 @@ class SchedulerBackend:
         self.supports_idempotency = bool(
             getattr(scheduler, "supports_idempotency", False)
         )
+        # Multi-tenant QoS (ISSUE 18): tenant/qos kwargs are forwarded
+        # only to schedulers that understand the axis — duck-typed like
+        # model routing, so fakes and older signatures stay untouched.
+        self.supports_qos = bool(getattr(scheduler, "supports_qos", False))
         # Journal-spill recovery happens HERE, the one seam every
         # deployment path (tiny, HF, GGUF, dp pool) funnels through: a
         # previous process's drained-but-unfinished requests resubmit so
@@ -6990,6 +7260,17 @@ class SchedulerBackend:
                 models = None
             if models:
                 out["models"] = models
+        # Multi-tenant QoS (ISSUE 18): per-tenant WFQ/admission counters
+        # — the lsot_tenant_* families. None (QoS off, or a scheduler
+        # without the seam) adds nothing: the pre-QoS payload intact.
+        qs = getattr(self.scheduler, "qos_stats", None)
+        if callable(qs):
+            try:
+                qos_block = qs()
+            except Exception:  # noqa: BLE001 — a churning fleet mid-read
+                qos_block = None
+            if qos_block:
+                out["qos"] = qos_block
         # Elastic fleet membership (ISSUE 17): size/joins/retires/drain
         # ledger + pushed-handoff depth/bytes/latency — rendered as the
         # lsot_fleet_* families (utils/prometheus.py).
@@ -7308,6 +7589,14 @@ class SchedulerBackend:
         bare schedulers and test fakes keep their exact signatures."""
         return {"model_id": self.model_id} if self._routes_models else {}
 
+    def _qos_kwargs(self, tenant: str, qos: str) -> Dict[str, object]:
+        """submit() kwargs for the tenant/qos axis (ISSUE 18): present
+        only for labeled requests on a QoS-capable scheduler — the
+        unlabeled path keeps the exact pre-QoS call shape."""
+        if (tenant or qos) and self.supports_qos:
+            return {"tenant": tenant, "qos": qos}
+        return {}
+
     def _budget(self, n_prompt_tokens: int, max_new_tokens: Optional[int]) -> int:
         sched = self.scheduler
         overshoot = sched.overshoot
@@ -7327,7 +7616,8 @@ class SchedulerBackend:
                         seed: int = 0,
                         stats_out: Optional[dict] = None,
                         constrain=None,
-                        deadline_s: Optional[float] = None):
+                        deadline_s: Optional[float] = None,
+                        tenant: str = "", qos: str = ""):
         """Stream the completion as text chunks while it decodes — the
         capability Ollama's `stream=true` API exposes and the reference
         never used. Token ids arrive from the scheduler's per-request
@@ -7362,6 +7652,7 @@ class SchedulerBackend:
             deadline_s=deadline_s if deadline_s is not None
             else self.deadline_s,
             trace=trace, **self._model_kwargs(),
+            **self._qos_kwargs(tenant, qos),
         )
         out_ids: List[int] = []
         emitted = ""
@@ -7442,7 +7733,8 @@ class SchedulerBackend:
     def complete(self, prompt: str, max_new_tokens: Optional[int] = None,
                  sampling: Optional[SamplingParams] = None, seed: int = 0,
                  constrain=None, deadline_s: Optional[float] = None,
-                 idempotency_key: Optional[str] = None):
+                 idempotency_key: Optional[str] = None,
+                 tenant: str = "", qos: str = ""):
         from .backends import Completion, trim_stop_texts
 
         from ..utils import tracing
@@ -7464,6 +7756,7 @@ class SchedulerBackend:
             else self.deadline_s,
             trace=tracing.current(),
             **kwargs, **self._model_kwargs(),
+            **self._qos_kwargs(tenant, qos),
         )
         out = fut.result()
         text = trim_stop_texts(self.tokenizer.decode(out), self.stop_texts)
@@ -7478,6 +7771,7 @@ class SchedulerBackend:
         self, prompts: Sequence[str], max_new_tokens: Optional[int] = None,
         sampling: Optional[SamplingParams] = None, seed: int = 0,
         constrain=None, deadline_s: Optional[float] = None,
+        tenant: str = "", qos: str = "",
     ):
         """Submit the whole batch at once: the scheduler interleaves the
         prompts through its slot pool, so this IS continuous batching —
@@ -7499,6 +7793,7 @@ class SchedulerBackend:
                 sampling=sampling or self.sampling, seed=seed,
                 on_token=on_tok, **constraint_kwargs,
                 deadline_s=effective_deadline, **self._model_kwargs(),
+                **self._qos_kwargs(tenant, qos),
             )
             for ids, (on_tok, _) in zip(ids_list, timers)
         ]

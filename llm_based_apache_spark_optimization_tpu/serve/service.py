@@ -139,6 +139,15 @@ class GenerationService:
 
         if slo_mod.ENGINE.enabled:
             snap["slo"] = slo_mod.ENGINE.report()
+        # Multi-tenant front door (ISSUE 18) under the reserved "qos"
+        # key: per-tenant admit/shed counters and live bucket levels —
+        # the lsot_tenant_* Prometheus families. Empty (key absent) for
+        # a quiet single-tenant deployment.
+        from .qos import ADMISSION
+
+        qos_block = ADMISSION.snapshot()
+        if qos_block:
+            snap["qos"] = qos_block
         return snap
 
     def metrics_prometheus(self) -> str:
@@ -478,6 +487,35 @@ class GenerationService:
             return {}
         return {"idempotency_key": idempotency_key}
 
+    @staticmethod
+    def _qos_kwargs(entry: ModelEntry, tenant: str, qos: str) -> Dict:
+        """Tenant/qos labels (ISSUE 18), forwarded only to backends that
+        understand the axis (`supports_qos`: the scheduler path, where
+        WFQ ordering and per-tenant prefix namespaces live). Elsewhere
+        the labels were still charged at admission — they are a
+        fairness/accounting hint, not a correctness contract."""
+        if not (tenant or qos) or not getattr(entry.backend,
+                                              "supports_qos", False):
+            return {}
+        return {"tenant": tenant, "qos": qos}
+
+    def _admit_qos(self, tenant: str, qos: str,
+                   deadline_s: Optional[float]) -> Optional[float]:
+        """Front-door admission (ISSUE 18): consume one bucket token for
+        (tenant, class) — raises TenantShed (→ HTTP 429) with a
+        bucket-aware Retry-After when the tenant is over budget — and
+        apply the class's default deadline when the request carries none
+        (interactive gets the tighter budget the deadline machinery
+        already honors). No-op with `LSOT_QOS=0`."""
+        from .qos import ADMISSION
+
+        if not ADMISSION.enabled:
+            return deadline_s
+        ADMISSION.admit(tenant, qos, fleet_hint=self.retry_after_hint())
+        if deadline_s is None:
+            return ADMISSION.default_deadline(qos)
+        return deadline_s
+
     def generate(
         self,
         model: str,
@@ -490,8 +528,11 @@ class GenerationService:
         deadline_s: Optional[float] = None,
         idempotency_key: Optional[str] = None,
         request_id: Optional[str] = None,
+        tenant: str = "",
+        qos: str = "",
     ) -> GenerateResult:
         entry = self._entry(model)
+        deadline_s = self._admit_qos(tenant, qos, deadline_s)
         rendered = entry.template(system, prompt)
         # Request-scoped tracing: honor the HTTP layer's sampling
         # decision when one exists, else head-sample here — the shared
@@ -510,6 +551,7 @@ class GenerationService:
                             **self._deadline_kwargs(entry, deadline_s),
                             **self._idempotency_kwargs(entry,
                                                        idempotency_key),
+                            **self._qos_kwargs(entry, tenant, qos),
                         )
         finally:
             TRACER.finish(own)
@@ -593,14 +635,21 @@ class GenerationService:
         constrain=None,
         deadline_s: Optional[float] = None,
         request_id: Optional[str] = None,
+        tenant: str = "",
+        qos: str = "",
     ):
         """Yield the completion as text chunks while it decodes (Ollama's
         `stream=true` surface). Backends without a `complete_stream` seam
         (the one-XLA-program engine, fakes) degrade to a single chunk.
-        Metrics record the request exactly like generate()."""
+        Metrics record the request exactly like generate(). Front-door
+        admission (ISSUE 18) runs on the generator's FIRST step — the
+        HTTP layer primes the stream before sending headers, so a shed
+        still answers a real 429."""
         entry = self._entry(model)
+        deadline_s = self._admit_qos(tenant, qos, deadline_s)
         ckw = self._constrain_kwargs(entry, constrain)
         ckw.update(self._deadline_kwargs(entry, deadline_s))
+        ckw.update(self._qos_kwargs(entry, tenant, qos))
         rendered = entry.template(system, prompt)
         # Tracing: the BACKEND generator reads tracing.current() at its
         # first step (the scheduler's complete_stream captures it before
@@ -697,6 +746,8 @@ class GenerationService:
         sampling: Optional[SamplingParams] = None,
         seed: int = 0,
         constrain=None,
+        tenant: str = "",
+        qos: str = "",
     ) -> "list[GenerateResult]":
         """Batched twin of generate(): one device program for all prompts.
 
@@ -705,12 +756,17 @@ class GenerationService:
         the batch in the metrics registry.
         """
         entry = self._entry(model)
+        # One admission token per batch MEMBER: a storm tenant cannot
+        # dodge its budget by folding the storm into one batch call.
+        for _ in prompts:
+            self._admit_qos(tenant, qos, None)
         rendered = [entry.template(system, p) for p in prompts]
         t0 = time.perf_counter()
         with trace_capture(f"generate-batch-{model}"):
             completions = entry.backend.complete_batch(
                 rendered, max_new_tokens=max_new_tokens, sampling=sampling,
                 seed=seed, **self._constrain_kwargs(entry, constrain),
+                **self._qos_kwargs(entry, tenant, qos),
             )
         latency = time.perf_counter() - t0
         with self._lock:

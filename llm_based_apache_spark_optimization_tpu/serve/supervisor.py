@@ -162,6 +162,12 @@ class JournalEntry:
     # named. Journaled so a crash replay re-places onto the SAME
     # checkpoint's replicas ("" = model-blind, the single-model shape).
     model_id: str = ""
+    # Multi-tenant QoS (ISSUE 18): tenant attribution and service class.
+    # Journaled so a crash replay — and a drain spill recovered by the
+    # NEXT process — bills to the same tenant and keeps its WFQ/prefix
+    # namespace ("" = unlabeled, the single-tenant shape).
+    tenant: str = ""
+    qos: str = ""
 
 
 class SupervisedScheduler:
@@ -249,6 +255,10 @@ class SupervisedScheduler:
         # budget dies first and the quarantine never fires.
         self.max_entry_replays = int(max_entry_replays)
         self._quarantined = 0
+        # Quarantine attribution per tenant (ISSUE 18): the poison-
+        # request counter gains a tenant axis (bounded top-K labels), so
+        # an operator sees WHOSE requests keep crashing the loop.
+        self._quarantined_by_tenant: Dict[str, float] = {}
         # Watchdog (serve/watchdog.py): a monitor thread compares the
         # inner loop's heartbeat age against
         # max(stall_min_s, stall_factor × measured round cadence) and
@@ -488,6 +498,26 @@ class SupervisedScheduler:
         scheduler routes on it (a pool; bare schedulers validate)."""
         return bool(getattr(self._inner, "supports_model_routing", False))
 
+    @property
+    def supports_qos(self):
+        """Tenant/qos axis passthrough (ISSUE 18): callers forward the
+        kwargs through supervision only when the INNER scheduler
+        understands them (duck-typed like model routing)."""
+        return bool(getattr(self._inner, "supports_qos", False))
+
+    def qos_stats(self):
+        """Per-tenant WFQ/admission counters passthrough (ISSUE 18),
+        with the supervisor's own per-tenant quarantine axis folded in
+        (the poison-quarantine enforcement arm's attribution)."""
+        fn = getattr(self._inner, "qos_stats", None)
+        out = fn() if callable(fn) else None
+        with self._lock:
+            quarantined = dict(self._quarantined_by_tenant)
+        if quarantined:
+            out = dict(out) if out else {}
+            out["quarantined"] = quarantined
+        return out
+
     def model_stats(self):
         """Per-model serving aggregation passthrough (ISSUE 16)."""
         fn = getattr(self._inner, "model_stats", None)
@@ -600,6 +630,8 @@ class SupervisedScheduler:
         constraint_spec=None,
         trace=None,
         model_id: str = "",
+        tenant: str = "",
+        qos: str = "",
     ) -> "Future[List[int]]":
         """Journal + submit. The returned future survives loop crashes: it
         resolves from whichever scheduler incarnation finishes the work.
@@ -680,6 +712,8 @@ class SupervisedScheduler:
                 future=Future(),
                 trace=trace,
                 model_id=str(model_id or ""),
+                tenant=str(tenant or ""),
+                qos=str(qos or ""),
             )
             self._next_rid += 1
             entry.future._lsot_entry = entry  # cancel() handle
@@ -929,6 +963,10 @@ class SupervisedScheduler:
                         rec["constrain"] = e.constraint_spec
                     if e.model_id:
                         rec["model_id"] = e.model_id
+                    if e.tenant:
+                        rec["tenant"] = e.tenant
+                    if e.qos:
+                        rec["qos"] = e.qos
                     records.append(rec)
             for key, result in self._completed.items():
                 records.append({
@@ -1045,6 +1083,8 @@ class SupervisedScheduler:
                     deadline_s=rem,
                     idempotency_key=rec.get("idempotency_key"),
                     model_id=str(rec.get("model_id", "") or ""),
+                    tenant=str(rec.get("tenant", "") or ""),
+                    qos=str(rec.get("qos", "") or ""),
                     **ckw,
                 )
             except Exception:  # noqa: BLE001 — per-record: salvage the rest
@@ -1144,6 +1184,13 @@ class SupervisedScheduler:
             # checkpoint's replicas after a crash — duck-typed inners
             # without the axis never see the kwarg.
             kwargs["model_id"] = entry.model_id
+        if (entry.tenant or entry.qos) and getattr(self._inner,
+                                                   "supports_qos", False):
+            # Tenant axis (ISSUE 18): replays and spill recovery keep
+            # their attribution so WFQ/preemption charge the right
+            # tenant after a crash; qos-blind inners never see it.
+            kwargs["tenant"] = entry.tenant
+            kwargs["qos"] = entry.qos
         fut = self._inner.submit(
             entry.ids, max_new_tokens=entry.max_new, sampling=entry.sampling,
             seed=entry.seed, on_token=tap,
@@ -1383,6 +1430,9 @@ class SupervisedScheduler:
         if self.max_entry_replays and \
                 e.crash_replays > self.max_entry_replays:
             self._quarantined += 1
+            from .qos import DEFAULT_TENANT, bounded_bump
+            bounded_bump(self._quarantined_by_tenant,
+                         e.tenant or DEFAULT_TENANT)
             resilience.inc("quarantined")
             self.flight.event("quarantine", rid=e.rid,
                               replays=e.crash_replays - 1)

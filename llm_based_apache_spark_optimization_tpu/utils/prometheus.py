@@ -389,6 +389,79 @@ def _emit_slo(emit: _Emitter, slo: Dict) -> None:
                          1.0 if v["burning"] else 0.0)
 
 
+def _emit_qos_admission(emit: _Emitter, qos: Dict) -> None:
+    """The front-door lsot_tenant_* families (ISSUE 18): per-(tenant,
+    class) admit/shed counters, cumulative shed wait, and live bucket
+    levels. Labels are bounded upstream (top-K + "_other" fold in
+    serve/qos.py), so a tenant-id flood cannot balloon the payload."""
+    for key, name in (
+            ("admitted", "lsot_tenant_admitted_total"),
+            ("shed", "lsot_tenant_shed_total"),
+            ("shed_wait_s", "lsot_tenant_shed_wait_seconds_total"),
+    ):
+        for label, v in (qos.get(key) or {}).items():
+            tenant, sep, cls = str(label).rpartition("/")
+            n = _num(v)
+            if n is not None:
+                emit.add(name,
+                         {"tenant": tenant if sep else str(label),
+                          "qos": cls if sep else ""},
+                         n, "counter")
+    for label, v in (qos.get("bucket_level") or {}).items():
+        tenant, sep, cls = str(label).rpartition("/")
+        n = _num(v)
+        if n is not None:
+            emit.add("lsot_tenant_bucket_level",
+                     {"tenant": tenant if sep else str(label),
+                      "qos": cls if sep else ""}, n)
+
+
+def _emit_qos_sched(emit: _Emitter, model: str, qv: Dict) -> None:
+    """Scheduler-side WFQ view (ISSUE 18): per-replica virtual time and
+    ready/page-wait depths, plus per-tenant submitted/preempted/
+    quarantined counters — first-class families on the shared model ×
+    replica × tenant vocabulary instead of path-flattened names (tenant
+    ids must be label VALUES, never metric names)."""
+    reps = qv.get("replicas")
+    if isinstance(reps, list):
+        views = [(str(r.get("replica") or f"r{i}"), r)
+                 for i, r in enumerate(reps) if isinstance(r, dict)]
+    else:
+        views = [("r0", qv)]
+    for rep, v in views:
+        labels = {"model": model, "replica": rep}
+        for key, name in (
+                ("virtual_time", "lsot_qos_virtual_time"),
+                ("ready", "lsot_qos_ready_depth"),
+                ("page_wait", "lsot_qos_page_wait_depth"),
+        ):
+            n = _num(v.get(key))
+            if n is not None:
+                emit.add(name, labels, n)
+        for key, name, mtype in (
+                ("submitted", "lsot_tenant_submitted_total", "counter"),
+                ("preempted", "lsot_tenant_preempted_total", "counter"),
+                ("weights", "lsot_tenant_weight", "gauge"),
+                ("backlog", "lsot_tenant_backlog", "gauge"),
+        ):
+            d = v.get(key)
+            if not isinstance(d, dict):
+                continue
+            for tenant, cnt in d.items():
+                n = _num(cnt)
+                if n is not None:
+                    emit.add(name, {**labels, "tenant": str(tenant)},
+                             n, mtype)
+    q = qv.get("quarantined")
+    if isinstance(q, dict):
+        for tenant, cnt in q.items():
+            n = _num(cnt)
+            if n is not None:
+                emit.add("lsot_tenant_quarantined_total",
+                         {"model": model, "tenant": str(tenant)},
+                         n, "counter")
+
+
 def render_prometheus(snapshot: Dict,
                       histograms: Optional[HistogramSet] = None) -> str:
     """Render `GenerationService.metrics_snapshot()` (+ the registry's
@@ -396,7 +469,8 @@ def render_prometheus(snapshot: Dict,
     emit = _Emitter()
     resilience = snapshot.get("resilience") or {}
     for model, agg in snapshot.items():
-        if model in ("resilience", "slo") or not isinstance(agg, dict):
+        if model in ("resilience", "slo", "qos") \
+                or not isinstance(agg, dict):
             continue
         for key, (suffix, mtype) in _MODEL_KEYS.items():
             n = _num(agg.get(key))
@@ -446,6 +520,12 @@ def render_prometheus(snapshot: Dict,
             fl = serving.pop("fleet", None)
             if isinstance(fl, dict):
                 _emit_fleet(emit, model, fl)
+            # WFQ/tenant scheduler stats render as first-class model ×
+            # replica × tenant families (ISSUE 18): tenant ids must be
+            # label values, never path-flattened metric names.
+            qv = serving.pop("qos", None)
+            if isinstance(qv, dict):
+                _emit_qos_sched(emit, model, qv)
             _flatten_serving(emit, model, "lsot_serving", serving)
     if resilience:
         breakers = resilience.get("breakers") or {}
@@ -468,6 +548,9 @@ def render_prometheus(snapshot: Dict,
     slo = snapshot.get("slo")
     if isinstance(slo, dict):
         _emit_slo(emit, slo)
+    qos = snapshot.get("qos")
+    if isinstance(qos, dict):
+        _emit_qos_admission(emit, qos)
     if histograms is not None:
         for name, series in sorted(histograms.snapshot().items()):
             name = _NAME_OK.sub("_", name)

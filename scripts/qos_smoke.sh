@@ -1,0 +1,128 @@
+#!/usr/bin/env bash
+# Multi-tenant front door smoke: the ISSUE-18 QoS layer end to end on a
+# real booted app.
+#
+# Boots the app (tiny in-tree model behind the continuous-batching
+# scheduler) with QoS admission ON and a deliberately tiny per-tenant
+# budget, drives a two-tenant storm over real HTTP, and asserts the
+# isolation contract:
+#
+#   1. the storm tenant blows its token bucket: burst-sized prefix
+#      serves 200, the rest shed TYPED 429 with a Retry-After header
+#      derived from the bucket's refill ETA (never a 500, never an
+#      unbounded queue);
+#   2. the quiet tenant is UNTOUCHED by the storm — its own bucket, its
+#      own budget — and serves 200 while the storm is being shed;
+#   3. an unknown qos class fails typed 400 naming the valid classes;
+#   4. the per-tenant counters surface in /metrics (JSON `qos` block,
+#      "tenant/qos"-keyed) and as lsot_tenant_* Prometheus families
+#      with tenant/qos LABELS (bounded cardinality — tenant ids are
+#      label values, never metric names).
+#
+# The default test lane runs the same flow in-process
+# (tests/test_qos.py::test_http_two_tenants_storm_shed_quiet_served,
+# not marked slow); this script is the focused real-HTTP lane, beside
+# chaos_smoke.sh / remote_smoke.sh / obs_smoke.sh / multimodel_smoke.sh.
+#
+#   scripts/qos_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export LSOT_QOS=1
+# Refill so slow (1 token / 50s) that real-HTTP generation walls cannot
+# sneak extra budget into the storm tenant's bucket mid-run.
+export LSOT_TENANT_RATE="${LSOT_TENANT_RATE:-0.02}"
+export LSOT_TENANT_BURST="${LSOT_TENANT_BURST:-2}"
+export LSOT_PREFIX_TENANT_NS=1
+
+python - <<'EOF'
+import json
+import urllib.error
+import urllib.request
+
+from llm_based_apache_spark_optimization_tpu.app.__main__ import (
+    make_tiny_service,
+)
+from llm_based_apache_spark_optimization_tpu.app.api import create_api_app
+from llm_based_apache_spark_optimization_tpu.app.config import AppConfig
+from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+from llm_based_apache_spark_optimization_tpu.serve.qos import ADMISSION
+from llm_based_apache_spark_optimization_tpu.sql import default_backend
+
+ADMISSION.reconfigure()  # pick up the env knobs above
+cfg = AppConfig(history_db=":memory:", port=0)
+service = make_tiny_service(8, scheduler=True)
+app = create_api_app(service, default_backend, SQLiteHistory(":memory:"),
+                     cfg)
+server = app.serve(cfg.host, 0, background=True)
+url = f"http://{cfg.host}:{server.server_address[1]}"
+print(f"qos_smoke: app up at {url} (rate=0.02/s burst=2)")
+
+
+def gen(tenant, qos, prompt="List the three largest fares"):
+    """POST /api/generate with gateway-style attribution headers.
+    Returns (status, headers, body-dict) — 4xx comes back as a status,
+    not an exception, so the storm loop reads like the contract."""
+    req = urllib.request.Request(
+        url + "/api/generate",
+        json.dumps({"model": "duckdb-nsql", "prompt": prompt}).encode(),
+        {"Content-Type": "application/json",
+         "X-Lsot-Tenant": tenant, "X-Lsot-Qos": qos})
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+# 1. storm tenant: burst of 2 serves, the rest shed typed 429 with a
+#    bucket-derived Retry-After.
+storm = [gen("storm", "batch") for _ in range(4)]
+assert [s for s, _, _ in storm[:2]] == [200, 200], \
+    [s for s, _, _ in storm]
+shed = [(s, h, b) for s, h, b in storm if s == 429]
+assert len(shed) == 2, [s for s, _, _ in storm]
+for _, h, b in shed:
+    assert float(h["Retry-After"]) >= 1, h
+    assert "storm" in b["error"], b
+print("qos_smoke: step 1 OK (storm: 2x200 then 2x429, "
+      f"Retry-After={shed[0][1]['Retry-After']}s)")
+
+# 2. the quiet tenant's budget is its own: served while the storm sheds.
+status, _, body = gen("quiet", "interactive")
+assert status == 200 and body["done"], (status, body)
+print("qos_smoke: step 2 OK (quiet tenant served mid-storm)")
+
+# 3. an unknown qos class fails typed 400.
+status, _, body = gen("probe", "premium")
+assert status == 400 and "unknown qos class" in body["error"], \
+    (status, body)
+print("qos_smoke: step 3 OK (unknown qos class -> typed 400)")
+
+
+def get(path):
+    with urllib.request.urlopen(url + path, timeout=60) as r:
+        return r.status, r.read().decode()
+
+
+# 4. per-tenant accounting: JSON qos block + lsot_tenant_* families.
+status, text = get("/metrics")
+assert status == 200
+snap = json.loads(text)["qos"]
+assert snap["admitted"]["quiet/interactive"] == 1, snap
+assert snap["admitted"]["storm/batch"] == 2, snap
+assert snap["shed"]["storm/batch"] == 2, snap
+
+status, text = get("/metrics?format=prometheus")
+assert status == 200
+for needle in (
+    'lsot_tenant_admitted_total{qos="interactive",tenant="quiet"} 1',
+    'lsot_tenant_shed_total{qos="batch",tenant="storm"} 2',
+    "lsot_tenant_bucket_level{",
+    "lsot_tenant_submitted_total{",
+):
+    assert needle in text, f"missing from exposition: {needle}"
+print("qos_smoke: step 4 OK (qos snapshot + lsot_tenant_* families)")
+print("qos_smoke: PASS")
+EOF

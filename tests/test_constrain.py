@@ -175,6 +175,62 @@ def test_in_and_between_invalid_forms_rejected_by_both():
         assert not is_valid_spark_sql(sql), sql
 
 
+def test_paren_boolean_grouping_accepted_by_both():
+    """Grammar-breadth slice (ISSUE 18 satellite): one level of
+    parenthesized boolean grouping in WHERE/HAVING —
+    `( pred OR pred ) AND pred` — joins the language (grammar + parser;
+    the token-mask compiler again needed no changes — parens are
+    punctuation already in the alphabet from IN-lists)."""
+    dfa = spark_sql_dfa()
+    sdfa = spark_sql_dfa("taxi", tuple(TAXI_COLUMNS))
+    good = [
+        "SELECT * FROM taxi WHERE ( VendorID = 1 OR VendorID = 2 ) "
+        "AND fare_amount > 10",
+        "SELECT * FROM taxi WHERE (extra = 'x' OR extra = 'y')",
+        "SELECT * FROM taxi WHERE fare_amount > 2 AND "
+        "(tip_amount IS NULL OR tip_amount < 1)",
+        "SELECT * FROM taxi WHERE (VendorID IN (1, 2) AND extra "
+        "LIKE 'a%') OR trip_distance BETWEEN 0.5 AND 2",
+        "select * from taxi where (vendorid = 1) and (vendorid = 2) "
+        "order by trip_distance limit 3;",
+        "SELECT COUNT(*) AS n FROM taxi GROUP BY VendorID "
+        "HAVING (VendorID = 1 OR VendorID = 2) AND COUNT(*) > 5",
+    ]
+    for sql in good:
+        assert dfa.accepts(sql), sql
+        assert sdfa.accepts(sql), sql
+        parse_spark_sql(sql)  # must not raise
+
+
+def test_paren_boolean_invalid_forms_rejected_by_both():
+    dfa = spark_sql_dfa()
+    bad = [
+        "SELECT * FROM taxi WHERE ()",                  # empty group
+        "SELECT * FROM taxi WHERE (a = 1",              # unbalanced open
+        "SELECT * FROM taxi WHERE a = 1)",              # unbalanced close
+        "SELECT * FROM taxi WHERE (a = 1) (b = 2)",     # missing connective
+        "SELECT * FROM taxi WHERE (a = 1 OR) AND b = 2",  # dangling OR
+        "SELECT * FROM taxi WHERE (AND a = 1)",         # leading connective
+        # JOIN..ON keeps a bare predicate: no boolean grouping there.
+        "SELECT * FROM taxi JOIN t ON (taxi.a = t.a) WHERE b = 1",
+    ]
+    for sql in bad:
+        assert not dfa.accepts(sql), sql
+        assert not is_valid_spark_sql(sql), sql
+
+
+def test_paren_nesting_depth_is_dfa_bounded():
+    """The DFA accepts exactly ONE grouping level (a regular language
+    cannot count); the reference parser recurses and accepts deeper
+    nesting — leniency in the safe direction (DFA ⊆ parser), asserted
+    explicitly so a future grammar change cannot silently flip it."""
+    nested = ("SELECT * FROM taxi WHERE ((VendorID = 1 OR VendorID = 2) "
+              "AND extra = 'x') OR fare_amount > 9")
+    dfa = spark_sql_dfa()
+    assert not dfa.accepts(nested)
+    assert is_valid_spark_sql(nested)
+
+
 def test_schema_mode_blocks_unknown_identifiers():
     sdfa = spark_sql_dfa("taxi", tuple(TAXI_COLUMNS))
     # A column not in the schema cannot even be *spelled*.

@@ -1,0 +1,295 @@
+"""Multi-tenant front door (ISSUE 18): QoS classes, per-tenant token
+buckets, the admission controller's bucket-aware Retry-After, bounded
+label cardinality, and the HTTP twin of scripts/qos_smoke.sh — two
+tenants through the real /api/generate endpoint, storm shed with a 429
+while the quiet tenant completes, per-tenant counters scrapable as
+lsot_tenant_* families.
+
+Hermetic: FakeBackend for the HTTP tests (no weights), explicit `now`
+stamps for every bucket-time assertion. The scheduler-level WFQ and
+off-switch reconciliation tests live in tests/test_scheduler.py (they
+need the TINY model); the storm-isolation latency contract lives in
+evalh/chaos.py stage 9."""
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.serve.qos import (
+    ADMISSION,
+    DEFAULT_TENANT,
+    OTHER_TENANT,
+    AdmissionController,
+    TenantBucketRegistry,
+    TenantShed,
+    TokenBucket,
+    bounded_bump,
+    normalize_qos,
+    parse_tenant_weights,
+    tenant_salt,
+)
+
+
+@pytest.fixture()
+def admission():
+    """A scratch controller; the module singleton is restored for tests
+    that must go through the real HTTP layer (which reads ADMISSION)."""
+    ctl = AdmissionController()
+    yield ctl
+
+
+@pytest.fixture()
+def singleton_admission():
+    """Reconfigure the process singleton for an HTTP test and restore
+    the (env-derived) defaults afterward."""
+    yield ADMISSION
+    ADMISSION.reconfigure()
+
+
+# ------------------------------------------------------------- class policy
+
+
+def test_normalize_qos_accepts_classes_rejects_garbage():
+    assert normalize_qos("interactive") == "interactive"
+    assert normalize_qos("  Batch ") == "batch"
+    assert normalize_qos("REPLAY") == "replay"
+    assert normalize_qos("") == ""
+    assert normalize_qos(None) == ""
+    with pytest.raises(ValueError, match="unknown qos class"):
+        normalize_qos("premium")
+
+
+def test_parse_tenant_weights_skips_malformed_entries():
+    w = parse_tenant_weights("a=4, b=1.5, junk, c=oops, =2, d=-1")
+    assert w == {"a": 4.0, "b": 1.5}
+    assert parse_tenant_weights("") == {}
+
+
+def test_tenant_salt_deterministic_and_empty_is_identity():
+    assert tenant_salt("") == ()  # unlabeled keys stay bit-for-bit
+    s = tenant_salt("acme")
+    assert s == tenant_salt("acme") and len(s) == 2
+    assert s != tenant_salt("acme2")
+    assert all(-(2**31) <= v < 2**31 for v in s)  # int32-safe
+
+
+def test_bounded_bump_folds_tail_into_other():
+    counters = {}
+    for i in range(5):
+        bounded_bump(counters, f"t{i}", top_k=3)
+    assert set(counters) == {"t0", "t1", "t2", OTHER_TENANT}
+    assert counters[OTHER_TENANT] == 2
+    bounded_bump(counters, "t1", top_k=3)  # existing key still its own
+    assert counters["t1"] == 2
+    bounded_bump(counters, "", top_k=99)
+    assert counters[DEFAULT_TENANT] == 1
+
+
+# ------------------------------------------------------------ token buckets
+
+
+def test_token_bucket_drain_refill_and_eta():
+    b = TokenBucket(rate=2.0, burst=4.0)
+    t0 = 100.0
+    assert all(b.take(1.0, now=t0) for _ in range(4))  # starts full
+    assert not b.take(1.0, now=t0)  # drained
+    assert b.refill_eta(1.0, now=t0) == pytest.approx(0.5)  # 1 token / 2 rps
+    assert not b.take(1.0, now=t0 + 0.25)  # half a token is not one
+    assert b.take(1.0, now=t0 + 0.5)
+    # Refill caps at burst: a long idle gap is not a bigger volley.
+    b2 = TokenBucket(rate=2.0, burst=4.0)
+    b2.take(1.0, now=t0)
+    assert all(b2.take(1.0, now=t0 + 1e6) for _ in range(4))
+    assert not b2.take(1.0, now=t0 + 1e6)
+
+
+def test_zero_rate_bucket_eta_capped():
+    b = TokenBucket(rate=0.0, burst=1.0)
+    assert b.take(1.0, now=5.0)
+    assert not b.take(1.0, now=6.0)
+    assert b.refill_eta(1.0, now=6.0) == 60.0  # never refills: sane cap
+
+
+def test_registry_per_class_override_and_unlimited_default():
+    reg = TenantBucketRegistry(rate_spec="0,interactive=2",
+                               burst_spec="interactive=2")
+    assert reg.bucket("a", "batch") is None  # rate 0 = unlimited
+    assert reg.check("a", "batch", now=1.0) is None
+    assert reg.check("a", "interactive", now=1.0) is None
+    assert reg.check("a", "interactive", now=1.0) is None
+    eta = reg.check("a", "interactive", now=1.0)
+    assert eta == pytest.approx(0.5)
+    # Tenants do not share budgets: b's bucket is untouched by a's storm.
+    assert reg.check("b", "interactive", now=1.0) is None
+
+
+def test_registry_bucket_count_bounded_by_overflow():
+    reg = TenantBucketRegistry(rate_spec="1", max_buckets=3)
+    for i in range(3):
+        assert reg.check(f"t{i}", "", now=1.0) is None
+    assert len(reg._buckets) == 3
+    # Strangers beyond the cap share ONE overflow bucket (rate 1,
+    # burst 2): a tenant-id flood cannot grow memory, and collectively
+    # throttling the flood is the intended failure mode.
+    assert reg.check("t3", "", now=1.0) is None
+    assert reg.check("t4", "", now=1.0) is None
+    assert reg.check("t5", "", now=1.0) is not None
+    assert set(reg._buckets) == {("t0", ""), ("t1", ""), ("t2", ""),
+                                 (OTHER_TENANT, "")}
+
+
+# ------------------------------------------------- admission controller
+
+
+def test_drained_bucket_retry_after_is_max_of_bucket_and_fleet(admission):
+    """ISSUE 18 satellite (a): the 429 hint must be max(bucket refill
+    ETA, fleet backpressure hint) — the fleet hint alone would tell a
+    rate-limited tenant to retry straight into the same empty bucket."""
+    admission.reconfigure(enabled=True, rate="2", burst="2")
+    admission.admit("acme", "batch", fleet_hint=0.0)
+    admission.admit("acme", "batch", fleet_hint=0.0)
+    # Bucket drained; tiny fleet hint: the BUCKET eta (~0.5s) must win.
+    with pytest.raises(TenantShed) as exc:
+        admission.admit("acme", "batch", fleet_hint=0.0)
+    assert 0.1 <= exc.value.retry_after_s <= 0.6
+    assert exc.value.tenant == "acme" and exc.value.qos == "batch"
+    # Fleet under heavy backpressure: the FLEET hint must win.
+    with pytest.raises(TenantShed) as exc2:
+        admission.admit("acme", "batch", fleet_hint=7.5)
+    assert exc2.value.retry_after_s == pytest.approx(7.5)
+    # TenantShed rides the existing Overloaded → 429 mapping.
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        Overloaded,
+    )
+
+    assert isinstance(exc.value, Overloaded)
+    snap = admission.snapshot()
+    assert snap["admitted"] == {"acme/batch": 2}
+    assert snap["shed"]["acme/batch"] == 2
+    assert snap["shed_wait_s"]["acme/batch"] > 0
+
+
+def test_admission_off_switch_never_sheds(admission):
+    admission.reconfigure(enabled=False, rate="0.0001", burst="1")
+    for _ in range(20):
+        admission.admit("storm", "batch", fleet_hint=9.0)
+    assert admission.snapshot() == {}
+
+
+def test_quiet_unlabeled_deployment_keeps_metrics_payload(admission):
+    """No tenant labels + no configured rates → zero accounting, so a
+    single-tenant deployment's /metrics payload is byte-identical to
+    the pre-QoS one."""
+    admission.reconfigure(enabled=True, rate="", burst="")
+    for _ in range(5):
+        admission.admit("", "", fleet_hint=1.0)
+    assert admission.snapshot() == {}
+    # Labeled traffic without rates IS counted (operators watch tenant
+    # mix before configuring budgets) but never shed.
+    admission.admit("acme", "interactive")
+    snap = admission.snapshot()
+    assert snap["admitted"] == {"acme/interactive": 1}
+    assert "shed_wait_s" not in snap
+
+
+def test_per_class_default_deadline(admission):
+    admission.reconfigure(enabled=True,
+                          deadlines={"interactive": 1.5, "batch": 0.0})
+    assert admission.default_deadline("interactive") == 1.5
+    assert admission.default_deadline("batch") is None
+    assert admission.default_deadline("") is None
+
+
+# ------------------------------------------------------------ HTTP twin
+
+
+CSV = "VendorID,total_amount\n1,12.5\n2,25.0\n"
+
+
+def _api_app(tmp_path):
+    from llm_based_apache_spark_optimization_tpu.app import (
+        AppConfig,
+        create_api_app,
+    )
+    from llm_based_apache_spark_optimization_tpu.history import SQLiteHistory
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+    from llm_based_apache_spark_optimization_tpu.sql import SQLiteBackend
+
+    cfg = AppConfig(input_dir=str(tmp_path / "input"),
+                    output_dir=str(tmp_path / "output"),
+                    history_db=":memory:", secret_key="test-secret")
+    svc = GenerationService()
+    svc.register("duckdb-nsql", FakeBackend(lambda p: "SELECT 1;"))
+    return create_api_app(svc, SQLiteBackend(), SQLiteHistory(), cfg)
+
+
+def test_http_two_tenants_storm_shed_quiet_served(tmp_path,
+                                                  singleton_admission):
+    """In-process twin of scripts/qos_smoke.sh: the storm tenant blows
+    its bucket and gets typed 429s with a Retry-After header; the quiet
+    tenant's budget is untouched; the per-tenant counters surface in
+    /metrics and as lsot_tenant_* Prometheus families."""
+    singleton_admission.reconfigure(enabled=True, rate="1", burst="2")
+    client = _api_app(tmp_path).test_client()
+
+    def gen(tenant, qos="batch"):
+        return client.post_json(
+            "/api/generate", {"model": "duckdb-nsql", "prompt": "hi"},
+            headers={"X-Lsot-Tenant": tenant, "X-Lsot-Qos": qos})
+
+    storm = [gen("storm") for _ in range(5)]
+    assert [r.status for r in storm[:2]] == [200, 200]  # burst=2
+    shed = [r for r in storm if r.status == 429]
+    assert len(shed) == 3
+    assert float(shed[0].headers["Retry-After"]) >= 1
+    quiet = gen("quiet", qos="interactive")
+    assert quiet.status == 200
+    assert quiet.json()["response"] == "SELECT 1;"
+
+    snap = client.get("/metrics").json()
+    assert snap["qos"]["admitted"]["quiet/interactive"] == 1
+    assert snap["qos"]["shed"]["storm/batch"] == 3
+    text = client.get("/metrics", query="format=prometheus").text
+    assert ('lsot_tenant_admitted_total{qos="interactive",'
+            'tenant="quiet"} 1' in text)
+    assert ('lsot_tenant_shed_total{qos="batch",'
+            'tenant="storm"} 3' in text)
+    assert "lsot_tenant_bucket_level{" in text
+
+
+def test_http_unknown_qos_class_is_400_header_wins_over_json(
+        tmp_path, singleton_admission):
+    singleton_admission.reconfigure(enabled=True, rate="100", burst="100")
+    client = _api_app(tmp_path).test_client()
+    res = client.post_json("/api/generate",
+                           {"model": "duckdb-nsql", "prompt": "hi",
+                            "qos": "premium"})
+    assert res.status == 400
+    assert "unknown qos class" in res.json()["error"]
+    # The gateway-injected header outranks the JSON body field.
+    res2 = client.post_json(
+        "/api/generate",
+        {"model": "duckdb-nsql", "prompt": "hi", "tenant": "body-t",
+         "qos": "batch"},
+        headers={"X-Lsot-Tenant": "header-t", "X-Lsot-Qos": "replay"})
+    assert res2.status == 200
+    snap = singleton_admission.snapshot()
+    assert snap["admitted"] == {"header-t/replay": 1}
+
+
+def test_http_streaming_shed_is_pre_header_429(tmp_path,
+                                               singleton_admission):
+    """A drained bucket must surface as a REAL 429 on the streaming
+    branch too — the stream is primed before headers go out, so the
+    lazy admission inside the generator cannot decay into a 200 plus
+    a mid-stream error line."""
+    singleton_admission.reconfigure(enabled=True, rate="1", burst="1")
+    client = _api_app(tmp_path).test_client()
+    body = {"model": "duckdb-nsql", "prompt": "hi", "stream": True}
+    hdrs = {"X-Lsot-Tenant": "s", "X-Lsot-Qos": "interactive"}
+    first = client.post_json("/api/generate", body, headers=hdrs)
+    assert first.status == 200
+    second = client.post_json("/api/generate", body, headers=hdrs)
+    assert second.status == 429
+    assert "Retry-After" in second.headers

@@ -914,3 +914,136 @@ def test_pool_weights_scale_backlog_comparison():
         parse_replica_weights("1,1,1", 2)
     with _pytest.raises(ValueError, match="pool has"):
         _fake_pool(_FakeReplica(), _FakeReplica(), weights=[1.0, 1.0, 2.0])
+
+
+# ----------------------------------------------------------- multi-tenant QoS
+
+
+def _mk_qos_req(ids, max_new=8, tenant="", deadline=None):
+    from concurrent.futures import Future
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        _Request,
+    )
+
+    return _Request(ids=list(ids), max_new=max_new, temperature=0.0,
+                    top_p=1.0, top_k=0, seed=0, future=Future(),
+                    tenant=tenant, deadline=deadline)
+
+
+def test_wfq_light_tenant_ahead_of_storm_backlog(tiny_model_module,
+                                                 monkeypatch):
+    """ISSUE 18: start-time fair queueing — a storm tenant's k-th queued
+    request finishes k virtual costs out, so a light tenant's single
+    request is served ahead of the storm's parked backlog (but behind
+    the storm's head-of-line, which tied at the global clock first)."""
+    monkeypatch.setenv("LSOT_QOS", "1")
+    cfg, params = tiny_model_module
+    sched = make_sched(cfg, params)
+    storm = [_mk_qos_req([1] * 8, tenant="storm") for _ in range(3)]
+    light = _mk_qos_req([1] * 8, tenant="light")
+    with sched._submit_lock:
+        for i, r in enumerate(storm + [light]):
+            r.rid = i + 1
+            sched._stamp_qos_locked(r)
+            sched._ready.append(r)
+    order = [sched._ready_pop().tenant for _ in range(4)]
+    assert order == ["storm", "light", "storm", "storm"]
+    assert sched._ready_pop() is None
+    # The per-tenant submit counters feed qos_stats → lsot_tenant_*.
+    assert sched.qos_stats()["submitted"] == {"storm": 3, "light": 1}
+    # Tenant prefix-cache namespacing: labeled requests got a salt,
+    # distinct per tenant, and () is reserved for unlabeled traffic.
+    assert storm[0].ns and light.ns and storm[0].ns != light.ns
+
+
+def test_wfq_weights_scale_tenant_share(tiny_model_module, monkeypatch):
+    """LSOT_TENANT_WEIGHTS: a weight-4 tenant's requests cost 1/4 the
+    virtual time, so its whole volley finishes before an equal-sized
+    weight-1 volley submitted FIRST."""
+    monkeypatch.setenv("LSOT_QOS", "1")
+    monkeypatch.setenv("LSOT_TENANT_WEIGHTS", "gold=4")
+    cfg, params = tiny_model_module
+    sched = make_sched(cfg, params)
+    reqs = ([_mk_qos_req([1] * 8, tenant="plain") for _ in range(2)]
+            + [_mk_qos_req([1] * 8, tenant="gold") for _ in range(2)])
+    with sched._submit_lock:
+        for i, r in enumerate(reqs):
+            r.rid = i + 1
+            sched._stamp_qos_locked(r)
+            sched._ready.append(r)
+    order = [sched._ready_pop().tenant for _ in range(4)]
+    assert order == ["gold", "gold", "plain", "plain"]
+    assert sched.qos_stats()["weights"] == {"gold": 4.0}
+
+
+def test_qos_off_reproduces_single_tenant_order_token_level(
+        tiny_model_module, monkeypatch):
+    """ISSUE 18 acceptance: `LSOT_QOS=0` reproduces the pre-QoS
+    admission path bit-for-bit — tenant-labeled submits leave ZERO QoS
+    state (FIFO queue only: empty ready pool, no vft/ns stamps, no
+    stats block) and outputs reconcile token-for-token with both the
+    engine golden and a QoS-on run of the same labeled workload."""
+    cfg, params = tiny_model_module
+    golden = engine_golden(cfg, params, PROMPTS, max_new=5)
+    monkeypatch.setenv("LSOT_QOS", "0")
+    with make_sched(cfg, params) as off:
+        futs = [off.submit(p, max_new_tokens=5, tenant=f"t{i % 2}",
+                           qos="batch")
+                for i, p in enumerate(PROMPTS)]
+        out_off = [f.result(timeout=120) for f in futs]
+        assert off.qos_stats() is None
+        assert off._ready == [] and off._wfq_vt == 0.0
+        reqs = [f._lsot_request for f in futs]
+        assert all(r.vft == 0.0 and r.ns == () for r in reqs)
+    assert out_off == golden
+    monkeypatch.setenv("LSOT_QOS", "1")
+    with make_sched(cfg, params) as on:
+        futs = [on.submit(p, max_new_tokens=5, tenant=f"t{i % 2}",
+                          qos="batch")
+                for i, p in enumerate(PROMPTS)]
+        out_on = [f.result(timeout=120) for f in futs]
+        assert sorted(on.qos_stats()["submitted"]) == ["t0", "t1"]
+    assert out_on == golden
+
+
+def test_sweep_page_wait_fails_expired_in_deadline_order(
+        tiny_model_module, monkeypatch):
+    """ISSUE 18 satellite (b): under WFQ the page-wait deque is no
+    longer deadline-monotone — a heavy tenant's EARLIER-expiring waiter
+    can sit behind a light tenant's. Expiry must still surface typed
+    DeadlineExceeded in DEADLINE order (clients racing timeouts and the
+    chaos loss accounting pair 504s with submit deadlines), and a
+    near-expired but live waiter must survive the sweep untouched."""
+    import time as _time
+
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        Deadline,
+        DeadlineExceeded,
+    )
+
+    monkeypatch.setenv("LSOT_QOS", "1")
+    cfg, params = tiny_model_module
+    sched = make_sched(cfg, params, kv_layout="paged", kv_page_size=8,
+                       kv_pages=16)
+    now = _time.monotonic()
+    # Parked in WFQ/service order: the light tenant's waiter expired a
+    # full second LATER than the heavy tenant's sitting behind it.
+    later = _mk_qos_req([1, 2], tenant="light",
+                        deadline=Deadline(now - 1.0))
+    earlier = _mk_qos_req([1, 2], tenant="heavy",
+                          deadline=Deadline(now - 2.0))
+    alive = _mk_qos_req([1, 2], tenant="heavy",
+                        deadline=Deadline(now + 30.0))
+    failed = []
+    for tag, r in (("later", later), ("earlier", earlier),
+                   ("alive", alive)):
+        r.submitted_at = _time.perf_counter()
+        r.future.add_done_callback(lambda f, t=tag: failed.append(t))
+        sched._page_wait.append(r)
+    sched._sweep_page_wait()
+    assert failed == ["earlier", "later"]  # deadline order, not queue order
+    for r in (earlier, later):
+        with pytest.raises(DeadlineExceeded):
+            r.future.result(timeout=1)
+    assert list(sched._page_wait) == [alive]

@@ -1544,3 +1544,138 @@ def test_quarantine_disabled_by_default():
         assert sup.health()["quarantined"] == 0
     finally:
         sup.shutdown()
+
+
+# ------------------------------- multi-tenant attribution (ISSUE 18)
+
+
+class QosManualInner(ManualInner):
+    """ManualInner that understands the tenant/qos axis: the supervisor
+    forwards the kwargs only to inners that advertise `supports_qos`, so
+    this subclass observes the attribution while the plain ManualInner
+    doubles as the legacy-gating check."""
+
+    supports_qos = True
+
+    def submit(self, ids, max_new_tokens=256, sampling=None, seed=0,
+               on_token=None, constraint=None, deadline_s=None,
+               tenant="", qos=""):
+        fut = super().submit(ids, max_new_tokens=max_new_tokens,
+                             sampling=sampling, seed=seed,
+                             on_token=on_token, constraint=constraint,
+                             deadline_s=deadline_s)
+        self.submitted[-1]["tenant"] = tenant
+        self.submitted[-1]["qos"] = qos
+        return fut
+
+
+class QosFactory(Factory):
+    def __call__(self):
+        inner = QosManualInner()
+        self.instances.append(inner)
+        return inner
+
+
+def make_qos_sup(max_restarts=3, **kw):
+    fac = QosFactory()
+    sup = SupervisedScheduler(
+        fac, max_restarts=max_restarts,
+        restart_policy=RetryPolicy(max_attempts=max_restarts + 1,
+                                   base_delay_s=0.01, max_delay_s=0.05),
+        rng=random.Random(0), sleep=lambda s: None, **kw,
+    )
+    return sup, fac
+
+
+def test_spill_recover_preserves_tenant_attribution(tmp_path):
+    """ISSUE-18 satellite: a labeled keyed request that spills on drain
+    carries its tenant/qos into the JSONL record, and recover() in the
+    next process resubmits WITH the attribution — the retried request
+    bills to the same tenant and keeps its prefix namespace."""
+    spill = str(tmp_path / "journal.jsonl")
+    sup, fac = make_qos_sup(spill_path=spill)
+    sup.start()
+    assert sup.supports_qos  # passthrough reflects the aware inner
+    pend = sup.submit([2, 3], max_new_tokens=5, idempotency_key="b",
+                      deadline_s=60.0, tenant="acme", qos="batch")
+    bare = sup.submit([4], idempotency_key="c")
+    inner = fac.instances[0]
+    assert inner.submitted[0]["tenant"] == "acme"
+    assert inner.submitted[0]["qos"] == "batch"
+    assert inner.submitted[1]["tenant"] == ""  # unlabeled stays unlabeled
+    report = sup.drain(deadline_s=0.2)
+    assert report["spilled"] == 2
+    with pytest.raises(Draining):
+        pend.result(timeout=5)
+    with pytest.raises(Draining):
+        bare.result(timeout=5)
+    by_key = {r["idempotency_key"]: r
+              for r in (json.loads(line) for line in open(spill))}
+    assert by_key["b"]["tenant"] == "acme" and by_key["b"]["qos"] == "batch"
+    # Unlabeled entries spill WITHOUT the keys (single-tenant wire shape).
+    assert "tenant" not in by_key["c"] and "qos" not in by_key["c"]
+
+    # Next process: recovery resubmits with the attribution intact.
+    sup2, fac2 = make_qos_sup(spill_path=spill)
+    sup2.start()
+    assert sup2.recover() == 2
+    inner2 = fac2.instances[0]
+    recs = {tuple(r["ids"]): r for r in inner2.submitted}
+    assert recs[(2, 3)]["tenant"] == "acme" and recs[(2, 3)]["qos"] == "batch"
+    assert recs[(4,)]["tenant"] == "" and recs[(4,)]["qos"] == ""
+    sup2.shutdown()
+
+
+def test_recover_labeled_spill_into_legacy_inner_drops_attribution(tmp_path):
+    """A spill written by a QoS-aware fleet must still recover on a
+    legacy inner (rollback path): the supervisor gates the kwargs on
+    `supports_qos`, so the qos-blind ManualInner — whose submit would
+    TypeError on unexpected kwargs — regenerates the work unlabeled
+    instead of crashing the recovery."""
+    spill = str(tmp_path / "journal.jsonl")
+    sup, fac = make_qos_sup(spill_path=spill)
+    sup.start()
+    sup.submit([2, 3], idempotency_key="b", tenant="acme", qos="batch")
+    sup.drain(deadline_s=0.2)
+    sup2, fac2, _ = make_sup(spill_path=spill)  # plain ManualInner fleet
+    sup2.start()
+    assert not sup2.supports_qos
+    assert sup2.recover() == 1
+    inner2 = fac2.instances[0]
+    assert inner2.submitted[0]["ids"] == [2, 3]
+    assert "tenant" not in inner2.submitted[0]  # kwargs never forwarded
+    inner2.finish(0, [7])
+    assert sup2.submit([2, 3], idempotency_key="b").result(timeout=5) == [7]
+    sup2.shutdown()
+
+
+@pytest.mark.chaos
+def test_quarantine_counter_gains_tenant_axis():
+    """ISSUE-18 satellite: when a tenant's poison request is quarantined,
+    qos_stats()['quarantined'] attributes it to THAT tenant — the noisy
+    neighbour is named, not just counted — and unlabeled poisons fall
+    under the 'default' bucket."""
+    from llm_based_apache_spark_optimization_tpu.serve.resilience import (
+        Quarantined,
+    )
+
+    sup = SupervisedScheduler(
+        _PoisonToy, max_restarts=10, max_entry_replays=2,
+        restart_policy=RetryPolicy(max_attempts=11, base_delay_s=0.001,
+                                   max_delay_s=0.01),
+        rng=random.Random(0),
+    ).start()
+    try:
+        # _PoisonToy is qos-blind: attribution still works because the
+        # quarantine bump reads the JOURNAL entry's tenant, not the inner.
+        assert not sup.supports_qos
+        assert sup.qos_stats() is None  # quiet fleet: no axis yet
+        poison = sup.submit([6, 6, 6], idempotency_key="poison",
+                            tenant="stormy", qos="batch")
+        with pytest.raises(Quarantined):
+            poison.result(timeout=30)
+        wait_for(lambda: sup.health()["state"] == "ready",
+                 msg="post-quarantine recovery")
+        assert sup.qos_stats()["quarantined"] == {"stormy": 1.0}
+    finally:
+        sup.shutdown()
