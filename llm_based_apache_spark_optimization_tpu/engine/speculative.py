@@ -392,13 +392,12 @@ def make_speculative_generate_fn(
         from .paged_kv import default_page_size
 
         page_size = int(kv_page_size or default_page_size())
-        # The verify window is T=D+1 > 1 and the ragged-paged kernel is a
-        # T=1 decode specialization: paged verify forwards always take the
-        # reference gather path (same pin the scheduler's spec_decode
-        # makes), even under a forced-pallas attention mode. A mesh shards
-        # the pool's KV-head axis over tp (constrain_cache's paged
-        # branch); page tables replicate.
-        decode = "xla"
+        # The verify window is T=D+1: since the ragged-paged kernel takes
+        # per-row query lengths, a resolved-pallas mode runs verify windows
+        # through the kernel grid; the auto resolution still lands on the
+        # reference gather path off-TPU. A mesh shards the pool's KV-head
+        # axis over tp (constrain_cache's paged branch); page tables
+        # replicate.
     return _make_speculative_generate_fn(
         cfg, max_new, stop_ids, mesh, draft_len, ngram,
         attn_impl or attention_impl(mesh),

@@ -2,6 +2,7 @@
 
 from .fixtures import (  # noqa: F401
     FOUR_QUERY_SUITE,
+    GRAMMAR_BREADTH_SUITE,
     SINGLE_COMPLEX_CASE,
     TAXI_DDL_SYSTEM,
     EvalCase,

@@ -24,7 +24,11 @@ from typing import Callable, Dict, List, Optional
 
 from ..ops.sampling import SamplingParams
 from ..serve.service import GenerationService
-from .fixtures import FOUR_QUERY_SUITE, TAXI_DDL_SYSTEM
+from .fixtures import (
+    FOUR_QUERY_SUITE,
+    GRAMMAR_BREADTH_SUITE,
+    TAXI_DDL_SYSTEM,
+)
 from .harness import ModelReport, evaluate_model, evaluate_model_batched
 from .spider import SPIDER_SMOKE
 
@@ -86,7 +90,8 @@ def sql_case_base():
     """The canonical SQL-workload case list every benchmark config draws
     from (and the oracle backend indexes — a drift between the two would
     falsely fail the instrument self-proof)."""
-    return [c.as_eval_case() for c in SPIDER_SMOKE] + list(FOUR_QUERY_SUITE)
+    return ([c.as_eval_case() for c in SPIDER_SMOKE]
+            + list(FOUR_QUERY_SUITE) + list(GRAMMAR_BREADTH_SUITE))
 
 
 def _sql_cases(n: int):

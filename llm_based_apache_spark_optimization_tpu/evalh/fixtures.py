@@ -84,6 +84,43 @@ SINGLE_COMPLEX_CASE = EvalCase(
     ),
 )
 
+#: Grammar-breadth suite (ISSUE 19 satellite, riding the ISSUE-16
+#: membership growth): NL→SQL pairs whose expected SQL exercises the
+#: `[NOT] IN (...)` and `[NOT] BETWEEN lo AND hi` predicates the
+#: constrained grammar admits — scored through the SAME harness as
+#: FOUR_QUERY_SUITE (grammar validity via the in-tree parser,
+#: executability + execution match via the sqlite taxi oracle), so
+#: every widened production has an end-to-end number, not just parser
+#: unit coverage. Kept separate from FOUR_QUERY_SUITE: that list IS the
+#: reference harness's behavioral contract and must not drift.
+GRAMMAR_BREADTH_SUITE: List[EvalCase] = [
+    EvalCase(
+        nl="Get all trips operated by vendor 1 or vendor 2.",
+        expected_sql="SELECT * FROM taxi WHERE VendorID IN (1, 2);",
+    ),
+    EvalCase(
+        nl="Count the trips between 1 and 5 miles long.",
+        expected_sql=(
+            "SELECT COUNT(*) FROM taxi "
+            "WHERE trip_distance BETWEEN 1.0 AND 5.0;"
+        ),
+    ),
+    EvalCase(
+        nl="Average fare for trips that were not solo rides.",
+        expected_sql=(
+            "SELECT AVG(fare_amount) FROM taxi "
+            "WHERE passenger_count NOT IN (1);"
+        ),
+    ),
+    EvalCase(
+        nl="Total fare by vendor excluding fares between 0 and 5 dollars.",
+        expected_sql=(
+            "SELECT VendorID, SUM(total_amount) AS Total_Fare FROM taxi "
+            "WHERE fare_amount NOT BETWEEN 0.0 AND 5.0 GROUP BY VendorID;"
+        ),
+    ),
+]
+
 FOUR_QUERY_SUITE: List[EvalCase] = [
     EvalCase(
         nl="Get all taxis with more than 2 passengers.",

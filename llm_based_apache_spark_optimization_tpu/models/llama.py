@@ -259,6 +259,10 @@ def forward(
     kv_lens: Optional[jnp.ndarray] = None,  # [B] i32 — live KV slots per row
                                             # (pallas impl: bounds HBM
                                             # streaming; 0 parks a row)
+    q_lens: Optional[jnp.ndarray] = None,   # [B] i32 — live query cols per
+                                            # row (paged ragged windows:
+                                            # dead cols write nothing and
+                                            # read zeros)
 ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
     """Run T tokens through the stack; returns (logits f32, cache').
 
@@ -320,16 +324,14 @@ def forward(
             "prefill fills bf16, then quantizes once — engine/generate.py)"
         )
     if paged_cache and not (
-        t <= _UNROLL_MAX_T and (impl == "xla" or (impl == "pallas"
-                                                  and t == 1))
+        t <= _UNROLL_MAX_T and impl in ("xla", "pallas")
     ):
         raise ValueError(
             "a paged KV cache serves the unrolled small-T path only "
-            f"(T <= {_UNROLL_MAX_T}; decode + verify windows): prefill "
-            "runs a contiguous transient/row cache and packs or scatters "
-            "its K/V into pool pages (engine/generate.py, "
-            "serve/scheduler.py). The pallas ragged-paged kernel is a "
-            "T=1 decode specialization; other T take the reference path."
+            f"(T <= {_UNROLL_MAX_T}; decode, verify windows, and mixed "
+            "ragged prefill+decode rounds): longer prefill runs a "
+            "contiguous transient/row cache and packs or scatters its K/V "
+            "into pool pages (engine/generate.py, serve/scheduler.py)."
         )
     mask = (
         attention_mask(positions, kv_size, cfg.sliding_window)
@@ -511,7 +513,7 @@ def forward(
                             fused_page_write_quantized(
                                 new_cache["kp"], new_cache["kps"],
                                 new_cache["vp"], new_cache["vps"],
-                                k, v, positions, ptab, l)
+                                k, v, positions, ptab, l, q_lens=q_lens)
                     else:
                         from ..ops.pallas import (
                             paged_write_reference_quantized,
@@ -522,22 +524,23 @@ def forward(
                             paged_write_reference_quantized(
                                 new_cache["kp"], new_cache["kps"],
                                 new_cache["vp"], new_cache["vps"],
-                                k, v, positions, ptab, l)
+                                k, v, positions, ptab, l, q_lens)
                 else:
                     if use_write_kernel:
                         from ..ops.pallas import fused_page_write
 
                         new_cache["kp"], new_cache["vp"] = fused_page_write(
                             new_cache["kp"], new_cache["vp"], k, v,
-                            positions, ptab, l)
+                            positions, ptab, l, q_lens=q_lens)
                     else:
                         from ..ops.pallas import paged_write_reference
 
                         new_cache["kp"] = paged_write_reference(
-                            new_cache["kp"], k, positions, ptab, l)
+                            new_cache["kp"], k, positions, ptab, l, q_lens)
                         new_cache["vp"] = paged_write_reference(
-                            new_cache["vp"], v, positions, ptab, l)
-                if impl == "pallas":  # T == 1 (validated above)
+                            new_cache["vp"], v, positions, ptab, l, q_lens)
+                if impl == "pallas":  # ragged windows (T·G bound validated
+                                      # in the kernel wrapper)
                     if quant_paged:
                         from ..ops.pallas import (
                             ragged_paged_attention_quantized,
@@ -549,14 +552,14 @@ def forward(
                                 mesh, q, new_cache["kp"][l],
                                 new_cache["kps"][l], new_cache["vp"][l],
                                 new_cache["vps"][l], ptab, positions,
-                                cfg.sliding_window, kv_lens,
+                                cfg.sliding_window, kv_lens, q_lens,
                             )
                         else:
                             attn = ragged_paged_attention_quantized(
                                 q, new_cache["kp"][l], new_cache["kps"][l],
                                 new_cache["vp"][l], new_cache["vps"][l],
                                 ptab, positions, cfg.sliding_window,
-                                kv_lens,
+                                kv_lens, q_lens,
                             )
                     else:
                         from ..ops.pallas import (
@@ -568,13 +571,13 @@ def forward(
                             attn = sharded_ragged_paged_attention(
                                 mesh, q, new_cache["kp"][l],
                                 new_cache["vp"][l], ptab, positions,
-                                cfg.sliding_window, kv_lens,
+                                cfg.sliding_window, kv_lens, q_lens,
                             )
                         else:
                             attn = ragged_paged_attention(
                                 q, new_cache["kp"][l], new_cache["vp"][l],
                                 ptab, positions, cfg.sliding_window,
-                                kv_lens,
+                                kv_lens, q_lens,
                             )
                 elif quant_paged:
                     from ..ops.pallas import gather_page_scales, gather_pages

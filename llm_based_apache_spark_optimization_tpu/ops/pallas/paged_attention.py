@@ -1,4 +1,4 @@
-"""Ragged paged attention (decode) over the shared KV page pool.
+"""Ragged paged attention over the shared KV page pool.
 
 The paged twin of `attention.py`'s K-folded flash decode kernel: K/V live in
 a shared pool `[P, K, page, H]` (engine/paged_kv.py) and each batch row owns
@@ -14,6 +14,16 @@ Kernel design:
   axis is folded into the cell exactly like the contiguous decode kernel —
   a pool page already holds all K heads contiguously, so a page IS the
   natural DMA block.
+- RAGGED QUERY WINDOWS (ISSUE 19): the query block folds BOTH the GQA
+  group axis and the T query-window axis into one row axis (GT = G·T —
+  identical to the decode layout at T=1), and per-row query lengths
+  `q_lens[b]` ride SCALAR PREFETCH beside `kv_lens` and the page table.
+  Window columns at or past a row's q_len get their query position masked
+  to -1 inside the kernel, so the causal mask hides every KV position,
+  their softmax weight is zero, and the finalize step emits exact zeros —
+  one grid therefore serves T=1 decode rows, T=D+1 speculative verify
+  windows, and multi-token prefill chunks in the SAME launch, which is
+  what lets the scheduler run mixed prefill+decode rounds as one program.
 - The page table rides SCALAR PREFETCH: the K/V BlockSpec index maps read
   `table[b, i]` to pick which POOL page cell (b, i) streams — the gather
   happens in the DMA engine's addressing, never as a materialized
@@ -31,10 +41,12 @@ Kernel design:
   the parity tests against `paged_attention_reference`).
 
 `paged_attention_reference` is the always-correct XLA path (gather the
-row's pages into a contiguous view, run the einsum attention): the golden
-in parity tests, the CPU/interpret fallback in `models/llama.forward`, and
-the T>1 path (speculative verify windows) — the kernel itself is a T=1
-decode specialization, like its contiguous sibling.
+row's pages into a contiguous view, run the einsum attention) with the
+kernel's exact ragged contract (`q_lens` columns past a row's window
+return zeros): the golden in parity tests and the CPU/interpret fallback
+in `models/llama.forward`. The kernel serves any window with
+T·G <= `_MAX_QROWS` folded rows (the folded query block must stay
+VMEM-resident); larger windows take the reference.
 """
 
 from __future__ import annotations
@@ -50,9 +62,14 @@ from jax.experimental.pallas import tpu as pltpu
 from ..common import NEG_INF, shard_map as _shard_map
 from .attention import _CompilerParams, _flash_block_update, _LANES
 
+# Upper bound on folded query rows (T·G) the kernel serves: the whole
+# folded query block plus its f32 accumulators must stay VMEM-resident
+# across the page sweep. Windows above it take the XLA reference.
+_MAX_QROWS = 512
+
 
 def _make_paged_decode_kernel(dequant):
-    """Paged decode kernel factory (grid = (B, NP), page axis innermost).
+    """Ragged paged kernel factory (grid = (B, NP), page axis innermost).
     `dequant(stream_refs, dtype) -> (k, v)` turns the DMA'd pool-page
     tiles into compute tiles — identity for bf16 pools, VMEM
     dequantization for int8 values + per-position scales — so the
@@ -61,6 +78,7 @@ def _make_paged_decode_kernel(dequant):
 
     def kernel(
         kvlen_ref,  # [B] i32 SMEM (scalar prefetch) — live KV tokens/row
+        qlen_ref,   # [B] i32 SMEM (scalar prefetch) — live query cols/row
         table_ref,  # [B, NP] i32 SMEM (scalar prefetch) — page tables
         qpos_ref,   # [1, 1, GT] i32
         q_ref,      # [1, K, GT, H]
@@ -69,11 +87,13 @@ def _make_paged_decode_kernel(dequant):
         scale: float,
         sliding_window: Optional[int],
         kv_len: int,
+        window: int,
     ):
         *stream_refs, o_ref, m_ref, l_ref, acc_ref = rest
         i = pl.program_id(1)
         ps = stream_refs[0].shape[2]
         kvl = kvlen_ref[pl.program_id(0)]
+        ql = qlen_ref[pl.program_id(0)]
 
         @pl.when(i == 0)
         def _init():
@@ -81,10 +101,17 @@ def _make_paged_decode_kernel(dequant):
             l_ref[:] = jnp.zeros_like(l_ref)
             acc_ref[:] = jnp.zeros_like(acc_ref)
 
-        qp_row = qpos_ref[0, 0]       # [GT]
+        # Folded row r = gi*window + ti, so r % window recovers the window
+        # column. Columns at or past this row's q_len get position -1: the
+        # causal mask then hides every KV position, l stays 0, and finalize
+        # emits exact zeros — dead rows cost no extra pages because the
+        # max-based skip below sees their position as -1, not a sentinel.
+        gt = qpos_ref.shape[2]
+        col = jax.lax.broadcasted_iota(jnp.int32, (gt, 1), 0)[:, 0] % window
+        qp_row = jnp.where(col < ql, qpos_ref[0, 0], -1)  # [GT]
 
         # Same skip rule as the contiguous decode kernel: pages whose
-        # first logical position exceeds every query position — or the
+        # first logical position exceeds every LIVE query position — or the
         # row's live length — contribute nothing (their DMA was already
         # elided by the clamped index map).
         @pl.when((i * ps <= jnp.max(qp_row)) & (i * ps < kvl))
@@ -130,31 +157,43 @@ _paged_decode_kernel_q8 = _make_paged_decode_kernel(_dequant_page_streams)
 
 
 def _run_paged_grid(kernel, q, streams, page_table, q_positions,
-                    sliding_window, kv_lens, interpret):
-    """The paged decode pipeline shared by the bf16 and int8 kernels:
+                    sliding_window, kv_lens, q_lens, interpret):
+    """The ragged paged pipeline shared by the bf16 and int8 kernels:
     grid (B, NP) with the page table in SCALAR PREFETCH — every stream's
     BlockSpec index map translates the kv_lens-clamped logical page
     through the table, so the gather happens in the DMA engine's
-    addressing for values and scales alike. `streams` is a list of
+    addressing for values and scales alike. The T query-window axis folds
+    into the GQA group axis (GT = G·T — identity at T=1, the decode
+    layout), and per-row `q_lens` ride prefetch so dead window columns
+    zero out in-kernel. `streams` is a list of
     (array [P, K, PS, ...tail], tail_block_shape) pairs — (h,) for K/V
     value pools, (1,) for per-position scale columns."""
     b, t, n, h = q.shape
     num_pages, kh, ps = streams[0][0].shape[:3]
     g = n // kh
+    gt = g * t
     np_tab = page_table.shape[1]
     s_virt = np_tab * ps
 
     if kv_lens is None:
         kv_lens = jnp.max(q_positions, axis=1) + 1
     kv_lens = jnp.clip(kv_lens.astype(jnp.int32), 0, s_virt)
+    if q_lens is None:
+        q_lens = jnp.full((b,), t, jnp.int32)
+    q_lens = jnp.clip(q_lens.astype(jnp.int32), 0, t)
     table = jnp.clip(page_table.astype(jnp.int32), 0, num_pages - 1)
 
-    # [B, 1, N, H] -> [B, K, G, H] (GT = G at T=1), like the contiguous
-    # decode grid.
-    q5 = q.reshape(b, kh, g, h)
+    # [B, T, N, H] -> [B, K, G·T, H]: fold the window axis under the GQA
+    # group axis so folded row r = gi*t + ti (identity at T=1 — the
+    # contiguous decode grid's layout).
+    q5 = (
+        q.reshape(b, t, kh, g, h)
+        .transpose(0, 2, 3, 1, 4)
+        .reshape(b, kh, gt, h)
+    )
     qpos = jnp.tile(q_positions.astype(jnp.int32), (1, g))[:, None, :]
 
-    def kv_map(bi, i, kvl, tab):
+    def kv_map(bi, i, kvl, ql, tab):
         # Clamp at the row's last LIVE logical page, then translate through
         # its table: steps past the live region re-map the same pool page
         # and the DMA is elided — the bandwidth saving, not just a compute
@@ -163,80 +202,99 @@ def _run_paged_grid(kernel, q, streams, page_table, q_positions,
         return (tab[bi, jnp.minimum(i, last)], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, np_tab),
         in_specs=[
-            pl.BlockSpec((1, 1, g), lambda bi, i, kvl, tab: (bi, 0, 0)),
-            pl.BlockSpec((1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)),
+            pl.BlockSpec((1, 1, gt), lambda bi, i, kvl, ql, tab: (bi, 0, 0)),
+            pl.BlockSpec(
+                (1, kh, gt, h), lambda bi, i, kvl, ql, tab: (bi, 0, 0, 0)
+            ),
         ] + [
             pl.BlockSpec((1, kh, ps) + tail, kv_map)
             for _, tail in streams
         ],
         out_specs=pl.BlockSpec(
-            (1, kh, g, h), lambda bi, i, kvl, tab: (bi, 0, 0, 0)
+            (1, kh, gt, h), lambda bi, i, kvl, ql, tab: (bi, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((kh, g, _LANES), jnp.float32),
-            pltpu.VMEM((kh, g, _LANES), jnp.float32),
-            pltpu.VMEM((kh, g, h), jnp.float32),
+            pltpu.VMEM((kh, gt, _LANES), jnp.float32),
+            pltpu.VMEM((kh, gt, _LANES), jnp.float32),
+            pltpu.VMEM((kh, gt, h), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         functools.partial(
             kernel, scale=h**-0.5,
-            sliding_window=sliding_window, kv_len=s_virt,
+            sliding_window=sliding_window, kv_len=s_virt, window=t,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, g, h), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kh, gt, h), q.dtype),
         # Batch rows are independent (megacore splits them); the page axis
         # carries the online-softmax accumulators in order on one core.
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(kv_lens, table, qpos, q5, *[arr for arr, _ in streams])
-    return out.reshape(b, kh, g, 1, h).transpose(0, 3, 1, 2, 4).reshape(
-        b, 1, n, h
+    )(kv_lens, q_lens, table, qpos, q5, *[arr for arr, _ in streams])
+    return out.reshape(b, kh, g, t, h).transpose(0, 3, 1, 2, 4).reshape(
+        b, t, n, h
     )
+
+
+def _validate_window(q, kh, page_size, interpret, *, quantized=False):
+    """One guard for both kernel variants (bf16 and int8): reject query
+    windows whose folded row count T·G exceeds `_MAX_QROWS` with ONE
+    consistent message naming the always-correct fallback, and resolve +
+    check the TPU sublane-alignment requirement. Returns the resolved
+    `interpret` flag."""
+    b, t, n, h = q.shape
+    g = n // max(kh, 1)
+    suffix = "_quantized" if quantized else ""
+    if t < 1 or t * g > _MAX_QROWS:
+        raise ValueError(
+            f"ragged_paged_attention{suffix} serves query windows with "
+            f"1 <= T*G <= {_MAX_QROWS} folded rows, got T={t} (G={g}); "
+            f"larger windows take paged_attention_reference{suffix}"
+        )
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if not interpret and page_size % 8:
+        raise ValueError(
+            f"pool pages must be sublane-aligned (page size multiple of 8) "
+            f"on TPU, got {page_size}"
+        )
+    return interpret
 
 
 @functools.partial(
     jax.jit, static_argnames=("sliding_window", "interpret")
 )
 def ragged_paged_attention(
-    q: jnp.ndarray,            # [B, 1, N, H] — decode only (T == 1)
+    q: jnp.ndarray,            # [B, T, N, H] — ragged query windows
     k_pool: jnp.ndarray,       # [P, K, PS, H] — one layer's page pool
     v_pool: jnp.ndarray,       # [P, K, PS, H]
     page_table: jnp.ndarray,   # [B, NP] i32 — pool page per logical page
-    q_positions: jnp.ndarray,  # [B, 1] i32
+    q_positions: jnp.ndarray,  # [B, T] i32
     sliding_window: Optional[int] = None,
     kv_lens: Optional[jnp.ndarray] = None,  # [B] i32 — live tokens per row
+    q_lens: Optional[jnp.ndarray] = None,   # [B] i32 — live query cols/row
     *,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
-    """Flash decode attention reading K/V through per-row page tables.
+    """Ragged flash attention reading K/V through per-row page tables.
 
-    Returns [B, 1, N, H] in q's dtype. Output depends only on the first
-    `kv_lens[b]` logical positions of each row (defaults to max(position)+1);
-    kv_lens=0 parks a row (zero output, one elided-DMA sweep)."""
-    b, t, n, h = q.shape
-    if t != 1:
-        raise ValueError(
-            f"ragged paged kernel is decode-only (T=1), got T={t}; verify "
-            f"windows take paged_attention_reference"
-        )
-    ps = k_pool.shape[2]
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    if not interpret and ps % 8:
-        raise ValueError(
-            f"pool pages must be sublane-aligned (page size multiple of 8) "
-            f"on TPU, got {ps}"
-        )
+    Returns [B, T, N, H] in q's dtype. Output depends only on the first
+    `kv_lens[b]` logical positions of each row (defaults to max(position)+1;
+    kv_lens=0 parks a row — zero output, one elided-DMA sweep) and the
+    first `q_lens[b]` window columns (defaults to T; columns past a row's
+    q_len return exact zeros). One launch therefore serves T=1 decode
+    rows, speculative verify windows, and prefill chunks together."""
+    kh = k_pool.shape[1]
+    interpret = _validate_window(q, kh, k_pool.shape[2], interpret)
     h = q.shape[3]
     return _run_paged_grid(
         _paged_decode_kernel, q, [(k_pool, (h,)), (v_pool, (h,))],
-        page_table, q_positions, sliding_window, kv_lens, interpret,
+        page_table, q_positions, sliding_window, kv_lens, q_lens, interpret,
     )
 
 
@@ -244,15 +302,16 @@ def ragged_paged_attention(
     jax.jit, static_argnames=("sliding_window", "interpret")
 )
 def ragged_paged_attention_quantized(
-    q: jnp.ndarray,            # [B, 1, N, H] — decode only (T == 1)
+    q: jnp.ndarray,            # [B, T, N, H] — ragged query windows
     k_pool: jnp.ndarray,       # [P, K, PS, H] int8 — one layer's page pool
     k_scale: jnp.ndarray,      # [P, K, PS] f32 — per-position K scales
     v_pool: jnp.ndarray,       # [P, K, PS, H] int8
     v_scale: jnp.ndarray,      # [P, K, PS] f32
     page_table: jnp.ndarray,   # [B, NP] i32
-    q_positions: jnp.ndarray,  # [B, 1] i32
+    q_positions: jnp.ndarray,  # [B, T] i32
     sliding_window: Optional[int] = None,
     kv_lens: Optional[jnp.ndarray] = None,  # [B] i32
+    q_lens: Optional[jnp.ndarray] = None,   # [B] i32
     *,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
@@ -262,26 +321,17 @@ def ragged_paged_attention_quantized(
     VMEM tiles inside the kernel — int8 streaming and per-row ragged
     bounding stacked, the paged twin of
     `attention.flash_gqa_attention_quantized`."""
-    b, t, n, h = q.shape
-    if t != 1:
-        raise ValueError(
-            f"quantized ragged paged kernel is decode-only (T=1), got "
-            f"T={t}; verify windows take paged_attention_reference_quantized"
-        )
-    ps = k_pool.shape[2]
-    if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
-    if not interpret and ps % 8:
-        raise ValueError(
-            f"pool pages must be sublane-aligned (page size multiple of 8) "
-            f"on TPU, got {ps}"
-        )
+    kh = k_pool.shape[1]
+    interpret = _validate_window(
+        q, kh, k_pool.shape[2], interpret, quantized=True
+    )
+    h = q.shape[3]
     ks4 = k_scale.astype(jnp.float32)[..., None]  # [P, K, PS, 1]
     vs4 = v_scale.astype(jnp.float32)[..., None]
     return _run_paged_grid(
         _paged_decode_kernel_q8, q,
         [(k_pool, (h,)), (ks4, (1,)), (v_pool, (h,)), (vs4, (1,))],
-        page_table, q_positions, sliding_window, kv_lens, interpret,
+        page_table, q_positions, sliding_window, kv_lens, q_lens, interpret,
     )
 
 
@@ -290,16 +340,18 @@ def sharded_ragged_paged_attention(
     q, k_pool, v_pool, page_table, q_positions,
     sliding_window: Optional[int] = None,
     kv_lens: Optional[jnp.ndarray] = None,
+    q_lens: Optional[jnp.ndarray] = None,
     *,
     interpret: Optional[bool] = None,
 ):
     """`ragged_paged_attention` under a tp mesh via `jax.shard_map`: the
     pool shards its KV-HEAD axis over tp (parallel/sharding — every page
     holds all heads, each device holds its heads' slice of every page),
-    page tables and positions replicate, and the per-device body is the
-    single-device kernel on local shapes — no collective inside, exactly
-    like `attention.sharded_flash_gqa_attention`. The batch axis rides
-    "dp" (dp=1 for the scheduler, whose slot axis never shards)."""
+    page tables, positions, and per-row lengths replicate, and the
+    per-device body is the single-device kernel on local shapes — no
+    collective inside, exactly like
+    `attention.sharded_flash_gqa_attention`. The batch axis rides "dp"
+    (dp=1 for the scheduler, whose slot axis never shards)."""
     from jax.sharding import PartitionSpec as P
 
     body = functools.partial(
@@ -308,15 +360,19 @@ def sharded_ragged_paged_attention(
     )
     if kv_lens is None:
         kv_lens = jnp.max(q_positions.astype(jnp.int32), axis=1) + 1
+    if q_lens is None:
+        q_lens = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
     return _shard_map(
-        lambda q_, k_, v_, t_, p_, l_: body(q_, k_, v_, t_, p_, kv_lens=l_),
+        lambda q_, k_, v_, t_, p_, l_, w_: body(
+            q_, k_, v_, t_, p_, kv_lens=l_, q_lens=w_
+        ),
         mesh=mesh,
         in_specs=(P("dp", None, "tp", None), P(None, "tp", None, None),
                   P(None, "tp", None, None), P("dp", None), P("dp", None),
-                  P("dp")),
+                  P("dp"), P("dp")),
         out_specs=P("dp", None, "tp", None),
         check_vma=False,
-    )(q, k_pool, v_pool, page_table, q_positions, kv_lens)
+    )(q, k_pool, v_pool, page_table, q_positions, kv_lens, q_lens)
 
 
 def sharded_ragged_paged_attention_quantized(
@@ -324,6 +380,7 @@ def sharded_ragged_paged_attention_quantized(
     q, k_pool, k_scale, v_pool, v_scale, page_table, q_positions,
     sliding_window: Optional[int] = None,
     kv_lens: Optional[jnp.ndarray] = None,
+    q_lens: Optional[jnp.ndarray] = None,
     *,
     interpret: Optional[bool] = None,
 ):
@@ -337,18 +394,21 @@ def sharded_ragged_paged_attention_quantized(
     )
     if kv_lens is None:
         kv_lens = jnp.max(q_positions.astype(jnp.int32), axis=1) + 1
+    if q_lens is None:
+        q_lens = jnp.full((q.shape[0],), q.shape[1], jnp.int32)
     return _shard_map(
-        lambda q_, k_, ks_, v_, vs_, t_, p_, l_: body(
-            q_, k_, ks_, v_, vs_, t_, p_, kv_lens=l_
+        lambda q_, k_, ks_, v_, vs_, t_, p_, l_, w_: body(
+            q_, k_, ks_, v_, vs_, t_, p_, kv_lens=l_, q_lens=w_
         ),
         mesh=mesh,
         in_specs=(P("dp", None, "tp", None), P(None, "tp", None, None),
                   P(None, "tp", None), P(None, "tp", None, None),
                   P(None, "tp", None), P("dp", None), P("dp", None),
-                  P("dp")),
+                  P("dp"), P("dp")),
         out_specs=P("dp", None, "tp", None),
         check_vma=False,
-    )(q, k_pool, k_scale, v_pool, v_scale, page_table, q_positions, kv_lens)
+    )(q, k_pool, k_scale, v_pool, v_scale, page_table, q_positions,
+      kv_lens, q_lens)
 
 
 def gather_pages(
@@ -388,6 +448,18 @@ def _mask_kv_lens(mask, kv_lens, s_virt):
     )[:, None, None])
 
 
+def _zero_dead_qcols(out, q_lens):
+    """The kernel's ragged-window contract for the XLA path: window
+    columns at or past a row's q_len return exact zeros (a dead column's
+    all-masked softmax would otherwise emit a uniform average)."""
+    b, t = out.shape[:2]
+    live = (
+        jnp.arange(t, dtype=jnp.int32)[None, :]
+        < jnp.clip(q_lens.astype(jnp.int32), 0, t)[:, None]
+    )
+    return jnp.where(live[:, :, None, None], out, jnp.zeros_like(out))
+
+
 def paged_attention_reference(
     q: jnp.ndarray,            # [B, T, N, H]
     k_pool: jnp.ndarray,       # [P, K, PS, H]
@@ -396,9 +468,11 @@ def paged_attention_reference(
     q_positions: jnp.ndarray,  # [B, T] i32
     sliding_window: Optional[int] = None,
     kv_lens: Optional[jnp.ndarray] = None,  # [B] i32
+    q_lens: Optional[jnp.ndarray] = None,   # [B] i32
 ) -> jnp.ndarray:
-    """XLA reference with the kernel's exact contract (golden in tests;
-    serves any T, so speculative verify windows run through it)."""
+    """XLA reference with the kernel's exact ragged contract (golden in
+    tests; serves any T and any per-row window, so oversized windows and
+    CPU runs take this path)."""
     from ..attention import attention_mask, gqa_attention
 
     k_full = gather_pages(k_pool, page_table)
@@ -407,13 +481,16 @@ def paged_attention_reference(
     mask = attention_mask(q_positions, s_virt, sliding_window)
     if kv_lens is not None:
         mask = _mask_kv_lens(mask, kv_lens, s_virt)
+    out = gqa_attention(q, k_full, v_full, mask)
+    if kv_lens is not None:
         # Fully-parked rows (kv_lens=0) return zeros like the kernel, not
         # a uniform softmax over NEG_INF scores.
-        out = gqa_attention(q, k_full, v_full, mask)
-        return jnp.where(
+        out = jnp.where(
             (kv_lens > 0)[:, None, None, None], out, jnp.zeros_like(out)
         )
-    return gqa_attention(q, k_full, v_full, mask)
+    if q_lens is not None:
+        out = _zero_dead_qcols(out, q_lens)
+    return out
 
 
 def paged_attention_reference_quantized(
@@ -426,12 +503,13 @@ def paged_attention_reference_quantized(
     q_positions: jnp.ndarray,  # [B, T] i32
     sliding_window: Optional[int] = None,
     kv_lens: Optional[jnp.ndarray] = None,  # [B] i32
+    q_lens: Optional[jnp.ndarray] = None,   # [B] i32
 ) -> jnp.ndarray:
     """XLA reference over the int8 pool: gather value pages AND scale
     columns through the table, then run the int8-streaming einsum
     attention (ops/attention.gqa_attention_quantized — the contiguous
-    int8 cache's exact math). Serves any T, so quantized verify windows
-    and CPU decode run through it."""
+    int8 cache's exact math). Serves any T and any per-row window, so
+    quantized oversized windows and CPU decode run through it."""
     from ..attention import attention_mask, gqa_attention_quantized
 
     k_full = gather_pages(k_pool, page_table)
@@ -442,9 +520,11 @@ def paged_attention_reference_quantized(
     mask = attention_mask(q_positions, s_virt, sliding_window)
     if kv_lens is not None:
         mask = _mask_kv_lens(mask, kv_lens, s_virt)
-        out = gqa_attention_quantized(q, k_full, ks_full, v_full, vs_full,
-                                      mask)
-        return jnp.where(
+    out = gqa_attention_quantized(q, k_full, ks_full, v_full, vs_full, mask)
+    if kv_lens is not None:
+        out = jnp.where(
             (kv_lens > 0)[:, None, None, None], out, jnp.zeros_like(out)
         )
-    return gqa_attention_quantized(q, k_full, ks_full, v_full, vs_full, mask)
+    if q_lens is not None:
+        out = _zero_dead_qcols(out, q_lens)
+    return out

@@ -56,11 +56,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _coords(positions, page_table, page_size, num_pages):
+def _coords(positions, page_table, page_size, num_pages, q_lens=None):
     """(pages [B, T], offs [B, T]): pool page + in-page offset per written
     position. Positions past the virtual row or through an unmapped table
     entry get page == num_pages — the kernel's skip flag and the XLA
-    scatter's dropped-OOB index, one definition shared by both paths."""
+    scatter's dropped-OOB index, one definition shared by both paths.
+    `q_lens` [B] (the ragged-window contract shared with the attention
+    kernel) additionally drops window columns at or past a row's live
+    query length, so mixed prefill+decode launches can pad every row to
+    one T without phantom writes."""
     pos = positions.astype(jnp.int32)
     np_tab = page_table.shape[1]
     page_idx = pos // page_size
@@ -74,6 +78,13 @@ def _coords(positions, page_table, page_size, num_pages):
     pages = jnp.where(
         (page_idx >= 0) & (page_idx < np_tab), pages, jnp.int32(num_pages)
     )
+    if q_lens is not None:
+        t = pos.shape[1]
+        live = (
+            jnp.arange(t, dtype=jnp.int32)[None, :]
+            < jnp.clip(q_lens.astype(jnp.int32), 0, t)[:, None]
+        )
+        pages = jnp.where(live, pages, jnp.int32(num_pages))
     offs = pos % page_size
     return pages, offs
 
@@ -151,6 +162,7 @@ def fused_page_write(
     page_table: jnp.ndarray,  # [B, NP] i32
     layer: int,
     *,
+    q_lens: Optional[jnp.ndarray] = None,  # [B] i32 live cols per row
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Write K and V slivers through per-row page tables at a static layer
@@ -161,7 +173,7 @@ def fused_page_write(
     ps = kp.shape[3]
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    pages, offs = _coords(positions, page_table, ps, num_pages)
+    pages, offs = _coords(positions, page_table, ps, num_pages, q_lens)
     b, t = pages.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -201,6 +213,7 @@ def fused_page_write_quantized(
     page_table: jnp.ndarray,  # [B, NP] i32
     layer: int,
     *,
+    q_lens: Optional[jnp.ndarray] = None,  # [B] i32 live cols per row
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """The int8-quantizing fused write: absmax-over-H scales computed on
@@ -212,7 +225,7 @@ def fused_page_write_quantized(
     kh, h = kp.shape[2], kp.shape[4]
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
-    pages, offs = _coords(positions, page_table, ps, num_pages)
+    pages, offs = _coords(positions, page_table, ps, num_pages, q_lens)
     b, t = pages.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -251,14 +264,16 @@ def paged_write_reference(
     positions: jnp.ndarray,   # [B, T] i32
     page_table: jnp.ndarray,  # [B, NP] i32
     layer: int,
+    q_lens: Optional[jnp.ndarray] = None,  # [B] i32 live cols per row
 ) -> jnp.ndarray:
     """XLA golden for the value write (one K-or-V pool): a single scatter
-    through the table whose OOB indices drop — parked/padding rows and
-    past-the-row positions write nothing. This IS the pre-kernel write
-    path, verbatim, so the bf16 CPU serving path stays bit-identical."""
+    through the table whose OOB indices drop — parked/padding rows,
+    past-the-row positions, and (with `q_lens`) dead window columns write
+    nothing. This IS the pre-kernel write path, verbatim, so the bf16 CPU
+    serving path stays bit-identical."""
     num_pages = pool.shape[1]
     ps = pool.shape[3]
-    pages, offs = _coords(positions, page_table, ps, num_pages)
+    pages, offs = _coords(positions, page_table, ps, num_pages, q_lens)
     # Advanced indices at non-adjacent dims (pool page, in-page offset)
     # broadcast to the front: the update is [B, T, K, H] — exactly `new`.
     return pool.at[layer, pages, :, offs].set(new.astype(pool.dtype))
@@ -268,6 +283,7 @@ def paged_write_reference_quantized(
     kp: jnp.ndarray, kps: jnp.ndarray, vp: jnp.ndarray, vps: jnp.ndarray,
     k_new: jnp.ndarray, v_new: jnp.ndarray,
     positions: jnp.ndarray, page_table: jnp.ndarray, layer: int,
+    q_lens: Optional[jnp.ndarray] = None,  # [B] i32 live cols per row
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """XLA golden for the quantizing write: ops/quant.quantize_kv on the
     fresh slivers, then the value scatter plus its scale twin (the scale
@@ -276,7 +292,7 @@ def paged_write_reference_quantized(
 
     num_pages = kp.shape[1]
     ps = kp.shape[3]
-    pages, offs = _coords(positions, page_table, ps, num_pages)
+    pages, offs = _coords(positions, page_table, ps, num_pages, q_lens)
     kq, vq = quantize_kv(k_new), quantize_kv(v_new)
     return (
         kp.at[layer, pages, :, offs].set(kq["q8"]),

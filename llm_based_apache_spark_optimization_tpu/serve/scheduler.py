@@ -639,6 +639,14 @@ class ContinuousBatchingScheduler:
         kv_watermark_low: Optional[float] = None,
         kv_watermark_high: Optional[float] = None,
         phase_role: str = "mixed",
+        # Unified ragged prefill+decode (ISSUE 19): admit prefill chunks
+        # and decode slots into ONE compiled mixed-round launch (per-slot
+        # query-length vector; prefill rows scatter their chunk, decode
+        # rows emit tokens), retiring the separate prefill pass from the
+        # loop's hot path. None = read LSOT_RAGGED (default off — the
+        # alternating scheduler, bit for bit). Paged-only, mixed-role
+        # only.
+        ragged: Optional[bool] = None,
         # Multi-model serving (ISSUE 16): which registered checkpoint
         # this replica holds. "" (the default) is the single-model
         # fleet, bit for bit — the pool only routes on model when a
@@ -1073,8 +1081,10 @@ class ContinuousBatchingScheduler:
         # In-flight rounds awaiting harvest: (issue-time slot->req list,
         # issue-time slot-epoch snapshot, toks device array, n_emit device
         # array or None, firsts list of (slot, req, first_tok device,
-        # epoch), issue wall stamp).
-        self._pending: "deque[Tuple[List[Optional[_Request]], List[int], jax.Array, object, list, float]]" = deque()
+        # epoch), issue wall stamp, mixed_meta — the unified ragged
+        # round's prefill-side attribution dict, None on alternating
+        # rounds).
+        self._pending: "deque[Tuple[List[Optional[_Request]], List[int], jax.Array, object, list, float, Optional[dict]]]" = deque()
         self._first_pending: list = []
         self._harvest_lag = 1  # rounds kept in flight before syncing
         (self._park_fn, self._ready_fn, self._retire_fn,
@@ -1082,6 +1092,40 @@ class ContinuousBatchingScheduler:
         if self._paged:
             (self._ptab_row_fn, self._copy_page_fn,
              self._restore_page_fn) = self._build_page_ops()
+        # Unified ragged prefill+decode (ISSUE 19): one compiled
+        # mixed-round program admits this round's prefill chunks and every
+        # decode slot into the SAME launch — forward takes a per-slot
+        # query-length vector (q_lens), prefill rows scatter their chunk
+        # through the page table while decode rows emit tokens, and the
+        # _loop hot path stops alternating a separate prefill pass with
+        # decode rounds. LSOT_RAGGED=0 (the default) keeps the alternating
+        # scheduler bit for bit.
+        if ragged is None:
+            ragged = os.environ.get("LSOT_RAGGED", "0").strip().lower() in (
+                "1", "true", "yes", "on"
+            )
+            if ragged and not (self._paged and self.phase_role == "mixed"):
+                # Env-driven opt-in degrades silently on replicas that
+                # can't serve it (contiguous layout, phase-split roles):
+                # one LSOT_RAGGED=1 environment may spawn heterogeneous
+                # fleets.
+                ragged = False
+        elif ragged and not (self._paged and self.phase_role == "mixed"):
+            raise ValueError(
+                "ragged mixed rounds need kv_layout='paged' (prefill rows "
+                "scatter chunks through page tables) and "
+                "phase_role='mixed' (a phase-split replica has no mixed "
+                "rounds to unify)"
+            )
+        self._ragged = bool(ragged)
+        if self._ragged:
+            from ..models.llama import _UNROLL_MAX_T
+
+            # Mixed rounds run prefill chunks through forward's unrolled
+            # paged path (one T for the whole batch), so chunks cap at the
+            # unroll bound instead of an arbitrary prompt_bucket.
+            self.prompt_bucket = min(self.prompt_bucket, _UNROLL_MAX_T)
+            self._mixed_fns: Dict[int, Callable] = {}
         # Prompt-chunk buckets: powers of two up to prompt_bucket, so a short
         # prompt pays a small forward instead of a full prompt_bucket one
         # (one compiled prefill program per bucket, built lazily).
@@ -2788,6 +2832,282 @@ class ContinuousBatchingScheduler:
 
         return spec_decode
 
+    def _build_mixed(self, t_bucket: int):
+        """One compiled MIXED round (LSOT_RAGGED=1, ISSUE 19): this
+        iteration's prompt chunks and the decode round ride a single
+        [S, t_bucket] ragged launch instead of alternating programs.
+        Prefill rows (is_pref) carry their whole chunk and scatter it
+        through their page tables; decode rows carry their current token
+        in column 0 with dead padding beyond — the per-row q_lens vector
+        routes dead columns' K/V writes to the sentinel page and (pallas)
+        zeroes their attention output, so neither class perturbs the
+        other. Step 0 is ONE ragged forward; chunk steps 1..chunk-1 reuse
+        _build_decode's T=1 step body verbatim under lax.scan (prefill
+        rows are inactive there: not yet armed, `active` gates every
+        advance). Sampling stays per-row deterministic: prefill rows
+        sample their first token at fold 0 under the grammar start
+        state's budget mask (== _build_prefill), decode rows sample chunk
+        token i at fold counts+i under their committed state (==
+        _build_decode) — so each request's token stream is identical to
+        the alternating control's; only round BOUNDARIES shift (a slot
+        finishing prefill here decodes starting next round)."""
+        cfg, mesh = self.cfg, self.mesh
+        impl, dimpl = self._impl, self._decode_impl
+        chunk = self.decode_chunk
+        pad_id = cfg.pad_id
+        nc = len(self._cache)
+        t = t_bucket
+        ps, np_tab = self._page_size, self._pages_per_slot
+        s_virt = np_tab * ps  # dead-col sentinel position (write drops)
+
+        @partial(jax.jit,
+                 donate_argnums=tuple(range(1, 3 + nc))
+                 + (8 + nc, 9 + nc, 10 + nc))
+        def mixed(params, *args):
+            cache = args[:nc]
+            (cur, pos, active, temps, topps, topks, seeds,
+             counts, cstates, crem) = args[nc:nc + 10]
+            (p_tokens, p_lengths, p_starts, is_pref, p_temps, p_topps,
+             p_topks, p_seeds, p_cinits, p_cbudgets) = args[nc + 10:nc + 20]
+            g_next, g_need = args[nc + 20:nc + 22]
+            ptab = args[nc + 22]
+            params = split_blocks(params)
+            col = jnp.arange(t, dtype=jnp.int32)[None, :]
+            tokens0 = jnp.where(
+                is_pref[:, None], p_tokens,
+                jnp.where(col == 0, cur[:, None], pad_id),
+            )
+            # Dead decode columns sit at the virtual-row position: their
+            # page lookup lands on the sentinel (write drops) and the
+            # causal mask over kv_lens keeps their garbage logits finite.
+            pos0 = jnp.where(
+                is_pref[:, None], p_starts[:, None] + col,
+                jnp.where(col == 0, pos[:, None], jnp.int32(s_virt)),
+            )
+            q_lens_v = jnp.where(is_pref, t, 1).astype(jnp.int32)
+            kv0 = jnp.where(
+                is_pref, jnp.clip(p_starts + t, 0, s_virt),
+                jnp.where(active, pos + 1, 0),
+            ).astype(jnp.int32)
+            logit_idx = jnp.where(is_pref, p_lengths - 1, 0)
+            logits, new_cache = forward(
+                cfg, params, tokens0, pos0,
+                _paged_cache_dict(cache, ptab),
+                logit_indices=logit_idx, attn_impl=impl, mesh=mesh,
+                kv_lens=kv0, q_lens=q_lens_v,
+            )
+            cache = _paged_cache_tuple(new_cache)
+            # Combined first sample, per-row knobs: prefill rows fold 0
+            # of THEIR seed under (init state, full budget); decode rows
+            # fold counts under (committed state, remaining budget).
+            m_states = jnp.where(is_pref, p_cinits, cstates)
+            m_rem = jnp.where(is_pref, p_cbudgets, crem)
+            m_seeds = jnp.where(is_pref, p_seeds, seeds)
+            m_counts = jnp.where(is_pref, 0, counts)
+            m_temps = jnp.where(is_pref, p_temps, temps)
+            m_topps = jnp.where(is_pref, p_topps, topps)
+            m_topks = jnp.where(is_pref, p_topks, topks)
+            keys = jax.vmap(
+                lambda s, c: jax.random.fold_in(jax.random.key(s), c)
+            )(m_seeds, m_counts)
+            logits0 = apply_token_mask(
+                logits[:, 0], g_need[m_states] <= m_rem[:, None]
+            )
+            toks0 = sample_runtime(logits0, m_temps, m_topps, m_topks, keys)
+            firsts = toks0
+            # Decode rows commit chunk token 0 (prefill rows arm on the
+            # host AFTER this launch, so `active` excludes them here).
+            d_nxt = jnp.where(active, toks0, pad_id)
+            cstates = jnp.where(active, g_next[cstates, d_nxt], cstates)
+            crem = jnp.where(active, crem - 1, crem)
+            pos = jnp.where(active, pos + 1, pos)
+            cur = d_nxt
+
+            def step(carry, i):
+                # _build_decode's step body, verbatim (T=1 per row).
+                cache, cur, pos, cstates, crem = carry
+                logits, new_cache = forward(
+                    cfg, params, cur[:, None], pos[:, None],
+                    _paged_cache_dict(cache, ptab), attn_impl=dimpl,
+                    mesh=mesh, kv_lens=jnp.where(active, pos + 1, 0),
+                )
+                step_logits = apply_token_mask(
+                    logits[:, 0], g_need[cstates] <= crem[:, None]
+                )
+                keys = jax.vmap(
+                    lambda s, c: jax.random.fold_in(jax.random.key(s), c)
+                )(seeds, counts + i)
+                nxt = sample_runtime(step_logits, temps, topps, topks, keys)
+                nxt = jnp.where(active, nxt, pad_id)
+                cstates = jnp.where(active, g_next[cstates, nxt], cstates)
+                crem = jnp.where(active, crem - 1, crem)
+                pos = jnp.where(active, pos + 1, pos)
+                return (_paged_cache_tuple(new_cache), nxt, pos, cstates,
+                        crem), nxt
+
+            # chunk == 1 leaves an empty scan: toks is just step 0's
+            # column. Fold indices continue at counts+1 where step 0
+            # (fold counts) left off — the control's i=1..chunk-1 steps.
+            (cache, cur, pos, cstates, crem), toks_rest = lax.scan(
+                step, (cache, cur, pos, cstates, crem),
+                jnp.arange(1, chunk),
+            )
+            toks = jnp.concatenate([d_nxt[None], toks_rest], 0).T
+            counts = jnp.where(active, counts + chunk, counts)
+            return (*cache, cur, pos, counts, cstates, crem, toks, firsts)
+
+        return mixed
+
+    def _build_mixed_spec(self, t_bucket: int):
+        """Speculative twin of _build_mixed: decode rows run their verify
+        window (T = D+1) and prefill rows their chunk (T = t_bucket) in
+        the SAME ragged launch — the window is padded to
+        max(t_bucket, D+1) columns and q_lens tells the kernel which
+        prefix of each row is real. The verify math (draft, per-position
+        grammar masking, greedy/rejection acceptance, history commit) is
+        _build_spec_decode's, applied to the window's first D+1 columns;
+        prefill rows additionally scatter their chunk into the draft
+        history (== _build_prefill's hist write) and sample their first
+        token from the chunk's last real logit at fold 0."""
+        from ..constrain.masks import fsm_advance_chain
+        from ..engine.speculative import (
+            emit_chain,
+            ngram_draft,
+            rejection_sample_chain,
+        )
+
+        cfg, mesh, impl = self.cfg, self.mesh, self._impl
+        D, ngram = self._spec_draft, self._spec_ngram
+        d1 = D + 1
+        t = t_bucket
+        T = max(t, d1)
+        pad_id = cfg.pad_id
+        nc = len(self._cache)
+        ps, np_tab = self._page_size, self._pages_per_slot
+        s_virt = np_tab * ps
+
+        @partial(jax.jit,
+                 donate_argnums=tuple(range(1, nc + 5))
+                 + (nc + 10, nc + 11, nc + 12))
+        def mixed_spec(params, *args):
+            cache = args[:nc]
+            (hist, hlen, cur, pos, active, temps, topps, topks, seeds,
+             counts, cstates, crem) = args[nc:nc + 12]
+            (p_tokens, p_lengths, p_starts, is_pref, p_temps, p_topps,
+             p_topks, p_seeds, p_cinits, p_cbudgets) = args[nc + 12:nc + 22]
+            g_next, g_need = args[nc + 22:nc + 24]
+            ptab = args[nc + 24]
+            params = split_blocks(params)
+            drafts = ngram_draft(hist, hlen, D, ngram)           # [S, D]
+            verify = jnp.concatenate([cur[:, None], drafts], 1)  # [S, D+1]
+            jd = jnp.arange(d1, dtype=jnp.int32)[None, :]
+            vpos = pos[:, None] + jd
+            col = jnp.arange(T, dtype=jnp.int32)[None, :]
+            if T > d1:
+                verify = jnp.pad(verify, ((0, 0), (0, T - d1)),
+                                 constant_values=pad_id)
+                vpos = jnp.pad(vpos, ((0, 0), (0, T - d1)),
+                               constant_values=s_virt)
+            pt = p_tokens
+            if T > t:
+                pt = jnp.pad(pt, ((0, 0), (0, T - t)),
+                             constant_values=pad_id)
+            p_pos = jnp.where(col < t, p_starts[:, None] + col,
+                              jnp.int32(s_virt))
+            tokens0 = jnp.where(is_pref[:, None], pt, verify)
+            pos0 = jnp.where(is_pref[:, None], p_pos, vpos)
+            q_lens_v = jnp.where(is_pref, t, d1).astype(jnp.int32)
+            kv0 = jnp.where(
+                is_pref, jnp.clip(p_starts + t, 0, s_virt),
+                jnp.where(active, pos + d1, 0),
+            ).astype(jnp.int32)
+            logits, new_cache = forward(
+                cfg, params, tokens0, pos0,
+                _paged_cache_dict(cache, ptab),
+                attn_impl=impl, mesh=mesh, kv_lens=kv0, q_lens=q_lens_v,
+            )
+            # ----- verify math: _build_spec_decode, on the first D+1
+            # columns (mid-prefill slots sit at temps=0/state park, same
+            # values the alternating control's spec round sees).
+            vlogits = logits[:, :d1]
+            pstates, vlen = fsm_advance_chain(
+                g_next, g_need, cstates, drafts, crem
+            )                                                    # [S,D+1],[S]
+            vlogits = apply_token_mask(
+                vlogits, g_need[pstates] <= (crem[:, None] - jd)[:, :, None]
+            )
+            preds = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            eq = ((drafts == preds[:, :D])
+                  & (jd[:, :D] < vlen[:, None])).astype(jnp.int32)
+            acc = jnp.sum(jnp.cumprod(eq, axis=1), axis=1)         # [S]
+            greedy = temps <= 0.0
+            keys = jax.vmap(
+                lambda s, c: jax.random.fold_in(jax.random.key(s), c)
+            )(seeds, counts)
+            ns = preds.shape[0]
+
+            def rejection_path(_):
+                filt = filtered_runtime_logits(
+                    vlogits, temps[:, None], topps[:, None], topks[:, None],
+                )
+                return rejection_sample_chain(filt, drafts, keys)
+
+            acc_s, extra = lax.cond(
+                jnp.all(greedy),
+                lambda _: (jnp.zeros((ns,), jnp.int32),
+                           jnp.zeros((ns,), jnp.int32)),
+                rejection_path, None,
+            )
+            emitted_s = emit_chain(drafts, acc_s, extra, pad_id)
+            n_emit = jnp.where(
+                active, jnp.where(greedy, acc + 1, acc_s + 1), 0
+            )
+            emitted = jnp.where(greedy[:, None], preds, emitted_s)
+            emitted = jnp.where(jd < n_emit[:, None], emitted, pad_id)
+            write_at = jnp.where(
+                active, hlen, jnp.int32(hist.shape[1])
+            )
+            hist = jax.vmap(
+                lambda h, e, s: lax.dynamic_update_slice(h, e, (s,))
+            )(hist, emitted, write_at)
+            cur = jax.vmap(
+                lambda e, n, c: jnp.where(n > 0, e[jnp.maximum(n - 1, 0)], c)
+            )(emitted, n_emit, cur)
+            idx = jnp.maximum(n_emit - 1, 0)
+            last_s = jnp.take_along_axis(pstates, idx[:, None], 1)[:, 0]
+            last_t = jnp.take_along_axis(emitted, idx[:, None], 1)[:, 0]
+            cstates = jnp.where(n_emit > 0, g_next[last_s, last_t], cstates)
+            crem = crem - n_emit
+            pos = pos + n_emit
+            hlen = hlen + n_emit
+            counts = counts + jnp.where(active & ~greedy, 1, 0)
+            # ----- prefill rows: chunk into the draft history (row S is
+            # the OOB drop for everyone else — disjoint from the emitted
+            # write above, whose prefill rows landed in the spare tail)
+            # and the first token from the chunk's last real logit.
+            rows = jnp.where(
+                is_pref, jnp.arange(is_pref.shape[0], dtype=jnp.int32),
+                jnp.int32(is_pref.shape[0]),
+            )
+            hist = hist.at[
+                rows[:, None],
+                p_starts[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :],
+            ].set(p_tokens)
+            fl = jnp.take_along_axis(
+                logits, jnp.clip(p_lengths - 1, 0, T - 1)[:, None, None],
+                axis=1,
+            )[:, 0]
+            fl = apply_token_mask(fl, g_need[p_cinits] <= p_cbudgets[:, None])
+            p_keys = jax.vmap(
+                lambda s: jax.random.fold_in(jax.random.key(s), 0)
+            )(p_seeds)
+            firsts = sample_runtime(fl, p_temps, p_topps, p_topks, p_keys)
+            out_cache = _paged_cache_tuple(new_cache)
+            return (*out_cache, hist, hlen, cur, pos, counts,
+                    cstates, crem, emitted, n_emit, firsts)
+
+        return mixed_spec
+
     # ------------------------------------------------------------- lifecycle
 
     def warmup(self, prompt_len: Optional[int] = None) -> None:
@@ -4410,8 +4730,185 @@ class ContinuousBatchingScheduler:
             n_emit = None
         self._pending.append((issue_reqs, list(self._slot_epoch), toks,
                               n_emit, self._first_pending,
-                              time.perf_counter()))
+                              time.perf_counter(), None))
         self._first_pending = []
+
+    def _issue_mixed(self) -> bool:
+        """LSOT_RAGGED=1 hot path (ISSUE 19): ONE compiled launch admits
+        this iteration's prompt chunks AND the decode round — no phase
+        alternation, no off-phase idle. Same group selection as
+        _prefill_step (one bucket per round, arrival order), same host
+        tail (publish / requeue / arm), same async pending/harvest
+        plumbing as _issue_decode — the round just carries a mixed_meta
+        so harvest attributes both phases' analytic work over one wall.
+        Returns False (caller falls back to the alternating path for
+        this iteration) when every queued entry was stale."""
+        group: List[Tuple[int, _Request]] = []
+        deferred = []
+        t = 0
+        while self._prefill_q and len(group) < self._prefill_kmax:
+            s, r = self._prefill_q.popleft()
+            if self._slot_req[s] is not r:
+                continue  # preempted while queued; re-admits via _page_wait
+            if not group:
+                t = self._next_bucket(r)
+                group.append((s, r))
+            elif self._next_bucket(r) == t:
+                group.append((s, r))
+            else:
+                deferred.append((s, r))
+        for item in reversed(deferred):  # keep arrival order for next passes
+            self._prefill_q.appendleft(item)
+        if not group:
+            return False
+        # Chaos seams: the mixed round IS the decode round, so the same
+        # crash/hang/wedge sites fire here (chaos contracts hold with
+        # ragged on).
+        FAULTS.check("sched:decode")
+        FAULTS.check("sched:hang")
+        if FAULTS.active:
+            FAULTS.check(f"sched:wedge_{self.flight.replica}")
+        if t not in self._mixed_fns:
+            self._mixed_fns[t] = (
+                self._build_mixed_spec(t) if self._spec_draft
+                else self._build_mixed(t)
+            )
+        # COW sweep over each chunk's write window (ragged implies paged).
+        for slot, req in group:
+            self._ensure_writable(slot, req.prefilled, req.prefilled + t)
+
+        # S-wide prefill-row vectors: non-group rows carry the inert
+        # defaults (is_pref=False routes them to the decode lane; the
+        # rest are never read for such rows).
+        S = self.num_slots
+        p_tokens = [[self.cfg.pad_id] * t for _ in range(S)]
+        p_lengths = [1] * S
+        p_starts = [0] * S
+        is_pref = [False] * S
+        p_temps = [0.0] * S
+        p_topps = [1.0] * S
+        p_topks = [0] * S
+        p_seeds = [0] * S
+        p_cinits = [0] * S
+        p_cbudgets = [1] * S
+        chunk_lens: Dict[int, int] = {}
+        for slot, req in group:
+            full = req.full_ids
+            chunk_ids = full[req.prefilled : req.prefilled + t]
+            p_tokens[slot] = (
+                chunk_ids + [self.cfg.pad_id] * (t - len(chunk_ids))
+            )
+            p_lengths[slot] = len(chunk_ids)
+            chunk_lens[slot] = len(chunk_ids)
+            p_starts[slot] = req.prefilled
+            is_pref[slot] = True
+            p_temps[slot] = req.temperature
+            p_topps[slot] = req.top_p
+            p_topks[slot] = req.top_k
+            p_seeds[slot] = req.seed & 0xFFFFFFFF
+            final = req.prefilled + len(chunk_ids) >= len(full)
+            con = (req.constraint is not None and final
+                   and not req.resume_pref)
+            p_cinits[slot] = req.constraint.init_state if con else 0
+            p_cbudgets[slot] = req.max_new if con else 1
+
+        active = np.asarray(
+            [r is not None and r.ready for r in self._slot_req]
+        )
+        issue_reqs = [
+            self._slot_req[i] if active[i] else None
+            for i in range(self.num_slots)
+        ]
+        nc = len(self._cache)
+        tab = self._ctables
+        p_args = (
+            jnp.asarray(p_tokens, jnp.int32),
+            jnp.asarray(p_lengths, jnp.int32),
+            jnp.asarray(p_starts, jnp.int32),
+            jnp.asarray(is_pref, jnp.bool_),
+            jnp.asarray(p_temps, jnp.float32),
+            jnp.asarray(p_topps, jnp.float32),
+            jnp.asarray(p_topks, jnp.int32),
+            jnp.asarray(p_seeds, jnp.uint32),
+            jnp.asarray(p_cinits, jnp.int32),
+            jnp.asarray(p_cbudgets, jnp.int32),
+        )
+        if self._spec_draft:
+            out = self._mixed_fns[t](
+                self.params, *self._cache, self._hist, self._hlen,
+                self._cur, self._pos, jnp.asarray(active), self._temps,
+                self._topps, self._topks, self._seeds, self._counts,
+                self._cstates, self._crem, *p_args, tab["next"],
+                tab["need"], self._ptab,
+            )
+            self._cache = out[:nc]
+            (self._hist, self._hlen, self._cur, self._pos, self._counts,
+             self._cstates, self._crem, toks, n_emit, firsts) = out[nc:]
+        else:
+            out = self._mixed_fns[t](
+                self.params, *self._cache, self._cur, self._pos,
+                jnp.asarray(active), self._temps, self._topps, self._topks,
+                self._seeds, self._counts, self._cstates, self._crem,
+                *p_args, tab["next"], tab["need"], self._ptab,
+            )
+            self._cache = out[:nc]
+            (self._cur, self._pos, self._counts, self._cstates, self._crem,
+             toks, firsts) = out[nc:]
+            n_emit = None
+        # Both phases' analytic work attributes over THIS round's wall at
+        # harvest (perfmodel.observe_mixed) — no note_prefill banking.
+        avg_start = sum(p_starts[s] for s, _ in group) // len(group)
+        mixed_meta = {
+            "pre_rows": len(group),
+            "pre_tokens": t,
+            "pre_ctx": avg_start + t // 2,
+        }
+
+        # Host tail for the chunk rows: _prefill_step's, minus the
+        # prefill-role handoff branch (ragged requires phase_role=mixed).
+        for slot, req in group:
+            chunk_start = req.prefilled
+            req.prefilled += chunk_lens[slot]
+            full = req.full_ids
+            if self._prefix_cache_blocks:
+                self._publish_blocks_paged(slot, req, chunk_start)
+            if req.prefilled < len(full):
+                self._prefill_q.append((slot, req))
+                continue
+            if req.resume_pref:
+                self._resume_ready(slot, req)
+                continue
+            req.ready = True
+            req.ready_at = time.perf_counter()
+            self._ensure_writable(slot, len(req.ids), req.page_end)
+            tok = firsts[slot : slot + 1]
+            cinit = (req.constraint.init_state if req.constraint is not None
+                     else 0)
+            (self._cur, self._pos, self._temps, self._topps, self._topks,
+             self._seeds, self._counts, self._cstates,
+             self._crem) = self._ready_fn(
+                self._cur, self._pos, self._temps, self._topps, self._topks,
+                self._seeds, self._counts, self._cstates, self._crem,
+                self._ctables["next"], jnp.int32(slot), tok,
+                jnp.int32(len(req.ids)),
+                jnp.float32(req.temperature), jnp.float32(req.top_p),
+                jnp.int32(req.top_k), jnp.uint32(req.seed & 0xFFFFFFFF),
+                jnp.int32(cinit), jnp.int32(req.max_new),
+            )
+            req.rng_count = 1
+            if self._spec_draft:
+                self._hist, self._hlen = self._spec_ready_fn(
+                    self._hist, self._hlen, jnp.int32(slot), tok,
+                    jnp.int32(len(req.ids)),
+                )
+            self._first_pending.append(
+                (slot, req, tok, self._slot_epoch[slot])
+            )
+        self._pending.append((issue_reqs, list(self._slot_epoch), toks,
+                              n_emit, self._first_pending,
+                              time.perf_counter(), mixed_meta))
+        self._first_pending = []
+        return True
 
     def _retire(self, slot: int, req: _Request, result: List[int]) -> None:
         """Resolve a finished request, free its slot, and reset the slot's
@@ -4513,8 +5010,8 @@ class ContinuousBatchingScheduler:
         # without duplicating delivered tokens (chaos tests assert zero
         # lost, zero double-streamed).
         FAULTS.check("sched:crash")
-        issue_reqs, epochs, toks_dev, n_emit_dev, firsts, t_issue = \
-            self._pending.popleft()
+        (issue_reqs, epochs, toks_dev, n_emit_dev, firsts, t_issue,
+         mixed_meta) = self._pending.popleft()
         toks, n_emit, first_vals = jax.device_get(
             (toks_dev, n_emit_dev, [t for (_, _, t, _) in firsts])
         )
@@ -4713,8 +5210,28 @@ class ContinuousBatchingScheduler:
             for r in issue_reqs if r is not None
         )
         perf_ctx = max(1, ctx_sum // max(1, occupancy))
-        att = self.perf.observe(phase, rows=self.num_slots, tokens=tokens,
-                                ctx=perf_ctx, wall_s=round_wall)
+        if mixed_meta is not None:
+            # Unified ragged round (LSOT_RAGGED=1): one launch did both
+            # phases' work, so ONE attribution covers decode/verify rows
+            # AND the chunk rows over the same wall. The record keeps the
+            # chunk-side inputs so the reconciliation test can recompute
+            # the ledger columns from the record alone (ragged-off
+            # records never carry these keys — byte-identical to the
+            # alternating control).
+            phase = "mixed"
+            att = self.perf.observe_mixed(
+                rows=self.num_slots, dec_tokens=tokens, dec_ctx=perf_ctx,
+                pre_rows=mixed_meta["pre_rows"],
+                pre_tokens=mixed_meta["pre_tokens"],
+                pre_ctx=mixed_meta["pre_ctx"], wall_s=round_wall,
+            )
+            rec["pre_rows"] = mixed_meta["pre_rows"]
+            rec["pre_tokens"] = mixed_meta["pre_tokens"]
+            rec["pre_ctx"] = mixed_meta["pre_ctx"]
+        else:
+            att = self.perf.observe(phase, rows=self.num_slots,
+                                    tokens=tokens, ctx=perf_ctx,
+                                    wall_s=round_wall)
         rec["phase"] = phase
         rec["perf_ctx"] = perf_ctx
         rec["mfu"] = att["mfu"]
@@ -4929,6 +5446,19 @@ class ContinuousBatchingScheduler:
                     # moving and will free pages.
                     self._page_wait.appendleft(req)
                     break
+            # Unified ragged round (LSOT_RAGGED=1, ISSUE 19): fold this
+            # iteration's prompt chunks INTO the decode launch — one
+            # compiled program, no phase alternation, the off-phase
+            # never idles. Falls through to the alternating path when
+            # every queued prefill entry was stale, so decode never
+            # stalls behind an empty mix.
+            if self._ragged and self._prefill_q:
+                if self._profile_arm is not None:
+                    self._maybe_start_profile()
+                if self._issue_mixed():
+                    if len(self._pending) > self._harvest_lag:
+                        self._harvest_round()
+                    continue
             # Fair interleave: at most one prompt chunk per decode round —
             # admission work is bounded, so active slots never wait longer
             # than one prompt_bucket forward.

@@ -312,6 +312,56 @@ class PerfModel:
             "bound": verdict(mfu, util),
         }
 
+    def mixed_attribution(self, *, rows: int, dec_tokens: int, dec_ctx: int,
+                          pre_rows: int, pre_tokens: int, pre_ctx: int,
+                          wall_s: float) -> Dict[str, float]:
+        """One UNIFIED RAGGED round's ledger entry (ISSUE 19): the launch
+        did the decode/verify rows' work AND `pre_rows` prompt chunks in
+        the same program, so both phases' analytic work sums over ONE
+        measured wall. Pure like round_attribution — the flight-record
+        reconciliation test recomputes mixed records through this exact
+        function (dec_tokens > 1 is a vanilla chunk round: `dec_tokens`
+        weight streams; dec_tokens == draft+1 with one stream is the
+        verify shape, which rides the decode pricing here because the
+        scan steps dominate and the record keeps the raw inputs either
+        way)."""
+        d_flops, d_hbm = self.phase_work("decode", rows=rows,
+                                         tokens=dec_tokens, ctx=dec_ctx)
+        p_flops, p_hbm = self.phase_work("prefill", rows=pre_rows,
+                                         tokens=pre_tokens, ctx=pre_ctx)
+        flops, hbm = d_flops + p_flops, d_hbm + p_hbm
+        if wall_s <= 0:
+            return {"flops": flops, "hbm_bytes": hbm, "tflops": 0.0,
+                    "gbs": 0.0, "mfu": 0.0, "hbm_util": 0.0,
+                    "bound": "memory-bound"}
+        flop_s, byte_s = flops / wall_s, hbm / wall_s
+        mfu = flop_s / self.peak_flops
+        util = byte_s / self.peak_bw
+        return {
+            "flops": flops,
+            "hbm_bytes": hbm,
+            "tflops": round(flop_s / 1e12, 4),
+            "gbs": round(byte_s / 1e9, 2),
+            "mfu": round(mfu, 6),
+            "hbm_util": round(util, 6),
+            "bound": verdict(mfu, util),
+        }
+
+    def observe_mixed(self, *, rows: int, dec_tokens: int, dec_ctx: int,
+                      pre_rows: int, pre_tokens: int, pre_ctx: int,
+                      wall_s: float) -> Dict[str, float]:
+        """mixed_attribution + fold into a dedicated "mixed" EWMA key —
+        stats() iterates the phase dict, so the mixed view appears beside
+        prefill/decode the first time a ragged round harvests and never
+        perturbs the alternating phases' EWMAs."""
+        att = self.mixed_attribution(
+            rows=rows, dec_tokens=dec_tokens, dec_ctx=dec_ctx,
+            pre_rows=pre_rows, pre_tokens=pre_tokens, pre_ctx=pre_ctx,
+            wall_s=wall_s,
+        )
+        self._fold("mixed", att)
+        return att
+
     def prefill_saved(self, tokens: int) -> Tuple[float, float]:
         """(FLOPs, seconds) a prefix-cache hit of `tokens` reused tokens
         SAVED: the one-row prefill forward those tokens would have cost

@@ -1028,6 +1028,122 @@ def test_quantized_ragged_kernel_matches_reference(rng, ps, np_tab):
     assert float(jnp.abs(parked[0]).max()) == 0.0
 
 
+@pytest.mark.parametrize("quant", [False, True])
+def test_ragged_window_shapes_property(rng, quant):
+    """ISSUE 19 satellite: randomized ragged windows — T=1 decode rows,
+    verify-window and prefill-chunk rows, a parked row (q_len=0), an
+    OOB-sentinel table entry, and kv_lens clamping mid-page of the last
+    live page — pin kernel == ragged XLA reference == a per-row
+    contiguous einsum loop, bf16-path and int8-pool variants."""
+    from llm_based_apache_spark_optimization_tpu.ops.attention import (
+        attention_mask,
+        gqa_attention,
+    )
+    from llm_based_apache_spark_optimization_tpu.ops.pallas import (
+        gather_pages,
+        paged_attention_reference,
+        paged_attention_reference_quantized,
+        ragged_paged_attention,
+        ragged_paged_attention_quantized,
+    )
+
+    b, T, kh, g, h, ps, np_tab, pool_pages = 5, 8, 2, 2, 8, 8, 4, 24
+    n = kh * g
+    s_virt = np_tab * ps
+    if quant:
+        kp = jnp.asarray(
+            rng.integers(-127, 128, size=(pool_pages, kh, ps, h)), jnp.int8
+        )
+        vp = jnp.asarray(
+            rng.integers(-127, 128, size=(pool_pages, kh, ps, h)), jnp.int8
+        )
+        ks = jnp.asarray(rng.uniform(0.01, 0.1, size=(pool_pages, kh, ps)),
+                         jnp.float32)
+        vs = jnp.asarray(rng.uniform(0.01, 0.1, size=(pool_pages, kh, ps)),
+                         jnp.float32)
+        # Dequantized twins for the per-row contiguous golden loop.
+        kp_f = kp.astype(jnp.float32) * ks[..., None]
+        vp_f = vp.astype(jnp.float32) * vs[..., None]
+    else:
+        kp = jnp.asarray(rng.normal(size=(pool_pages, kh, ps, h)),
+                         jnp.float32)
+        vp = jnp.asarray(rng.normal(size=(pool_pages, kh, ps, h)),
+                         jnp.float32)
+        kp_f, vp_f = kp, vp
+
+    for trial in range(2):
+        tab = np.stack(
+            [rng.permutation(pool_pages)[:np_tab] for _ in range(b)]
+        )
+        tab[1, -1] = pool_pages  # unmapped sentinel past the live region
+        tab = jnp.asarray(tab, jnp.int32)
+        # Mixed window shapes per trial: decode row, mid-size windows,
+        # one full-T chunk, one parked row (q_len=0, kv_lens=0).
+        q_lens = np.asarray(
+            [1, int(rng.integers(2, T)), T, int(rng.integers(1, T + 1)), 0],
+            np.int32,
+        )
+        starts = np.asarray(
+            [int(rng.integers(0, s_virt - int(ql))) if ql else 0
+             for ql in q_lens],
+            np.int32,
+        )
+        pos = np.full((b, T), s_virt - 1, np.int32)  # dead-col junk
+        for bi in range(b):
+            pos[bi, : q_lens[bi]] = starts[bi] + np.arange(q_lens[bi])
+        # Row 3's kv_lens clamps MID-PAGE below its own window top: the
+        # kernel must stream the last live page but mask its tail.
+        kvl = starts + q_lens
+        kvl[3] = max(1, int(kvl[3]) - int(rng.integers(0, min(kvl[3], ps))))
+        kvl[4] = 0
+        pos, q_lens_j = jnp.asarray(pos), jnp.asarray(q_lens)
+        kvl_j = jnp.asarray(kvl)
+        q = jnp.asarray(rng.normal(size=(b, T, n, h)), jnp.float32)
+
+        if quant:
+            out_k = ragged_paged_attention_quantized(
+                q, kp, ks, vp, vs, tab, pos, None, kvl_j, q_lens_j
+            )
+            out_r = paged_attention_reference_quantized(
+                q, kp, ks, vp, vs, tab, pos, None, kvl_j, q_lens_j
+            )
+            atol = 2e-5
+        else:
+            out_k = ragged_paged_attention(
+                q, kp, vp, tab, pos, None, kvl_j, q_lens_j
+            )
+            out_r = paged_attention_reference(
+                q, kp, vp, tab, pos, None, kvl_j, q_lens_j
+            )
+            atol = 2e-6
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                                   atol=atol)
+
+        # Per-row contiguous golden loop: each row alone, gathered to a
+        # contiguous [s_virt] layout, plain einsum over its live window.
+        golden = np.zeros((b, T, n, h), np.float32)
+        for bi in range(b):
+            ql, kl = int(q_lens[bi]), int(kvl[bi])
+            if ql == 0 or kl == 0:
+                continue
+            kf = gather_pages(kp_f, tab[bi : bi + 1])
+            vf = gather_pages(vp_f, tab[bi : bi + 1])
+            mask = attention_mask(pos[bi : bi + 1, :ql], s_virt)
+            mask = mask & (jnp.arange(s_virt)[None, None, :] < kl)
+            o = gqa_attention(q[bi : bi + 1, :ql], kf, vf, mask)
+            golden[bi, :ql] = np.asarray(o[0])
+        np.testing.assert_allclose(np.asarray(out_k), golden,
+                                   atol=5e-5 if quant else 2e-6)
+        # Dead columns and the parked row are EXACT zeros in both.
+        for bi in range(b):
+            ql = int(q_lens[bi])
+            assert float(jnp.abs(out_k[bi, ql:]).max() if ql < T
+                         else 0.0) == 0.0
+            assert float(jnp.abs(out_r[bi, ql:]).max() if ql < T
+                         else 0.0) == 0.0
+        assert float(jnp.abs(out_k[4]).max()) == 0.0
+
+
 def test_fused_page_write_matches_reference(rng):
     """The fused Pallas page-write kernel (tentpole): bit-identical to
     the XLA scatter-through-table reference — including dropped sentinel
