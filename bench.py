@@ -2199,6 +2199,120 @@ def _bench_qos(cfg, params, n_batch: int = 4, n_inter: int = 3,
     return best
 
 
+def _bench_repair(cfg, params, n_req: int = 6, prompt_len: int = 32,
+                  max_new: int = 8, reps: int = 2) -> dict:
+    """Repair-wave pass (ISSUE 20): the self-healing loop's serving
+    shape. A failed request's repair rounds reuse the ORIGINAL system
+    prompt verbatim (app/repair.build_repair_prompt's contract) with a
+    short unique tail (error text + question), ride QoS class `replay`
+    under the requesting tenant, and arrive as a correlated wave — the
+    near-total-prefix-reuse short-gen traffic the ISSUE names as a
+    routing/prefix-cache/QoS stress unlike any prior fixture. Committed
+    figures: the wave's TTFT p50/p95, tok/s, and its prefix_hit_rate
+    (per-wave prefix_stats delta) — a repair wave that stops hitting the
+    schema prefix re-pays full prefill exactly when the fleet is already
+    dealing with failures."""
+    import os as _os
+    import time as _t
+
+    import numpy as np
+
+    from llm_based_apache_spark_optimization_tpu.serve.scheduler import (
+        ContinuousBatchingScheduler,
+    )
+
+    decode_chunk = 4
+    bucket = max(prompt_len, 16)
+    # Room for the bucketed prompt + generation + harvest overshoot (the
+    # admission check prices the NEXT bucket up for block-aligned
+    # prefix-cache admissions, hence 2x the prompt bucket).
+    max_seq = min(2 * bucket + max_new + 3 * decode_chunk + 8,
+                  cfg.max_seq_len)
+    # The scheduler latches LSOT_QOS at __init__ — force the QoS path on
+    # so the wave's tenant/replay-class submits take the front-door path.
+    saved = _os.environ.get("LSOT_QOS")
+    _os.environ["LSOT_QOS"] = "1"
+    try:
+        sched = ContinuousBatchingScheduler(
+            cfg, params, num_slots=2, max_seq=max_seq,
+            prompt_bucket=bucket, stop_ids=(-1,),
+            decode_chunk=decode_chunk, prefix_cache_blocks=256,
+        )
+    finally:
+        if saved is None:
+            _os.environ.pop("LSOT_QOS", None)
+        else:
+            _os.environ["LSOT_QOS"] = saved
+    sched.warmup(prompt_len)
+    pblock = sched._pblock
+    shared_len = max(pblock, (prompt_len // 2) // pblock * pblock)
+    tail_len = prompt_len - shared_len
+    if tail_len > 0:
+        # Repair admissions prefill only the tail bucket — warm it too
+        # or the timed wave compiles mid-flight.
+        sched.warmup(tail_len)
+    rng = np.random.default_rng(27)
+    shared = _mk_prompts(cfg, 1, shared_len, rng)[0]
+
+    def pct(vals, q):
+        return round(float(np.percentile(vals, q)), 4) if vals else 0.0
+
+    def submit_wave(prompts, stamps):
+        t0 = _t.perf_counter()
+        futs = [
+            # tenant="repair" on every submit INCLUDING the publisher:
+            # prefix namespaces are tenant-salted (ISSUE 18), so the
+            # wave only re-hits blocks published under its own tenant —
+            # exactly as production repair rounds reuse their own
+            # request's schema prefix.
+            sched.submit(ids, max_new_tokens=max_new, tenant="repair",
+                         qos="replay",
+                         on_token=(lambda _tok, ss=ss:
+                                   ss.append(_t.perf_counter())))
+            for ids, ss in zip(prompts, stamps)
+        ]
+        total = sum(len(f.result()) for f in futs)
+        return total, _t.perf_counter() - t0, t0
+
+    best = None
+    with sched:
+        sched.generate([shared[:decode_chunk]], max_new_tokens=2)  # decode program
+        # The "original request": publishes the schema prefix the repair
+        # wave then re-hits (publish gate needs two sightings).
+        warm = [shared + t for t in _mk_prompts(cfg, 2, tail_len, rng)]
+        submit_wave(warm, [[] for _ in warm])
+        for _ in range(reps):
+            # Fresh unique tails per rep (error text differs per repair
+            # round); resubmitting identical prompts would measure
+            # full-prompt replay caching, not the schema-prefix pattern.
+            prompts = [shared + t
+                       for t in _mk_prompts(cfg, n_req, tail_len, rng)]
+            stamps = [[] for _ in prompts]
+            pre = dict(sched.prefix_stats)
+            total, wall, t0 = submit_wave(prompts, stamps)
+            post = dict(sched.prefix_stats)
+            dstats = {k: post[k] - pre[k]
+                      for k in ("hits", "misses", "blocks_reused",
+                                "reused_tokens")}
+            ttfts = [ss[0] - t0 for ss in stamps if ss]
+            hm = dstats["hits"] + dstats["misses"]
+            cand = {
+                "tok_s": total / wall if wall > 0 else 0.0,
+                "wall_s": round(wall, 3),
+                "requests": n_req,
+                "shared_prefix_tokens": shared_len,
+                **({"ttft_p50_s": pct(ttfts, 50),
+                    "ttft_p95_s": pct(ttfts, 95)} if ttfts else {}),
+                **dstats,
+                "prefix_hit_rate": round(dstats["hits"] / hm, 4) if hm
+                else 0.0,
+            }
+            if best is None or cand["tok_s"] > best["tok_s"]:
+                best = cand
+    best["tok_s"] = round(best["tok_s"], 1)
+    return best
+
+
 def _bench_disagg_remote(cfg, params, n_long: int = 3, n_short: int = 3,
                          long_prompt: int = 24, short_prompt: int = 6,
                          long_new: int = 4, short_new: int = 24,
@@ -2795,6 +2909,18 @@ def _bench_scheduler(cfg, params, prompt_len, max_new, batch,
                                           decode_chunk=decode_chunk)
         except Exception as e:  # noqa: BLE001 — keep the leg's numbers
             out["ragged"] = {"error": str(e)[:200]}
+
+    if os.environ.get("BENCH_SCHED_REPAIR", "1") == "1" and kv_quant is None:
+        # Repair-wave pass (ISSUE 20): correlated short-gen requests
+        # sharing the failed request's schema prefix, riding tenant
+        # "repair" / QoS class `replay` — TTFT p50/p95 + prefix-hit-rate
+        # of the self-healing loop's serving shape. Instrument pass,
+        # never fatal to the leg; skipped under kv_quant to keep the
+        # 7b_sched slice lean.
+        try:
+            out["repair"] = _bench_repair(cfg, params)
+        except Exception as e:  # noqa: BLE001 — keep the leg's numbers
+            out["repair"] = {"error": str(e)[:200]}
 
     if os.environ.get("BENCH_SCHED_PREFIX", "1") == "1" and kv_quant is None:
         # Warm-prefix pass: the reference's ACTUAL serving pattern is the

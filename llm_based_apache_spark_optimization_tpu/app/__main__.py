@@ -810,6 +810,11 @@ def main(argv=None) -> None:
                                                 "contiguous"))
             if args.backend == "tiny" else make_fake_service()
         )
+    # Per-tenant model routing (ISSUE 20): LSOT_TENANT_MODELS resolves
+    # through AppConfig like every other knob — the service's env-derived
+    # map is replaced with the config's (they agree unless overrides were
+    # passed programmatically; the setter wins either way).
+    service.set_tenant_models(cfg.tenant_models)
     history = SQLiteHistory(cfg.history_db)
     factory = create_api_app if args.api else create_web_app
     # Pass the backend factory, not an instance: each request gets an

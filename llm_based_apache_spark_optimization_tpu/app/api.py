@@ -132,12 +132,19 @@ def create_api_app(
         if not os.path.exists(file_path):
             return Response.json(
                 {"error": "CSV file not found at " + file_path})
+        # Tenant identity (ISSUE 18/20): header wins, JSON field as the
+        # no-proxy fallback — same extraction as /api/generate. The
+        # pipeline threads it to the initial generate AND any repair
+        # rounds (which ride QoS class `replay` under this tenant).
+        tenant = str(req.environ.get("HTTP_X_LSOT_TENANT", "")
+                     or data.get("tenant", "") or "").strip()
         trace = TRACER.begin(request_id=request_id, endpoint="/process-data/")
         try:
             with tracing.use(trace):
                 with tracing.span("pipeline.run", file=file_name):
                     result = pipeline.run(file_path, input_text,
-                                          request_id=request_id)
+                                          request_id=request_id,
+                                          tenant=tenant)
         except UNAVAILABLE_ERRORS as e:
             # Overload/outage is the SERVER's state, not a §2.2 pipeline
             # outcome: answer 429/503/504 so clients back off, instead of

@@ -273,6 +273,35 @@ class AppConfig:
     qos_deadline_interactive: float = 0.0
     qos_deadline_batch: float = 0.0
     qos_deadline_replay: float = 0.0
+    # --- self-healing SQL (app/repair.py; README "Self-healing SQL").
+    # When a generated query fails execution, classify the engine error
+    # (syntax/schema/type/resource/transient) and feed error text +
+    # original question + schema back through the constrained decoder,
+    # re-executing up to repair_max_rounds times. Repair rounds are
+    # charged against the ORIGINAL request deadline and ride QoS class
+    # `replay` under the requesting tenant. repair=False reproduces the
+    # pre-repair failure path bit for bit (straight to error analysis).
+    repair: bool = True
+    repair_max_rounds: int = 2
+    # Model the repair regenerate rides on; "" = the same sql_model that
+    # produced the query. A tenant can also pin one via tenant_models.
+    repair_model: str = ""
+    # Exponential backoff base between repair rounds (round 2 waits
+    # backoff, round 3 waits 2x backoff, ...).
+    repair_backoff_s: float = 0.05
+    # Breaker on the REPAIR PATH itself: this many consecutive typed
+    # repair-generate failures (fleet down, overloaded) open the circuit
+    # and failures degrade straight to the diagnosed error until
+    # repair_breaker_reset_s passes.
+    repair_breaker_threshold: int = 3
+    repair_breaker_reset_s: float = 30.0
+    # --- per-tenant model routing (serve/qos.parse_tenant_models;
+    # README "Serving multiple models"). "tenantA=duckdb-nsql,
+    # tenantB=llama3.2": requests from a listed tenant route to that
+    # model_id atop the multi-model pool; unknown tenants (and tenants
+    # mapped to unregistered models) fall through to the request's own
+    # model. "" = no routing (today's behavior bit for bit).
+    tenant_models: str = ""
 
     @classmethod
     def from_env(cls, **overrides) -> "AppConfig":

@@ -42,6 +42,9 @@ ST_SAVE_DB = "Saving results to MySQL..."
 ST_ERR = "Error occurred"
 ST_ERR_RESOLVE = "Trying to resolve error..."
 ST_ERR_DONE = "Error resolved"
+# Self-healing SQL (app/repair.py) — new stage, emitted only when a repair
+# round actually runs, so LSOT_REPAIR=0 status feeds are byte-identical.
+ST_REPAIR = "Repairing SQL query..."
 
 
 @dataclasses.dataclass
@@ -98,6 +101,24 @@ class Pipeline:
         )
         self.history = history
         self.config = config
+        # Self-healing SQL (app/repair.py): ONE engine — hence one
+        # breaker — shared across runs, so "repair has been failing
+        # lately" is remembered between requests. None when
+        # LSOT_REPAIR=0: the failure path below is then the pre-repair
+        # explain path, bit for bit.
+        self._repair_engine = None
+        if config.repair and config.repair_max_rounds > 0:
+            from .repair import RepairEngine
+
+            self._repair_engine = RepairEngine(
+                max_rounds=config.repair_max_rounds,
+                backoff_s=config.repair_backoff_s,
+                breaker=CircuitBreaker(
+                    "sql repair",
+                    failure_threshold=config.repair_breaker_threshold,
+                    reset_after_s=config.repair_breaker_reset_s,
+                ),
+            )
 
     def run(
         self,
@@ -105,13 +126,27 @@ class Pipeline:
         input_text: str,
         status: StatusCb = _noop_status,
         request_id: str = "",
+        tenant: str = "",
     ) -> PipelineResult:
-        """Execute the full pipeline for one staged CSV + NL question."""
+        """Execute the full pipeline for one staged CSV + NL question.
+
+        `tenant` (ISSUE 20) threads the front door's tenant id through to
+        the generation service — the initial generate AND any repair
+        rounds are admitted/charged under it, and repair rides its prefix
+        namespace. "" = the single-tenant behavior, unchanged."""
         cfg = self.config
         file_name = Path(file_path).name
         result = PipelineResult(ok=False, input_file_name=file_name,
                                 input_data=input_text)
         sql = self._sql_factory()
+        # The repair budget is charged against the ORIGINAL request
+        # deadline: start the clock before the first generate, so rounds
+        # spend what the client granted, never more.
+        repair_deadline = None
+        if self._repair_engine is not None and cfg.deadline_s:
+            from ..serve.resilience import Deadline
+
+            repair_deadline = Deadline.after(cfg.deadline_s)
 
         status("processing", ST_LOAD)
         schema = sql.load_csv(file_path, cfg.view_name)
@@ -180,6 +215,7 @@ class Pipeline:
             # the id the client got in X-Request-Id would grep to
             # nothing.
             request_id=request_id or None,
+            tenant=tenant,
         )
         result.sql_query = res.response
         status("processing", ST_GEN_OK)
@@ -188,9 +224,18 @@ class Pipeline:
         try:
             table = sql.execute(result.sql_query)
         except Exception as e:
-            result.error_message = str(e)
-            result.error_solution = self.explain_error(result.error_message, status)
-            return result
+            table = None
+            if self._repair_engine is not None:
+                table = self._repair_sql(
+                    e, result, sql, constrain, input_text, status,
+                    request_id, tenant, repair_deadline,
+                )
+            if table is None:
+                if not result.error_message:
+                    result.error_message = str(e)
+                result.error_solution = self.explain_error(
+                    result.error_message, status)
+                return result
 
         status("processing", ST_SAVE_CSV)
         stamp = time.strftime("%Y_%m_%d_%H_%M_%S")
@@ -215,6 +260,67 @@ class Pipeline:
         result.ok = True
         status("done", "done")
         return result
+
+    def _repair_sql(self, first_error, result, sql, constrain, input_text,
+                    status, request_id, tenant, deadline):
+        """Drive the bounded repair loop (app/repair.py) for one failed
+        execution: error text + original question + schema back through
+        the constrained decoder, re-execute, up to
+        LSOT_REPAIR_MAX_ROUNDS. Returns the repaired ResultTable (with
+        result.sql_query updated to the query that actually ran) or None
+        — with result.error_message already holding the terminal
+        diagnosed engine error for the explain path."""
+        from .repair import build_repair_prompt
+
+        cfg = self.config
+        status("processing", ST_REPAIR)
+        model = cfg.repair_model or cfg.sql_model
+        if cfg.repair_model and cfg.repair_model not in self.service.models():
+            # A pinned-but-unregistered repair model must not turn a
+            # diagnosable SQL error into a dead request: fall back loudly.
+            log.warning(
+                "repair model %r is not registered (available: %s); "
+                "repairing with the SQL model instead",
+                cfg.repair_model, self.service.models(),
+            )
+            model = cfg.sql_model
+        # The ORIGINAL system prompt, verbatim: a repair wave's prefill
+        # prefix-hits the schema blocks the first generate already cached.
+        system = (
+            f"Table name is {cfg.view_name}. "
+            f"The structure of the table is:\n{result.table_schema}"
+        )
+
+        def regenerate(error_text, failed_sql, remaining):
+            res = self.service.generate(
+                model=model,
+                system=system,
+                prompt=build_repair_prompt(input_text, failed_sql,
+                                           error_text),
+                max_new_tokens=cfg.max_new_tokens,
+                constrain=constrain,
+                deadline_s=(remaining if remaining is not None
+                            else (cfg.deadline_s or None)),
+                request_id=f"{request_id}-repair" if request_id else None,
+                tenant=tenant,
+                # Repair is deferrable retry traffic: it rides the
+                # backfill class so a repair storm cannot starve
+                # interactive requests (serve/qos.py).
+                qos="replay",
+            )
+            return res.response
+
+        outcome = self._repair_engine.run(
+            first_error, result.sql_query,
+            execute=sql.execute, regenerate=regenerate,
+            deadline=deadline, request_id=request_id,
+        )
+        if outcome.ok:
+            result.sql_query = outcome.sql
+            status("processing", ST_GEN_OK)
+            return outcome.result
+        result.error_message = outcome.error
+        return None
 
     def explain_error(self, error_message: str, status: StatusCb = _noop_status) -> str:
         """Error-analysis path — §2.2 prompts verbatim (FastAPI/app.py:99-111).

@@ -80,6 +80,18 @@ def main(argv=None) -> None:
     ap.add_argument("--chaos-seed", type=int, default=0, metavar="N",
                     help="seed for the --chaos injection RNG (same spec + "
                          "seed replays the same fault schedule)")
+    ap.add_argument("--repair", nargs="?", metavar="MAX_ROUNDS", type=int,
+                    const=2,  # bare --repair = the production default
+                    help="repair leg (ISSUE 20): drive the self-healing "
+                         "execute→diagnose→repair loop over the Spider "
+                         "fixture path (per-case DDL instantiated into its "
+                         "own SQLite database; --spider DEV_JSON for real "
+                         "data) and report cumulative executable% after "
+                         "k ∈ {0..MAX_ROUNDS} repair rounds — one-shot vs "
+                         "self-healed, the paper's headline number. Runs "
+                         "the clean suite AND the injected-fault suite "
+                         "(per-class sql:* sites, where k=0 is 0% by "
+                         "construction)")
     ap.add_argument("--max-new-tokens", type=int, default=64)
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--virtual-devices", type=int, default=0, metavar="N",
@@ -144,6 +156,33 @@ def main(argv=None) -> None:
         (lambda tp: make_tiny_service(args.max_new_tokens, tp=tp))
         if args.backend == "tiny" else None
     )
+
+    if args.repair is not None:
+        if args.configs is not None:
+            sys.exit("--repair is its own leg (executable% after k repair "
+                     "rounds); it does not combine with --configs")
+        if args.spider and args.backend == "oracle":
+            sys.exit("--backend oracle is the in-tree-suite instrument "
+                     "self-proof; it does not know external --spider "
+                     "cases — use --backend tiny/fake there")
+        from .repair import format_repair_summary, run_repair_leg
+        from .spider import SPIDER_SMOKE, SpiderLoadError, load_spider
+
+        if args.spider:
+            try:
+                rcases = load_spider(args.spider, limit=50)
+            except SpiderLoadError as e:
+                sys.exit(f"--spider: {e}")
+        else:
+            rcases = SPIDER_SMOKE
+        model = (args.models or service.models())[0]
+        for inject in (False, True):
+            rep = run_repair_leg(
+                service, model, cases=rcases, max_rounds=args.repair,
+                inject=inject, max_new_tokens=args.max_new_tokens,
+            )
+            print(format_repair_summary(rep))
+        return
 
     if args.configs is not None:
         if args.explain:

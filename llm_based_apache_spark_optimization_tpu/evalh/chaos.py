@@ -1674,6 +1674,215 @@ def _run_qos_stage(seed: int) -> Dict:
     return report
 
 
+_REPAIR_REPORTS: Dict[int, Dict] = {}
+
+
+def _run_repair_stage(seed: int) -> Dict:
+    """Self-healing SQL chaos (ISSUE 20): the execute→diagnose→repair
+    loop under per-class fault injection, through the REAL pipeline
+    (app/pipeline.Pipeline + app/repair.RepairEngine + ResilientSQLBackend
+    over SQLite with the taxi fixture). Host-only; four parts:
+
+    A. **repaired** — the SQL model emits broken SQL one-shot and the
+       corrected query on repair prompts: every request must come back
+       `ok` with exactly one repair round charged.
+    B. **per-class bounded termination** — each `sql:*` fault site fires
+       on EVERY execute (p=1, the unrepairable worst case): every
+       request must terminate TYPED (diagnosed error + explain fallback,
+       never a hang or an escape) within LSOT_REPAIR_MAX_ROUNDS rounds,
+       with the right taxonomy class counted.
+    C. **LSOT_REPAIR=0 off-switch** — the same broken-SQL traffic with
+       repair disabled must reproduce the pre-repair failure path bit
+       for bit: the raw engine error + explainer answer, exactly one SQL
+       generate + one explain model call, no repair status stage, zero
+       movement on every repair counter.
+    D. **non-repair traffic untouched** — clean traffic (correct SQL
+       one-shot) under repair=on must be token-identical to a
+       repair-off control, with zero repair counters moved and the same
+       single model call.
+    """
+    cached = _REPAIR_REPORTS.get(seed)
+    if cached is not None:
+        return dict(cached)
+    import tempfile
+    from pathlib import Path as _Path
+
+    from ..app.config import AppConfig
+    from ..app.pipeline import ST_REPAIR, Pipeline
+    from ..serve.backends import FakeBackend
+    from ..serve.service import GenerationService
+    from ..sql.sqlite_backend import SQLiteBackend
+    from ..utils.faults import FAULTS
+    from ..utils.observability import repair as repair_counters
+    from .fixtures import write_taxi_fixture_csv
+
+    BROKEN = "SELEC * FORM temp_view"
+    GOOD = "SELECT COUNT(*) FROM temp_view"
+    EXPLAIN = "Check that the referenced columns exist in the schema."
+    REPAIR_MARKER = "failed with this error"
+
+    def build(sql_fn, repair_on: bool, out_dir: str):
+        svc = GenerationService()
+        sqlgen = FakeBackend(sql_fn)
+        expl = FakeBackend(lambda p: EXPLAIN)
+        svc.register("duckdb-nsql", sqlgen)
+        svc.register("llama3.2", expl)
+        cfg = AppConfig(
+            repair=repair_on, repair_max_rounds=2, repair_backoff_s=0.0,
+            # High SQL breaker threshold: part B's persistent transient
+            # faults must reach the CLASSIFIER every round, not flip the
+            # engine breaker into CircuitOpen mid-stage.
+            breaker_threshold=100,
+            output_dir=out_dir, history_db=":memory:",
+        )
+        return Pipeline(svc, SQLiteBackend, None, cfg), sqlgen, expl
+
+    def delta(before):
+        now = repair_counters.snapshot()
+        return {k: v - before.get(k, 0)
+                for k, v in now.items() if v != before.get(k, 0)}
+
+    lost = 0
+    report: Dict = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        csv_path = str(_Path(tmp) / "taxi.csv")
+        write_taxi_fixture_csv(csv_path)
+        out_dir = str(_Path(tmp) / "out")
+        _Path(out_dir).mkdir()
+
+        def broken_then_fixed(p):
+            return GOOD if REPAIR_MARKER in p else BROKEN
+
+        # Part A — clean repaired path: broken one-shot, fixed on repair.
+        pipe, sqlgen, _ = build(broken_then_fixed, True, out_dir)
+        requests = 3
+        before = repair_counters.snapshot()
+        statuses: list = []
+        repaired_ok = 0
+        for _ in range(requests):
+            try:
+                res = pipe.run(csv_path, "How many rows are there?",
+                               status=lambda s, m: statuses.append(m))
+            except Exception:  # noqa: BLE001 — an escape IS the lost case
+                lost += 1
+                continue
+            if res.ok and res.sql_query == GOOD:
+                repaired_ok += 1
+            elif not res.error_message:
+                lost += 1
+        d = delta(before)
+        assert repaired_ok == requests, (
+            f"only {repaired_ok}/{requests} broken-SQL requests came back "
+            f"repaired"
+        )
+        assert d.get("repaired", 0) == requests, (
+            f"repaired counter moved {d.get('repaired', 0)}, "
+            f"expected {requests}"
+        )
+        assert d.get("repair_rounds", 0) == requests, (
+            "each repaired request should charge exactly one round, got "
+            f"{d.get('repair_rounds', 0)} for {requests} requests"
+        )
+        assert ST_REPAIR in statuses, (
+            "the repair stage never surfaced in the status feed"
+        )
+        report["repaired"] = {"requests": requests, "ok": repaired_ok,
+                              "rounds": d.get("repair_rounds", 0)}
+
+        # Part B — per-class bounded termination: every execute (initial
+        # AND every repair re-execute) raises the class's representative
+        # engine error; the loop must stop typed within max_rounds. Own
+        # injection scope per class.
+        per_class: Dict[str, Dict] = {}
+        for site in ("sql:syntax", "sql:schema", "sql:transient"):
+            cls_name = site.rpartition(":")[2]
+            pipe, sqlgen, expl = build(lambda p: GOOD, True, out_dir)
+            before = repair_counters.snapshot()
+            FAULTS.configure(f"{site}:1", seed)
+            try:
+                res = pipe.run(csv_path, "How many rows are there?")
+            except Exception:  # noqa: BLE001 — an escape IS the lost case
+                lost += 1
+                res = None
+            finally:
+                FAULTS.clear()
+            d = delta(before)
+            terminal_typed = (
+                res is not None and not res.ok
+                and bool(res.error_message) and bool(res.error_solution)
+            )
+            assert terminal_typed, (
+                f"{site}: request did not terminate typed "
+                f"(res={res and (res.ok, res.error_message)})"
+            )
+            assert d.get("repair_rounds", 0) <= 2, (
+                f"{site}: {d.get('repair_rounds', 0)} rounds exceeds "
+                f"LSOT_REPAIR_MAX_ROUNDS=2"
+            )
+            assert d.get(f"diagnosed_{cls_name}", 0) >= 1, (
+                f"{site}: taxonomy counted {d} — no diagnosed_{cls_name}"
+            )
+            per_class[cls_name] = {
+                "terminal_typed": terminal_typed,
+                "rounds": d.get("repair_rounds", 0),
+                "diagnosed": d.get(f"diagnosed_{cls_name}", 0),
+            }
+        report["per_class"] = per_class
+
+        # Part C — off-switch: repair=0 reproduces the pre-repair failure
+        # path bit for bit (raw engine error + explainer answer, one SQL
+        # generate + one explain call, no repair stage, counters frozen).
+        pipe, sqlgen, expl = build(broken_then_fixed, False, out_dir)
+        before = repair_counters.snapshot()
+        statuses_off: list = []
+        try:
+            res_off = pipe.run(csv_path, "How many rows are there?",
+                               status=lambda s, m: statuses_off.append(m))
+        except Exception:  # noqa: BLE001
+            lost += 1
+            res_off = None
+        d = delta(before)
+        assert res_off is not None and not res_off.ok
+        assert "syntax error" in res_off.error_message.lower()
+        assert res_off.error_solution == EXPLAIN
+        assert len(sqlgen.calls) == 1 and len(expl.calls) == 1, (
+            f"repair-off made {len(sqlgen.calls)} SQL + {len(expl.calls)} "
+            f"explain model calls; pre-repair behavior is exactly 1 + 1"
+        )
+        assert ST_REPAIR not in statuses_off
+        assert d == {}, f"repair-off moved repair counters: {d}"
+        report["repair_off"] = {"identical": True,
+                                "model_calls": len(sqlgen.calls)
+                                + len(expl.calls)}
+
+        # Part D — non-repair traffic: clean requests under repair=on are
+        # token-identical to a repair-off control, zero repair counters.
+        pipe_on, gen_on, _ = build(lambda p: GOOD, True, out_dir)
+        pipe_ctl, gen_ctl, _ = build(lambda p: GOOD, False, out_dir)
+        before = repair_counters.snapshot()
+        try:
+            res_on = pipe_on.run(csv_path, "How many rows are there?")
+            res_ctl = pipe_ctl.run(csv_path, "How many rows are there?")
+        except Exception:  # noqa: BLE001
+            lost += 1
+            res_on = res_ctl = None
+        d = delta(before)
+        assert res_on is not None and res_on.ok and res_ctl.ok
+        assert res_on.sql_query == res_ctl.sql_query == GOOD, (
+            "repair=on perturbed clean traffic's generated tokens"
+        )
+        assert len(gen_on.calls) == len(gen_ctl.calls) == 1
+        assert gen_on.calls == gen_ctl.calls, (
+            "repair=on perturbed the clean request's rendered prompt"
+        )
+        assert d == {}, f"clean traffic moved repair counters: {d}"
+        report["clean"] = {"identical": True}
+
+    report["lost"] = lost
+    _REPAIR_REPORTS[seed] = report
+    return dict(report)
+
+
 def run_chaos(
     spec: Optional[str] = None,
     seed: int = 0,
@@ -1857,6 +2066,15 @@ def run_chaos(
     # the degradation; zero lost; an LSOT_QOS=0 drive reconciles
     # token-for-token (off-switch discipline). Own injection-free scope.
     qos_report = _run_qos_stage(seed)
+    # Stage 10 — self-healing SQL: the real pipeline's
+    # execute→diagnose→repair loop under per-class `sql:*` injection —
+    # broken SQL repaired in bounded rounds, every persistent-fault
+    # request terminating typed within LSOT_REPAIR_MAX_ROUNDS,
+    # LSOT_REPAIR=0 reproducing the pre-repair path bit for bit, and
+    # clean traffic token-identical to a repair-off control. Own
+    # injection scopes per fault class, host-only, outside the snapshot
+    # pair like stages 3-9.
+    repair_report = _run_repair_stage(seed)
     requests = rounds * len(FOUR_QUERY_SUITE)
     hung = requests - sum(outcomes.values())
     hung += scheduler_report["unresolved"]
@@ -1867,6 +2085,7 @@ def run_chaos(
     hung += sum(w["lost"] for w in net_report["waves"].values())
     hung += elastic_report["lost"]
     hung += qos_report["lost"]
+    hung += repair_report["lost"]
     assert hung == 0, f"{hung} request(s) never reached a terminal state"
     # Wall-clock figures are non-deterministic by nature: lifted OUT of
     # the scheduler stage's report so the seeded-replay determinism
@@ -1886,6 +2105,7 @@ def run_chaos(
         "transport": net_report,
         "elastic": elastic_report,
         "qos": qos_report,
+        "repair": repair_report,
         "latency": latency,
         "resilience_delta": {
             k: after.get(k, 0) - before.get(k, 0)

@@ -23,6 +23,14 @@ from typing import Dict, List, Optional, Tuple
 from .fixtures import EvalCase
 
 
+class SpiderLoadError(ValueError):
+    """Typed failure from `load_spider` (ISSUE 20): a missing dataset
+    file, unreadable JSON, a malformed example row or tables.json entry
+    all raise THIS — so an eval leg over operator-supplied Spider paths
+    fails with one catchable, message-bearing error instead of crashing
+    mid-leg with whatever KeyError/JSONDecodeError the input produced."""
+
+
 @dataclasses.dataclass(frozen=True)
 class SpiderCase:
     db_id: str
@@ -118,24 +126,35 @@ SPIDER_SMOKE: List[SpiderCase] = [
 ]
 
 
-def _ddl_from_tables_json(tables: dict) -> Dict[str, str]:
+def _ddl_from_tables_json(tables) -> Dict[str, str]:
     """db_id -> flattened CREATE TABLE DDL from Spider's tables.json entry."""
+    if not isinstance(tables, list):
+        raise SpiderLoadError(
+            f"tables.json must be a JSON array of database entries, "
+            f"got {type(tables).__name__}")
     out = {}
-    for db in tables:
-        stmts = []
-        names = db["table_names_original"]
-        cols_by_table: Dict[int, List[Tuple[str, str]]] = {}
-        for (t_idx, col), ctype in zip(
-            db["column_names_original"], db["column_types"]
-        ):
-            if t_idx >= 0:
-                cols_by_table.setdefault(t_idx, []).append((col, ctype))
-        for t_idx, tname in enumerate(names):
-            cols = ", ".join(
-                f"{c} {t}" for c, t in cols_by_table.get(t_idx, [])
-            )
-            stmts.append(f"CREATE TABLE {tname} ({cols});")
-        out[db["db_id"]] = " ".join(stmts)
+    for i, db in enumerate(tables):
+        try:
+            db_id = db["db_id"]
+            stmts = []
+            names = db["table_names_original"]
+            cols_by_table: Dict[int, List[Tuple[str, str]]] = {}
+            for (t_idx, col), ctype in zip(
+                db["column_names_original"], db["column_types"]
+            ):
+                if t_idx >= 0:
+                    cols_by_table.setdefault(t_idx, []).append((col, ctype))
+            for t_idx, tname in enumerate(names):
+                cols = ", ".join(
+                    f"{c} {t}" for c, t in cols_by_table.get(t_idx, [])
+                )
+                stmts.append(f"CREATE TABLE {tname} ({cols});")
+        except (KeyError, TypeError, ValueError) as e:
+            raise SpiderLoadError(
+                f"malformed tables.json entry #{i}"
+                f"{' (db_id ' + repr(db.get('db_id')) + ')' if isinstance(db, dict) else ''}"
+                f": {e!r}") from e
+        out[db_id] = " ".join(stmts)
     return out
 
 
@@ -147,23 +166,63 @@ def load_spider(
 
     `tables_json` defaults to `tables.json` next to the data file; without
     it, cases carry an empty schema (prompt-side schema then must come from
-    elsewhere)."""
+    elsewhere).
+
+    Every failure mode — missing file, unreadable JSON, a row without
+    question/query/db_id, a malformed tables.json entry — raises the
+    typed `SpiderLoadError` with the offending path/row named, so a
+    harness leg iterating operator paths degrades that one leg instead
+    of crashing mid-run (ISSUE 20)."""
     data_json = Path(data_json)
-    rows = json.loads(data_json.read_text())
+    try:
+        text = data_json.read_text()
+    except OSError as e:
+        raise SpiderLoadError(f"cannot read Spider data {data_json}: {e}") \
+            from e
+    try:
+        rows = json.loads(text)
+    except ValueError as e:
+        raise SpiderLoadError(
+            f"Spider data {data_json} is not valid JSON: {e}") from e
+    if not isinstance(rows, list):
+        raise SpiderLoadError(
+            f"Spider data {data_json} must be a JSON array of examples, "
+            f"got {type(rows).__name__}")
+    if not rows:
+        # An empty example list would hand a leg zero cases — its
+        # rates would all be 0/0. Fail typed at the load boundary
+        # where the operator can see WHICH file was empty.
+        raise SpiderLoadError(f"Spider data {data_json} holds no examples")
     if tables_json is None:
         cand = data_json.parent / "tables.json"
         tables_json = cand if cand.exists() else None
-    ddl = (
-        _ddl_from_tables_json(json.loads(Path(tables_json).read_text()))
-        if tables_json else {}
-    )
-    cases = [
-        SpiderCase(
-            db_id=r["db_id"],
-            schema_ddl=ddl.get(r["db_id"], ""),
-            nl=r["question"],
-            expected_sql=r["query"],
-        )
-        for r in rows
-    ]
+    if tables_json:
+        tables_path = Path(tables_json)
+        try:
+            tables_text = tables_path.read_text()
+        except OSError as e:
+            raise SpiderLoadError(
+                f"cannot read Spider schemas {tables_path}: {e}") from e
+        try:
+            tables = json.loads(tables_text)
+        except ValueError as e:
+            raise SpiderLoadError(
+                f"Spider schemas {tables_path} is not valid JSON: {e}") \
+                from e
+        ddl = _ddl_from_tables_json(tables)
+    else:
+        ddl = {}
+    cases = []
+    for i, r in enumerate(rows):
+        try:
+            cases.append(SpiderCase(
+                db_id=r["db_id"],
+                schema_ddl=ddl.get(r["db_id"], ""),
+                nl=r["question"],
+                expected_sql=r["query"],
+            ))
+        except (KeyError, TypeError) as e:
+            raise SpiderLoadError(
+                f"malformed Spider example #{i} in {data_json} "
+                f"(need question/query/db_id): {e!r}") from e
     return cases[:limit] if limit else cases

@@ -125,6 +125,23 @@ def parse_tenant_weights(spec: str) -> Dict[str, float]:
     return out
 
 
+def parse_tenant_models(spec: str) -> Dict[str, str]:
+    """`LSOT_TENANT_MODELS` ("tenantA=duckdb-nsql,tenantB=llama3.2") →
+    tenant → model_id routing map atop the multi-model pool (ISSUE 20:
+    what lets a tenant pin its SQL/repair/explainer model). Unknown
+    tenants fall through to the request's own model; malformed entries
+    are ignored (a bad knob must not take down serving)."""
+    out: Dict[str, str] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part or "=" not in part:
+            continue
+        name, _, val = part.partition("=")
+        if name.strip() and val.strip():
+            out[name.strip()] = val.strip()
+    return out
+
+
 def _parse_budget_spec(spec: str) -> Tuple[float, Dict[str, float]]:
     """"2,interactive=4,batch=1" → (2.0, {"interactive": 4.0, ...}).
     The bare number is the default for every class; `class=value`

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import threading
 import time
 from typing import Dict, Optional
@@ -26,6 +27,8 @@ from ..utils.observability import (
 )
 from ..utils.tracing import TRACER
 from .templates import TEMPLATES, Template
+
+log = logging.getLogger("lsot.service")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +71,49 @@ class GenerationService:
         # Drain mode (SIGTERM path): once set, the HTTP layers answer new
         # work with 503 + Retry-After while in-flight requests finish.
         self._draining = False
+        # Per-tenant model routing (ISSUE 20, LSOT_TENANT_MODELS): tenant
+        # → model_id atop the multi-model pool. Resolved at every
+        # generate front door; unknown tenants (and tenants pinned to a
+        # model that never registered) fall through to the request's own
+        # model, warned once per tenant.
+        import os
+
+        from .qos import parse_tenant_models
+
+        self._tenant_models: Dict[str, str] = parse_tenant_models(
+            os.environ.get("LSOT_TENANT_MODELS", ""))
+        self._tenant_model_warned: set = set()
+
+    def set_tenant_models(self, spec: str) -> None:
+        """Install a tenant → model_id routing map from its spec string
+        (config wiring; replaces the env-derived map wholesale)."""
+        from .qos import parse_tenant_models
+
+        with self._lock:
+            self._tenant_models = parse_tenant_models(spec)
+            self._tenant_model_warned = set()
+
+    def resolve_model(self, model: str, tenant: str) -> str:
+        """Apply per-tenant model routing: a listed tenant's requests ride
+        its pinned model_id; everything else — no tenant, unlisted
+        tenant, pinned model not (yet) registered — falls through to the
+        request's own `model` untouched."""
+        if not tenant:
+            return model
+        with self._lock:
+            pinned = self._tenant_models.get(tenant)
+            if pinned is None:
+                return model
+            if pinned not in self._models:
+                if tenant not in self._tenant_model_warned:
+                    self._tenant_model_warned.add(tenant)
+                    log.warning(
+                        "tenant %r pins model %r which is not registered "
+                        "(available: %s); falling through to %r",
+                        tenant, pinned, sorted(self._models), model,
+                    )
+                return model
+        return pinned
 
     def register(self, name: str, backend, template: str = "completion") -> None:
         if template not in TEMPLATES:
@@ -148,6 +194,16 @@ class GenerationService:
         qos_block = ADMISSION.snapshot()
         if qos_block:
             snap["qos"] = qos_block
+        # Self-healing SQL (ISSUE 20) under the reserved "repair" key:
+        # repair_rounds/repaired/unrepairable + per-class diagnosed
+        # counters and the last few repair flight rows — the
+        # lsot_repair_* Prometheus families. Empty (key absent) until a
+        # repair loop has actually run.
+        from ..app.repair import repair_metrics_block
+
+        repair_block = repair_metrics_block()
+        if repair_block:
+            snap["repair"] = repair_block
         return snap
 
     def metrics_prometheus(self) -> str:
@@ -531,6 +587,7 @@ class GenerationService:
         tenant: str = "",
         qos: str = "",
     ) -> GenerateResult:
+        model = self.resolve_model(model, tenant)
         entry = self._entry(model)
         deadline_s = self._admit_qos(tenant, qos, deadline_s)
         rendered = entry.template(system, prompt)
@@ -645,6 +702,7 @@ class GenerationService:
         admission (ISSUE 18) runs on the generator's FIRST step — the
         HTTP layer primes the stream before sending headers, so a shed
         still answers a real 429."""
+        model = self.resolve_model(model, tenant)
         entry = self._entry(model)
         deadline_s = self._admit_qos(tenant, qos, deadline_s)
         ckw = self._constrain_kwargs(entry, constrain)
@@ -755,6 +813,7 @@ class GenerationService:
         request's latency when submitted together); tok/s aggregates across
         the batch in the metrics registry.
         """
+        model = self.resolve_model(model, tenant)
         entry = self._entry(model)
         # One admission token per batch MEMBER: a storm tenant cannot
         # dodge its budget by folding the storm into one batch call.

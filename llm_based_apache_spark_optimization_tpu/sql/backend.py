@@ -131,6 +131,14 @@ class ResilientSQLBackend:
             # caller-side deadlines see real elapsed time.
             FAULTS.check("sql:stall")
             FAULTS.check("sql:exec")
+            # Per-class SQL error sites (ISSUE 20): each raises a
+            # REPRESENTATIVE engine error for one branch of the repair
+            # taxonomy — syntax/schema are deterministic engine answers
+            # (no retry, breaker records success), transient is
+            # lock-contention-shaped (retried, breaker-counted).
+            FAULTS.check("sql:syntax")
+            FAULTS.check("sql:schema")
+            FAULTS.check("sql:transient")
             return self.inner.execute(sql)
 
         # The span covers the whole retry ladder (what the REQUEST paid),

@@ -66,6 +66,18 @@ lease-expiry → targeted-restart → journal-replay recovery path
 reads `FAULTS.site_active(site)`, which never draws — concurrent
 stream deliveries must not perturb the seeded schedule.
 
+**Per-class SQL error sites** (ISSUE 20): `sql:syntax`, `sql:schema`
+and `sql:transient` fire inside `ResilientSQLBackend.execute` and raise
+a REPRESENTATIVE engine error instead of the generic `InjectedFault` —
+the exact strings a real sqlite engine produces for each class of the
+repair taxonomy (app/repair.classify_sql_error), so chaos stage 10 and
+the unit tests can exercise every taxonomy branch deterministically.
+`sql:syntax`/`sql:schema` raise `InjectedSQLError` (a plain Exception:
+deterministic engine answers, NEVER retried or breaker-counted);
+`sql:transient` raises `InjectedFault` (a ConnectionError: the retry
+ladder and breaker treat it like the lock-contention outage it
+simulates). `SQL_FAULT_ERRORS` below is the site → message table.
+
 **Fleet-membership site** (ISSUE 17): `fleet:spawn:p` fires inside the
 autoscaler's scale-up attempt (serve/elastic.py) BEFORE the standby
 worker is contacted — an injected spawn failure must degrade to "keep
@@ -96,7 +108,8 @@ from typing import Dict, Tuple
 
 from .observability import resilience
 
-__all__ = ["FAULTS", "FaultRegistry", "InjectedFault"]
+__all__ = ["FAULTS", "FaultRegistry", "InjectedFault", "InjectedSQLError",
+           "SQL_FAULT_ERRORS"]
 
 
 class InjectedFault(ConnectionError):
@@ -104,9 +117,33 @@ class InjectedFault(ConnectionError):
     retry layers' connect-phase classifiers (and generic OSError handlers)
     treat it like the real outage it simulates."""
 
-    def __init__(self, site: str):
-        super().__init__(f"injected fault at {site!r} (LSOT_FAULTS)")
+    def __init__(self, site: str, message: str = ""):
+        super().__init__(message or f"injected fault at {site!r} (LSOT_FAULTS)")
         self.site = site
+
+
+class InjectedSQLError(Exception):
+    """A deliberately injected DETERMINISTIC engine error (ISSUE 20):
+    the message is a representative real-engine string for one class of
+    the repair taxonomy. A plain Exception on purpose — retry ladders
+    and breakers must treat it exactly like the syntax/schema error it
+    simulates (no retry, no breaker count), so the only layer that acts
+    on it is the repair loop's classifier."""
+
+    def __init__(self, site: str, message: str):
+        super().__init__(message)
+        self.site = site
+
+
+#: Per-class SQL fault sites (ISSUE 20): site → (exception class,
+#: representative engine error string). The messages are the shapes
+#: app/repair.classify_sql_error keys on, so configuring
+#: `sql:syntax:1` drives the exact taxonomy branch a real engine would.
+SQL_FAULT_ERRORS = {
+    "sql:syntax": (InjectedSQLError, 'near "FORM": syntax error'),
+    "sql:schema": (InjectedSQLError, "no such column: total_amout"),
+    "sql:transient": (InjectedFault, "database is locked"),
+}
 
 
 class FaultRegistry:
@@ -226,6 +263,10 @@ class FaultRegistry:
             # Outside the lock: a wedge must not block other sites' checks.
             self._sleep(secs)
             return
+        sql_err = SQL_FAULT_ERRORS.get(site)
+        if sql_err is not None:
+            exc_cls, message = sql_err
+            raise exc_cls(site, message)
         raise InjectedFault(site)
 
     def fires(self, site: str) -> bool:

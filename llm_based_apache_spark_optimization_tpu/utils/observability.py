@@ -394,6 +394,15 @@ class CounterSet:
 #: /metrics payload by GenerationService.metrics_snapshot.
 resilience = CounterSet()
 
+#: Process-wide self-healing-SQL counters (app/repair.py writes them:
+#: repair_rounds, repaired, unrepairable, breaker_skips, deadline_stops,
+#: plus one diagnosed_<class> counter per taxonomy class — a FIXED
+#: five-entry vocabulary, so cardinality is bounded by construction) —
+#: merged into the /metrics payload under the reserved "repair" key by
+#: GenerationService.metrics_snapshot and rendered as the lsot_repair_*
+#: Prometheus families.
+repair = CounterSet()
+
 
 @contextlib.contextmanager
 def trace_capture(name: str = "lsot") -> Iterator[None]:

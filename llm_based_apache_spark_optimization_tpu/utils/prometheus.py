@@ -416,6 +416,31 @@ def _emit_qos_admission(emit: _Emitter, qos: Dict) -> None:
                       "qos": cls if sep else ""}, n)
 
 
+def _emit_repair(emit: _Emitter, rep: Dict) -> None:
+    """The self-healing-SQL lsot_repair_* families (ISSUE 20). Label
+    cardinality is bounded by construction: the only labeled family is
+    lsot_repair_errors_total{class=...}, whose classes come from the
+    fixed five-value taxonomy (app/repair.REPAIR_CLASSES); the "recent"
+    flight rows are /metrics JSON only and never become series."""
+    for key, name in (
+            ("repair_rounds", "lsot_repair_rounds_total"),
+            ("repaired", "lsot_repair_repaired_total"),
+            ("unrepairable", "lsot_repair_unrepairable_total"),
+            ("breaker_skips", "lsot_repair_breaker_skips_total"),
+            ("deadline_stops", "lsot_repair_deadline_stops_total"),
+    ):
+        n = _num(rep.get(key))
+        if n is not None:
+            emit.add(name, {}, n, "counter")
+    for key, v in rep.items():
+        if not key.startswith("diagnosed_"):
+            continue
+        n = _num(v)
+        if n is not None:
+            emit.add("lsot_repair_errors_total",
+                     {"class": key[len("diagnosed_"):]}, n, "counter")
+
+
 def _emit_qos_sched(emit: _Emitter, model: str, qv: Dict) -> None:
     """Scheduler-side WFQ view (ISSUE 18): per-replica virtual time and
     ready/page-wait depths, plus per-tenant submitted/preempted/
@@ -469,7 +494,7 @@ def render_prometheus(snapshot: Dict,
     emit = _Emitter()
     resilience = snapshot.get("resilience") or {}
     for model, agg in snapshot.items():
-        if model in ("resilience", "slo", "qos") \
+        if model in ("resilience", "slo", "qos", "repair") \
                 or not isinstance(agg, dict):
             continue
         for key, (suffix, mtype) in _MODEL_KEYS.items():
@@ -551,6 +576,9 @@ def render_prometheus(snapshot: Dict,
     qos = snapshot.get("qos")
     if isinstance(qos, dict):
         _emit_qos_admission(emit, qos)
+    rep = snapshot.get("repair")
+    if isinstance(rep, dict):
+        _emit_repair(emit, rep)
     if histograms is not None:
         for name, series in sorted(histograms.snapshot().items()):
             name = _NAME_OK.sub("_", name)

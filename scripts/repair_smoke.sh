@@ -1,0 +1,147 @@
+#!/usr/bin/env bash
+# Self-healing SQL smoke: the ISSUE-20 execute→diagnose→repair loop end
+# to end on a real booted app.
+#
+# Boots the headless API (scripted SQL model: broken SQL one-shot, the
+# corrected query on repair prompts) and drives /process-data/ over real
+# HTTP, asserting the self-healing contract:
+#
+#   1. a request whose generated SQL fails execution comes back
+#      "Query executed successfully!" with the REPAIRED query — the
+#      failure was diagnosed, fed back through the model with the error
+#      text + original question, and re-executed, all inside one
+#      request;
+#   2. with the repair path disabled for one request's worth of traffic
+#      the same broken SQL surfaces the reference failure shape
+#      ({"error": "SQL execution failed", sql_query, error_details}) —
+#      the off-switch is the pre-repair path, not a different error;
+#   3. repair-round attribution surfaces in /metrics (JSON `repair`
+#      block: rounds charged, repaired count, per-class diagnosis
+#      counters) and as lsot_repair_* Prometheus families
+#      (lsot_repair_rounds_total, lsot_repair_repaired_total,
+#      lsot_repair_errors_total{class=...}).
+#
+# The default test lane runs the same flow in-process
+# (tests/test_repair_smoke.py::test_http_broken_sql_comes_back_repaired,
+# not marked slow); this script is the focused real-HTTP lane, beside
+# qos_smoke.sh / chaos_smoke.sh / obs_smoke.sh / multimodel_smoke.sh.
+#
+#   scripts/repair_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export LSOT_REPAIR="${LSOT_REPAIR:-1}"
+export LSOT_REPAIR_MAX_ROUNDS="${LSOT_REPAIR_MAX_ROUNDS:-2}"
+# Smoke runs measure rounds, not wall clock.
+export LSOT_REPAIR_BACKOFF_S=0
+
+python - <<'EOF'
+import json
+import tempfile
+import urllib.request
+from pathlib import Path
+
+from llm_based_apache_spark_optimization_tpu.app.api import create_api_app
+from llm_based_apache_spark_optimization_tpu.app.config import AppConfig
+from llm_based_apache_spark_optimization_tpu.evalh.fixtures import (
+    write_taxi_fixture_csv,
+)
+from llm_based_apache_spark_optimization_tpu.serve.backends import FakeBackend
+from llm_based_apache_spark_optimization_tpu.serve.service import (
+    GenerationService,
+)
+from llm_based_apache_spark_optimization_tpu.sql.sqlite_backend import (
+    SQLiteBackend,
+)
+
+BROKEN = "SELEC * FORM temp_view"
+GOOD = "SELECT COUNT(*) FROM temp_view"
+# build_repair_prompt's fixed phrasing — how the scripted model tells a
+# repair round apart from the one-shot ask.
+REPAIR_MARKER = "failed with this error"
+
+tmp = Path(tempfile.mkdtemp(prefix="repair_smoke_"))
+(tmp / "in").mkdir()
+(tmp / "out").mkdir()
+write_taxi_fixture_csv(str(tmp / "in" / "taxi.csv"))
+
+service = GenerationService()
+service.register("duckdb-nsql", FakeBackend(
+    lambda p: GOOD if REPAIR_MARKER in p else BROKEN))
+service.register("llama3.2", FakeBackend(
+    lambda p: "Check that the referenced columns exist in the schema."))
+cfg = AppConfig.from_env(input_dir=str(tmp / "in"),
+                         output_dir=str(tmp / "out"),
+                         history_db=":memory:", port=0)
+app = create_api_app(service, SQLiteBackend, None, cfg)
+server = app.serve(cfg.host, 0, background=True)
+url = f"http://{cfg.host}:{server.server_address[1]}"
+print(f"repair_smoke: app up at {url} "
+      f"(repair={cfg.repair}, max_rounds={cfg.repair_max_rounds})")
+
+
+def post(path, body, tenant=""):
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-Lsot-Tenant"] = tenant
+    req = urllib.request.Request(url + path, json.dumps(body).encode(),
+                                 headers)
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return r.status, json.loads(r.read())
+
+
+# 1. broken one-shot SQL comes back REPAIRED inside the request.
+for i in range(2):
+    status, body = post("/process-data/",
+                        {"input_text": "How many rows are there?",
+                         "file_name": "taxi.csv"},
+                        tenant="acme")
+    assert status == 200, (status, body)
+    assert body.get("message") == "Query executed successfully!", body
+    assert body["sql_query"] == GOOD, body
+print("repair_smoke: step 1 OK (2x broken one-shot -> repaired, "
+      f"final sql={GOOD!r})")
+
+# 2. off-switch sanity on the same app shape: a fresh app with
+#    LSOT_REPAIR-style repair=False must surface the reference failure
+#    contract for the identical traffic.
+cfg_off = AppConfig.from_env(input_dir=str(tmp / "in"),
+                             output_dir=str(tmp / "out"),
+                             history_db=":memory:", port=0, repair=False)
+app_off = create_api_app(service, SQLiteBackend, None, cfg_off)
+server_off = app_off.serve(cfg_off.host, 0, background=True)
+url_off = f"http://{cfg_off.host}:{server_off.server_address[1]}"
+req = urllib.request.Request(
+    url_off + "/process-data/",
+    json.dumps({"input_text": "How many rows are there?",
+                "file_name": "taxi.csv"}).encode(),
+    {"Content-Type": "application/json"})
+with urllib.request.urlopen(req, timeout=120) as r:
+    body_off = json.loads(r.read())
+assert body_off.get("error") == "SQL execution failed", body_off
+assert body_off["sql_query"] == BROKEN, body_off
+assert body_off["error_details"], body_off
+print("repair_smoke: step 2 OK (repair=off -> reference failure shape, "
+      "sql stays broken, explainer answered)")
+
+# 3. attribution: JSON repair block + lsot_repair_* families.
+with urllib.request.urlopen(url + "/metrics", timeout=60) as r:
+    snap = json.loads(r.read())
+rep = snap.get("repair")
+assert rep, f"no repair block in /metrics: {sorted(snap)}"
+assert rep["repaired"] >= 2, rep
+assert rep["repair_rounds"] >= 2, rep
+
+with urllib.request.urlopen(url + "/metrics?format=prometheus",
+                            timeout=60) as r:
+    text = r.read().decode()
+for needle in (
+    "lsot_repair_rounds_total ",
+    "lsot_repair_repaired_total ",
+):
+    assert needle in text, f"missing from exposition: {needle}"
+print("repair_smoke: step 3 OK (repair counters in /metrics JSON + "
+      "lsot_repair_* Prometheus families)")
+print("repair_smoke: PASS")
+EOF

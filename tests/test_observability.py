@@ -87,10 +87,12 @@ def test_metrics_endpoint():
     res = client.request("GET", "/metrics")
     assert res.status == 200
     body = json.loads(res.body)
-    # The reserved "resilience" key carries PROCESS-GLOBAL fault-tolerance
-    # counters (serve/resilience.py) — other tests in the same process may
+    # The reserved "resilience"/"qos"/"repair" keys carry PROCESS-GLOBAL
+    # counters (serve/resilience.py, serve/qos.ADMISSION,
+    # utils/observability.repair) — other tests in the same process may
     # legitimately have moved them; per-model metrics must still be empty.
-    body.pop("resilience", None)
+    for reserved in ("resilience", "qos", "repair"):
+        body.pop(reserved, None)
     assert body == {}
     svc.generate("duckdb-nsql", "q")
     res = client.request("GET", "/metrics")

@@ -1,0 +1,92 @@
+"""In-process twin of scripts/repair_smoke.sh: the self-healing SQL loop
+end to end through the headless API — broken one-shot SQL comes back
+repaired inside the request, the off-switch reproduces the reference
+failure shape, and repair attribution surfaces in /metrics + Prometheus.
+"""
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.app import repair as repair_mod
+from llm_based_apache_spark_optimization_tpu.serve.flightrecorder import (
+    FlightRecorder,
+)
+from llm_based_apache_spark_optimization_tpu.utils.observability import (
+    CounterSet,
+)
+
+BROKEN = "SELEC * FORM temp_view"
+GOOD = "SELECT COUNT(*) FROM temp_view"
+MARKER = "failed with this error"  # build_repair_prompt's fixed phrasing
+
+
+@pytest.fixture()
+def counters(monkeypatch):
+    fresh = CounterSet()
+    monkeypatch.setattr(repair_mod, "repair_counters", fresh)
+    monkeypatch.setattr(repair_mod, "REPAIR_FLIGHT",
+                        FlightRecorder(replica="repair"))
+    return fresh
+
+
+def _client(tmp_path, **cfg_overrides):
+    from llm_based_apache_spark_optimization_tpu.app import (
+        AppConfig,
+        create_api_app,
+    )
+    from llm_based_apache_spark_optimization_tpu.evalh.fixtures import (
+        write_taxi_fixture_csv,
+    )
+    from llm_based_apache_spark_optimization_tpu.serve import (
+        FakeBackend,
+        GenerationService,
+    )
+    from llm_based_apache_spark_optimization_tpu.sql import SQLiteBackend
+
+    cfg = AppConfig(input_dir=str(tmp_path / "input"),
+                    output_dir=str(tmp_path / "output"),
+                    history_db=":memory:", repair_backoff_s=0.0,
+                    **cfg_overrides)
+    svc = GenerationService()
+    svc.register("duckdb-nsql", FakeBackend(
+        lambda p: GOOD if MARKER in p else BROKEN))
+    svc.register("llama3.2", FakeBackend(lambda p: "Check the schema."))
+    app = create_api_app(svc, SQLiteBackend, None, cfg)
+    write_taxi_fixture_csv(str(tmp_path / "input" / "taxi.csv"))
+    return app.test_client()
+
+
+def test_http_broken_sql_comes_back_repaired(tmp_path, counters):
+    client = _client(tmp_path)
+    for _ in range(2):
+        res = client.post_json(
+            "/process-data/",
+            {"input_text": "How many rows are there?",
+             "file_name": "taxi.csv"},
+            headers={"X-Lsot-Tenant": "acme"})
+        assert res.status == 200
+        body = res.json()
+        assert body["message"] == "Query executed successfully!"
+        assert body["sql_query"] == GOOD
+        assert body["output_file"]
+
+    snap = client.get("/metrics").json()
+    assert snap["repair"]["repaired"] == 2
+    assert snap["repair"]["repair_rounds"] == 2
+    text = client.get("/metrics", query="format=prometheus").text
+    assert "lsot_repair_repaired_total 2" in text
+    assert "lsot_repair_rounds_total 2" in text
+
+
+def test_http_repair_off_reproduces_reference_failure_shape(tmp_path,
+                                                            counters):
+    client = _client(tmp_path, repair=False)
+    res = client.post_json(
+        "/process-data/",
+        {"input_text": "How many rows are there?", "file_name": "taxi.csv"})
+    assert res.status == 200  # §2.2: pipeline failures are 200 + error body
+    body = res.json()
+    assert body["error"] == "SQL execution failed"
+    assert body["sql_query"] == BROKEN
+    assert body["error_details"] == "Check the schema."
+    assert counters.snapshot() == {}  # zero repair-counter movement
+    assert "repair" not in client.get("/metrics").json()

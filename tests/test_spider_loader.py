@@ -1,0 +1,121 @@
+"""`evalh/spider.load_spider` failure paths (ISSUE 20 satellite): every
+malformed-input mode raises the typed SpiderLoadError naming the
+offending file/row — never a raw KeyError/JSONDecodeError mid-leg.
+"""
+
+import json
+
+import pytest
+
+from llm_based_apache_spark_optimization_tpu.evalh.spider import (
+    SPIDER_SMOKE,
+    SpiderLoadError,
+    load_spider,
+)
+
+ROW = {"db_id": "concert_singer", "question": "How many singers?",
+       "query": "SELECT COUNT(*) FROM singer;"}
+
+TABLES = [{
+    "db_id": "concert_singer",
+    "table_names_original": ["singer"],
+    "column_names_original": [[-1, "*"], [0, "singer_id"], [0, "name"]],
+    "column_types": ["text", "int", "text"],
+}]
+
+
+def test_missing_file_is_typed(tmp_path):
+    with pytest.raises(SpiderLoadError, match="cannot read Spider data"):
+        load_spider(tmp_path / "nope.json")
+
+
+def test_invalid_json_is_typed(tmp_path):
+    p = tmp_path / "dev.json"
+    p.write_text("{not json")
+    with pytest.raises(SpiderLoadError, match="not valid JSON"):
+        load_spider(p)
+
+
+def test_non_list_payload_is_typed(tmp_path):
+    p = tmp_path / "dev.json"
+    p.write_text(json.dumps({"examples": []}))
+    with pytest.raises(SpiderLoadError, match="must be a JSON array"):
+        load_spider(p)
+
+
+def test_empty_example_list_is_typed(tmp_path):
+    p = tmp_path / "dev.json"
+    p.write_text("[]")
+    with pytest.raises(SpiderLoadError, match="holds no examples"):
+        load_spider(p)
+
+
+def test_malformed_row_names_its_index(tmp_path):
+    p = tmp_path / "dev.json"
+    p.write_text(json.dumps([ROW, {"question": "no query or db_id"}]))
+    with pytest.raises(SpiderLoadError, match="example #1"):
+        load_spider(p)
+
+
+def test_malformed_tables_json_is_typed(tmp_path):
+    data = tmp_path / "dev.json"
+    data.write_text(json.dumps([ROW]))
+    tables = tmp_path / "tables.json"
+
+    tables.write_text(json.dumps({"not": "a list"}))
+    with pytest.raises(SpiderLoadError, match="must be a JSON array"):
+        load_spider(data, tables)
+
+    tables.write_text(json.dumps([{"db_id": "x"}]))  # missing column keys
+    with pytest.raises(SpiderLoadError, match="tables.json entry #0"):
+        load_spider(data, tables)
+
+    tables.write_text("{broken")
+    with pytest.raises(SpiderLoadError, match="not valid JSON"):
+        load_spider(data, tables)
+
+
+def test_unreadable_tables_json_is_typed(tmp_path):
+    data = tmp_path / "dev.json"
+    data.write_text(json.dumps([ROW]))
+    with pytest.raises(SpiderLoadError, match="cannot read Spider schemas"):
+        load_spider(data, tmp_path / "no-tables.json")
+
+
+def test_spider_load_error_is_catchable_as_valueerror(tmp_path):
+    """Harness call sites that predate the typed error still catch it."""
+    with pytest.raises(ValueError):
+        load_spider(tmp_path / "nope.json")
+
+
+def test_good_dataset_loads_with_schemas(tmp_path):
+    data = tmp_path / "dev.json"
+    data.write_text(json.dumps([ROW, dict(ROW, question="Names?")]))
+    # tables.json is discovered next to the data file by default.
+    (tmp_path / "tables.json").write_text(json.dumps(TABLES))
+    cases = load_spider(data)
+    assert len(cases) == 2
+    assert cases[0].nl == "How many singers?"
+    assert "CREATE TABLE singer (singer_id int, name text);" \
+        in cases[0].schema_ddl
+    assert load_spider(data, limit=1) == cases[:1]
+
+
+def test_missing_tables_json_means_empty_schema(tmp_path):
+    data = tmp_path / "dev.json"
+    data.write_text(json.dumps([ROW]))
+    cases = load_spider(data)  # no tables.json anywhere nearby
+    assert cases[0].schema_ddl == ""
+
+
+def test_smoke_suite_ddl_instantiates():
+    """Every embedded case's DDL must actually build its database — the
+    repair leg's backend_for_ddl depends on it."""
+    from llm_based_apache_spark_optimization_tpu.evalh.repair import (
+        backend_for_ddl,
+    )
+
+    for case in SPIDER_SMOKE:
+        b = backend_for_ddl(case.schema_ddl)
+        b.execute(case.expected_sql)  # expected SQL is executable too
+        b.close()
